@@ -1,0 +1,28 @@
+"""raft_tpu — a TPU-native distributed-consensus framework.
+
+A brand-new implementation of the capabilities of the reference
+(eastwd/raft-sample: a 3-node, goroutine+channel Raft demo — leader election +
+log replication, /root/reference/main.go), re-designed TPU-first:
+
+- The hot path (AppendEntries replication, ack/vote aggregation, quorum
+  commit) is a batched, statically-shaped XLA program over a ``replica`` mesh
+  axis (``shard_map``), replacing the reference's serial per-peer channel
+  sends + blocking replies (main.go:332-395) with collectives that correlate
+  requests and replies by construction.
+- The cold path (role transitions, election timers, client I/O) is a
+  single-threaded host event loop (``raft.engine``), replacing the
+  reference's goroutine-per-node trampoline (main.go:98-109).
+- Log-entry batches can be Reed–Solomon erasure-coded over GF(2^8)
+  (``ec``) so each follower stores a shard instead of a full copy, with
+  all_gather + decode reconstruction on the read path.
+
+See SURVEY.md for the full structural analysis of the reference and
+BASELINE.md for the target numbers.
+"""
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import ReplicaState, init_state
+
+__all__ = ["RaftConfig", "ReplicaState", "init_state"]
+
+__version__ = "0.1.0"

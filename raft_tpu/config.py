@@ -1,0 +1,83 @@
+"""Cluster / protocol configuration.
+
+The reference has no config system at all — cluster size (main.go:81), every
+timeout (main.go:89,114,194,394) and channel depth (main.go:68-72) are
+hardcoded (SURVEY.md §5). Here they are a single frozen dataclass covering the
+five BASELINE.json benchmark configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftConfig:
+    """All knobs for a raft_tpu cluster.
+
+    Timing defaults mirror the reference's hardcoded constants (in seconds):
+    follower election timeout uniform 10-30 s (main.go:114), candidate
+    re-election timeout uniform 10-13 s (main.go:194), leader tick 2 s
+    (main.go:394), client injection 10 s (main.go:89). The host engine runs
+    them against a virtual clock in tests, so the absolute values only matter
+    for live runs.
+    """
+
+    # --- cluster shape ---
+    n_replicas: int = 3                 # reference: 3, hardcoded (main.go:81)
+    entry_bytes: int = 256              # north-star entry payload size
+    batch_size: int = 1024              # entries per replication step (config 2)
+    log_capacity: int = 1 << 15         # fixed device ring-buffer capacity
+
+    # --- erasure coding (config 3); k = data shards, m = parity shards ---
+    # None disables EC: every replica stores the full payload, like the
+    # reference's full-copy replication (main.go:344-371).
+    rs_k: Optional[int] = None
+    rs_m: Optional[int] = None
+
+    # --- timing (seconds; reference values noted above) ---
+    follower_timeout: Tuple[float, float] = (10.0, 30.0)
+    candidate_timeout: Tuple[float, float] = (10.0, 13.0)
+    heartbeat_period: float = 2.0
+    client_period: float = 10.0
+
+    # --- loopback-transport fidelity (golden model only) ---
+    channel_depth: int = 10             # reference channel buffer (main.go:68-72)
+
+    # --- determinism ---
+    seed: int = 0
+
+    # --- transport selection: the plugin boundary named by the north star ---
+    # "tpu_mesh": one replica row per device over a Mesh axis (falls back to
+    #   "single" when fewer chips than replicas are available);
+    # "single": all replica rows resident on one device.
+    # The host-side golden model (reference semantics, for differential
+    # tests) is not a device transport — see raft_tpu.golden.
+    transport: str = "tpu_mesh"
+
+    def __post_init__(self):
+        if self.n_replicas < 1 or self.n_replicas % 2 == 0:
+            raise ValueError("n_replicas must be odd and >= 1")
+        if self.batch_size < 1 or self.batch_size > self.log_capacity:
+            raise ValueError("batch_size must be in [1, log_capacity]")
+        if (self.rs_k is None) != (self.rs_m is None):
+            raise ValueError("rs_k and rs_m must be set together")
+        if self.rs_k is not None:
+            if self.rs_k + self.rs_m != self.n_replicas:
+                raise ValueError("RS(n,k): k+m must equal n_replicas")
+            if self.entry_bytes % self.rs_k != 0:
+                raise ValueError("entry_bytes must be divisible by rs_k")
+
+    @property
+    def majority(self) -> int:
+        return self.n_replicas // 2 + 1
+
+    @property
+    def ec_enabled(self) -> bool:
+        return self.rs_k is not None
+
+    @property
+    def shard_bytes(self) -> int:
+        """Per-replica stored bytes per entry (full copy when EC is off)."""
+        return self.entry_bytes // self.rs_k if self.ec_enabled else self.entry_bytes

@@ -1,0 +1,3 @@
+from raft_tpu.core.state import ReplicaState, init_state
+
+__all__ = ["ReplicaState", "init_state"]

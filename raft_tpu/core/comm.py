@@ -1,0 +1,81 @@
+"""Replica-axis communication primitives.
+
+The protocol kernels in ``core.step`` are written once against this tiny
+interface and run in two placements:
+
+- ``SingleDeviceComm`` — the whole replica-major state lives on one device
+  (the replica axis is an ordinary batch axis); "collectives" are plain
+  reductions/indexing. This is how the benchmark runs on a single TPU chip,
+  and how ``vmap``-style CI tests run.
+- ``MeshComm`` — the state is sharded one replica row per device over a
+  ``jax.sharding.Mesh`` axis (ICI), and the same operations lower to XLA
+  collectives (``all_gather``) inside ``shard_map``.
+
+This is the TPU-native answer to the reference's transport layer: there, a
+"send" is a raw write into a peer's Go channel and a "reply" is a blocking
+read on the sender's own channel with no correlation id (main.go:344, 373,
+131 — SURVEY.md §2 "transport semantics"). Collectives correlate request and
+response by construction, so the reference's misattribution hazard (its
+main.go:242 bug class) cannot exist here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Comm:
+    """Interface. L = replica rows held locally, R = cluster size."""
+
+    n_replicas: int
+
+    def replica_ids(self) -> jax.Array:
+        """Global replica id of each local row — i32[L]."""
+        raise NotImplementedError
+
+    def all_gather(self, x: jax.Array) -> jax.Array:
+        """[L, ...] per-replica values -> full [R, ...] on every participant."""
+        raise NotImplementedError
+
+    def select_row(self, x: jax.Array, idx) -> jax.Array:
+        """Broadcast one replica's row to all: [L, ...] -> [...] of row ``idx``."""
+        raise NotImplementedError
+
+
+class SingleDeviceComm(Comm):
+    """All R replica rows resident on one device (L == R)."""
+
+    def __init__(self, n_replicas: int):
+        self.n_replicas = n_replicas
+
+    def replica_ids(self) -> jax.Array:
+        return jnp.arange(self.n_replicas, dtype=jnp.int32)
+
+    def all_gather(self, x: jax.Array) -> jax.Array:
+        return x
+
+    def select_row(self, x: jax.Array, idx) -> jax.Array:
+        return x[idx]
+
+
+class MeshComm(Comm):
+    """One replica row per device along mesh axis ``axis`` (L == 1).
+
+    Only meaningful inside ``shard_map`` over that axis; ``all_gather`` rides
+    ICI (or the virtual-device loopback in CPU tests).
+    """
+
+    def __init__(self, n_replicas: int, axis: str = "replica"):
+        self.n_replicas = n_replicas
+        self.axis = axis
+
+    def replica_ids(self) -> jax.Array:
+        return lax.axis_index(self.axis).astype(jnp.int32)[None]
+
+    def all_gather(self, x: jax.Array) -> jax.Array:
+        return lax.all_gather(x, self.axis, tiled=True)
+
+    def select_row(self, x: jax.Array, idx) -> jax.Array:
+        return lax.all_gather(x, self.axis, tiled=True)[idx]

@@ -1,0 +1,130 @@
+"""Replica-major device state.
+
+The reference keeps per-node state in a ``Node`` struct — persistent fields
+``Term``/``Voted``/``Log`` (commented persistent but never written to disk,
+main.go:18-21), volatile ``CommitIndex``/``LastApplied`` (main.go:23-25) and
+leader-only ``NextIndex``/``MatchIndex`` maps (main.go:27-29) — one Go struct
+per goroutine.
+
+Here the same state lives as **replica-major arrays** (leading axis = replica)
+so that all replicas' transitions are one vectorized XLA program: on a device
+mesh the leading axis is sharded over the ``replica`` mesh axis (one replica's
+rows per chip); on a single device it is an ordinary batch axis. The log is a
+fixed-capacity ring buffer of ``(term, payload)`` — XLA needs static shapes,
+so "how far behind is peer p" becomes masked windows over the ring instead of
+variable-length sends (SURVEY.md §7 hard part 2).
+
+Index convention: log indices are **1-based**, matching the reference
+(``GetLog(index)`` → ``Log[index-1]``, main.go:403-405). Index ``i`` lives in
+ring slot ``(i - 1) % capacity``. ``last_index`` is the index of the last
+entry (0 = empty log) — the reference calls this ``LastApplied`` and uses it
+as "last log index", not "last applied to a state machine" (main.go:149;
+there is no state machine, SURVEY.md §2).
+
+``match_index``/``match_term`` recast the reference's matchIndex protocol
+(followers self-report their match point in every AppendEntries response,
+main.go:301; the leader keeps MatchIndex/NextIndex maps, main.go:27-29):
+each replica tracks the highest log index it has *verified consistent with
+the current leader* and the leader term that verification is valid for.
+Only verified match counts toward quorum — a raw ``last_index`` may cover a
+divergent suffix left over from an old term and must not (Raft safety).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from raft_tpu.config import RaftConfig
+
+NO_VOTE = jnp.int32(-1)
+
+
+@struct.dataclass
+class ReplicaState:
+    """All per-replica durable + volatile state, replica-major.
+
+    Shapes below use R = number of replica rows held locally (the full
+    ``n_replicas`` on a single device; 1 per device under ``shard_map``),
+    C = log capacity, S = stored bytes per entry (full entry, or one RS
+    shard when erasure coding is on).
+    """
+
+    term: jax.Array          # i32[R]   current term (reference ``Term``)
+    voted_for: jax.Array     # i32[R]   candidate id voted for this term, -1 = none.
+    #   The reference uses a bool ``Voted`` that is never reset on term
+    #   advance (main.go:160,168) — a liveness bug we deliberately do not
+    #   copy (SURVEY.md §2).
+    last_index: jax.Array    # i32[R]   index of last log entry (0 = empty)
+    commit_index: jax.Array  # i32[R]   highest committed index
+    match_index: jax.Array   # i32[R]   highest index verified consistent with
+    #                                   the current leader's log (0 until the
+    #                                   first accepted window of a term)
+    match_term: jax.Array    # i32[R]   leader term match_index is valid for
+    log_term: jax.Array      # i32[R, C]     term of entry in each ring slot
+    log_payload: jax.Array   # u8[R, C, S]   payload bytes (or RS shard) per slot
+
+    @property
+    def capacity(self) -> int:
+        return self.log_term.shape[-1]
+
+
+def init_state(cfg: RaftConfig, rows: Optional[int] = None) -> ReplicaState:
+    """Zero state for ``rows`` replica rows (default: the whole cluster).
+
+    Mirrors ``NewNode`` (main.go:59-76): term 0, no vote, empty log,
+    commit 0 — but batched across replicas.
+    """
+    r = cfg.n_replicas if rows is None else rows
+    c, s = cfg.log_capacity, cfg.shard_bytes
+    return ReplicaState(
+        term=jnp.zeros((r,), jnp.int32),
+        voted_for=jnp.full((r,), NO_VOTE, jnp.int32),
+        last_index=jnp.zeros((r,), jnp.int32),
+        commit_index=jnp.zeros((r,), jnp.int32),
+        match_index=jnp.zeros((r,), jnp.int32),
+        match_term=jnp.zeros((r,), jnp.int32),
+        log_term=jnp.zeros((r, c), jnp.int32),
+        log_payload=jnp.zeros((r, c, s), jnp.uint8),
+    )
+
+
+def slot_of(index: jax.Array, capacity: int) -> jax.Array:
+    """Ring slot of 1-based log index ``index``."""
+    return (index - 1) % capacity
+
+
+def log_entries(state: ReplicaState, replica: int, lo: int, hi: int) -> np.ndarray:
+    """Host-side read of payloads for indices [lo, hi] on one replica row.
+
+    Debug/verification path (differential tests compare committed prefixes at
+    quiescence, SURVEY.md §7 hard part 4) — not the hot path.
+    """
+    if hi < lo:
+        return np.zeros((0, state.log_payload.shape[-1]), np.uint8)
+    idx = np.arange(lo, hi + 1)
+    slots = (idx - 1) % state.capacity
+    return np.asarray(state.log_payload[replica, slots])
+
+
+def committed_payloads(state: ReplicaState, replica: int) -> np.ndarray:
+    """The committed log prefix of one replica as raw bytes [n_committed, S]."""
+    hi = int(state.commit_index[replica])
+    return log_entries(state, replica, 1, hi)
+
+
+def last_log_term(state: ReplicaState) -> jax.Array:
+    """Term of each replica's last entry (0 for an empty log) — i32[R].
+
+    Used by the RequestVote up-to-date check (Raft §5.4.1), which the
+    reference schemas but never populates or checks (main.go:185-186, 264;
+    SURVEY.md §2) — implemented for real here.
+    """
+    cap = state.capacity
+    slot = slot_of(jnp.maximum(state.last_index, 1), cap)
+    t = jnp.take_along_axis(state.log_term, slot[:, None], axis=1)[:, 0]
+    return jnp.where(state.last_index > 0, t, 0)

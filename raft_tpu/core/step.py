@@ -1,0 +1,377 @@
+"""The protocol hot path as pure, jittable, statically-shaped kernels.
+
+The reference's replication tick (main.go:332-395) is: for each peer pick a
+payload (full log / suffix / empty heartbeat), push it into the peer's
+channel, block on one reply, update ``MatchIndex``/``NextIndex``; then commit
+by histogramming match indices. Its election round (main.go:253-284) is a
+serial blocking poll of each peer. Both are recast here as **one batched
+device program over the replica axis** (SURVEY.md §3.3, §7):
+
+- payload selection      -> two masked windows over the leader's ring buffer
+                            (frontier = fresh client batch, repair = catch-up
+                            for the slowest verified match), broadcast by
+                            all_gather — or per-replica erasure-coded shards
+                            (the "scatter") when EC is on
+- follower check/append  -> vectorized masked scatter into every replica's
+                            ring simultaneously
+- reply collection       -> the all-gathered verified ``match_index`` vector
+                            IS the AppendEntriesResponse.MatchIndex of every
+                            peer (the reference carries it per-reply,
+                            main.go:301)
+- commit rule            -> k-th largest of the verified match vector
+                            (paper-correct; the reference's exact-bucket rule
+                            main.go:382-391 lives in ``quorum.commit`` as a
+                            compat mode)
+- vote counting          -> sum over the gathered grant vector
+                            (main.go:255-273's count loop)
+
+Everything is static-shape: a replication step always moves windows of
+``B`` entries (masked down to the valid count), so XLA compiles one program
+reused every step, and a ``lax.scan`` over steps runs with no host
+round-trip per batch (SURVEY.md §7 hard part 1).
+
+Match semantics (Raft safety): quorum counts **verified** match — the
+highest index a replica has confirmed consistent with the *current* leader
+via an accepted consistency-checked window — never raw log length. A
+replica rejoining with a divergent same-length log contributes 0 until the
+repair window re-covers and truncates its junk; its ``commit_index`` also
+only advances over its verified prefix (``min(leaderCommit, match)``).
+
+Correctness deltas vs the reference (deliberate; SURVEY.md §2 "protocol
+semantics"): conflicting suffixes are truncated (the reference blind-appends,
+main.go:148), re-delivered windows are idempotent (no dup-append), votes are
+per-term with the §5.4.1 up-to-date check (the reference's sticky bool
+``Voted`` main.go:160 is a liveness bug), commit counts the leader and only
+current-term entries (§5.4.2), and a follower's commit advances to
+``min(leaderCommit, match)`` without the reference's off-by-one ``+1``
+(main.go:152).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.comm import Comm
+from raft_tpu.core.state import NO_VOTE, ReplicaState, last_log_term, slot_of
+from raft_tpu.quorum.commit import commit_from_match
+
+
+class RepInfo(NamedTuple):
+    """Replicated (unsharded) outputs of a replication step."""
+
+    commit_index: jax.Array  # i32[]  global commit index after the step
+    match: jax.Array         # i32[R] verified per-replica match (0 if dead)
+    max_term: jax.Array      # i32[]  highest term seen in the cluster; if this
+    #                                 exceeds the leader's term the host engine
+    #                                 steps the leader down (main.go:312-321)
+    repair_start: jax.Array  # i32[]  first index the repair window covered
+    frontier_len: jax.Array  # i32[]  client entries ingested this step
+
+
+class VoteInfo(NamedTuple):
+    votes: jax.Array         # i32[]  granted votes (includes candidate's own)
+    max_term: jax.Array      # i32[]  highest term in the cluster after voting
+    grants: jax.Array        # bool[R] per-replica grant vector
+
+
+def replicate_step(
+    comm: Comm,
+    state: ReplicaState,
+    client_payload: jax.Array,  # u8[L, B, S] new entries for each local row —
+    #   identical rows when EC is off (full copies, like the reference's
+    #   full-payload sends main.go:344-371); row r = replica r's RS shard
+    #   when EC is on (the scatter of the north star).
+    client_count: jax.Array,    # i32[]  valid entries in client_payload (<= B)
+    leader: jax.Array,          # i32[]  global replica id of the leader
+    leader_term: jax.Array,     # i32[]  leader's current term
+    alive: jax.Array,           # bool[R] fault mask: dead replicas receive nothing
+    slow: jax.Array,            # bool[R] fault mask: slow replicas receive but
+    #                                     do not append (stale matchIndex,
+    #                                     BASELINE config 4)
+    *,
+    ec: bool = False,
+) -> tuple[ReplicaState, RepInfo]:
+    """One leader tick: ingest + repair + replicate + quorum commit, on device.
+
+    Equivalent capability to one pass of the reference's leader ``default``
+    branch (main.go:332-395) *plus* every follower's AppendEntries handling
+    (main.go:121-156), collapsed into a single collective program.
+
+    Two windows move per step, mirroring the reference's per-peer payload
+    choice (full log for a never-synced peer / suffix for a lagging peer /
+    heartbeat, main.go:341-372) without letting one straggler pin the
+    frontier:
+
+    - **repair window** starts just past the slowest live verified match, so
+      lagging or rejoining replicas heal B entries per step (the reference's
+      NextIndex=1 full resend, main.go:343-351, batched);
+    - **frontier window** carries the fresh client batch, so the healthy
+      quorum keeps committing regardless of stragglers.
+
+    In EC mode only the frontier moves (each replica receives its own RS
+    shard; a lagging replica's shards are not in the leader's log and are
+    repaired by reconstruction instead — see the ``ec`` package).
+    """
+    cap = state.capacity
+    B = client_payload.shape[1]
+    ids = comm.replica_ids()                       # i32[L]
+    is_leader_row = ids == leader                  # bool[L]
+    alive_l = alive[ids]                           # bool[L]
+    slow_l = slow[ids]                             # bool[L]
+    term0 = state.term
+    barange = jnp.arange(B, dtype=jnp.int32)
+    rows = jnp.arange(ids.shape[0])[:, None]
+    # Harden against malformed driver inputs: a batch can only carry [0, B]
+    # entries, and terms start at 1 (term 0 = "no election ever held" — an
+    # unelected leader must not ingest or commit; empty ring slots hold term
+    # 0, which would otherwise satisfy the §5.4.2 current-term check).
+    client_count = jnp.clip(client_count, 0, B)
+    legit = leader_term >= 1
+
+    # ---- 1. Leader ingests the client batch into its own log --------------
+    # (reference: LogReq case, append + LastApplied++, main.go:327-331)
+    # A deposed leader (its own term already past leader_term) must not
+    # ingest: those entries would carry a stale term.
+    leader_current = legit & (comm.all_gather(term0)[leader] <= leader_term)
+    frontier_count = jnp.where(leader_current, client_count, 0)
+    ingest_row = is_leader_row & leader_current
+    ingest_mask = ingest_row[:, None] & (barange < frontier_count)[None, :]
+    ingest_pos = slot_of(state.last_index[:, None] + 1 + barange[None, :], cap)
+    cur_p = state.log_payload[rows, ingest_pos]            # u8[L, B, S]
+    cur_t = state.log_term[rows, ingest_pos]               # i32[L, B]
+    log_payload = state.log_payload.at[rows, ingest_pos].set(
+        jnp.where(ingest_mask[..., None], client_payload, cur_p)
+    )
+    log_term = state.log_term.at[rows, ingest_pos].set(
+        jnp.where(ingest_mask, leader_term, cur_t)
+    )
+    last_index = state.last_index + jnp.where(ingest_row, frontier_count, 0)
+    frontier_start = comm.all_gather(state.last_index)[leader] + 1
+
+    # ---- 2. Verified match bookkeeping ------------------------------------
+    # match_index is only meaningful for the term it was verified in; a new
+    # leader implicitly resets everyone to 0 (the reference resets
+    # NextIndex=1 on election, main.go:281, forcing a full resend).
+    heard = alive_l & legit & (leader_term >= term0)       # reject stale leader
+    m_eff = jnp.where(state.match_term == leader_term, state.match_index, 0)
+    m_eff = jnp.where(is_leader_row & leader_current, last_index, m_eff)
+
+    lasts = comm.all_gather(last_index)
+    leader_last = lasts[leader]
+
+    def materialize(ws):
+        """Window [ws, ws+B) of the leader's log, broadcast to every row."""
+        wpos = slot_of(ws + barange, cap)
+        win_p = comm.select_row(jnp.take(log_payload, wpos, axis=1), leader)[None]
+        win_t = comm.select_row(jnp.take(log_term, wpos, axis=1), leader)
+        prev_slot = slot_of(jnp.maximum(ws - 1, 1), cap)
+        prev_term = jnp.where(
+            ws == 1, 0, comm.select_row(log_term[:, prev_slot], leader)
+        )
+        return wpos, win_p, win_t, prev_term, prev_slot
+
+    def apply_window(carry, ws, count, win_p, win_t, prev_term, prev_slot, wpos):
+        """Follower consistency check + append for one window.
+
+        Reference checks (main.go:129-146): term too low -> reject; gap ->
+        reject; PrevLogTerm mismatch -> reject. Then blind append
+        (main.go:148). Here: same gates vectorized, the overlap is compared
+        term-wise, and conflicting suffixes are truncated (§5.3). A
+        zero-count window still verifies the prev point (heartbeat).
+        """
+        log_term, log_payload, last_index, m_eff = carry
+        my_prev_t = log_term[:, prev_slot]                 # i32[L]
+        has_prev = (ws == 1) | (
+            (last_index >= ws - 1) & (my_prev_t == prev_term)
+        )
+        accept = heard & ~slow_l & has_prev                # bool[L]
+        valid = barange < count                            # bool[B]
+
+        widx = ws + barange                                # i32[B] global idx
+        my_win_t = jnp.take(log_term, wpos, axis=1)        # i32[L, B]
+        exists = widx[None, :] <= last_index[:, None]      # bool[L, B]
+        mismatch = exists & (my_win_t != win_t[None, :]) & valid[None, :]
+        any_mm = jnp.any(mismatch, axis=1)                 # bool[L]
+
+        write = accept[:, None] & valid[None, :]           # bool[L, B]
+        cur_wp = jnp.take(log_payload, wpos, axis=1)
+        log_payload = log_payload.at[:, wpos].set(
+            jnp.where(write[..., None], jnp.broadcast_to(win_p, cur_wp.shape), cur_wp)
+        )
+        log_term = log_term.at[:, wpos].set(
+            jnp.where(write, win_t[None, :], my_win_t)
+        )
+        we = ws + count - 1                                # = ws-1 on heartbeat
+        # No conflict: keep any consistent suffix beyond the window (never
+        # truncate committed entries). Conflict: truncate to the window end.
+        last_index = jnp.where(
+            accept,
+            jnp.where(any_mm, jnp.maximum(we, ws - 1), jnp.maximum(last_index, we)),
+            last_index,
+        )
+        # The accepted window verifies the prefix up to its end (Log
+        # Matching: a matching prev entry implies the whole prefix matches).
+        m_eff = jnp.where(accept, jnp.maximum(m_eff, we), m_eff)
+        return (log_term, log_payload, last_index, m_eff)
+
+    # ---- 3. Repair window: heal the slowest live verified match -----------
+    matches0 = comm.all_gather(m_eff)                      # i32[R]
+    repair_mask = alive & ~slow
+    repair_ws = jnp.min(jnp.where(repair_mask, matches0, leader_last)) + 1
+    repair_count = jnp.where(
+        legit, jnp.clip(leader_last - repair_ws + 1, 0, B), 0
+    )
+    carry = (log_term, log_payload, last_index, m_eff)
+    if not ec:
+        wpos, win_p, win_t, prev_term, prev_slot = materialize(repair_ws)
+        carry = apply_window(
+            carry, repair_ws, repair_count, win_p, win_t, prev_term, prev_slot, wpos
+        )
+
+    # ---- 4. Frontier window: the fresh client batch ------------------------
+    fpos = slot_of(frontier_start + barange, cap)
+    if ec:
+        # Each replica receives its own shard (scatter); the leader's log
+        # cannot source peers' shards, so only fresh entries move here.
+        win_p = client_payload
+        win_t = jnp.broadcast_to(leader_term, (B,))
+        prev_slot = slot_of(jnp.maximum(frontier_start - 1, 1), cap)
+        prev_term = jnp.where(
+            frontier_start == 1,
+            0,
+            comm.select_row(carry[0][:, prev_slot], leader),
+        )
+    else:
+        _, win_p, win_t, prev_term, prev_slot = materialize(frontier_start)
+    carry = apply_window(
+        carry, frontier_start, frontier_count, win_p, win_t, prev_term, prev_slot, fpos
+    )
+    log_term, log_payload, last_index, m_eff = carry
+
+    # Term adoption on hearing from a legitimate leader (main.go:155 adopts;
+    # paper: also reset vote when the term advances).
+    adopt = heard & (leader_term > term0)
+    voted_for = jnp.where(adopt, NO_VOTE, state.voted_for)
+    term = jnp.where(heard, jnp.maximum(term0, leader_term), term0)
+
+    # ---- 5. Quorum commit -------------------------------------------------
+    # Reference: exact-bucket histogram over follower MatchIndex only
+    # (main.go:381-391) — stalls while followers disagree and ignores the
+    # leader's own log. Paper-correct rule: k-th largest of the verified
+    # match vector, restricted to current-term entries (§5.4.2).
+    match = jnp.where(alive, comm.all_gather(m_eff), 0)    # i32[R]
+    commit_cand = commit_from_match(match)
+    cand_slot = slot_of(jnp.maximum(commit_cand, 1), cap)
+    cand_term = comm.select_row(log_term[:, cand_slot], leader)
+    commit_prev = comm.all_gather(state.commit_index)[leader]
+    commit_ok = legit & (commit_cand >= 1) & (cand_term == leader_term)
+    global_commit = jnp.where(
+        commit_ok, jnp.maximum(commit_prev, commit_cand), commit_prev
+    )
+
+    # Followers advance to min(leaderCommit, verified match) — never over an
+    # unverified suffix. (The reference's min(LeaderCommit, len(Log)+1),
+    # main.go:152, can point one past the log; the +1 is not reproduced —
+    # documented deviation, SURVEY.md §2.)
+    my_commit = jnp.where(
+        is_leader_row, global_commit, jnp.minimum(global_commit, m_eff)
+    )
+    commit_index = jnp.where(
+        (heard & ~slow_l) | (is_leader_row & leader_current),
+        jnp.maximum(state.commit_index, my_commit),
+        state.commit_index,
+    )
+
+    new_state = ReplicaState(
+        term=term,
+        voted_for=voted_for,
+        last_index=last_index,
+        commit_index=commit_index,
+        match_index=jnp.where(heard | is_leader_row, m_eff, state.match_index),
+        match_term=jnp.where(heard | is_leader_row, leader_term, state.match_term),
+        log_term=log_term,
+        log_payload=log_payload,
+    )
+    info = RepInfo(
+        commit_index=global_commit,
+        match=match,
+        max_term=jnp.max(comm.all_gather(term)),
+        repair_start=repair_ws,
+        frontier_len=frontier_count,
+    )
+    return new_state, info
+
+
+def scan_replicate(
+    comm, ec, state, payloads, counts, leader, leader_term, alive, slow
+):
+    """T replication steps as one compiled ``lax.scan`` — no host round-trip
+    per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
+    ``payloads``: u8[T, L, B, S]; ``counts``: i32[T]."""
+
+    def body(st, xs):
+        payload, count = xs
+        st, info = replicate_step(
+            comm, st, payload, count, leader, leader_term, alive, slow, ec=ec
+        )
+        return st, info
+
+    return jax.lax.scan(body, state, (payloads, counts))
+
+
+def vote_step(
+    comm: Comm,
+    state: ReplicaState,
+    candidate: jax.Array,   # i32[] global replica id of the candidate
+    cand_term: jax.Array,   # i32[] term the candidate is campaigning in
+    alive: jax.Array,       # bool[R]
+) -> tuple[ReplicaState, VoteInfo]:
+    """One election round: every replica votes simultaneously.
+
+    Capability parity with the candidate's serial poll (main.go:253-273) and
+    the follower/candidate vote handlers (main.go:157-170, 224-246), with
+    the paper's rules restored: votes are per-term (``voted_for`` resets when
+    the term advances — the reference's ``Voted`` bool never does,
+    main.go:160), and the §5.4.1 up-to-date check is enforced (the reference
+    schemas LastLogIndex/LastLogTerm but never fills or checks them,
+    main.go:185-186, 264). The candidate's self-vote (main.go:255) falls out
+    naturally: its own row grants.
+    """
+    ids = comm.replica_ids()
+    alive_l = alive[ids]
+
+    lasts = comm.all_gather(state.last_index)
+    my_lterm = last_log_term(state)
+    lterms = comm.all_gather(my_lterm)
+    cand_last, cand_lterm = lasts[candidate], lterms[candidate]
+
+    newer = cand_term > state.term
+    term = jnp.maximum(state.term, cand_term)
+    vf = jnp.where(newer, NO_VOTE, state.voted_for)
+    up_to_date = (cand_lterm > my_lterm) | (
+        (cand_lterm == my_lterm) & (cand_last >= state.last_index)
+    )
+    grant = (
+        alive_l
+        & (cand_term >= state.term)
+        & ((vf == NO_VOTE) | (vf == candidate))
+        & up_to_date
+    )
+    voted_for = jnp.where(grant, candidate, vf)
+    # Every live replica that heard the request adopts the higher term
+    # (denials included — paper §5.1; reference adopts only on grant,
+    # main.go:168).
+    term = jnp.where(alive_l, term, state.term)
+    voted_for = jnp.where(alive_l, voted_for, state.voted_for)
+
+    grants = comm.all_gather(grant) & alive
+    new_state = state.replace(term=term, voted_for=voted_for)
+    info = VoteInfo(
+        votes=jnp.sum(grants.astype(jnp.int32)),
+        max_term=jnp.max(comm.all_gather(term)),
+        grants=grants,
+    )
+    return new_state, info

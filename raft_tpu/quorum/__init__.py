@@ -1,0 +1,13 @@
+from raft_tpu.quorum.commit import (
+    commit_from_match,
+    majority,
+    reference_bucket_commit,
+    vote_majority,
+)
+
+__all__ = [
+    "commit_from_match",
+    "majority",
+    "reference_bucket_commit",
+    "vote_majority",
+]

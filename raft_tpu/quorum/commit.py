@@ -1,0 +1,61 @@
+"""Quorum rules as small jittable kernels.
+
+Two commit rules are provided (SURVEY.md §7 layer 3):
+
+- ``commit_from_match`` — the paper-correct rule: the largest N such that a
+  majority of replicas have matchIndex >= N, computed as the k-th largest
+  element of the match vector. This is the rule used on the hot path and in
+  benchmarks; it advances even while followers sit at different offsets
+  (straggler path, BASELINE config 4).
+- ``reference_bucket_commit`` — the reference's exact-bucket rule
+  (main.go:381-391): histogram follower matchIndex values and commit index i
+  only if *the exact value* i is held by a strict majority of the whole
+  cluster. Deviations preserved for differential testing: the leader's own
+  log is not counted, and commit stalls while followers disagree
+  (SURVEY.md §2 "leader commit rule"). Never used in benchmarks.
+
+Vote majority mirrors the reference's ``count > len(Nodes)/2`` test
+(main.go:273).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def majority(n: int) -> int:
+    """Strict majority of an n-replica cluster."""
+    return n // 2 + 1
+
+
+def commit_from_match(match: jax.Array) -> jax.Array:
+    """Largest N with |{r : match[r] >= N}| >= majority — i32[] from i32[R].
+
+    k-th order statistic: sort ascending and take the element such that it
+    and everything after it (= majority elements) are >= it.
+    """
+    n = match.shape[0]
+    return jnp.sort(match)[n - majority(n)]
+
+
+def reference_bucket_commit(
+    follower_match: jax.Array, n_nodes: int, commit_prev: jax.Array
+) -> jax.Array:
+    """The reference's exact-bucket commit (main.go:381-391), vectorized.
+
+    ``follower_match``: i32[F] matchIndex of the followers only (the
+    reference iterates ``n.MatchIndex``, which never contains the leader —
+    main.go:280-281). Commit advances to the largest value v held by a
+    strict majority of the *whole cluster* (``count(v) > n_nodes/2``) with
+    v > previous commit; otherwise stays.
+    """
+    eq = follower_match[:, None] == follower_match[None, :]
+    counts = jnp.sum(eq, axis=1)                       # i32[F] bucket sizes
+    ok = (counts > n_nodes // 2) & (follower_match > commit_prev)
+    return jnp.max(jnp.where(ok, follower_match, commit_prev))
+
+
+def vote_majority(votes: jax.Array, n_nodes: int) -> jax.Array:
+    """True iff ``votes`` is a strict majority (main.go:273)."""
+    return votes > n_nodes // 2

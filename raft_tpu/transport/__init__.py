@@ -1,0 +1,10 @@
+from raft_tpu.transport.base import Transport, make_transport
+from raft_tpu.transport.device import SingleDeviceTransport
+from raft_tpu.transport.tpu_mesh import TpuMeshTransport
+
+__all__ = [
+    "Transport",
+    "make_transport",
+    "SingleDeviceTransport",
+    "TpuMeshTransport",
+]

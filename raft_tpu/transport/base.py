@@ -1,0 +1,76 @@
+"""The ``Transport`` plugin boundary.
+
+This is the seam named by the north star (BASELINE.json): the reference's
+"transport" is a global map of Go channels standing in for sockets
+(main.go:12, 31-38 — the comment says "ソケットの代わり", stand-in for
+sockets). Here a transport owns *where replica state lives and how the
+collective steps run*:
+
+- ``SingleDeviceTransport`` — replica axis resident on one device (how the
+  benchmark runs on a single TPU chip, and the fast CI path).
+- ``TpuMeshTransport``   — one replica row per device over a
+  ``jax.sharding.Mesh`` axis; identical program, collectives ride ICI.
+- ``LoopbackTransport``  — host-side golden model reproducing the
+  reference's message-level semantics for differential testing
+  (``raft_tpu.golden``).
+
+All device transports expose the same step signatures so the host engine
+(``raft.engine``) is backend-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Tuple
+
+import jax
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import ReplicaState
+from raft_tpu.core.step import RepInfo, VoteInfo
+
+
+class Transport(Protocol):
+    cfg: RaftConfig
+
+    def init(self) -> ReplicaState:
+        """Fresh cluster state placed for this backend."""
+        ...
+
+    def replicate(
+        self,
+        state: ReplicaState,
+        client_payload: jax.Array,   # u8[R, B, S] per-replica rows (see step.py)
+        client_count,                # i32 valid entries
+        leader,                      # i32 leader replica id
+        leader_term,                 # i32
+        alive,                       # bool[R]
+        slow,                        # bool[R]
+    ) -> Tuple[ReplicaState, RepInfo]:
+        ...
+
+    def request_votes(
+        self, state: ReplicaState, candidate, cand_term, alive
+    ) -> Tuple[ReplicaState, VoteInfo]:
+        ...
+
+
+def make_transport(cfg: RaftConfig, devices=None) -> "Transport":
+    """Build the configured device transport."""
+    from raft_tpu.transport.device import SingleDeviceTransport
+    from raft_tpu.transport.tpu_mesh import TpuMeshTransport
+
+    if cfg.transport == "tpu_mesh":
+        devices = devices if devices is not None else jax.devices()
+        if len(devices) >= cfg.n_replicas:
+            return TpuMeshTransport(cfg, devices[: cfg.n_replicas])
+        # Fewer chips than replicas: fall back to the resident layout (the
+        # program is the same; the replica axis just isn't sharded).
+        return SingleDeviceTransport(cfg)
+    if cfg.transport == "single":
+        return SingleDeviceTransport(cfg)
+    if cfg.transport == "loopback":
+        raise ValueError(
+            "the loopback golden model is host-side, not a device transport; "
+            "use raft_tpu.golden directly (it exists for differential tests)"
+        )
+    raise ValueError(f"unknown device transport {cfg.transport!r}")

@@ -1,0 +1,67 @@
+"""Single-device transport: the replica axis as a resident batch axis.
+
+All R replica state machines live on one chip; collectives degenerate to
+reductions/indexing over the leading axis (``core.comm.SingleDeviceComm``).
+This is how the benchmark runs on one TPU chip and the fastest CI path —
+and it is the same compiled program as the mesh layout, only placement
+differs (SURVEY.md §7 "minimum end-to-end slice").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.comm import SingleDeviceComm
+from raft_tpu.core.state import ReplicaState, init_state
+from raft_tpu.core.step import (
+    RepInfo,
+    VoteInfo,
+    replicate_step,
+    scan_replicate,
+    vote_step,
+)
+
+
+class SingleDeviceTransport:
+    def __init__(self, cfg: RaftConfig):
+        self.cfg = cfg
+        comm = SingleDeviceComm(cfg.n_replicas)
+        self._replicate = jax.jit(
+            partial(replicate_step, comm, ec=cfg.ec_enabled)
+        )
+        self._vote = jax.jit(partial(vote_step, comm))
+        self._replicate_many = jax.jit(
+            partial(scan_replicate, comm, cfg.ec_enabled)
+        )
+
+    def init(self) -> ReplicaState:
+        return init_state(self.cfg)
+
+    def replicate(
+        self, state, client_payload, client_count, leader, leader_term, alive, slow
+    ) -> Tuple[ReplicaState, RepInfo]:
+        return self._replicate(
+            state, client_payload, jnp.int32(client_count), jnp.int32(leader),
+            jnp.int32(leader_term), alive, slow,
+        )
+
+    def replicate_many(
+        self, state, payloads, counts, leader, leader_term, alive, slow
+    ) -> Tuple[ReplicaState, RepInfo]:
+        """T replication steps as one compiled ``lax.scan`` — no host
+        round-trip per batch (SURVEY.md §7 hard part 1). ``payloads`` is
+        u8[T, R, B, S]; ``counts`` i32[T]."""
+        return self._replicate_many(
+            state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
+            alive, slow,
+        )
+
+    def request_votes(
+        self, state, candidate, cand_term, alive
+    ) -> Tuple[ReplicaState, VoteInfo]:
+        return self._vote(state, jnp.int32(candidate), jnp.int32(cand_term), alive)

@@ -1,0 +1,121 @@
+"""Mesh transport: one replica row per device over a ``replica`` mesh axis.
+
+The TPU-native recast of the reference's "network" (a global map of Go
+channels, main.go:12, 32-38): replica state machines are rows of the same
+replica-major arrays, sharded one per chip over a ``jax.sharding.Mesh``
+axis. AppendEntries becomes the leader-window all_gather/scatter inside the
+step kernel, and ack/vote aggregation becomes gather+reduce — all XLA
+collectives riding ICI (SURVEY.md §5 "distributed communication backend").
+
+The program body is byte-identical to the single-device transport
+(``core.step``); only ``Comm`` and placement change — which is exactly the
+property the differential tests rely on.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.comm import MeshComm
+from raft_tpu.core.state import ReplicaState, init_state
+from raft_tpu.core.step import (
+    RepInfo,
+    VoteInfo,
+    replicate_step,
+    scan_replicate,
+    vote_step,
+)
+
+AXIS = "replica"
+
+
+class TpuMeshTransport:
+    def __init__(self, cfg: RaftConfig, devices: Sequence[jax.Device] | None = None):
+        self.cfg = cfg
+        devices = list(devices) if devices is not None else jax.devices()
+        if len(devices) < cfg.n_replicas:
+            raise ValueError(
+                f"need {cfg.n_replicas} devices for one replica row each, "
+                f"got {len(devices)}"
+            )
+        self.mesh = Mesh(np.array(devices[: cfg.n_replicas]), (AXIS,))
+        self._row = NamedSharding(self.mesh, P(AXIS))
+        self._rep = NamedSharding(self.mesh, P())
+        comm = MeshComm(cfg.n_replicas, AXIS)
+
+        state_specs = ReplicaState(
+            term=P(AXIS), voted_for=P(AXIS), last_index=P(AXIS),
+            commit_index=P(AXIS), match_index=P(AXIS), match_term=P(AXIS),
+            log_term=P(AXIS), log_payload=P(AXIS),
+        )
+        info_specs = RepInfo(
+            commit_index=P(), match=P(), max_term=P(),
+            repair_start=P(), frontier_len=P(),
+        )
+        vote_specs = VoteInfo(votes=P(), max_term=P(), grants=P())
+
+        self._replicate = jax.jit(
+            jax.shard_map(
+                partial(replicate_step, comm, ec=cfg.ec_enabled),
+                mesh=self.mesh,
+                in_specs=(state_specs, P(AXIS), P(), P(), P(), P(), P()),
+                out_specs=(state_specs, info_specs),
+                check_vma=False,
+            )
+        )
+        self._vote = jax.jit(
+            jax.shard_map(
+                partial(vote_step, comm),
+                mesh=self.mesh,
+                in_specs=(state_specs, P(), P(), P()),
+                out_specs=(state_specs, vote_specs),
+                check_vma=False,
+            )
+        )
+        self._replicate_many = jax.jit(
+            jax.shard_map(
+                partial(scan_replicate, comm, cfg.ec_enabled),
+                mesh=self.mesh,
+                in_specs=(state_specs, P(None, AXIS), P(), P(), P(), P(), P()),
+                out_specs=(state_specs, info_specs),
+                check_vma=False,
+            )
+        )
+
+    def init(self) -> ReplicaState:
+        state = init_state(self.cfg)
+        return jax.device_put(state, self._row)
+
+    def shard_rows(self, payload):
+        """Place a u8[R, B, S] per-replica payload one row per device (the
+        'scatter' of the north star when rows are RS shards)."""
+        return jax.device_put(payload, self._row)
+
+    def replicate(
+        self, state, client_payload, client_count, leader, leader_term, alive, slow
+    ) -> Tuple[ReplicaState, RepInfo]:
+        return self._replicate(
+            state, client_payload, jnp.int32(client_count), jnp.int32(leader),
+            jnp.int32(leader_term), alive, slow,
+        )
+
+    def replicate_many(
+        self, state, payloads, counts, leader, leader_term, alive, slow
+    ) -> Tuple[ReplicaState, RepInfo]:
+        """u8[T, R, B, S] payloads → T steps in one compiled scan."""
+        return self._replicate_many(
+            state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
+            alive, slow,
+        )
+
+    def request_votes(
+        self, state, candidate, cand_term, alive
+    ) -> Tuple[ReplicaState, VoteInfo]:
+        return self._vote(state, jnp.int32(candidate), jnp.int32(cand_term), alive)

@@ -1,0 +1,23 @@
+"""Test configuration: force JAX onto CPU with 8 virtual host devices.
+
+Multi-replica programs (shard_map over a 'replica' mesh axis) are exercised on
+virtual CPU devices so the full 3- and 5-replica meshes run in CI without TPU
+hardware; TPU runs only change the mesh/backend (SURVEY.md §4).
+
+Note: the environment pre-imports jax (sitecustomize on PYTHONPATH) with the
+'axon' TPU platform selected, so setting JAX_PLATFORMS here is too late —
+override via jax.config before any backend is initialized instead.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,255 @@
+"""Unit tests for the replicate/vote step kernels (single-device layout).
+
+Scenario sources: reference behaviors per SURVEY.md §2 — follower
+AppendEntries gates (main.go:121-156), vote rules (main.go:157-170),
+leader tick + commit (main.go:332-395) — implemented paper-correct.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.comm import SingleDeviceComm
+from raft_tpu.core.state import NO_VOTE, init_state, slot_of
+from raft_tpu.core.step import replicate_step, vote_step
+
+CFG = RaftConfig(n_replicas=3, entry_bytes=8, batch_size=4, log_capacity=32)
+COMM = SingleDeviceComm(3)
+ALIVE = jnp.ones(3, bool)
+QUIET = jnp.zeros(3, bool)
+
+
+def batch(vals, rows=3, entry=8):
+    """u8[rows, B, entry] batch whose entries are filled with ``vals``."""
+    b = jnp.asarray(vals, jnp.uint8)[None, :, None]
+    return jnp.broadcast_to(b, (rows, len(vals), entry))
+
+
+def rep(state, payload, count, leader=0, term=1, alive=ALIVE, slow=QUIET):
+    return replicate_step(
+        COMM, state, payload, jnp.int32(count), jnp.int32(leader),
+        jnp.int32(term), alive, slow,
+    )
+
+
+def vote(state, cand, term, alive=ALIVE):
+    return vote_step(COMM, state, jnp.int32(cand), jnp.int32(term), alive)
+
+
+class TestVote:
+    def test_fresh_election_unanimous(self):
+        state, info = vote(init_state(CFG), 0, 1)
+        assert int(info.votes) == 3
+        assert np.all(np.asarray(state.term) == 1)
+        assert np.all(np.asarray(state.voted_for) == 0)
+
+    def test_one_vote_per_term(self):
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, info = vote(state, 1, 1)  # same term, already voted for 0
+        assert int(info.votes) == 0     # even candidate 1's own row is bound
+        # higher term resets voted_for (unlike the reference's sticky Voted,
+        # main.go:160)
+        state, info = vote(state, 1, 2)
+        assert int(info.votes) == 3
+
+    def test_up_to_date_check_denies_stale_candidate(self):
+        state = init_state(CFG)
+        state, _ = vote(state, 0, 1)
+        state, _ = rep(state, batch([1, 2, 3, 4]), 4)  # all replicas at idx 4
+        # strip candidate 1's log to simulate a stale replica
+        state = state.replace(
+            last_index=state.last_index.at[1].set(0),
+        )
+        state, info = vote(state, 1, 2)
+        # replicas 0 and 2 have longer logs -> deny; only self-vote granted
+        assert int(info.votes) == 1
+        assert list(np.asarray(info.grants)) == [False, True, False]
+
+    def test_dead_replicas_do_not_vote(self):
+        alive = jnp.array([True, True, False])
+        state, info = vote(init_state(CFG), 0, 1, alive=alive)
+        assert int(info.votes) == 2
+        assert int(state.term[2]) == 0  # unreachable replica saw nothing
+
+
+class TestReplicate:
+    def test_steady_state_commits_in_one_step(self):
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, info = rep(state, batch([10, 11, 12, 13]), 4)
+        assert int(info.commit_index) == 4
+        assert np.all(np.asarray(state.last_index) == 4)
+        assert np.all(np.asarray(state.commit_index) == 4)
+        # payload replicated byte-identically
+        for r in range(3):
+            np.testing.assert_array_equal(
+                np.asarray(state.log_payload[r, :4, 0]), [10, 11, 12, 13]
+            )
+
+    def test_partial_batch_masks_invalid_entries(self):
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, info = rep(state, batch([7, 8, 0, 0]), 2)
+        assert int(info.commit_index) == 2
+        assert np.all(np.asarray(state.last_index) == 2)
+
+    def test_slow_follower_straggler_commit(self):
+        """BASELINE config 4: commit must advance with f slow replicas —
+        the k-th largest rule handles it; the reference's exact-bucket rule
+        stalls (SURVEY.md §7 hard part 5)."""
+        state, _ = vote(init_state(CFG), 0, 1)
+        slow = jnp.array([False, False, True])
+        state, info = rep(state, batch([1, 2, 3, 4]), 4, slow=slow)
+        assert int(info.commit_index) == 4          # 2-of-3 quorum
+        assert list(np.asarray(info.match)) == [4, 4, 0]
+
+    def test_catch_up_window_heals_straggler(self):
+        state, _ = vote(init_state(CFG), 0, 1)
+        slow = jnp.array([False, False, True])
+        state, _ = rep(state, batch([1, 2, 3, 4]), 4, slow=slow)
+        # heartbeat with nobody slow: repair window restarts at the straggler
+        state, info = rep(state, batch([0, 0, 0, 0]), 0)
+        assert int(info.repair_start) == 1
+        assert list(np.asarray(info.match)) == [4, 4, 4]
+        assert np.all(np.asarray(state.commit_index) == 4)
+
+    def test_persistent_straggler_does_not_stall_commit(self):
+        """A permanently slow follower must not pin the frontier: the healthy
+        quorum keeps committing fresh batches (BASELINE config 4), and the
+        straggler heals after it recovers."""
+        state, _ = vote(init_state(CFG), 0, 1)
+        slow = jnp.array([False, False, True])
+        for i in range(5):
+            state, info = rep(state, batch([i] * 4), 4, slow=slow)
+        assert int(info.commit_index) == 20
+        assert list(np.asarray(info.match)) == [20, 20, 0]
+        # straggler recovers: repair window heals B entries per heartbeat
+        for _ in range(5):
+            state, info = rep(state, batch([0] * 4), 0)
+        assert list(np.asarray(info.match)) == [20, 20, 20]
+        assert int(state.commit_index[2]) == 20
+
+    def test_dead_replica_rejects_everything(self):
+        alive = jnp.array([True, True, False])
+        state, _ = vote(init_state(CFG), 0, 1, alive=alive)
+        state, info = rep(state, batch([1, 2, 3, 4]), 4, alive=alive)
+        assert int(info.commit_index) == 4
+        assert int(state.last_index[2]) == 0
+        assert int(state.term[2]) == 0
+
+    def test_stale_leader_rejected_and_reported(self):
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, _ = rep(state, batch([1, 2, 3, 4]), 4)
+        state, _ = vote(state, 1, 5)  # cluster moves to term 5
+        state, info = rep(state, batch([9, 9, 9, 9]), 4, leader=0, term=1)
+        assert np.all(np.asarray(state.last_index) == 4)  # nothing appended
+        assert int(info.max_term) == 5  # host engine steps the leader down
+
+    def test_no_commit_of_prior_term_entries(self):
+        """Raft §5.4.2: a new leader may not commit old-term entries by
+        counting replicas — only entries of its own term."""
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, _ = rep(state, batch([1, 2, 3, 4]), 4)          # committed @1
+        state, _ = vote(state, 1, 2)                           # new leader, term 2
+        # heartbeat from new leader: window has only term-1 entries
+        state, info = rep(state, batch([0] * 4), 0, leader=1, term=2)
+        assert int(info.commit_index) == 4  # already committed, no regression
+        # now append one term-2 entry: committable
+        state, info = rep(state, batch([5, 0, 0, 0]), 1, leader=1, term=2)
+        assert int(info.commit_index) == 5
+
+    def test_conflict_truncation(self):
+        """Raft §5.3: follower deletes conflicting suffix. The reference
+        blind-appends instead (main.go:148) — divergence is deliberate."""
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, _ = rep(state, batch([1, 2, 0, 0]), 2)          # common prefix @1..2
+        # fabricate: replica 1 has uncommitted term-1 junk at idx 3..4
+        lt = state.log_term.at[1, 2:4].set(1)
+        lp = state.log_payload.at[1, 2:4].set(99)
+        state = state.replace(
+            log_term=lt, log_payload=lp,
+            last_index=state.last_index.at[1].set(4),
+        )
+        # leader 0 wins term 2 and appends one entry at idx 3
+        state, _ = vote(state, 0, 2)
+        state, info = rep(state, batch([42, 0, 0, 0]), 1, leader=0, term=2)
+        assert int(info.commit_index) == 3
+        assert int(state.last_index[1]) == 3          # junk truncated
+        assert int(state.log_term[1, 2]) == 2
+        assert int(state.log_payload[1, 2, 0]) == 42
+
+    def test_consistent_suffix_not_truncated(self):
+        """Entries beyond the window that are term-consistent survive —
+        truncating them could discard committed data (safety)."""
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, _ = rep(state, batch([1, 2, 3, 4]), 4)
+        # replica 2 loses its verification (stale match) but its log still
+        # holds 1..4 consistently
+        state2 = state.replace(match_index=state.match_index.at[2].set(2))
+        state2, info = rep(state2, batch([0] * 4), 0)
+        # repair re-sends from idx 3; replica 2's suffix matches -> kept
+        assert int(state2.last_index[2]) == 4
+        assert int(state2.match_index[2]) == 4
+
+    def test_redelivery_is_idempotent(self):
+        """The reference double-appends a re-delivered batch (SURVEY.md §2
+        item 4). Here overwriting an identical window is a no-op."""
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, _ = rep(state, batch([1, 2, 3, 4]), 4)
+        # force the repair window back to 1 by wiping r2's verified match
+        state = state.replace(match_index=state.match_index.at[2].set(0))
+        state, _ = rep(state, batch([0] * 4), 0)
+        assert np.all(np.asarray(state.last_index) == 4)  # not 8
+
+    def test_divergent_rejoin_commits_no_junk(self):
+        """Safety: a rejoining replica whose same-length log diverges must
+        not count toward quorum nor advance commit over its junk — only
+        verified match does. (Found by review; Raft matchIndex semantics.)"""
+        # leader 0 (term 1) ingests [11..14] but nobody accepts
+        state, _ = vote(init_state(CFG), 0, 1)
+        state, info = rep(
+            state, batch([11, 12, 13, 14]), 4,
+            slow=jnp.array([False, True, True]),
+        )
+        assert int(info.commit_index) == 0  # 1-of-3 is no quorum
+        # leader 0 dies; 1 wins term 2 and commits [21..24] at the same idxs
+        alive2 = jnp.array([False, True, True])
+        state, _ = vote(state, 1, 2, alive=alive2)
+        state, info = rep(
+            state, batch([21, 22, 23, 24]), 4, leader=1, term=2, alive=alive2
+        )
+        assert int(info.commit_index) == 4
+        # replica 0 rejoins: its junk must contribute nothing until repaired
+        state, info = rep(state, batch([0] * 4), 0, leader=1, term=2)
+        assert int(state.commit_index[0]) == 4  # advanced only after repair
+        np.testing.assert_array_equal(
+            np.asarray(state.log_payload[0, :4, 0]), [21, 22, 23, 24]
+        )
+        for r in range(3):
+            np.testing.assert_array_equal(
+                np.asarray(state.log_payload[r, :4]),
+                np.asarray(state.log_payload[1, :4]),
+            )
+
+    def test_ring_wraparound(self):
+        cfg = RaftConfig(n_replicas=3, entry_bytes=8, batch_size=4, log_capacity=8)
+        state, _ = vote(init_state(cfg), 0, 1)
+        for i in range(5):  # 20 entries through a capacity-8 ring
+            state, info = rep(state, batch([i, i, i, i]), 4)
+        assert int(info.commit_index) == 20
+        assert int(slot_of(jnp.int32(20), 8)) == 3
+        assert int(state.log_payload[0, slot_of(jnp.int32(20), 8), 0]) == 4
+
+
+class TestSingleReplica:
+    def test_r1_cluster_commits_alone(self):
+        cfg = RaftConfig(n_replicas=1, entry_bytes=8, batch_size=4, log_capacity=32)
+        comm = SingleDeviceComm(1)
+        state = init_state(cfg)
+        state, vi = vote_step(comm, state, jnp.int32(0), jnp.int32(1), jnp.ones(1, bool))
+        assert int(vi.votes) == 1
+        state, info = replicate_step(
+            comm, state, batch([1, 2, 3, 4], rows=1), jnp.int32(4),
+            jnp.int32(0), jnp.int32(1), jnp.ones(1, bool), jnp.zeros(1, bool),
+        )
+        assert int(info.commit_index) == 4

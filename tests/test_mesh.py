@@ -1,0 +1,89 @@
+"""Mesh-transport tests: the same protocol program sharded one replica row
+per device over a ``replica`` mesh axis (virtual CPU devices in CI;
+SURVEY.md §4 "multi-replica without hardware")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.transport import SingleDeviceTransport, TpuMeshTransport
+
+
+def batch(vals, rows, entry=8):
+    b = jnp.asarray(vals, jnp.uint8)[None, :, None]
+    return jnp.broadcast_to(b, (rows, len(vals), entry))
+
+
+@pytest.fixture(params=[3, 5])
+def cfg(request):
+    return RaftConfig(
+        n_replicas=request.param, entry_bytes=8, batch_size=4, log_capacity=64
+    )
+
+
+def test_mesh_matches_single_device(cfg):
+    """Identical trajectories on the resident and mesh layouts."""
+    n = cfg.n_replicas
+    mesh_t = TpuMeshTransport(cfg, jax.devices()[:n])
+    single_t = SingleDeviceTransport(cfg)
+    alive = jnp.ones(n, bool)
+    slow = jnp.zeros(n, bool)
+    slow1 = slow.at[n - 1].set(True)
+
+    states = {"mesh": mesh_t.init(), "single": single_t.init()}
+    infos = {}
+    for name, t in (("mesh", mesh_t), ("single", single_t)):
+        s = states[name]
+        s, _ = t.request_votes(s, 0, 1, alive)
+        s, _ = t.replicate(s, batch([1, 2, 3, 4], n), 4, 0, 1, alive, slow)
+        s, _ = t.replicate(s, batch([5, 6, 0, 0], n), 2, 0, 1, alive, slow1)
+        s, info = t.replicate(s, batch([0] * 4, n), 0, 0, 1, alive, slow)
+        states[name], infos[name] = s, info
+
+    for field in ("commit_index", "match", "max_term"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(infos["mesh"], field)),
+            np.asarray(getattr(infos["single"], field)),
+        )
+    for r in range(n):
+        np.testing.assert_array_equal(
+            np.asarray(states["mesh"].log_payload[r, :6]),
+            np.asarray(states["single"].log_payload[r, :6]),
+        )
+    assert int(infos["mesh"].commit_index) == 6
+
+
+def test_mesh_election_quorum(cfg):
+    n = cfg.n_replicas
+    t = TpuMeshTransport(cfg, jax.devices()[:n])
+    state = t.init()
+    state, info = t.request_votes(state, 2, 1, jnp.ones(n, bool))
+    assert int(info.votes) == n
+    state, info = t.request_votes(state, 0, 1, jnp.ones(n, bool))
+    assert int(info.votes) == 0  # term-1 votes (incl. 0's own) already bound to 2
+    state, info = t.request_votes(state, 0, 2, jnp.ones(n, bool))
+    assert int(info.votes) == n  # fresh term resets voted_for
+
+
+def test_mesh_scan_replication(cfg):
+    """T steps fused into one compiled scan on the mesh."""
+    n = cfg.n_replicas
+    t = TpuMeshTransport(cfg, jax.devices()[:n])
+    state = t.init()
+    state, _ = t.request_votes(state, 0, 1, jnp.ones(n, bool))
+    T, B = 5, cfg.batch_size
+    payloads = jnp.broadcast_to(
+        jnp.arange(T * B, dtype=jnp.uint8).reshape(T, 1, B, 1),
+        (T, n, B, cfg.entry_bytes),
+    )
+    counts = jnp.full((T,), B, jnp.int32)
+    state, infos = t.replicate_many(
+        state, payloads, counts, 0, 1, jnp.ones(n, bool), jnp.zeros(n, bool)
+    )
+    assert list(np.asarray(infos.commit_index)) == [B * (i + 1) for i in range(T)]
+    np.testing.assert_array_equal(
+        np.asarray(state.log_payload[n - 1, : T * B, 0]),
+        np.arange(T * B, dtype=np.uint8),
+    )

@@ -1,0 +1,48 @@
+"""Quorum kernel unit tests (paper rule vs reference exact-bucket rule)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.quorum import (
+    commit_from_match,
+    majority,
+    reference_bucket_commit,
+    vote_majority,
+)
+
+
+def test_majority():
+    assert majority(1) == 1
+    assert majority(3) == 2
+    assert majority(5) == 3
+
+
+def test_commit_from_match_kth_largest():
+    assert int(commit_from_match(jnp.array([4, 4, 4]))) == 4
+    assert int(commit_from_match(jnp.array([4, 4, 0]))) == 4
+    assert int(commit_from_match(jnp.array([4, 2, 0]))) == 2
+    assert int(commit_from_match(jnp.array([9, 7, 5, 3, 1]))) == 5
+    assert int(commit_from_match(jnp.array([9, 9, 0, 0, 0]))) == 0
+
+
+def test_reference_bucket_rule_stalls_on_disagreement():
+    """The reference commits only when a strict majority of the *cluster*
+    holds the exact same matchIndex (main.go:382-391): followers at
+    different offsets stall it, while the paper rule advances."""
+    prev = jnp.int32(0)
+    # 3-node cluster, followers at 4 and 2: bucket rule stalls
+    assert int(reference_bucket_commit(jnp.array([4, 2]), 3, prev)) == 0
+    assert int(commit_from_match(jnp.array([5, 4, 2]))) == 4
+    # followers agree at 4: both advance
+    assert int(reference_bucket_commit(jnp.array([4, 4]), 3, prev)) == 4
+
+
+def test_reference_bucket_rule_never_regresses():
+    assert int(reference_bucket_commit(jnp.array([2, 2]), 3, jnp.int32(3))) == 3
+
+
+def test_vote_majority():
+    assert bool(vote_majority(jnp.int32(2), 3))
+    assert not bool(vote_majority(jnp.int32(1), 3))
+    assert not bool(vote_majority(jnp.int32(2), 5))
+    assert bool(vote_majority(jnp.int32(3), 5))
