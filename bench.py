@@ -1,0 +1,98 @@
+"""Headline benchmark: batched replication commit latency on one chip.
+
+BASELINE config 2 shape — 3 replicas, batched AppendEntries (batch=1024,
+256 B entries), quorum commit — run as the device-resident pipeline
+(``lax.scan`` over replication steps, no host round-trip per batch,
+SURVEY.md §7 hard part 1). Each step ingests, replicates, and quorum-commits
+one 1024-entry batch, so per-step wall time IS the commit latency of a batch.
+
+The reference's implied commit latency is ~2 s (an entry waits for the next
+replication tick, main.go:394; BASELINE.md "commit latency (implied)").
+``vs_baseline`` reports the speedup over that: 2e6 µs / our p50.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": "commit_p50_latency", "value": <p50 µs>, "unit": "us",
+   "vs_baseline": <speedup over the 2 s reference tick>, ...extras}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.comm import SingleDeviceComm
+from raft_tpu.core.state import init_state
+from raft_tpu.core.step import scan_replicate
+
+REFERENCE_TICK_US = 2_000_000.0  # main.go:394 — 2 s replication tick
+
+
+def main(steps_per_chunk: int = 64, chunks: int = 16) -> None:
+    cfg = RaftConfig()  # 3 replicas, 256 B entries, batch 1024
+    comm = SingleDeviceComm(cfg.n_replicas)
+    fn = jax.jit(
+        partial(scan_replicate, comm, cfg.ec_enabled), donate_argnums=(0,)
+    )
+
+    state = init_state(cfg)
+    alive = jnp.ones((cfg.n_replicas,), bool)
+    slow = jnp.zeros((cfg.n_replicas,), bool)
+    leader, leader_term = jnp.int32(0), jnp.int32(1)
+
+    rng = np.random.default_rng(cfg.seed)
+    payloads = jnp.asarray(
+        rng.integers(
+            0,
+            256,
+            (steps_per_chunk, cfg.n_replicas, cfg.batch_size, cfg.entry_bytes),
+            dtype=np.uint8,
+        )
+    )
+    counts = jnp.full((steps_per_chunk,), cfg.batch_size, jnp.int32)
+
+    # Warmup / compile (first TPU compile is slow; later calls hit the cache).
+    state, info = fn(state, payloads, counts, leader, leader_term, alive, slow)
+    jax.block_until_ready(info)
+
+    per_step_us = []
+    for _ in range(chunks):
+        t0 = time.perf_counter()
+        state, info = fn(state, payloads, counts, leader, leader_term, alive, slow)
+        jax.block_until_ready(info)
+        dt = time.perf_counter() - t0
+        per_step_us.append(dt / steps_per_chunk * 1e6)
+
+    committed = int(info.commit_index[-1])
+    expect = (chunks + 1) * steps_per_chunk * cfg.batch_size
+    assert committed == expect, f"commit_index {committed} != {expect}"
+
+    p50 = float(np.percentile(per_step_us, 50))
+    p99 = float(np.percentile(per_step_us, 99))
+    entries_per_s = cfg.batch_size / (float(np.mean(per_step_us)) / 1e6)
+    print(
+        json.dumps(
+            {
+                "metric": "commit_p50_latency",
+                "value": round(p50, 3),
+                "unit": "us",
+                "vs_baseline": round(REFERENCE_TICK_US / p50, 1),
+                "p99_us": round(p99, 3),
+                "entries_per_sec": round(entries_per_s, 1),
+                "batch": cfg.batch_size,
+                "entry_bytes": cfg.entry_bytes,
+                "n_replicas": cfg.n_replicas,
+                "backend": jax.devices()[0].platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -56,9 +56,19 @@ class RaftConfig:
     # tests) is not a device transport — see raft_tpu.golden.
     transport: str = "tpu_mesh"
 
+    # --- payload-byte sharding (second mesh axis, tpu_mesh only) ---
+    # Each log slot's bytes are split over this many devices (the
+    # long-dimension / sequence-parallel analogue); needs
+    # n_replicas * payload_shards devices.
+    payload_shards: int = 1
+
     def __post_init__(self):
-        if self.n_replicas < 1 or self.n_replicas % 2 == 0:
-            raise ValueError("n_replicas must be odd and >= 1")
+        if self.n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        # Odd cluster sizes are the useful ones (an even cluster tolerates no
+        # more failures than the next odd size down) but even sizes are valid
+        # Raft (majority = n//2 + 1) and arise when a mesh has an even device
+        # count, so they are allowed rather than rejected.
         if self.batch_size < 1 or self.batch_size > self.log_capacity:
             raise ValueError("batch_size must be in [1, log_capacity]")
         if (self.rs_k is None) != (self.rs_m is None):
@@ -68,10 +78,18 @@ class RaftConfig:
                 raise ValueError("RS(n,k): k+m must equal n_replicas")
             if self.entry_bytes % self.rs_k != 0:
                 raise ValueError("entry_bytes must be divisible by rs_k")
+        if self.payload_shards < 1:
+            raise ValueError("payload_shards must be >= 1")
+        if self.shard_bytes % self.payload_shards:
+            raise ValueError(
+                "per-entry stored bytes must divide evenly over payload_shards"
+            )
 
     @property
     def majority(self) -> int:
-        return self.n_replicas // 2 + 1
+        from raft_tpu.quorum.commit import majority
+
+        return majority(self.n_replicas)
 
     @property
     def ec_enabled(self) -> bool:

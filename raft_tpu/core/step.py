@@ -136,7 +136,18 @@ def replicate_step(
     # A deposed leader (its own term already past leader_term) must not
     # ingest: those entries would carry a stale term.
     leader_current = legit & (comm.all_gather(term0)[leader] <= leader_term)
-    frontier_count = jnp.where(leader_current, client_count, 0)
+    # Ring backpressure: ingest may only overwrite slots of *committed*
+    # entries (committed = consumed; that is the ring's contract). Without
+    # this, a stalled quorum would let the frontier lap uncommitted entries
+    # and destroy them. The reference has no such pressure point — its log
+    # is an unbounded Go slice (main.go:148) — but a fixed-capacity device
+    # ring (SURVEY.md §7 hard part 2) must enforce it.
+    leader_last0 = comm.all_gather(state.last_index)[leader]
+    leader_commit0 = comm.all_gather(state.commit_index)[leader]
+    room = cap - (leader_last0 - leader_commit0)
+    frontier_count = jnp.where(
+        leader_current, jnp.minimum(client_count, jnp.maximum(room, 0)), 0
+    )
     ingest_row = is_leader_row & leader_current
     ingest_mask = ingest_row[:, None] & (barange < frontier_count)[None, :]
     ingest_pos = slot_of(state.last_index[:, None] + 1 + barange[None, :], cap)
@@ -149,7 +160,8 @@ def replicate_step(
         jnp.where(ingest_mask, leader_term, cur_t)
     )
     last_index = state.last_index + jnp.where(ingest_row, frontier_count, 0)
-    frontier_start = comm.all_gather(state.last_index)[leader] + 1
+    frontier_start = leader_last0 + 1
+    leader_last = leader_last0 + frontier_count            # post-ingest
 
     # ---- 2. Verified match bookkeeping ------------------------------------
     # match_index is only meaningful for the term it was verified in; a new
@@ -158,9 +170,6 @@ def replicate_step(
     heard = alive_l & legit & (leader_term >= term0)       # reject stale leader
     m_eff = jnp.where(state.match_term == leader_term, state.match_index, 0)
     m_eff = jnp.where(is_leader_row & leader_current, last_index, m_eff)
-
-    lasts = comm.all_gather(last_index)
-    leader_last = lasts[leader]
 
     def materialize(ws):
         """Window [ws, ws+B) of the leader's log, broadcast to every row."""
@@ -218,9 +227,18 @@ def replicate_step(
         return (log_term, log_payload, last_index, m_eff)
 
     # ---- 3. Repair window: heal the slowest live verified match -----------
+    # The window is clamped to the leader's ring horizon — the oldest index
+    # whose slot has not been overwritten. A replica lagging by >= capacity
+    # cannot be log-healed (its next window's prev-check fails, so it stalls
+    # rather than accepting wrapped bytes); it needs snapshot install
+    # (checkpoint subsystem) to rejoin, exactly like Raft's InstallSnapshot
+    # after log compaction.
     matches0 = comm.all_gather(m_eff)                      # i32[R]
     repair_mask = alive & ~slow
-    repair_ws = jnp.min(jnp.where(repair_mask, matches0, leader_last)) + 1
+    horizon = jnp.maximum(leader_last - cap + 1, 1)
+    repair_ws = jnp.maximum(
+        jnp.min(jnp.where(repair_mask, matches0, leader_last)) + 1, horizon
+    )
     repair_count = jnp.where(
         legit, jnp.clip(leader_last - repair_ws + 1, 0, B), 0
     )
@@ -266,10 +284,9 @@ def replicate_step(
     commit_cand = commit_from_match(match)
     cand_slot = slot_of(jnp.maximum(commit_cand, 1), cap)
     cand_term = comm.select_row(log_term[:, cand_slot], leader)
-    commit_prev = comm.all_gather(state.commit_index)[leader]
     commit_ok = legit & (commit_cand >= 1) & (cand_term == leader_term)
     global_commit = jnp.where(
-        commit_ok, jnp.maximum(commit_prev, commit_cand), commit_prev
+        commit_ok, jnp.maximum(leader_commit0, commit_cand), leader_commit0
     )
 
     # Followers advance to min(leaderCommit, verified match) — never over an

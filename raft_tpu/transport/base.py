@@ -61,10 +61,13 @@ def make_transport(cfg: RaftConfig, devices=None) -> "Transport":
 
     if cfg.transport == "tpu_mesh":
         devices = devices if devices is not None else jax.devices()
-        if len(devices) >= cfg.n_replicas:
-            return TpuMeshTransport(cfg, devices[: cfg.n_replicas])
-        # Fewer chips than replicas: fall back to the resident layout (the
-        # program is the same; the replica axis just isn't sharded).
+        need = cfg.n_replicas * cfg.payload_shards
+        if len(devices) >= need:
+            return TpuMeshTransport(
+                cfg, devices[:need], payload_shards=cfg.payload_shards
+            )
+        # Fewer chips than the mesh needs: fall back to the resident layout
+        # (the program is the same; the replica axis just isn't sharded).
         return SingleDeviceTransport(cfg)
     if cfg.transport == "single":
         return SingleDeviceTransport(cfg)

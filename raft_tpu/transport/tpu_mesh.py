@@ -10,6 +10,14 @@ collectives riding ICI (SURVEY.md §5 "distributed communication backend").
 The program body is byte-identical to the single-device transport
 (``core.step``); only ``Comm`` and placement change — which is exactly the
 property the differential tests rely on.
+
+A second, optional mesh axis (``pshard``) shards the payload *byte*
+dimension, the framework's long-dimension/sequence-parallel analogue: every
+log slot's bytes are split across ``payload_shards`` devices, so per-device
+HBM for the log shrinks by that factor and the replication windows move
+byte-slices in parallel. The protocol kernels never reduce over the byte
+axis, so they run unchanged on the 2-D mesh — replica collectives ride one
+axis, the byte axis stays local.
 """
 
 from __future__ import annotations
@@ -34,26 +42,43 @@ from raft_tpu.core.step import (
 )
 
 AXIS = "replica"
+PAYLOAD_AXIS = "pshard"
 
 
 class TpuMeshTransport:
-    def __init__(self, cfg: RaftConfig, devices: Sequence[jax.Device] | None = None):
+    def __init__(
+        self,
+        cfg: RaftConfig,
+        devices: Sequence[jax.Device] | None = None,
+        payload_shards: int | None = None,
+    ):
         self.cfg = cfg
+        if payload_shards is None:
+            payload_shards = cfg.payload_shards
         devices = list(devices) if devices is not None else jax.devices()
-        if len(devices) < cfg.n_replicas:
+        need = cfg.n_replicas * payload_shards
+        if len(devices) < need:
             raise ValueError(
-                f"need {cfg.n_replicas} devices for one replica row each, "
-                f"got {len(devices)}"
+                f"need {need} devices ({cfg.n_replicas} replicas x "
+                f"{payload_shards} payload shards), got {len(devices)}"
             )
-        self.mesh = Mesh(np.array(devices[: cfg.n_replicas]), (AXIS,))
+        if cfg.shard_bytes % payload_shards:
+            raise ValueError(
+                f"per-entry stored bytes ({cfg.shard_bytes}) must divide "
+                f"evenly over {payload_shards} payload shards"
+            )
+        self.payload_shards = payload_shards
+        grid = np.array(devices[:need]).reshape(cfg.n_replicas, payload_shards)
+        self.mesh = Mesh(grid, (AXIS, PAYLOAD_AXIS))
+        pax = PAYLOAD_AXIS if payload_shards > 1 else None
         self._row = NamedSharding(self.mesh, P(AXIS))
-        self._rep = NamedSharding(self.mesh, P())
+        self._payload3 = NamedSharding(self.mesh, P(AXIS, None, pax))
         comm = MeshComm(cfg.n_replicas, AXIS)
 
         state_specs = ReplicaState(
             term=P(AXIS), voted_for=P(AXIS), last_index=P(AXIS),
             commit_index=P(AXIS), match_index=P(AXIS), match_term=P(AXIS),
-            log_term=P(AXIS), log_payload=P(AXIS),
+            log_term=P(AXIS), log_payload=P(AXIS, None, pax),
         )
         info_specs = RepInfo(
             commit_index=P(), match=P(), max_term=P(),
@@ -65,7 +90,7 @@ class TpuMeshTransport:
             jax.shard_map(
                 partial(replicate_step, comm, ec=cfg.ec_enabled),
                 mesh=self.mesh,
-                in_specs=(state_specs, P(AXIS), P(), P(), P(), P(), P()),
+                in_specs=(state_specs, P(AXIS, None, pax), P(), P(), P(), P(), P()),
                 out_specs=(state_specs, info_specs),
                 check_vma=False,
             )
@@ -83,7 +108,9 @@ class TpuMeshTransport:
             jax.shard_map(
                 partial(scan_replicate, comm, cfg.ec_enabled),
                 mesh=self.mesh,
-                in_specs=(state_specs, P(None, AXIS), P(), P(), P(), P(), P()),
+                in_specs=(
+                    state_specs, P(None, AXIS, None, pax), P(), P(), P(), P(), P(),
+                ),
                 out_specs=(state_specs, info_specs),
                 check_vma=False,
             )
@@ -91,12 +118,18 @@ class TpuMeshTransport:
 
     def init(self) -> ReplicaState:
         state = init_state(self.cfg)
-        return jax.device_put(state, self._row)
+        shardings = ReplicaState(
+            term=self._row, voted_for=self._row, last_index=self._row,
+            commit_index=self._row, match_index=self._row, match_term=self._row,
+            log_term=NamedSharding(self.mesh, P(AXIS, None)),
+            log_payload=self._payload3,
+        )
+        return jax.tree.map(jax.device_put, state, shardings)
 
     def shard_rows(self, payload):
         """Place a u8[R, B, S] per-replica payload one row per device (the
         'scatter' of the north star when rows are RS shards)."""
-        return jax.device_put(payload, self._row)
+        return jax.device_put(payload, self._payload3)
 
     def replicate(
         self, state, client_payload, client_count, leader, leader_term, alive, slow

@@ -253,3 +253,41 @@ class TestSingleReplica:
             jnp.int32(0), jnp.int32(1), jnp.ones(1, bool), jnp.zeros(1, bool),
         )
         assert int(info.commit_index) == 4
+
+
+class TestRingGuards:
+    """Fixed-capacity ring safety: backpressure + horizon clamp.
+
+    The reference's log is an unbounded Go slice (main.go:148); a
+    fixed-capacity device ring must (a) never overwrite uncommitted entries
+    and (b) never repair a replica from slots the frontier has lapped
+    (SURVEY.md §7 hard part 2).
+    """
+
+    def test_ingest_backpressure_when_quorum_stalled(self):
+        # Only the leader is alive: nothing can commit, so ingest must stop
+        # once the ring holds `capacity` uncommitted entries.
+        state = init_state(CFG)
+        state, _ = vote(state, 0, 1)
+        only0 = jnp.array([True, False, False])
+        steps = CFG.log_capacity // CFG.batch_size + 3
+        for _ in range(steps):
+            state, info = rep(state, batch([7] * 4), 4, alive=only0)
+        assert int(info.commit_index) == 0
+        assert int(state.last_index[0]) == CFG.log_capacity  # clamped, no lap
+
+    def test_lapped_replica_stalls_instead_of_corrupting(self):
+        # Follower 2 sleeps while the frontier wraps the ring; when it wakes
+        # its verified match must stay 0 (prev-check fails at the horizon)
+        # rather than accepting wrapped bytes as the old prefix.
+        state = init_state(CFG)
+        state, _ = vote(state, 0, 1)
+        slow2 = jnp.array([False, False, True])
+        steps = CFG.log_capacity // CFG.batch_size + 2  # lap slot 1
+        for i in range(steps):
+            state, info = rep(state, batch([i % 251 + 1] * 4), 4, slow=slow2)
+        assert int(info.commit_index) == steps * 4      # quorum of {0,1}
+        state, info = rep(state, batch([0] * 4), 0)     # 2 wakes (heartbeat)
+        assert int(info.match[2]) == 0                  # stalled, not healed
+        # and its log was not scribbled with wrapped entries
+        assert int(state.last_index[2]) == 0

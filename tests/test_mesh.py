@@ -16,17 +16,23 @@ def batch(vals, rows, entry=8):
     return jnp.broadcast_to(b, (rows, len(vals), entry))
 
 
-@pytest.fixture(params=[3, 5])
+@pytest.fixture(params=[(3, 1), (5, 1), (3, 2), (4, 2)])
 def cfg(request):
+    n, ps = request.param
     return RaftConfig(
-        n_replicas=request.param, entry_bytes=8, batch_size=4, log_capacity=64
+        n_replicas=n,
+        entry_bytes=8,
+        batch_size=4,
+        log_capacity=64,
+        payload_shards=ps,
     )
 
 
 def test_mesh_matches_single_device(cfg):
-    """Identical trajectories on the resident and mesh layouts."""
+    """Identical trajectories on the resident and mesh layouts — including
+    the 2-D mesh (payload bytes sharded over the ``pshard`` axis)."""
     n = cfg.n_replicas
-    mesh_t = TpuMeshTransport(cfg, jax.devices()[:n])
+    mesh_t = TpuMeshTransport(cfg, jax.devices()[: n * cfg.payload_shards])
     single_t = SingleDeviceTransport(cfg)
     alive = jnp.ones(n, bool)
     slow = jnp.zeros(n, bool)
@@ -57,7 +63,7 @@ def test_mesh_matches_single_device(cfg):
 
 def test_mesh_election_quorum(cfg):
     n = cfg.n_replicas
-    t = TpuMeshTransport(cfg, jax.devices()[:n])
+    t = TpuMeshTransport(cfg, jax.devices()[: n * cfg.payload_shards])
     state = t.init()
     state, info = t.request_votes(state, 2, 1, jnp.ones(n, bool))
     assert int(info.votes) == n
@@ -70,7 +76,7 @@ def test_mesh_election_quorum(cfg):
 def test_mesh_scan_replication(cfg):
     """T steps fused into one compiled scan on the mesh."""
     n = cfg.n_replicas
-    t = TpuMeshTransport(cfg, jax.devices()[:n])
+    t = TpuMeshTransport(cfg, jax.devices()[: n * cfg.payload_shards])
     state = t.init()
     state, _ = t.request_votes(state, 0, 1, jnp.ones(n, bool))
     T, B = 5, cfg.batch_size
