@@ -1,0 +1,25 @@
+"""Golden model: the reference's semantics as a host-side oracle.
+
+A pure-Python re-expression of /root/reference/main.go's message-level
+behavior (SURVEY.md §4 "golden model"), driven by a seeded virtual-clock
+scheduler, used by the differential tests to check that the device path's
+*committed log* is byte-identical (the north-star acceptance criterion).
+"""
+
+from raft_tpu.golden.model import (
+    AppendEntriesRequest,
+    AppendEntriesResponse,
+    GoldenCluster,
+    GoldenNode,
+    VoteRequest,
+    VoteResponse,
+)
+
+__all__ = [
+    "AppendEntriesRequest",
+    "AppendEntriesResponse",
+    "GoldenCluster",
+    "GoldenNode",
+    "VoteRequest",
+    "VoteResponse",
+]

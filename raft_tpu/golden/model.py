@@ -1,0 +1,392 @@
+"""The reference's Raft, re-expressed as a deterministic host-side oracle.
+
+This is a behavioral port of /root/reference/main.go at the *message* level:
+the same state fields, the same request/response schemas, and the same
+handler logic — including the reference's deliberate deviations from the
+Raft paper, which the differential tests must reproduce, not fix
+(SURVEY.md §2 "protocol semantics in detail"):
+
+- blind append with no conflict truncation (main.go:148);
+- commit advance ``min(LeaderCommit, len(log) + 1)`` with its ``+1``
+  (main.go:151-154);
+- a sticky ``voted`` bool instead of per-term ``votedFor`` (main.go:160,
+  never reset on term advance — the only reset is a leader stepping down,
+  main.go:318);
+- no §5.4.1 up-to-date check (LastLogIndex/LastLogTerm are carried but
+  never filled or read, main.go:185-186, 264);
+- followers self-report their match point in every response and the leader
+  jumps straight to it (main.go:301, 375-378);
+- the exact-bucket commit rule over follower match indices only
+  (main.go:381-391).
+
+The one reference behavior deliberately *not* ported is the main.go:242
+bug (a candidate denying a competing vote writes the rejection into its
+own response channel, corrupting its next count) — SURVEY.md §2 marks it a
+defect to exclude from the oracle.
+
+Scheduling: the reference runs one goroutine per node with blocking
+channel round-trips (send to peer, immediately block on own response
+channel — main.go:259-269, 334-379). Because every request is followed by
+a synchronous wait for exactly one reply, the observable semantics are
+those of an atomic RPC; the oracle models it as a direct handler call.
+Timers (election timeouts, the 2 s leader tick, the 10 s client period)
+run on a seeded virtual clock, so every run is replayable (SURVEY.md §7
+hard part 4: deterministic schedules for byte-identical comparison).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import random
+from typing import Callable, Dict, List, Optional, Tuple
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+@dataclasses.dataclass
+class LogEntry:
+    """main.go:46-49 — the reference payload is one int; here raw bytes so
+    the differential test can compare against 256 B device entries."""
+
+    term: int
+    payload: bytes
+
+
+@dataclasses.dataclass
+class VoteRequest:          # main.go:182-187
+    term: int
+    candidate_id: str
+    last_log_index: int = 0  # schema'd but never filled by the reference
+    last_log_term: int = 0
+
+
+@dataclasses.dataclass
+class VoteResponse:         # main.go:188-191
+    term: int
+    vote: bool
+
+
+@dataclasses.dataclass
+class AppendEntriesRequest:  # main.go:289-296
+    term: int
+    leader_id: str
+    logs: List[LogEntry]
+    leader_commit: int
+    prev_log_index: int
+    prev_log_term: int
+
+
+@dataclasses.dataclass
+class AppendEntriesResponse:  # main.go:298-302
+    term: int
+    success: bool
+    match_index: int
+
+
+class GoldenNode:
+    """One replica's state + handlers (the reference's ``Node``,
+    main.go:14-39, with the role handlers' message logic)."""
+
+    def __init__(self, node_id: str, trace: Optional[Callable[[str], None]] = None):
+        self.id = node_id
+        self.state = FOLLOWER          # main.go:61
+        self.term = 0
+        self.voted = False             # the reference's sticky bool
+        self.log: List[LogEntry] = []
+        self.commit_index = 0
+        self.last_applied = 0          # used as "last log index" (SURVEY §2)
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+        self._trace = trace
+
+    # -- observability: the reference's nodelog format (main.go:399-401) ----
+    def nodelog(self, message: str) -> str:
+        line = (
+            f"[{self.id}:{self.term}:{self.commit_index}:{self.last_applied}]"
+            f"[{self.state}]{message}"
+        )
+        if self._trace:
+            self._trace(line)
+        return line
+
+    # -- log accessors (1-indexed, main.go:403-409) -------------------------
+    def get_log(self, index: int) -> LogEntry:
+        return self.log[index - 1]
+
+    def get_logs_from(self, index: int) -> List[LogEntry]:
+        return self.log[index - 1 :]
+
+    # -- follower/candidate message handlers --------------------------------
+    def handle_append_entries(self, r: AppendEntriesRequest) -> AppendEntriesResponse:
+        """Follower AppendEntries logic, main.go:121-156 (quirks preserved)."""
+        self.nodelog(f"AppendEntriesRequest received from {r.leader_id}")
+        if r.term < self.term:                       # main.go:129-133
+            return AppendEntriesResponse(self.term, False, self.last_applied)
+        if self.state == LEADER:
+            # A leader hearing an equal-term AppendEntries refuses and stays
+            # (main.go:322-326); a higher term makes it step down and ack
+            # (main.go:309-321).
+            if r.term == self.term:
+                return AppendEntriesResponse(self.term, False, self.last_applied)
+            self.step_down(r.term)
+            return AppendEntriesResponse(self.term, True, self.last_applied)
+        if self.state == CANDIDATE:
+            # A candidate steps down on >=-term AppendEntries (main.go:204-217).
+            self.state = FOLLOWER
+            self.term = r.term
+            self.nodelog("step down to follower (AppendEntries received)")
+        if self.last_applied > 0:                    # main.go:135-146
+            if self.last_applied + len(r.logs) < r.prev_log_index:
+                return AppendEntriesResponse(self.term, False, self.last_applied)
+            if self.get_log(r.prev_log_index).term != r.prev_log_term:
+                return AppendEntriesResponse(self.term, False, self.last_applied)
+        self.log.extend(r.logs)                      # blind append, main.go:148
+        self.last_applied += len(r.logs)             # main.go:149
+        if r.leader_commit > self.commit_index:      # main.go:151-154 (the +1
+            self.commit_index = min(r.leader_commit, len(self.log) + 1)
+        self.term = r.term                           # main.go:155
+        return AppendEntriesResponse(self.term, True, self.last_applied)
+
+    def handle_request_vote(self, r: VoteRequest) -> VoteResponse:
+        """Vote logic, main.go:157-170 (follower) / 224-246 (candidate)."""
+        if self.state == CANDIDATE:
+            # Candidate grants only to a strictly-higher-term candidate
+            # (main.go:227-239); the equal/lower-term denial's main.go:242
+            # self-delivery bug is NOT ported (SURVEY.md §2).
+            if r.term > self.term:
+                self.term = r.term
+                self.voted = True
+                self.state = FOLLOWER
+                self.nodelog(f"vote to {r.candidate_id} (higher term); step down")
+                return VoteResponse(self.term, True)
+            return VoteResponse(self.term, False)
+        if r.term < self.term or self.voted:         # main.go:160
+            self.nodelog(f"vote request denied to {r.candidate_id}")
+            return VoteResponse(self.term, False)
+        self.term = r.term                           # main.go:168
+        self.voted = True
+        self.nodelog(f"voted to {r.candidate_id}")
+        return VoteResponse(self.term, True)
+
+    def step_down(self, term: int) -> None:
+        """Leader -> follower on higher-term AppendEntries (main.go:312-321)
+        — the only place the reference resets ``voted``."""
+        self.state = FOLLOWER
+        self.voted = False
+        self.term = term
+        self.nodelog("step down to follower")
+
+    # -- client ingest (leader only), main.go:327-331 -----------------------
+    def client_append(self, payload: bytes) -> None:
+        self.log.append(LogEntry(self.term, payload))
+        self.last_applied += 1
+        self.nodelog("new log received")
+
+    def committed_payloads(self) -> List[bytes]:
+        """The committed prefix — the differential-test join key. The
+        reference's commit_index can point one past the log (its +1 quirk);
+        the prefix is what exists."""
+        return [e.payload for e in self.log[: min(self.commit_index, len(self.log))]]
+
+
+class GoldenCluster:
+    """All nodes + the seeded virtual-clock scheduler.
+
+    Events reproduce the reference's timers: follower election timeout
+    uniform 10-29 s inclusive (main.go:114), candidate re-election timeout
+    10-13 s (main.go:194), leader tick 2 s (main.go:394), client inject
+    10 s (main.go:89). ``rng`` draws make every schedule replayable.
+    """
+
+    def __init__(
+        self,
+        n_nodes: int = 3,
+        seed: int = 0,
+        trace: Optional[Callable[[str], None]] = None,
+    ):
+        self.rng = random.Random(seed)
+        self.nodes: Dict[str, GoldenNode] = {
+            f"Server{i}": GoldenNode(f"Server{i}", trace) for i in range(n_nodes)
+        }
+        self.now = 0.0
+        self._q: List[Tuple[float, int, str, str]] = []  # (t, seq, kind, node)
+        self._seq = 0
+        self._timer_gen: Dict[str, int] = {n: 0 for n in self.nodes}
+        self.client_values: List[bytes] = []   # injection queue (see inject())
+        for name in self.nodes:
+            self._arm_follower_timeout(name)
+
+    # -- scheduling ---------------------------------------------------------
+    def _push(self, t: float, kind: str, node: str) -> None:
+        heapq.heappush(self._q, (t, self._seq, kind, node))
+        self._seq += 1
+
+    def _arm_follower_timeout(self, name: str) -> None:
+        # rand.Intn(20) + 10 seconds, inclusive ints (main.go:114)
+        self._timer_gen[name] += 1
+        dt = float(self.rng.randint(10, 29))
+        self._push(self.now + dt, f"etimer:{self._timer_gen[name]}", name)
+
+    def _arm_candidate_timeout(self, name: str) -> None:
+        # rand.Intn(4) + 10 (main.go:194)
+        self._timer_gen[name] += 1
+        dt = float(self.rng.randint(10, 13))
+        self._push(self.now + dt, f"ctimer:{self._timer_gen[name]}", name)
+
+    def inject(self, payload: bytes) -> None:
+        """Queue one client entry; delivered to every self-identified leader
+        at the next client tick (main.go:87-95 pushes to all Leader-state
+        nodes)."""
+        self.client_values.append(payload)
+
+    # -- the role bodies that need the cluster (send/recv) ------------------
+    def _campaign(self, cand: GoldenNode) -> None:
+        """One election round: vote for self then poll every peer
+        synchronously (main.go:253-284)."""
+        count = 1
+        cand.voted = True                            # main.go:255-256
+        for name, peer in self.nodes.items():
+            if name == cand.id or cand.state != CANDIDATE:
+                continue
+            res = peer.handle_request_vote(
+                VoteRequest(cand.term, cand.id)      # fields as sent, main.go:264
+            )
+            if res.vote:
+                count += 1
+        if cand.state != CANDIDATE:
+            return
+        if count > len(self.nodes) / 2:              # main.go:273
+            cand.state = LEADER
+            cand.nodelog("state changed to leader")
+            for name in self.nodes:                  # main.go:275-284
+                if name != cand.id:
+                    cand.match_index[name] = 0
+                    cand.next_index[name] = 1
+            self._push(self.now, "ltick", cand.id)
+
+    def _leader_tick(self, leader: GoldenNode) -> None:
+        """One pass of the leader default branch (main.go:332-395)."""
+        for name, peer in self.nodes.items():
+            if name == leader.id:
+                continue
+            ni = leader.next_index[name]
+            if ni == 1 and leader.last_applied > 0:  # never synced: full log
+                req = AppendEntriesRequest(          # main.go:343-351
+                    leader.term, leader.id, list(leader.log),
+                    leader.commit_index, 0, 0,
+                )
+            elif 1 < ni <= leader.last_applied:      # behind: suffix
+                mi = leader.match_index[name]
+                req = AppendEntriesRequest(          # main.go:352-361
+                    leader.term, leader.id, leader.get_logs_from(ni),
+                    leader.commit_index, mi,
+                    leader.get_log(mi).term if mi > 0 else 0,
+                )
+            else:                                    # up to date: heartbeat
+                req = AppendEntriesRequest(          # main.go:362-372
+                    leader.term, leader.id, [], leader.commit_index,
+                    leader.last_applied,
+                    leader.get_log(leader.last_applied).term
+                    if leader.last_applied > 0
+                    else 0,
+                )
+            res = peer.handle_append_entries(req)    # send + blocking reply
+            if res.success:                          # main.go:375-378
+                leader.match_index[name] = res.match_index
+                leader.next_index[name] = res.match_index + 1
+            elif res.term > leader.term:
+                leader.step_down(res.term)
+                self._arm_follower_timeout(leader.id)
+                return
+        # exact-bucket commit over follower match values (main.go:381-391)
+        counter: Dict[int, int] = {}
+        for mi in leader.match_index.values():
+            counter[mi] = counter.get(mi, 0) + 1
+        for i, v in counter.items():
+            if v > len(self.nodes) // 2 and i > leader.commit_index:
+                leader.commit_index = i
+                leader.nodelog(f"commit index changed to {i}")
+        self._push(self.now + 2.0, "ltick", leader.id)   # main.go:394
+
+    # -- event loop ---------------------------------------------------------
+    def step_event(self) -> bool:
+        """Dispatch one scheduled event; False when the queue is empty."""
+        if not self._q:
+            return False
+        t, _, kind, name = heapq.heappop(self._q)
+        self.now = max(self.now, t)
+        node = self.nodes[name]
+        if kind.startswith("etimer:"):
+            # Election timeout is armed at follower entry and *reset on every
+            # AppendEntries/vote receipt* (main.go:124-127, 162) — the oracle
+            # approximates resets by re-arming stale timers: only the newest
+            # generation fires.
+            gen = int(kind.split(":")[1])
+            if node.state != FOLLOWER or gen != self._timer_gen[name]:
+                return True
+            if self._heard_recently(name):
+                self._arm_follower_timeout(name)
+                return True
+            node.state = CANDIDATE                   # main.go:171-177
+            node.term += 1
+            node.nodelog("state changed to candidate")
+            self._campaign(node)
+            if node.state == CANDIDATE:
+                self._arm_candidate_timeout(name)
+        elif kind.startswith("ctimer:"):
+            gen = int(kind.split(":")[1])
+            if node.state != CANDIDATE or gen != self._timer_gen[name]:
+                return True
+            node.term += 1                           # main.go:248-251
+            self._campaign(node)
+            if node.state == CANDIDATE:
+                self._arm_candidate_timeout(name)
+        elif kind == "ltick":
+            if node.state == LEADER:
+                self._leader_tick(node)
+            else:
+                self._arm_follower_timeout(name)
+        elif kind == "client":
+            # main.go:87-95: push queued values to every Leader-state node.
+            if self.client_values:
+                leaders = [n for n in self.nodes.values() if n.state == LEADER]
+                if leaders:
+                    for v in self.client_values:
+                        for leader in leaders:
+                            leader.client_append(v)
+                    self.client_values.clear()
+            self._push(self.now + 10.0, "client", name)
+        return True
+
+    def _heard_recently(self, name: str) -> bool:
+        """A follower with a live leader keeps having its timer reset; model
+        that as: some leader exists whose next tick precedes this timeout."""
+        return any(n.state == LEADER for n in self.nodes.values())
+
+    def start_client(self) -> None:
+        """Arm the reference's 10 s client loop (main.go:87-95)."""
+        self._push(self.now + 10.0, "client", next(iter(self.nodes)))
+
+    def run_until(self, t: float, max_events: int = 100_000) -> None:
+        for _ in range(max_events):
+            if not self._q or self._q[0][0] > t:
+                break
+            self.step_event()
+        self.now = max(self.now, t)
+
+    def leader(self) -> Optional[GoldenNode]:
+        for n in self.nodes.values():
+            if n.state == LEADER:
+                return n
+        return None
+
+    def run_until_leader(self, limit: float = 600.0) -> GoldenNode:
+        while self.leader() is None and self.now < limit:
+            if not self.step_event():
+                break
+        lead = self.leader()
+        assert lead is not None, "no leader elected within the time limit"
+        return lead

@@ -1,0 +1,141 @@
+"""Golden-model tests + the device-vs-golden differential.
+
+The golden model re-expresses the reference's message-level semantics on a
+seeded virtual clock (raft_tpu.golden.model); the differential test checks
+the north-star acceptance criterion: the device path's *committed log* is
+byte-identical to the oracle's (SURVEY.md §4, BASELINE.json north_star).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import committed_payloads
+from raft_tpu.golden import GoldenCluster
+from raft_tpu.transport import SingleDeviceTransport
+
+ENTRY = 32
+
+
+def inject_and_settle(cluster, payloads):
+    """Queue payloads, then run client tick + enough leader ticks for the
+    reference's deferred replication (comment at main.go:330) to commit and
+    for followers to hear the advanced commit index."""
+    cluster.start_client()
+    for p in payloads:
+        cluster.inject(p)
+    cluster.run_until(cluster.now + 40.0)
+
+
+class TestGoldenModel:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_elects_exactly_one_leader(self, seed):
+        c = GoldenCluster(3, seed=seed)
+        lead = c.run_until_leader()
+        assert sum(n.state == "leader" for n in c.nodes.values()) == 1
+        assert lead.term >= 1
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_commits_are_consistent_prefixes(self, seed):
+        rng = np.random.default_rng(seed)
+        c = GoldenCluster(3, seed=seed)
+        lead = c.run_until_leader()
+        payloads = [rng.integers(0, 256, ENTRY, dtype=np.uint8).tobytes()
+                    for _ in range(5)]
+        inject_and_settle(c, payloads)
+        assert lead.commit_index >= 5
+        committed = {n: node.committed_payloads() for n, node in c.nodes.items()}
+        # every node's committed prefix is a prefix of the leader's
+        lead_c = committed[lead.id]
+        assert lead_c[:5] == payloads
+        for n, cp in committed.items():
+            assert cp == lead_c[: len(cp)], n
+
+    def test_nodelog_format(self):
+        lines = []
+        c = GoldenCluster(3, seed=0, trace=lines.append)
+        c.run_until_leader()
+        # the reference's format: [Id:Term:CommitIndex:LastApplied][state]msg
+        assert any(
+            line.startswith("[Server") and "][" in line for line in lines
+        )
+        lead = c.leader()
+        got = lead.nodelog("hello")
+        assert got == (
+            f"[{lead.id}:{lead.term}:{lead.commit_index}:"
+            f"{lead.last_applied}][leader]hello"
+        )
+
+    def test_sticky_voted_quirk_preserved(self):
+        """The reference never resets ``voted`` on term advance in follower
+        state (main.go:160,168) — the oracle must reproduce that."""
+        from raft_tpu.golden.model import GoldenNode, VoteRequest
+
+        n = GoldenNode("Server0")
+        assert n.handle_request_vote(VoteRequest(1, "Server1")).vote
+        # higher term, different candidate: the paper grants; the reference
+        # denies because ``voted`` is still set
+        assert not n.handle_request_vote(VoteRequest(2, "Server2")).vote
+
+    def test_plus_one_commit_quirk_preserved(self):
+        """min(LeaderCommit, len(log)+1) — main.go:151-154."""
+        from raft_tpu.golden.model import AppendEntriesRequest, GoldenNode, LogEntry
+
+        n = GoldenNode("Server0")
+        r = n.handle_append_entries(
+            AppendEntriesRequest(1, "Server1", [LogEntry(1, b"x")], 99, 0, 0)
+        )
+        assert r.success and n.commit_index == 2  # len(log)+1, not len(log)
+
+
+class TestDifferential:
+    """Device path vs golden oracle: byte-identical committed logs."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_committed_log_byte_identical(self, seed):
+        rng = np.random.default_rng(seed)
+        n_entries, B = 40, 8
+        payload_bytes = [
+            rng.integers(0, 256, ENTRY, dtype=np.uint8).tobytes()
+            for _ in range(n_entries)
+        ]
+
+        # --- golden run -----------------------------------------------------
+        c = GoldenCluster(3, seed=seed)
+        lead = c.run_until_leader()
+        inject_and_settle(c, payload_bytes)
+        golden_committed = lead.committed_payloads()
+        assert len(golden_committed) >= n_entries
+
+        # --- device run: same leader identity, same payload order -----------
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=B, log_capacity=128,
+            transport="single",
+        )
+        t = SingleDeviceTransport(cfg)
+        state = t.init()
+        alive = jnp.ones(3, bool)
+        slow = jnp.zeros(3, bool)
+        leader_id = int(lead.id.removeprefix("Server"))
+        state, vi = t.request_votes(state, leader_id, 1, alive)
+        assert int(vi.votes) == 3
+        flat = np.frombuffer(b"".join(payload_bytes), np.uint8).reshape(
+            n_entries, ENTRY
+        )
+        for ofs in range(0, n_entries, B):
+            chunk = flat[ofs : ofs + B]
+            buf = np.zeros((3, B, ENTRY), np.uint8)
+            buf[:, : len(chunk)] = chunk[None]
+            state, info = t.replicate(
+                state, jnp.asarray(buf), len(chunk), leader_id, 1, alive, slow
+            )
+        assert int(info.commit_index) == n_entries
+
+        # --- the join: committed bytes equal on every replica ----------------
+        want = np.frombuffer(
+            b"".join(golden_committed[:n_entries]), np.uint8
+        ).reshape(n_entries, ENTRY)
+        for r in range(3):
+            got = committed_payloads(state, r)
+            np.testing.assert_array_equal(got, want, err_msg=f"replica {r}")
