@@ -1,0 +1,13 @@
+"""Host protocol engine: the cold path of the framework.
+
+Role transitions, election timers, and client I/O are branchy and stateful,
+so they live in a single-threaded host event loop (SURVEY.md §7 "design
+stance") that launches the batched device steps in ``core.step``. This
+replaces the reference's goroutine-per-node trampoline (``Run()``,
+main.go:98-109) and its wall-clock timers with one deterministic scheduler
+on a virtual clock — every run is replayable from a seed.
+"""
+
+from raft_tpu.raft.engine import RaftEngine, VirtualClock
+
+__all__ = ["RaftEngine", "VirtualClock"]

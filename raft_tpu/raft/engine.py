@@ -1,0 +1,366 @@
+"""The cluster engine: timers + roles on host, protocol steps on device.
+
+Capability map to the reference (SURVEY.md §1, §3):
+
+- ``Run()`` role trampoline (main.go:98-109)       -> ``roles[]`` + the event
+  loop: each replica's role is host metadata; transitions happen when timer
+  events fire or device-step results (``max_term``) demand them.
+- follower election timeout (main.go:114, 171-177) -> ``_fire_follower``:
+  role -> candidate, term+1, a device vote round (``vote_step``).
+- candidate round + majority (main.go:253-284)     -> ``_campaign``: one
+  collective vote step replaces the serial peer poll; majority promotes to
+  leader and triggers an immediate authority heartbeat.
+- leader 2 s tick (main.go:332-395)                -> ``_fire_leader_tick``:
+  drain up to one batch from the client queue, run one replicate step
+  (ingest + repair + replicate + quorum commit fused on device).
+- leader step-down (main.go:309-321)               -> after any step, if
+  ``info.max_term`` exceeds the leader's term the leader reverts to
+  follower (the reference learns this from an AppendEntries with a higher
+  term; here the term rides the same collective).
+- client loop (main.go:87-95)                      -> ``submit()`` queues
+  payloads; unlike the reference's fire-and-forget client (which never gets
+  a reply — comment main.go:330), ``submit`` returns a sequence number and
+  ``commit_watermark`` tells the client when it is durable.
+
+Timers run on a virtual clock by default — tests and differential runs are
+deterministic and fast (no 10-29 s waits); a live demo can pass a wall
+clock (``time.monotonic``).
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import ReplicaState
+from raft_tpu.transport.base import Transport, make_transport
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class VirtualClock:
+    """Deterministic time source; the engine advances it to each event."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+class RaftEngine:
+    """One process hosting all replica control planes.
+
+    The reference runs one goroutine per node against shared channels; here
+    one host thread owns every replica's timers and roles, and the *data*
+    plane (all replicas' state transitions) is the batched device program.
+    Fault masks (``alive``/``slow``) are first-class: a "dead" replica's
+    timers do not fire and the device step ignores it, which is exactly how
+    the reference's only failure mode (a silent node) manifests.
+    """
+
+    def __init__(
+        self,
+        cfg: RaftConfig,
+        transport: Optional[Transport] = None,
+        trace: Optional[Callable[[str], None]] = None,
+    ):
+        self.cfg = cfg
+        self.t: Transport = transport if transport is not None else make_transport(cfg)
+        self.state: ReplicaState = self.t.init()
+        self.rng = random.Random(cfg.seed)
+        self.clock = VirtualClock()
+        self._trace = trace
+
+        n = cfg.n_replicas
+        self.roles: List[str] = [FOLLOWER] * n
+        self.terms = np.zeros(n, np.int64)     # host mirror for timer logic
+        self.alive = np.ones(n, bool)
+        self.slow = np.zeros(n, bool)
+        self.leader_id: Optional[int] = None
+        self.leader_term = 0
+        self.commit_watermark = 0                  # committed LOG INDEX
+        self.submit_time: Dict[int, float] = {}    # seq -> submit time
+        self.commit_time: Dict[int, float] = {}    # seq -> commit time
+        #   (commit_time[s] - submit_time[s] is the per-entry commit latency
+        #    the obs package histograms — the BASELINE p50/p99 metric)
+        self._seq_at_index: Dict[int, int] = {}    # log index -> client seq
+        #   Mapped at ingestion time, because log indices and sequence
+        #   numbers diverge once a leadership change drops queued entries.
+        self._hb_payload = None                    # cached all-zero batch
+
+        self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
+        self._next_seq = 1
+        self._q: List[Tuple[float, int, str, int]] = []   # (t, tiebreak, kind, replica)
+        self._seq_events = 0
+        self._timer_gen = [0] * n
+        for r in range(n):
+            self._arm_follower(r)
+
+    # ------------------------------------------------------------------ util
+    def nodelog(self, r: int, msg: str) -> str:
+        """The reference's trace schema (main.go:399-401) — the differential
+        join key: [Id:Term:CommitIndex:LastApplied][state]msg."""
+        line = (
+            f"[Server{r}:{self.terms[r]}:{int(self.state.commit_index[r])}:"
+            f"{int(self.state.last_index[r])}][{self.roles[r]}]{msg}"
+        )
+        if self._trace:
+            self._trace(line)
+        return line
+
+    def _push(self, t: float, kind: str, replica: int) -> None:
+        heapq.heappush(self._q, (t, self._seq_events, kind, replica))
+        self._seq_events += 1
+
+    def _arm_follower(self, r: int) -> None:
+        """Randomized election timeout (reference: uniform int 10-29 s,
+        main.go:114) scaled by the configured window."""
+        self._timer_gen[r] += 1
+        lo, hi = self.cfg.follower_timeout
+        self._push(self.clock.now + self.rng.uniform(lo, hi), f"e:{self._timer_gen[r]}", r)
+
+    def _arm_candidate(self, r: int) -> None:
+        # reference: uniform 10-13 s (main.go:194)
+        self._timer_gen[r] += 1
+        lo, hi = self.cfg.candidate_timeout
+        self._push(self.clock.now + self.rng.uniform(lo, hi), f"c:{self._timer_gen[r]}", r)
+
+    # ------------------------------------------------------------- client API
+    def submit(self, payload: bytes) -> int:
+        """Queue one entry; returns its sequence number. The entry is
+        durable once ``seq in engine.commit_time`` (``is_durable(seq)``).
+        The reference's client never learns the fate of an entry
+        (main.go:330); here the engine reports it honestly — including the
+        loss case: entries queued or ingested-but-uncommitted across a
+        leadership change may be dropped (the reference drops them too) and
+        their seq simply never becomes durable; clients resubmit."""
+        if len(payload) != self.cfg.entry_bytes:
+            raise ValueError(
+                f"payload must be exactly {self.cfg.entry_bytes} bytes"
+            )
+        seq = self._next_seq
+        self._next_seq += 1
+        self._queue.append((seq, payload))
+        self.submit_time[seq] = self.clock.now
+        return seq
+
+    def is_durable(self, seq: int) -> bool:
+        return seq in self.commit_time
+
+    # ---------------------------------------------------------- fault toggles
+    def fail(self, r: int) -> None:
+        """Silence a replica (crash). Its timers stop; the device step masks
+        it out. The reference has no equivalent hook (no node ever fails,
+        SURVEY.md §5) — this is the fault-injection surface."""
+        self.alive[r] = False
+        if self.leader_id == r:
+            self.leader_id = None
+        self.roles[r] = FOLLOWER
+        self.nodelog(r, "killed")
+
+    def recover(self, r: int) -> None:
+        self.alive[r] = True
+        self.roles[r] = FOLLOWER
+        self.nodelog(r, "recovered")
+        self._arm_follower(r)
+
+    def set_slow(self, r: int, is_slow: bool) -> None:
+        """Induced-slow follower: receives traffic, appends nothing (stale
+        matchIndex — BASELINE config 4)."""
+        self.slow[r] = is_slow
+
+    # ------------------------------------------------------------- event loop
+    def step_event(self) -> bool:
+        """Advance the clock to the next timer and handle it."""
+        if not self._q:
+            return False
+        t, _, kind, r = heapq.heappop(self._q)
+        self.clock.now = max(self.clock.now, t)
+        tag, _, gen = kind.partition(":")
+        if tag in ("e", "c") and int(gen) != self._timer_gen[r]:
+            return True  # stale timer generation (reset since armed)
+        if tag == "e":
+            self._fire_follower(r)
+        elif tag == "c":
+            self._fire_candidate(r)
+        elif tag == "l":
+            self._fire_leader_tick(r)
+        return True
+
+    def run_for(self, seconds: float, max_events: int = 100_000) -> None:
+        end = self.clock.now + seconds
+        for _ in range(max_events):
+            if not self._q or self._q[0][0] > end:
+                break
+            self.step_event()
+        self.clock.now = end
+
+    def run_until_leader(self, limit: float = 600.0) -> int:
+        end = self.clock.now + limit
+        while self.leader_id is None and self.clock.now < end and self._q:
+            self.step_event()
+        assert self.leader_id is not None, "no leader elected within limit"
+        return self.leader_id
+
+    def run_until_committed(self, seq: int, limit: float = 600.0) -> None:
+        """Run until client entry ``seq`` is durable (see ``submit``)."""
+        end = self.clock.now + limit
+        while not self.is_durable(seq) and self.clock.now < end and self._q:
+            self.step_event()
+        assert self.is_durable(seq), (
+            f"seq {seq} not committed (watermark {self.commit_watermark})"
+        )
+
+    # ----------------------------------------------------------- role actions
+    def _fire_follower(self, r: int) -> None:
+        """Election timeout (main.go:171-177): follower -> candidate."""
+        if not self.alive[r] or self.roles[r] != FOLLOWER:
+            return
+        # A live current leader keeps resetting follower timers via its
+        # heartbeats (main.go:124-127); replicate steps re-arm heard
+        # followers, so a firing timer here means no current leader reached
+        # this replica — campaign.
+        self.roles[r] = CANDIDATE
+        self.terms[r] += 1
+        self.nodelog(r, "state changed to candidate")
+        self._campaign(r)
+
+    def _fire_candidate(self, r: int) -> None:
+        """Candidate re-election timeout (main.go:248-251): term+1, retry."""
+        if not self.alive[r] or self.roles[r] != CANDIDATE:
+            return
+        self.terms[r] += 1
+        self._campaign(r)
+
+    def _campaign(self, r: int) -> None:
+        """One collective vote round (replaces the serial poll,
+        main.go:253-284)."""
+        cand_term = int(self.terms[r])
+        self.state, info = self.t.request_votes(
+            self.state, r, cand_term, jnp.asarray(self.alive)
+        )
+        votes = int(info.votes)
+        max_term = int(info.max_term)
+        self.terms[self.alive] = np.maximum(self.terms[self.alive], cand_term)
+        if max_term > cand_term:
+            # someone is ahead; fall back to follower in the newer term
+            self.terms[r] = max_term
+            self.roles[r] = FOLLOWER
+            self._arm_follower(r)
+            return
+        if votes > self.cfg.n_replicas // 2:       # main.go:273
+            # A different leader's log may differ above the commit watermark,
+            # so index->seq mappings for uncommitted entries are no longer
+            # trustworthy: drop them (their seqs read as lost — conservative;
+            # the reference silently loses such entries too, main.go:330).
+            # The same replica re-winning keeps its own log, mappings intact.
+            if self.leader_id != r:
+                self._seq_at_index = {
+                    i: s for i, s in self._seq_at_index.items()
+                    if i <= self.commit_watermark
+                }
+            self.roles[r] = LEADER
+            self.leader_id = r
+            self.leader_term = cand_term
+            # demote any stale leader bookkeeping (device already denied it)
+            for p in range(self.cfg.n_replicas):
+                if p != r and self.roles[p] == LEADER:
+                    self.roles[p] = FOLLOWER
+                    self._arm_follower(p)
+            self.nodelog(r, "state changed to leader")
+            self._push(self.clock.now, f"l:{self._timer_gen[r]}", r)
+        else:
+            self._arm_candidate(r)
+
+    def _fire_leader_tick(self, r: int) -> None:
+        """One leader tick (main.go:332-395): batch ingest + replicate +
+        commit, then re-arm. Also the followers' heartbeat: every heard
+        replica's election timer resets."""
+        if not self.alive[r] or self.roles[r] != LEADER or self.leader_id != r:
+            return
+        cfg = self.cfg
+        B, S = cfg.batch_size, cfg.shard_bytes
+        take = min(len(self._queue), B)
+        if take == 0:
+            if self._hb_payload is None:
+                self._hb_payload = jnp.zeros((cfg.n_replicas, B, S), jnp.uint8)
+            payload = self._hb_payload
+        elif cfg.ec_enabled:
+            raise NotImplementedError(
+                "EC client path lands with the ec package (RS shard rows)"
+            )
+        else:
+            buf = np.zeros((cfg.n_replicas, B, S), np.uint8)
+            flat = np.frombuffer(
+                b"".join(p for _, p in self._queue[:take]), np.uint8
+            ).reshape(take, S)
+            buf[:, :take] = flat[None]
+            payload = jnp.asarray(buf)
+        self.state, info = self.t.replicate(
+            self.state,
+            payload,
+            take,
+            r,
+            self.leader_term,
+            jnp.asarray(self.alive),
+            jnp.asarray(self.slow),
+        )
+        max_term = int(info.max_term)
+        if max_term > self.leader_term:
+            # A higher term exists: step down (main.go:309-321). The device
+            # step refused ingest/commit for the stale term, so nothing was
+            # consumed from the queue.
+            self.roles[r] = FOLLOWER
+            self.terms[r] = max_term
+            if self.leader_id == r:
+                self.leader_id = None
+            self.nodelog(r, "step down to follower")
+            self._arm_follower(r)
+            return
+        # Heard replicas adopted the leader's term on device (core.step);
+        # keep the host mirror in sync so post-failover campaigns start from
+        # the real term, not a stale one.
+        self.terms[self.alive] = np.maximum(
+            self.terms[self.alive], self.leader_term
+        )
+        # Ring backpressure: the device step ingests at most `room` entries
+        # (never overwriting uncommitted slots); anything it left behind
+        # stays queued for a later tick.
+        ingested = int(info.frontier_len)
+        if ingested:
+            last = int(self.state.last_index[r])        # post-ingest
+            for i, (seq, _) in enumerate(self._queue[:ingested]):
+                self._seq_at_index[last - ingested + 1 + i] = seq
+            self._queue = self._queue[ingested:]
+        commit = int(info.commit_index)
+        if commit > self.commit_watermark:
+            for idx in range(self.commit_watermark + 1, commit + 1):
+                seq = self._seq_at_index.get(idx)
+                if seq is not None and seq not in self.commit_time:
+                    self.commit_time[seq] = self.clock.now
+            self.commit_watermark = commit
+            self.nodelog(r, f"commit index changed to {commit}")
+        # heartbeats reset every heard follower's election timer
+        for p in range(cfg.n_replicas):
+            if p != r and self.alive[p] and self.roles[p] == FOLLOWER:
+                self._arm_follower(p)
+            if self.alive[p] and self.roles[p] == CANDIDATE:
+                # a candidate hearing a current leader steps down
+                # (main.go:204-217)
+                self.roles[p] = FOLLOWER
+                self._arm_follower(p)
+        self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
+
+    def commit_latencies(self) -> np.ndarray:
+        """Per-entry commit latency (seconds) for every durable entry."""
+        return np.array(
+            [self.commit_time[s] - self.submit_time[s] for s in self.commit_time]
+        )
