@@ -35,6 +35,14 @@ class RaftConfig:
     # reference's full-copy replication (main.go:344-371).
     rs_k: Optional[int] = None
     rs_m: Optional[int] = None
+    # EC durability margin: an EC commit needs k + margin shard-holding
+    # acks (vs plain majority when EC is off). A committed batch then
+    # survives `margin` immediate replica failures (>= k shards remain for
+    # reconstruction), and the §5.4.1 up-to-date vote check keeps any
+    # shard-less replica from winning leadership over the holders. Plain
+    # majority would be unsafe: k acks alone means ANY single holder
+    # failure can make a committed entry unreconstructable.
+    ec_commit_margin: int = 1
 
     # --- timing (seconds; reference values noted above) ---
     follower_timeout: Tuple[float, float] = (10.0, 30.0)
@@ -78,6 +86,11 @@ class RaftConfig:
                 raise ValueError("RS(n,k): k+m must equal n_replicas")
             if self.entry_bytes % self.rs_k != 0:
                 raise ValueError("entry_bytes must be divisible by rs_k")
+            if not (0 <= self.ec_commit_margin <= self.rs_m):
+                # surviving `margin` failures needs n - margin >= k shard
+                # holders, i.e. margin <= m; a larger margin would silently
+                # clamp and void the documented durability guarantee
+                raise ValueError("ec_commit_margin must be in [0, rs_m]")
         if self.payload_shards < 1:
             raise ValueError("payload_shards must be >= 1")
         if self.shard_bytes % self.payload_shards:
@@ -90,6 +103,14 @@ class RaftConfig:
         from raft_tpu.quorum.commit import majority
 
         return majority(self.n_replicas)
+
+    @property
+    def commit_quorum(self) -> int:
+        """Acks required to commit: majority, or k + margin under EC (see
+        ``ec_commit_margin``)."""
+        if not self.ec_enabled:
+            return self.majority
+        return max(self.majority, self.rs_k + self.ec_commit_margin)
 
     @property
     def ec_enabled(self) -> bool:

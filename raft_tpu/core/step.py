@@ -93,6 +93,7 @@ def replicate_step(
     #                                     BASELINE config 4)
     *,
     ec: bool = False,
+    commit_quorum: int | None = None,
 ) -> tuple[ReplicaState, RepInfo]:
     """One leader tick: ingest + repair + replicate + quorum commit, on device.
 
@@ -281,7 +282,7 @@ def replicate_step(
     # leader's own log. Paper-correct rule: k-th largest of the verified
     # match vector, restricted to current-term entries (§5.4.2).
     match = jnp.where(alive, comm.all_gather(m_eff), 0)    # i32[R]
-    commit_cand = commit_from_match(match)
+    commit_cand = commit_from_match(match, commit_quorum)
     cand_slot = slot_of(jnp.maximum(commit_cand, 1), cap)
     cand_term = comm.select_row(log_term[:, cand_slot], leader)
     commit_ok = legit & (commit_cand >= 1) & (cand_term == leader_term)
@@ -323,7 +324,8 @@ def replicate_step(
 
 
 def scan_replicate(
-    comm, ec, state, payloads, counts, leader, leader_term, alive, slow
+    comm, ec, commit_quorum, state, payloads, counts, leader, leader_term,
+    alive, slow,
 ):
     """T replication steps as one compiled ``lax.scan`` — no host round-trip
     per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
@@ -332,7 +334,8 @@ def scan_replicate(
     def body(st, xs):
         payload, count = xs
         st, info = replicate_step(
-            comm, st, payload, count, leader, leader_term, alive, slow, ec=ec
+            comm, st, payload, count, leader, leader_term, alive, slow,
+            ec=ec, commit_quorum=commit_quorum,
         )
         return st, info
 

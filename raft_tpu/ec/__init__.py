@@ -1,0 +1,19 @@
+"""Erasure coding: Reed-Solomon over GF(2^8) for log-shard replication.
+
+The reference replicates by sending full entries to every follower
+(main.go:344-371) — n copies for n replicas. Here a batch of entries can
+instead be RS(n, k)-encoded so each replica stores one shard (storage and
+per-link bandwidth drop from n full copies to n/k), and any k live replicas
+reconstruct every committed entry (BASELINE configs 3-4; the "shard matrix
+scatter" of the north star).
+
+Layers:
+- ``gf``     — GF(2^8) table arithmetic (NumPy; the ground truth)
+- ``rs``     — systematic Cauchy RS codec: NumPy reference + the jittable
+               XLA path (LUT gathers + XOR reduce)
+- ``kernels``— Pallas TPU encode kernel (the hot op)
+"""
+
+from raft_tpu.ec.rs import RSCode
+
+__all__ = ["RSCode"]

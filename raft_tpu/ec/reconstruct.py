@@ -1,0 +1,149 @@
+"""Read-path reconstruction and repair for erasure-coded logs.
+
+With RS(n, k) on, each replica's ring slot holds its own shard
+(``core.step`` EC mode scatters row r of the shard matrix to replica r).
+Reading an entry therefore needs k shard rows + a decode (all_gather +
+inverse-matrix apply — the "reconstruction" of BASELINE config 3), and a
+*lagging* replica cannot be healed from the leader's log (the leader holds
+only its own shards): repair is reconstruct -> re-encode -> install, the
+EC analogue of Raft's InstallSnapshot.
+
+The fast path pays none of this: systematic data shards mean a read
+quorum that includes the first k replicas needs no decode at all, and
+commit never decodes anything.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.state import ReplicaState, slot_of
+from raft_tpu.ec.rs import RSCode
+
+
+def gather_shard_window(
+    state: ReplicaState, rows: Sequence[int], lo: int, hi: int
+) -> np.ndarray:
+    """u8[len(rows), hi-lo+1, Sk] shard slices for log indices [lo, hi]."""
+    idx = np.arange(lo, hi + 1)
+    slots = (idx - 1) % state.capacity
+    return np.asarray(state.log_payload[np.asarray(rows)[:, None], slots[None, :]])
+
+
+def reconstruct(
+    state: ReplicaState, code: RSCode, rows: Sequence[int], lo: int, hi: int
+) -> np.ndarray:
+    """Decode entries [lo, hi] (1-based, inclusive) from the shard rows of
+    the k replicas in ``rows`` -> u8[hi-lo+1, S].
+
+    ``rows`` picks WHICH replicas serve the read (any k live ones); the
+    decode matrix for that subset is formed on host (rs.decode_matrix) and
+    applied on device.
+    """
+    assert len(rows) == code.k
+    shards = gather_shard_window(state, rows, lo, hi)
+    return np.asarray(code.decode_jax(jnp.asarray(shards), list(rows)))
+
+
+def install_window(
+    state: ReplicaState,
+    replica: int,
+    start: jax.Array,          # i32[] first log index of the window
+    count: jax.Array,          # i32[] valid entries
+    payload: jax.Array,        # u8[B, Sk] re-encoded shards for ``replica``
+    terms: jax.Array,          # i32[B] entry terms
+    leader_term: jax.Array,    # i32[] term the installed prefix is verified for
+    commit_to: jax.Array,      # i32[] commit index covered by the install
+) -> ReplicaState:
+    """Install a verified window into one replica's row (jittable).
+
+    The installed prefix is by construction consistent with the committed
+    log (it was reconstructed from a read quorum), so match/commit advance
+    to the window end — exactly what accepting a leader window does in
+    ``core.step.apply_window``, minus the consistency probe that shard
+    reconstruction replaces.
+    """
+    cap = state.capacity
+    B = payload.shape[0]
+    barange = jnp.arange(B, dtype=jnp.int32)
+    valid = barange < count
+    pos = slot_of(start + barange, cap)
+
+    row_p = state.log_payload[replica]
+    row_t = state.log_term[replica]
+    row_p = row_p.at[pos].set(
+        jnp.where(valid[:, None], payload, row_p[pos])
+    )
+    row_t = row_t.at[pos].set(jnp.where(valid, terms, row_t[pos]))
+    we = start + count - 1
+    new_last = jnp.maximum(state.last_index[replica], we)
+    new_match = jnp.maximum(
+        jnp.where(state.match_term[replica] == leader_term,
+                  state.match_index[replica], 0),
+        we,
+    )
+    return state.replace(
+        log_payload=state.log_payload.at[replica].set(row_p),
+        log_term=state.log_term.at[replica].set(row_t),
+        last_index=state.last_index.at[replica].set(new_last),
+        match_index=state.match_index.at[replica].set(new_match),
+        match_term=state.match_term.at[replica].set(leader_term),
+        commit_index=state.commit_index.at[replica].set(
+            jnp.maximum(state.commit_index[replica],
+                        jnp.minimum(commit_to, we))
+        ),
+    )
+
+
+def heal_replica(
+    state: ReplicaState,
+    code: RSCode,
+    replica: int,
+    donor_rows: Sequence[int],
+    lo: int,
+    hi: int,
+    leader_term: int,
+    commit_to: int,
+    batch: int,
+) -> ReplicaState:
+    """Reconstruct entries [lo, hi] from ``donor_rows`` and install replica
+    ``replica``'s re-encoded shards, ``batch`` entries at a time.
+
+    Raises ``ValueError`` if any donor's ring has already lapped ``lo``
+    (slot (idx-1) % capacity would hold a NEWER entry's shard — decoding it
+    would install silent garbage). Mirrors the non-EC repair window's
+    horizon clamp (core.step): a replica lagging by >= capacity stalls for
+    the checkpoint subsystem instead of corrupting."""
+    donor_last = np.asarray(state.last_index)[list(donor_rows)]
+    horizon = int(donor_last.max()) - state.capacity + 1
+    if lo < horizon:
+        raise ValueError(
+            f"heal range start {lo} below donor ring horizon {horizon}; "
+            "replica needs snapshot install, not log repair"
+        )
+    idx = np.arange(lo, hi + 1)
+    slots = (idx - 1) % state.capacity
+    terms_all = np.asarray(state.log_term[donor_rows[0], slots])
+    data = reconstruct(state, code, donor_rows, lo, hi)     # [N, S]
+    shards = code.encode(data)[replica]                     # [N, Sk]
+    for ofs in range(0, len(idx), batch):
+        n = min(batch, len(idx) - ofs)
+        buf = np.zeros((batch, shards.shape[-1]), np.uint8)
+        buf[:n] = shards[ofs : ofs + n]
+        tbuf = np.zeros(batch, np.int32)
+        tbuf[:n] = terms_all[ofs : ofs + n]
+        state = install_window(
+            state,
+            replica,
+            jnp.int32(lo + ofs),
+            jnp.int32(n),
+            jnp.asarray(buf),
+            jnp.asarray(tbuf),
+            jnp.int32(leader_term),
+            jnp.int32(commit_to),
+        )
+    return state

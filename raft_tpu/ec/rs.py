@@ -1,0 +1,156 @@
+"""Systematic Cauchy Reed-Solomon codec: RS(n, k) over GF(2^8).
+
+Generator matrix G (n x k): the top k rows are the identity (data shards
+are byte-slices of the entry — systematic, so the fast read path pays no
+decode), and the m = n - k parity rows form a Cauchy matrix
+``C[p, j] = 1 / (x_p ^ y_j)`` with x_p = k + p, y_j = j. Every square
+submatrix of a Cauchy matrix is invertible, so any k of the n shard rows
+reconstruct the entry (the MDS property the straggler/loss configs rely
+on, BASELINE configs 3-4).
+
+Three encode paths share these matrices:
+- ``encode``/``decode`` — NumPy ground truth (tests' oracle);
+- ``encode_jax``/``decode_jax`` — jittable XLA: per-(parity, data) 256-byte
+  LUT gathers + XOR reduce, batched over entries;
+- ``raft_tpu.ec.kernels`` — the Pallas TPU kernel (same LUTs, VMEM tiles).
+
+Decode strategy: which shards survive is data known only at call time, so
+the k x k inverse is computed on host (microseconds for k <= 16) and
+shipped as constant-multiplication LUTs; the device applies gathers + XOR.
+Raft only decodes when a replica must *read* entries it holds only shards
+of (reconstruction), never on the commit hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ec import gf
+
+
+@dataclasses.dataclass(frozen=True)
+class RSCode:
+    """RS(n, k): n total shards, k data shards, m = n - k parity."""
+
+    n: int
+    k: int
+
+    def __post_init__(self):
+        if not (1 <= self.k <= self.n <= 256 - self.k):
+            raise ValueError("need 1 <= k <= n and distinct Cauchy points")
+
+    @property
+    def m(self) -> int:
+        return self.n - self.k
+
+    # ---------------------------------------------------------------- matrices
+    @property
+    def parity_matrix(self) -> np.ndarray:
+        """C: u8[m, k] — Cauchy block of the generator."""
+        x = np.arange(self.k, self.k + self.m, dtype=np.uint8)[:, None]
+        y = np.arange(self.k, dtype=np.uint8)[None, :]
+        return gf.inv(x ^ y)
+
+    @property
+    def generator(self) -> np.ndarray:
+        """G: u8[n, k] — [I_k ; C]."""
+        return np.concatenate(
+            [np.eye(self.k, dtype=np.uint8), self.parity_matrix]
+        )
+
+    def decode_matrix(self, rows: Sequence[int]) -> np.ndarray:
+        """u8[k, k] turning shards at ``rows`` (any k distinct) into data."""
+        rows = list(rows)
+        assert len(rows) == self.k, f"need exactly k={self.k} shard rows"
+        return gf.mat_inv(self.generator[rows])
+
+    # ---------------------------------------------------------- NumPy oracle
+    def split(self, data: np.ndarray) -> np.ndarray:
+        """u8[..., S] -> u8[k, ..., S/k]: byte-slice into data shards."""
+        data = np.asarray(data, np.uint8)
+        s = data.shape[-1]
+        assert s % self.k == 0, "entry bytes must divide by k"
+        return np.moveaxis(
+            data.reshape(*data.shape[:-1], self.k, s // self.k), -2, 0
+        )
+
+    def unsplit(self, shards: np.ndarray) -> np.ndarray:
+        """Inverse of ``split``: u8[k, ..., S/k] -> u8[..., S]."""
+        return np.moveaxis(np.asarray(shards, np.uint8), 0, -2).reshape(
+            *shards.shape[1:-1], shards.shape[0] * shards.shape[-1]
+        )
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """u8[..., S] entries -> u8[n, ..., S/k] shard rows (row r is what
+        replica r stores — the scatter matrix of the north star)."""
+        d = self.split(data)                            # [k, ..., S/k]
+        prods = gf.mul(
+            self.parity_matrix.reshape(self.m, self.k, *([1] * (d.ndim - 1))),
+            d[None],
+        )
+        parity = np.bitwise_xor.reduce(prods, axis=1)   # [m, ..., S/k]
+        return np.concatenate([d, parity])
+
+    def decode(self, shards: np.ndarray, rows: Sequence[int]) -> np.ndarray:
+        """u8[k, ..., S/k] surviving shards (from ``rows``) -> u8[..., S]."""
+        D = self.decode_matrix(rows)
+        sh = np.asarray(shards, np.uint8)
+        prods = gf.mul(D.reshape(self.k, self.k, *([1] * (sh.ndim - 1))), sh[None])
+        return self.unsplit(np.bitwise_xor.reduce(prods, axis=1))
+
+    # --------------------------------------------------------------- XLA path
+    def _luts(self, M: np.ndarray) -> np.ndarray:
+        """u8[rows, cols, 256] constant-multiplication tables for matrix M."""
+        return np.stack(
+            [np.stack([gf.mul_table(int(c)) for c in row]) for row in M]
+        )
+
+    @property
+    def parity_luts(self) -> np.ndarray:
+        return self._luts(self.parity_matrix)           # [m, k, 256]
+
+    def encode_jax(self, data: jax.Array) -> jax.Array:
+        """Jittable encode: u8[..., S] -> u8[n, ..., S/k]."""
+        return _encode_xla(self.k, self.m, jnp.asarray(self.parity_luts), data)
+
+    def decode_jax(self, shards: jax.Array, rows: Sequence[int]) -> jax.Array:
+        """Jittable decode of shards gathered from ``rows`` (static)."""
+        luts = jnp.asarray(self._luts(self.decode_matrix(rows)))  # [k, k, 256]
+        return _decode_xla(self.k, luts, shards)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _encode_xla(k: int, m: int, luts: jax.Array, data: jax.Array) -> jax.Array:
+    d = data.reshape(*data.shape[:-1], k, data.shape[-1] // k)
+    d = jnp.moveaxis(d, -2, 0)                           # [k, ..., S/k]
+    parity = _apply_luts_xla(luts, d)                    # [m, ..., S/k]
+    return jnp.concatenate([d, parity])
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _decode_xla(k: int, luts: jax.Array, shards: jax.Array) -> jax.Array:
+    d = _apply_luts_xla(luts, shards)                    # [k, ..., S/k]
+    return jnp.moveaxis(d, 0, -2).reshape(*shards.shape[1:-1], -1)
+
+
+def _apply_luts_xla(luts: jax.Array, src: jax.Array) -> jax.Array:
+    """rows_out[i] = XOR_j luts[i, j][src[j]] — the whole codec is gathers
+    plus XOR; XLA fuses the reduction."""
+    out_rows, in_rows = luts.shape[0], luts.shape[1]
+    gathered = jax.vmap(
+        lambda row_luts: jax.lax.reduce(
+            jnp.stack(
+                [jnp.take(row_luts[j], src[j].astype(jnp.int32)) for j in range(in_rows)]
+            ),
+            jnp.uint8(0),
+            jax.lax.bitwise_xor,
+            (0,),
+        )
+    )(luts)
+    return gathered

@@ -29,14 +29,19 @@ def majority(n: int) -> int:
     return n // 2 + 1
 
 
-def commit_from_match(match: jax.Array) -> jax.Array:
-    """Largest N with |{r : match[r] >= N}| >= majority — i32[] from i32[R].
+def commit_from_match(match: jax.Array, quorum: int | None = None) -> jax.Array:
+    """Largest N with |{r : match[r] >= N}| >= quorum — i32[] from i32[R].
+
+    ``quorum`` defaults to strict majority; erasure-coded logs pass the
+    larger k + margin quorum (RaftConfig.commit_quorum) because an EC
+    commit is only as durable as the number of shard-holders it has.
 
     k-th order statistic: sort ascending and take the element such that it
-    and everything after it (= majority elements) are >= it.
+    and everything after it (= quorum elements) are >= it.
     """
     n = match.shape[0]
-    return jnp.sort(match)[n - majority(n)]
+    q = majority(n) if quorum is None else quorum
+    return jnp.sort(match)[n - q]
 
 
 def reference_bucket_commit(

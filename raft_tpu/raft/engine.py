@@ -95,6 +95,21 @@ class RaftEngine:
         #   Mapped at ingestion time, because log indices and sequence
         #   numbers diverge once a leadership change drops queued entries.
         self._hb_payload = None                    # cached all-zero batch
+        if cfg.ec_enabled:
+            from raft_tpu.ec.rs import RSCode
+
+            self._code = RSCode(cfg.n_replicas, cfg.rs_k)
+        else:
+            self._code = None
+        self._uncommitted: Dict[int, Tuple[bytes, int]] = {}
+        #   log index -> (full payload, ingest term), EC mode only. The
+        #   leader's device log holds only its own shard row, so the
+        #   uncommitted suffix is not reconstructable from fewer than
+        #   commit_quorum shard-holders; the host retains full entries until
+        #   they commit so recovered replicas can be re-served (otherwise a
+        #   dead-and-back follower pair would stall commit forever at the
+        #   k+margin quorum). Bounded by ring backpressure:
+        #   leader_last - commit <= log_capacity entries.
 
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
         self._next_seq = 1
@@ -294,9 +309,16 @@ class RaftEngine:
                 self._hb_payload = jnp.zeros((cfg.n_replicas, B, S), jnp.uint8)
             payload = self._hb_payload
         elif cfg.ec_enabled:
-            raise NotImplementedError(
-                "EC client path lands with the ec package (RS shard rows)"
-            )
+            # RS-encode the batch: row r of the shard matrix is what replica
+            # r stores (the scatter of the north star). Encode rides the
+            # bit-decomposition XLA path (ec.kernels; Pallas on TPU benches).
+            from raft_tpu.ec.kernels import encode_bitwise_xla
+
+            data = np.zeros((B, cfg.entry_bytes), np.uint8)
+            data[:take] = np.frombuffer(
+                b"".join(p for _, p in self._queue[:take]), np.uint8
+            ).reshape(take, cfg.entry_bytes)
+            payload = encode_bitwise_xla(self._code, jnp.asarray(data))
         else:
             buf = np.zeros((cfg.n_replicas, B, S), np.uint8)
             flat = np.frombuffer(
@@ -337,8 +359,11 @@ class RaftEngine:
         ingested = int(info.frontier_len)
         if ingested:
             last = int(self.state.last_index[r])        # post-ingest
-            for i, (seq, _) in enumerate(self._queue[:ingested]):
-                self._seq_at_index[last - ingested + 1 + i] = seq
+            for i, (seq, p) in enumerate(self._queue[:ingested]):
+                idx = last - ingested + 1 + i
+                self._seq_at_index[idx] = seq
+                if cfg.ec_enabled:
+                    self._uncommitted[idx] = (p, self.leader_term)
             self._queue = self._queue[ingested:]
         commit = int(info.commit_index)
         if commit > self.commit_watermark:
@@ -348,6 +373,10 @@ class RaftEngine:
                     self.commit_time[seq] = self.clock.now
             self.commit_watermark = commit
             self.nodelog(r, f"commit index changed to {commit}")
+            for idx in [i for i in self._uncommitted if i <= commit]:
+                del self._uncommitted[idx]
+        if cfg.ec_enabled:
+            self._ec_heal(r, info)
         # heartbeats reset every heard follower's election timer
         for p in range(cfg.n_replicas):
             if p != r and self.alive[p] and self.roles[p] == FOLLOWER:
@@ -358,6 +387,81 @@ class RaftEngine:
                 self.roles[p] = FOLLOWER
                 self._arm_follower(p)
         self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
+
+    def _ec_heal(self, leader: int, info) -> None:
+        """Two-phase repair for erasure-coded logs.
+
+        With EC on there is no leader-log repair window (the leader holds
+        only its own shard row), so a live replica that missed a window can
+        never re-join via AppendEntries. Heal it instead:
+
+        - committed range: reconstruct from k shard-holders and install the
+          replica's re-encoded shards (heal_replica — the EC
+          InstallSnapshot); refuses ring-lapped donors (ValueError -> the
+          replica waits for the checkpoint subsystem).
+        - uncommitted suffix: re-serve full entries from the host
+          ``_uncommitted`` buffer (fewer than commit_quorum replicas hold
+          those shards, so reconstruction can't; without this path two
+          recovered followers would stall commit forever at the k+margin
+          quorum). Terms are verified against the current leader's log so a
+          buffer entry superseded across leadership changes is never
+          installed."""
+        from raft_tpu.ec.reconstruct import heal_replica, install_window
+
+        match = np.asarray(info.match)
+        n, k = self.cfg.n_replicas, self.cfg.rs_k
+        leader_last = int(self.state.last_index[leader])
+        hi_rec = self.commit_watermark
+        for p in range(n):
+            if p == leader or not self.alive[p] or self.slow[p]:
+                continue
+            if match[p] >= leader_last:
+                continue
+            lo = int(match[p]) + 1
+            if lo <= hi_rec:
+                donors = [
+                    q for q in range(n) if self.alive[q] and match[q] >= hi_rec
+                ]
+                if len(donors) < k:
+                    continue
+                try:
+                    self.state = heal_replica(
+                        self.state, self._code, p, donors[:k], lo, hi_rec,
+                        self.leader_term, hi_rec, self.cfg.batch_size,
+                    )
+                except ValueError:
+                    continue  # below donor ring horizon: snapshot territory
+                self.nodelog(p, f"healed by reconstruction to {hi_rec}")
+                lo = hi_rec + 1
+            if lo <= leader_last:
+                idx = list(range(lo, leader_last + 1))
+                if any(i not in self._uncommitted for i in idx):
+                    continue  # suffix not servable (no buffer for it)
+                slots = (np.asarray(idx) - 1) % self.state.capacity
+                log_terms = np.asarray(self.state.log_term[leader, slots])
+                if any(
+                    self._uncommitted[i][1] != int(t)
+                    for i, t in zip(idx, log_terms)
+                ):
+                    continue  # superseded across a leadership change
+                data = np.frombuffer(
+                    b"".join(self._uncommitted[i][0] for i in idx), np.uint8
+                ).reshape(len(idx), self.cfg.entry_bytes)
+                shards = self._code.encode(data)[p]
+                B = self.cfg.batch_size
+                for ofs in range(0, len(idx), B):
+                    m = min(B, len(idx) - ofs)
+                    buf = np.zeros((B, shards.shape[-1]), np.uint8)
+                    buf[:m] = shards[ofs : ofs + m]
+                    tbuf = np.zeros(B, np.int32)
+                    tbuf[:m] = log_terms[ofs : ofs + m]
+                    self.state = install_window(
+                        self.state, p, jnp.int32(lo + ofs), jnp.int32(m),
+                        jnp.asarray(buf), jnp.asarray(tbuf),
+                        jnp.int32(self.leader_term),
+                        jnp.int32(self.commit_watermark),
+                    )
+                self.nodelog(p, f"suffix re-served to {leader_last}")
 
     def commit_latencies(self) -> np.ndarray:
         """Per-entry commit latency (seconds) for every durable entry."""

@@ -32,11 +32,14 @@ class SingleDeviceTransport:
         self.cfg = cfg
         comm = SingleDeviceComm(cfg.n_replicas)
         self._replicate = jax.jit(
-            partial(replicate_step, comm, ec=cfg.ec_enabled)
+            partial(
+                replicate_step, comm,
+                ec=cfg.ec_enabled, commit_quorum=cfg.commit_quorum,
+            )
         )
         self._vote = jax.jit(partial(vote_step, comm))
         self._replicate_many = jax.jit(
-            partial(scan_replicate, comm, cfg.ec_enabled)
+            partial(scan_replicate, comm, cfg.ec_enabled, cfg.commit_quorum)
         )
 
     def init(self) -> ReplicaState:

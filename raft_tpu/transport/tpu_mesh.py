@@ -88,7 +88,10 @@ class TpuMeshTransport:
 
         self._replicate = jax.jit(
             jax.shard_map(
-                partial(replicate_step, comm, ec=cfg.ec_enabled),
+                partial(
+                    replicate_step, comm,
+                    ec=cfg.ec_enabled, commit_quorum=cfg.commit_quorum,
+                ),
                 mesh=self.mesh,
                 in_specs=(state_specs, P(AXIS, None, pax), P(), P(), P(), P(), P()),
                 out_specs=(state_specs, info_specs),
@@ -106,7 +109,7 @@ class TpuMeshTransport:
         )
         self._replicate_many = jax.jit(
             jax.shard_map(
-                partial(scan_replicate, comm, cfg.ec_enabled),
+                partial(scan_replicate, comm, cfg.ec_enabled, cfg.commit_quorum),
                 mesh=self.mesh,
                 in_specs=(
                     state_specs, P(None, AXIS, None, pax), P(), P(), P(), P(), P(),
