@@ -66,6 +66,14 @@ def install_window(
     to the window end — exactly what accepting a leader window does in
     ``core.step.apply_window``, minus the consistency probe that shard
     reconstruction replaces.
+
+    Truncation invariant (matches apply_window's): any *unverified* suffix
+    beyond the installed window is cut. A healed replica that once led a
+    lost term must not keep junk entries inflating its ``last_index`` /
+    ``last_log_term`` — a stale suffix would let it win the §5.4.1 vote
+    check and wedge the cluster behind entries no quorum holds shards for.
+    Suffix entries verified for the current leader term (or committed) are
+    kept.
     """
     cap = state.capacity
     B = payload.shape[0]
@@ -80,12 +88,18 @@ def install_window(
     )
     row_t = row_t.at[pos].set(jnp.where(valid, terms, row_t[pos]))
     we = start + count - 1
-    new_last = jnp.maximum(state.last_index[replica], we)
-    new_match = jnp.maximum(
-        jnp.where(state.match_term[replica] == leader_term,
-                  state.match_index[replica], 0),
-        we,
+    verified = jnp.where(
+        state.match_term[replica] == leader_term,
+        state.match_index[replica],
+        0,
     )
+    protected = jnp.maximum(
+        jnp.maximum(we, verified), state.commit_index[replica]
+    )
+    new_last = jnp.minimum(
+        jnp.maximum(state.last_index[replica], we), protected
+    )
+    new_match = jnp.maximum(verified, we)
     return state.replace(
         log_payload=state.log_payload.at[replica].set(row_p),
         log_term=state.log_term.at[replica].set(row_t),
@@ -97,6 +111,38 @@ def install_window(
                         jnp.minimum(commit_to, we))
         ),
     )
+
+
+def install_entries(
+    state: ReplicaState,
+    replica: int,
+    start: int,
+    shards: np.ndarray,        # u8[N, Sk] this replica's shard per entry
+    terms: np.ndarray,         # i32[N]
+    leader_term: int,
+    commit_to: int,
+    batch: int,
+) -> ReplicaState:
+    """Chunked install_window over a contiguous index range — shared by
+    reconstruction healing and the engine's uncommitted-suffix re-serve."""
+    n_entries = shards.shape[0]
+    for ofs in range(0, n_entries, batch):
+        m = min(batch, n_entries - ofs)
+        buf = np.zeros((batch, shards.shape[-1]), np.uint8)
+        buf[:m] = shards[ofs : ofs + m]
+        tbuf = np.zeros(batch, np.int32)
+        tbuf[:m] = terms[ofs : ofs + m]
+        state = install_window(
+            state,
+            replica,
+            jnp.int32(start + ofs),
+            jnp.int32(m),
+            jnp.asarray(buf),
+            jnp.asarray(tbuf),
+            jnp.int32(leader_term),
+            jnp.int32(commit_to),
+        )
+    return state
 
 
 def heal_replica(
@@ -130,20 +176,6 @@ def heal_replica(
     terms_all = np.asarray(state.log_term[donor_rows[0], slots])
     data = reconstruct(state, code, donor_rows, lo, hi)     # [N, S]
     shards = code.encode(data)[replica]                     # [N, Sk]
-    for ofs in range(0, len(idx), batch):
-        n = min(batch, len(idx) - ofs)
-        buf = np.zeros((batch, shards.shape[-1]), np.uint8)
-        buf[:n] = shards[ofs : ofs + n]
-        tbuf = np.zeros(batch, np.int32)
-        tbuf[:n] = terms_all[ofs : ofs + n]
-        state = install_window(
-            state,
-            replica,
-            jnp.int32(lo + ofs),
-            jnp.int32(n),
-            jnp.asarray(buf),
-            jnp.asarray(tbuf),
-            jnp.int32(leader_term),
-            jnp.int32(commit_to),
-        )
-    return state
+    return install_entries(
+        state, replica, lo, shards, terms_all, leader_term, commit_to, batch
+    )

@@ -1,0 +1,74 @@
+"""Scripted fault schedules for the engine's virtual clock.
+
+A ``FaultPlan`` is a time-ordered list of fault events; the engine merges
+them into its timer heap (``RaftEngine.schedule_faults``) so faults
+interleave deterministically with elections and replication ticks.
+
+Actions:
+- ``kill`` / ``recover``  — crash-stop a replica / bring it back
+  (BASELINE config 4's hard variant; the engine masks it from collectives)
+- ``slow`` / ``unslow``   — induced-slow follower: receives traffic,
+  appends nothing, matchIndex goes stale (BASELINE config 4)
+- ``campaign``            — force a disruptive candidacy: term bump + vote
+  round regardless of a live leader (the randomized term bumps of
+  BASELINE config 5's election storm)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import List
+
+ACTIONS = ("kill", "recover", "slow", "unslow", "campaign")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    t: float
+    action: str
+    replica: int
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r}")
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    events: List[FaultEvent] = dataclasses.field(default_factory=list)
+
+    def add(self, t: float, action: str, replica: int) -> "FaultPlan":
+        self.events.append(FaultEvent(t, action, replica))
+        return self
+
+    @classmethod
+    def slow_window(cls, replica: int, start: float, stop: float) -> "FaultPlan":
+        """Config 4: one follower slow for [start, stop)."""
+        return cls([FaultEvent(start, "slow", replica),
+                    FaultEvent(stop, "unslow", replica)])
+
+    @classmethod
+    def crash_recover(cls, replica: int, t_kill: float, t_recover: float) -> "FaultPlan":
+        return cls([FaultEvent(t_kill, "kill", replica),
+                    FaultEvent(t_recover, "recover", replica)])
+
+    @classmethod
+    def election_storm(
+        cls, n_replicas: int, start: float, stop: float,
+        mean_interval: float, seed: int = 0,
+    ) -> "FaultPlan":
+        """Config 5: randomized disruptive candidacies (term bumps) from
+        random replicas at ~exponential intervals over [start, stop)."""
+        rng = random.Random(seed)
+        events = []
+        t = start
+        while True:
+            t += rng.expovariate(1.0 / mean_interval)
+            if t >= stop:
+                break
+            events.append(FaultEvent(t, "campaign", rng.randrange(n_replicas)))
+        return cls(events)
+
+    def merged(self, other: "FaultPlan") -> "FaultPlan":
+        return FaultPlan(sorted(self.events + other.events, key=lambda e: e.t))

@@ -108,7 +108,7 @@ class GoldenNode:
             f"[{self.id}:{self.term}:{self.commit_index}:{self.last_applied}]"
             f"[{self.state}]{message}"
         )
-        if self._trace:
+        if self._trace is not None:  # not truthiness: empty sinks are falsy
             self._trace(line)
         return line
 

@@ -1,0 +1,14 @@
+"""Observability: trace capture + protocol metrics.
+
+The reference's only observability is ``nodelog`` printing
+``[Id:Term:CommitIndex:LastApplied][state]message`` to stdout from 19 call
+sites (main.go:399-401). That schema is kept verbatim — it is the
+differential-test join key between the golden model, the engine, and (by
+eye) the original Go binary — and extended with structured capture and the
+BASELINE metric set (entries/sec, p50/p99 commit latency).
+"""
+
+from raft_tpu.obs.trace import TraceRecord, TraceRecorder
+from raft_tpu.obs.metrics import LatencySummary, summarize_engine
+
+__all__ = ["TraceRecord", "TraceRecorder", "LatencySummary", "summarize_engine"]

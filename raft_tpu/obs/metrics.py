@@ -1,0 +1,69 @@
+"""Protocol metrics: the BASELINE metric set.
+
+``BASELINE.json``'s metric is "log entries committed/sec; p50/p99 commit
+latency" — computed here from the engine's per-entry submit/commit
+timestamps (virtual-clock seconds for deterministic runs, wall seconds for
+live ones). The reference publishes no numbers; its implied commit latency
+is the 2 s replication tick (BASELINE.md)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencySummary:
+    count: int
+    p50: float
+    p99: float
+    mean: float
+    max: float
+
+    @classmethod
+    def of(cls, samples: np.ndarray) -> "LatencySummary":
+        if len(samples) == 0:
+            return cls(0, float("nan"), float("nan"), float("nan"), float("nan"))
+        return cls(
+            count=len(samples),
+            p50=float(np.percentile(samples, 50)),
+            p99=float(np.percentile(samples, 99)),
+            mean=float(np.mean(samples)),
+            max=float(np.max(samples)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineReport:
+    committed_entries: int
+    elapsed_s: float
+    entries_per_sec: float
+    commit_latency: LatencySummary
+    in_flight_entries: int     # ingested, commit pending (healthy pipeline)
+    lost_entries: int          # submitted, never durable (leadership changes)
+    leader_changes: int
+
+
+def summarize_engine(engine, trace=None) -> EngineReport:
+    """Metrics from a finished (or paused) engine run; ``trace`` is an
+    optional TraceRecorder for leadership-change counting."""
+    lat = engine.commit_latencies()
+    elapsed = engine.clock.now
+    committed = len(engine.commit_time)
+    leader_changes = 0
+    if trace is not None:
+        leader_changes = len(trace.matching("state changed to leader"))
+    in_flight = engine.in_flight_count
+    return EngineReport(
+        committed_entries=committed,
+        elapsed_s=elapsed,
+        entries_per_sec=committed / elapsed if elapsed > 0 else float("nan"),
+        commit_latency=LatencySummary.of(lat),
+        in_flight_entries=in_flight,
+        lost_entries=(
+            len(engine.submit_time) - committed - len(engine._queue) - in_flight
+        ),
+        leader_changes=leader_changes,
+    )

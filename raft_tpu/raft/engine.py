@@ -127,7 +127,7 @@ class RaftEngine:
             f"[Server{r}:{self.terms[r]}:{int(self.state.commit_index[r])}:"
             f"{int(self.state.last_index[r])}][{self.roles[r]}]{msg}"
         )
-        if self._trace:
+        if self._trace is not None:  # not truthiness: empty sinks are falsy
             self._trace(line)
         return line
 
@@ -170,6 +170,15 @@ class RaftEngine:
     def is_durable(self, seq: int) -> bool:
         return seq in self.commit_time
 
+    @property
+    def in_flight_count(self) -> int:
+        """Entries ingested into the leader's log but not yet committed
+        (they commit on a later tick; neither durable nor lost)."""
+        return sum(
+            1 for seq in self._seq_at_index.values()
+            if seq not in self.commit_time
+        )
+
     # ---------------------------------------------------------- fault toggles
     def fail(self, r: int) -> None:
         """Silence a replica (crash). Its timers stop; the device step masks
@@ -192,6 +201,28 @@ class RaftEngine:
         matchIndex — BASELINE config 4)."""
         self.slow[r] = is_slow
 
+    def force_campaign(self, r: int) -> None:
+        """Disruptive candidacy regardless of a live leader: term bump +
+        vote round (the election-storm injection, BASELINE config 5)."""
+        if not self.alive[r]:
+            return
+        if self.roles[r] == LEADER and self.leader_id == r:
+            return  # a leader bumping itself is a no-op disruption
+        self.roles[r] = CANDIDATE
+        self.terms[r] += 1
+        self.nodelog(r, "state changed to candidate (injected)")
+        self._campaign(r)  # every _campaign outcome re-arms the right timer
+
+    def schedule_faults(self, plan) -> None:
+        """Merge a ``faults.FaultPlan`` into the event heap; events fire at
+        their absolute virtual-clock times, interleaved deterministically
+        with protocol timers."""
+        self._fault_events = getattr(self, "_fault_events", [])
+        base = len(self._fault_events)
+        self._fault_events.extend(plan.events)
+        for i, ev in enumerate(plan.events):
+            self._push(ev.t, f"f:{base + i}", ev.replica)
+
     # ------------------------------------------------------------- event loop
     def step_event(self) -> bool:
         """Advance the clock to the next timer and handle it."""
@@ -208,6 +239,15 @@ class RaftEngine:
             self._fire_candidate(r)
         elif tag == "l":
             self._fire_leader_tick(r)
+        elif tag == "f":
+            ev = self._fault_events[int(gen)]
+            {
+                "kill": self.fail,
+                "recover": self.recover,
+                "slow": lambda p: self.set_slow(p, True),
+                "unslow": lambda p: self.set_slow(p, False),
+                "campaign": self.force_campaign,
+            }[ev.action](ev.replica)
         return True
 
     def run_for(self, seconds: float, max_events: int = 100_000) -> None:
@@ -375,6 +415,8 @@ class RaftEngine:
             self.nodelog(r, f"commit index changed to {commit}")
             for idx in [i for i in self._uncommitted if i <= commit]:
                 del self._uncommitted[idx]
+            for idx in [i for i in self._seq_at_index if i <= commit]:
+                del self._seq_at_index[idx]
         if cfg.ec_enabled:
             self._ec_heal(r, info)
         # heartbeats reset every heard follower's election timer
@@ -406,7 +448,7 @@ class RaftEngine:
           quorum). Terms are verified against the current leader's log so a
           buffer entry superseded across leadership changes is never
           installed."""
-        from raft_tpu.ec.reconstruct import heal_replica, install_window
+        from raft_tpu.ec.reconstruct import heal_replica, install_entries
 
         match = np.asarray(info.match)
         n, k = self.cfg.n_replicas, self.cfg.rs_k
@@ -419,8 +461,17 @@ class RaftEngine:
                 continue
             lo = int(match[p]) + 1
             if lo <= hi_rec:
+                # Donor criterion is the replica's own committed prefix, NOT
+                # current-term match: committed entries are immutable, so a
+                # replica whose commit_index covers the range holds valid
+                # shards even if its term-scoped match was reset by a
+                # leadership change (otherwise healing wedges after failover:
+                # every follower's match is 0 in the new term although all
+                # of them hold the committed shards).
+                commits = np.asarray(self.state.commit_index)
                 donors = [
-                    q for q in range(n) if self.alive[q] and match[q] >= hi_rec
+                    q for q in range(n)
+                    if self.alive[q] and int(commits[q]) >= hi_rec
                 ]
                 if len(donors) < k:
                     continue
@@ -448,19 +499,11 @@ class RaftEngine:
                     b"".join(self._uncommitted[i][0] for i in idx), np.uint8
                 ).reshape(len(idx), self.cfg.entry_bytes)
                 shards = self._code.encode(data)[p]
-                B = self.cfg.batch_size
-                for ofs in range(0, len(idx), B):
-                    m = min(B, len(idx) - ofs)
-                    buf = np.zeros((B, shards.shape[-1]), np.uint8)
-                    buf[:m] = shards[ofs : ofs + m]
-                    tbuf = np.zeros(B, np.int32)
-                    tbuf[:m] = log_terms[ofs : ofs + m]
-                    self.state = install_window(
-                        self.state, p, jnp.int32(lo + ofs), jnp.int32(m),
-                        jnp.asarray(buf), jnp.asarray(tbuf),
-                        jnp.int32(self.leader_term),
-                        jnp.int32(self.commit_watermark),
-                    )
+                self.state = install_entries(
+                    self.state, p, lo, shards, log_terms,
+                    self.leader_term, self.commit_watermark,
+                    self.cfg.batch_size,
+                )
                 self.nodelog(p, f"suffix re-served to {leader_last}")
 
     def commit_latencies(self) -> np.ndarray:

@@ -146,3 +146,93 @@ class TestECRecovery:
         seqs = [e.submit(p) for p in payloads(5, seed=9)]
         e.run_until_committed(seqs[-1])
         assert e._uncommitted == {}
+
+    def test_deposed_leader_with_stranded_suffix_cannot_wedge(self):
+        """The review's wedge scenario: a replica leads alone, ingests
+        entries only it holds shards of, is deposed, recovers, and — having
+        the longest log — wins a later election. Commit must still make
+        progress: the host uncommitted-buffer re-serves the stranded suffix
+        to the followers (no quorum holds its shards, so reconstruction
+        cannot)."""
+        e = mk_ec_engine(8)
+        lead = e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(4, seed=10)]
+        e.run_until_committed(seqs[-1])
+        w = e.commit_watermark
+        others = [q for q in range(5) if q != lead]
+        for q in others:
+            e.fail(q)
+        stranded = [e.submit(p) for p in payloads(3, seed=11)]
+        e.run_for(3 * e.cfg.heartbeat_period)   # ingested by lead alone
+        assert int(e.state.last_index[lead]) > w
+        e.fail(lead)
+        for q in others:
+            e.recover(q)
+        e.run_until_leader()
+        e.recover(lead)
+        e.run_for(4 * e.cfg.heartbeat_period)   # heal + re-verify pass
+        # adversarial turn: the recovered replica has the longest log and
+        # campaigns; its win must not wedge the cluster
+        e.force_campaign(lead)
+        e.run_for(4 * e.cfg.heartbeat_period)
+        fresh = [e.submit(p) for p in payloads(3, seed=12)]
+        e.run_until_committed(fresh[-1], limit=900.0)
+        assert all(e.is_durable(s) for s in fresh)
+
+
+class TestInstallWindow:
+    def test_unverified_suffix_truncated_on_install(self):
+        """install_window must cut a junk suffix beyond the installed range
+        (unless committed or verified for the current leader term)."""
+        import jax.numpy as jnp
+
+        from raft_tpu.core.state import init_state
+        from raft_tpu.ec.reconstruct import install_window
+
+        cfg = RaftConfig(
+            n_replicas=5, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+            rs_k=3, rs_m=2, transport="single",
+        )
+        state = init_state(cfg)
+        # replica 1: 10 junk entries of term 2, match verified for term 2
+        state = state.replace(
+            last_index=state.last_index.at[1].set(10),
+            match_index=state.match_index.at[1].set(10),
+            match_term=state.match_term.at[1].set(2),
+        )
+        # heal installs [1..4] for leader term 3: term-2 match is stale, so
+        # the suffix 5..10 must go
+        state = install_window(
+            state, 1, jnp.int32(1), jnp.int32(4),
+            jnp.zeros((4, ENTRY // 3), jnp.uint8),
+            jnp.full((4,), 3, jnp.int32), jnp.int32(3), jnp.int32(4),
+        )
+        assert int(state.last_index[1]) == 4
+        assert int(state.match_index[1]) == 4
+        assert int(state.match_term[1]) == 3
+
+    def test_verified_suffix_kept_on_install(self):
+        import jax.numpy as jnp
+
+        from raft_tpu.core.state import init_state
+        from raft_tpu.ec.reconstruct import install_window
+
+        cfg = RaftConfig(
+            n_replicas=5, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+            rs_k=3, rs_m=2, transport="single",
+        )
+        state = init_state(cfg)
+        # suffix verified for the CURRENT leader term survives an install
+        # of an earlier range
+        state = state.replace(
+            last_index=state.last_index.at[1].set(10),
+            match_index=state.match_index.at[1].set(10),
+            match_term=state.match_term.at[1].set(3),
+        )
+        state = install_window(
+            state, 1, jnp.int32(1), jnp.int32(4),
+            jnp.zeros((4, ENTRY // 3), jnp.uint8),
+            jnp.full((4,), 3, jnp.int32), jnp.int32(3), jnp.int32(4),
+        )
+        assert int(state.last_index[1]) == 10
+        assert int(state.match_index[1]) == 10
