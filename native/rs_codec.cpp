@@ -1,0 +1,96 @@
+// Host-side Reed-Solomon GF(2^8) codec for raft_tpu.
+//
+// The TPU data plane encodes with the Pallas kernel (raft_tpu/ec/kernels.py);
+// this library is the *host* data plane: the engine's heal/re-serve paths and
+// host clients encode/decode without paying NumPy's per-op dispatch. It is
+// the C++-native component of the build (the reference has no native code at
+// all — /root/reference is two Go files; this obligation comes from the
+// north star's runtime design, see SURVEY.md §2).
+//
+// Algorithm: the same bit-decomposition as the Pallas kernel, word-sliced.
+// Multiplying a byte x by a constant c over GF(2^8) is GF(2)-linear in x's
+// bits:  mul(c, x) = XOR over set bits i of x of mul(c, 1<<i).
+// Processing 8 bytes per uint64 lane: for bit i, build a per-byte 0x00/0xFF
+// mask from x's bit i and XOR in the broadcast constant mul(c, 1<<i). All
+// ops are shift/and/multiply-by-0x01...01/xor on u64 — auto-vectorizable,
+// no table gathers in the inner loop.
+//
+// Build: g++ -O3 -shared -fPIC (see raft_tpu/native/__init__.py, which
+// builds lazily and falls back to NumPy if no compiler is available).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kPoly = 0x11d;
+
+// mul(c, 1<<i) for one constant c — the 8 bit-basis products.
+void bit_basis(uint8_t c, uint8_t out[8]) {
+  uint32_t v = c;
+  for (int i = 0; i < 8; ++i) {
+    out[i] = static_cast<uint8_t>(v);
+    v <<= 1;
+    if (v & 0x100) v ^= kPoly;
+  }
+}
+
+constexpr uint64_t kLsb = 0x0101010101010101ULL;
+
+// dst ^= mul(c, src) over n bytes (word-sliced bit decomposition).
+void xor_mul_const(uint8_t* dst, const uint8_t* src, uint8_t c, long n) {
+  if (c == 0) return;
+  uint8_t basis[8];
+  bit_basis(c, basis);
+  long w = n / 8;
+  const uint64_t* s64 = reinterpret_cast<const uint64_t*>(src);
+  uint64_t* d64 = reinterpret_cast<uint64_t*>(dst);
+  for (long j = 0; j < w; ++j) {
+    uint64_t x = s64[j];
+    uint64_t acc = 0;
+    for (int i = 0; i < 8; ++i) {
+      if (basis[i] == 0) continue;
+      uint64_t mask = ((x >> i) & kLsb) * 0xFFULL;  // 0x00/0xFF per byte
+      acc ^= mask & (kLsb * basis[i]);
+    }
+    d64[j] ^= acc;
+  }
+  for (long j = w * 8; j < n; ++j) {  // tail bytes, scalar
+    uint8_t x = src[j], acc = 0;
+    for (int i = 0; i < 8; ++i)
+      if (x & (1u << i)) acc ^= basis[i];
+    dst[j] ^= acc;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[r] = XOR_c mul(matrix[r*in_rows + c], in[c]) for r in [0, out_rows):
+// the generic GF(2^8) matrix apply over contiguous byte rows of length
+// row_bytes. Parity encode and erasure decode are both this operation
+// (with the Cauchy block / the inverted submatrix respectively).
+void rs_apply_matrix(const uint8_t* in, uint8_t* out, const uint8_t* matrix,
+                     int in_rows, int out_rows, long row_bytes) {
+  std::memset(out, 0, static_cast<size_t>(out_rows) * row_bytes);
+  for (int r = 0; r < out_rows; ++r) {
+    uint8_t* dst = out + static_cast<size_t>(r) * row_bytes;
+    for (int c = 0; c < in_rows; ++c) {
+      xor_mul_const(dst, in + static_cast<size_t>(c) * row_bytes,
+                    matrix[r * in_rows + c], row_bytes);
+    }
+  }
+}
+
+// Scalar GF(2^8) multiply — exported for tests.
+uint8_t rs_gf_mul(uint8_t a, uint8_t b) {
+  uint8_t basis[8];
+  bit_basis(a, basis);
+  uint8_t acc = 0;
+  for (int i = 0; i < 8; ++i)
+    if (b & (1u << i)) acc ^= basis[i];
+  return acc;
+}
+
+}  // extern "C"

@@ -175,7 +175,7 @@ def heal_replica(
     slots = (idx - 1) % state.capacity
     terms_all = np.asarray(state.log_term[donor_rows[0], slots])
     data = reconstruct(state, code, donor_rows, lo, hi)     # [N, S]
-    shards = code.encode(data)[replica]                     # [N, Sk]
+    shards = code.encode_host(data)[replica]                # [N, Sk]
     return install_entries(
         state, replica, lo, shards, terms_all, leader_term, commit_to, batch
     )

@@ -104,6 +104,28 @@ class RSCode:
         prods = gf.mul(D.reshape(self.k, self.k, *([1] * (sh.ndim - 1))), sh[None])
         return self.unsplit(np.bitwise_xor.reduce(prods, axis=1))
 
+    # ---------------------------------------------------- C++ host fast path
+    def encode_host(self, data: np.ndarray) -> np.ndarray:
+        """``encode`` on the C++ codec (ctypes, word-sliced bit
+        decomposition — raft_tpu.native); NumPy oracle when the native
+        library is unavailable. Host data plane: engine heal/re-serve."""
+        from raft_tpu import native
+
+        d = self.split(np.ascontiguousarray(data))      # [k, ..., S/k]
+        parity = native.apply_matrix(self.parity_matrix, d)
+        if parity is None:
+            return self.encode(data)
+        return np.concatenate([d, parity])
+
+    def decode_host(self, shards: np.ndarray, rows: Sequence[int]) -> np.ndarray:
+        """``decode`` on the C++ codec; NumPy oracle fallback."""
+        from raft_tpu import native
+
+        out = native.apply_matrix(self.decode_matrix(rows), shards)
+        if out is None:
+            return self.decode(shards, rows)
+        return self.unsplit(out)
+
     # --------------------------------------------------------------- XLA path
     def _luts(self, M: np.ndarray) -> np.ndarray:
         """u8[rows, cols, 256] constant-multiplication tables for matrix M."""

@@ -1,0 +1,102 @@
+"""ctypes bindings for the C++ host codec (native/rs_codec.cpp).
+
+The library is built lazily with g++ on first use and cached next to the
+source; every entry point degrades to the NumPy oracle when the toolchain
+or the .so is unavailable, so the framework never *requires* the native
+path — it is the fast host data plane, not a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parents[2] / "native" / "rs_codec.cpp"
+_LIB = _SRC.with_suffix(".so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The codec library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _SRC.exists() or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError:
+            return None
+        lib.rs_apply_matrix.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_long,
+        ]
+        lib.rs_apply_matrix.restype = None
+        lib.rs_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+        lib.rs_gf_mul.restype = ctypes.c_uint8
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def apply_matrix(matrix: np.ndarray, rows: np.ndarray) -> Optional[np.ndarray]:
+    """out[r] = XOR_c mul(matrix[r, c], rows[c]) via the C++ codec.
+
+    ``rows``: u8[in_rows, ...] (trailing dims flattened); returns
+    u8[out_rows, ...] or None when the library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    matrix = np.ascontiguousarray(matrix, np.uint8)
+    rows_c = np.ascontiguousarray(rows, np.uint8)
+    out_rows, in_rows = matrix.shape
+    assert rows_c.shape[0] == in_rows
+    row_bytes = int(rows_c[0].size)
+    out = np.empty((out_rows,) + rows_c.shape[1:], np.uint8)
+    lib.rs_apply_matrix(
+        rows_c.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        in_rows,
+        out_rows,
+        row_bytes,
+    )
+    return out
+
+
+def gf_mul(a: int, b: int) -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    return int(lib.rs_gf_mul(a, b))

@@ -498,7 +498,7 @@ class RaftEngine:
                 data = np.frombuffer(
                     b"".join(self._uncommitted[i][0] for i in idx), np.uint8
                 ).reshape(len(idx), self.cfg.entry_bytes)
-                shards = self._code.encode(data)[p]
+                shards = self._code.encode_host(data)[p]
                 self.state = install_entries(
                     self.state, p, lo, shards, log_terms,
                     self.leader_term, self.commit_watermark,
