@@ -77,8 +77,9 @@ class RaftConfig:
         # more failures than the next odd size down) but even sizes are valid
         # Raft (majority = n//2 + 1) and arise when a mesh has an even device
         # count, so they are allowed rather than rejected.
-        if self.batch_size < 1 or self.batch_size > self.log_capacity:
-            raise ValueError("batch_size must be in [1, log_capacity]")
+        if self.batch_size < 1 or 2 * self.batch_size > self.log_capacity:
+            # >= 2B so a window's two ring pieces never overlap (core.ring)
+            raise ValueError("log_capacity must be >= 2 * batch_size")
         if (self.rs_k is None) != (self.rs_m is None):
             raise ValueError("rs_k and rs_m must be set together")
         if self.rs_k is not None:

@@ -1,0 +1,78 @@
+"""Ring-buffer window access without generic scatter/gather.
+
+XLA lowers 2-D advanced-index updates (``buf.at[rows, dest].set``) on TPU
+to a *generic scatter* — a sequential per-element DMA loop (~80 ns per
+updated row; a [3, 1024] window costs ~250 us). The protocol's windows are
+contiguous-with-wraparound in slot space, so they decompose into at most
+two contiguous pieces; these helpers express every window read/write as
+``dynamic_slice`` + select + ``dynamic_update_slice`` on those pieces
+(~1 us for the same window — measured on v5e).
+
+Both helpers require ``capacity >= 2 * B`` so the two pieces cannot
+overlap (RaftConfig validates this).
+
+Piece layout for a window of B slots starting at slot ``s``:
+- piece A at ``min(s, C - B)`` — covers the tail part (or the whole window
+  when it does not wrap);
+- piece B at ``0`` — covers the wrapped head (a no-op rewrite of current
+  bytes when the window does not wrap).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _piece(buf: jax.Array, win: jax.Array, s: jax.Array, mask: jax.Array,
+           base: jax.Array) -> jax.Array:
+    """Read-modify-write one contiguous piece of the window.
+
+    ``buf``: [L, C, ...]; ``win``: [L, B, ...] window values (win[:, j] is
+    the value for slot (s + j) % C); ``mask``: bool[L, B] which window
+    lanes actually write; ``base``: i32[] piece start slot.
+    """
+    L, C = buf.shape[0], buf.shape[1]
+    B = win.shape[1]
+    zeros = (0,) * (buf.ndim - 2)
+    cur = lax.dynamic_slice(buf, (0, base) + zeros, (L, B) + buf.shape[2:])
+    # window-relative position of each covered slot; >= B when the slot is
+    # outside the window (then current bytes are written back unchanged)
+    rel = (base + jnp.arange(B, dtype=jnp.int32) - s) % C
+    safe = jnp.clip(rel, 0, B - 1)
+    win_at = jnp.take(win, safe, axis=1)
+    mask_at = jnp.take(mask, safe, axis=1)
+    sel = (rel < B)[None, :] & mask_at
+    sel = sel.reshape(sel.shape + (1,) * (buf.ndim - 2))
+    return lax.dynamic_update_slice(
+        buf, jnp.where(sel, win_at, cur), (0, base) + zeros
+    )
+
+
+def write_window(buf: jax.Array, win: jax.Array, s: jax.Array,
+                 mask: jax.Array) -> jax.Array:
+    """Masked write of window ``win`` at slots [s, s+B) mod C into ``buf``.
+
+    buf: [L, C, ...]; win: [L, B, ...]; s: i32[] start slot; mask: bool[L, B].
+    """
+    C, B = buf.shape[1], win.shape[1]
+    buf = _piece(buf, win, s, mask, jnp.minimum(s, C - B))
+    return _piece(buf, win, s, mask, jnp.zeros_like(s))
+
+
+def read_window(buf: jax.Array, s: jax.Array, B: int) -> jax.Array:
+    """Window [s, s+B) mod C of ``buf`` -> [L, B, ...]."""
+    L, C = buf.shape[0], buf.shape[1]
+    zeros = (0,) * (buf.ndim - 2)
+    sA = jnp.minimum(s, C - B)
+    a = lax.dynamic_slice(buf, (0, sA) + zeros, (L, B) + buf.shape[2:])
+    b = lax.dynamic_slice(buf, (0, 0) + zeros, (L, B) + buf.shape[2:])
+    j = jnp.arange(B, dtype=jnp.int32)
+    no_wrap = s + j < C                     # bool[B]
+    ia = jnp.clip(s + j - sA, 0, B - 1)
+    ib = jnp.clip(s + j - C, 0, B - 1)
+    at = jnp.take(a, ia, axis=1)
+    bt = jnp.take(b, ib, axis=1)
+    cond = no_wrap.reshape((1, B) + (1,) * (buf.ndim - 2))
+    return jnp.where(cond, at, bt)

@@ -41,7 +41,11 @@ from flax import struct
 
 from raft_tpu.config import RaftConfig
 
-NO_VOTE = jnp.int32(-1)
+# A Python int, NOT jnp.int32(-1): a closure-captured device array embeds a
+# constant into every jitted program that touches it, which defeats XLA's
+# in-place buffer aliasing inside lax.scan (measured ~1000x slowdown of the
+# replication scan from one captured scalar).
+NO_VOTE = -1
 
 
 @struct.dataclass

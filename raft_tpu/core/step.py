@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.comm import Comm
+from raft_tpu.core.ring import read_window, write_window
 from raft_tpu.core.state import NO_VOTE, ReplicaState, last_log_term, slot_of
 from raft_tpu.quorum.commit import commit_from_match
 
@@ -132,10 +133,14 @@ def replicate_step(
     client_count = jnp.clip(client_count, 0, B)
     legit = leader_term >= 1
 
-    # ---- 1. Leader ingests the client batch into its own log --------------
+    # ---- 1. Frontier accounting (the leader's client batch) ---------------
     # (reference: LogReq case, append + LastApplied++, main.go:327-331)
     # A deposed leader (its own term already past leader_term) must not
     # ingest: those entries would carry a stale term.
+    # There is NO separate ingest scatter: the frontier window below writes
+    # the batch into every accepting row — the leader's included — so the
+    # leader's log receives the bytes exactly once (one full-buffer update
+    # fewer per step; this path is the <50 us budget, SURVEY.md §6).
     leader_current = legit & (comm.all_gather(term0)[leader] <= leader_term)
     # Ring backpressure: ingest may only overwrite slots of *committed*
     # entries (committed = consumed; that is the ring's contract). Without
@@ -150,17 +155,6 @@ def replicate_step(
         leader_current, jnp.minimum(client_count, jnp.maximum(room, 0)), 0
     )
     ingest_row = is_leader_row & leader_current
-    ingest_mask = ingest_row[:, None] & (barange < frontier_count)[None, :]
-    ingest_pos = slot_of(state.last_index[:, None] + 1 + barange[None, :], cap)
-    cur_p = state.log_payload[rows, ingest_pos]            # u8[L, B, S]
-    cur_t = state.log_term[rows, ingest_pos]               # i32[L, B]
-    log_payload = state.log_payload.at[rows, ingest_pos].set(
-        jnp.where(ingest_mask[..., None], client_payload, cur_p)
-    )
-    log_term = state.log_term.at[rows, ingest_pos].set(
-        jnp.where(ingest_mask, leader_term, cur_t)
-    )
-    last_index = state.last_index + jnp.where(ingest_row, frontier_count, 0)
     frontier_start = leader_last0 + 1
     leader_last = leader_last0 + frontier_count            # post-ingest
 
@@ -170,20 +164,18 @@ def replicate_step(
     # NextIndex=1 on election, main.go:281, forcing a full resend).
     heard = alive_l & legit & (leader_term >= term0)       # reject stale leader
     m_eff = jnp.where(state.match_term == leader_term, state.match_index, 0)
-    m_eff = jnp.where(is_leader_row & leader_current, last_index, m_eff)
+    m_eff = jnp.where(ingest_row, leader_last, m_eff)
+    log_term, log_payload, last_index = (
+        state.log_term, state.log_payload, state.last_index,
+    )
 
-    def materialize(ws):
-        """Window [ws, ws+B) of the leader's log, broadcast to every row."""
-        wpos = slot_of(ws + barange, cap)
-        win_p = comm.select_row(jnp.take(log_payload, wpos, axis=1), leader)[None]
-        win_t = comm.select_row(jnp.take(log_term, wpos, axis=1), leader)
-        prev_slot = slot_of(jnp.maximum(ws - 1, 1), cap)
-        prev_term = jnp.where(
-            ws == 1, 0, comm.select_row(log_term[:, prev_slot], leader)
+    def leader_prev_term(lt, ws, prev_slot):
+        return jnp.where(
+            ws == 1, 0, comm.select_row(lt[:, prev_slot], leader)
         )
-        return wpos, win_p, win_t, prev_term, prev_slot
 
-    def apply_window(carry, ws, count, win_p, win_t, prev_term, prev_slot, wpos):
+    def apply_window(carry, ws, count, win_p, win_t, prev_term, prev_slot,
+                     force_leader_row=False):
         """Follower consistency check + append for one window.
 
         Reference checks (main.go:129-146): term too low -> reject; gap ->
@@ -191,6 +183,11 @@ def replicate_step(
         (main.go:148). Here: same gates vectorized, the overlap is compared
         term-wise, and conflicting suffixes are truncated (§5.3). A
         zero-count window still verifies the prev point (heartbeat).
+
+        The writes go through ``core.ring.write_window`` (two contiguous
+        dynamic-update-slice pieces): a 2-D advanced-index update would
+        lower to XLA's generic scatter, a sequential per-element DMA loop
+        on TPU (~250 us per window vs ~1 us for the slice form).
         """
         log_term, log_payload, last_index, m_eff = carry
         my_prev_t = log_term[:, prev_slot]                 # i32[L]
@@ -198,21 +195,30 @@ def replicate_step(
             (last_index >= ws - 1) & (my_prev_t == prev_term)
         )
         accept = heard & ~slow_l & has_prev                # bool[L]
+        if force_leader_row:
+            # the leader always accepts its own fresh batch (it IS the
+            # window's source); its prev point is its own log tail
+            accept = accept | ingest_row
         valid = barange < count                            # bool[B]
-
         widx = ws + barange                                # i32[B] global idx
-        my_win_t = jnp.take(log_term, wpos, axis=1)        # i32[L, B]
+        my_win_t = read_window(log_term, slot_of(ws, cap), B)  # i32[L, B]
         exists = widx[None, :] <= last_index[:, None]      # bool[L, B]
         mismatch = exists & (my_win_t != win_t[None, :]) & valid[None, :]
         any_mm = jnp.any(mismatch, axis=1)                 # bool[L]
 
         write = accept[:, None] & valid[None, :]           # bool[L, B]
-        cur_wp = jnp.take(log_payload, wpos, axis=1)
-        log_payload = log_payload.at[:, wpos].set(
-            jnp.where(write[..., None], jnp.broadcast_to(win_p, cur_wp.shape), cur_wp)
+        start_slot = slot_of(ws, cap)
+        log_payload = write_window(
+            log_payload,
+            jnp.broadcast_to(win_p, (rows.shape[0], B, log_payload.shape[-1])),
+            start_slot,
+            write,
         )
-        log_term = log_term.at[:, wpos].set(
-            jnp.where(write, win_t[None, :], my_win_t)
+        log_term = write_window(
+            log_term,
+            jnp.broadcast_to(win_t[None, :], my_win_t.shape),
+            start_slot,
+            write,
         )
         we = ws + count - 1                                # = ws-1 on heartbeat
         # No conflict: keep any consistent suffix beyond the window (never
@@ -233,40 +239,49 @@ def replicate_step(
     # cannot be log-healed (its next window's prev-check fails, so it stalls
     # rather than accepting wrapped bytes); it needs snapshot install
     # (checkpoint subsystem) to rejoin, exactly like Raft's InstallSnapshot
-    # after log compaction.
+    # after log compaction. It serves only entries already in the leader's
+    # log (<= leader_last0): fresh entries ride the frontier window.
     matches0 = comm.all_gather(m_eff)                      # i32[R]
     repair_mask = alive & ~slow
     horizon = jnp.maximum(leader_last - cap + 1, 1)
     repair_ws = jnp.maximum(
-        jnp.min(jnp.where(repair_mask, matches0, leader_last)) + 1, horizon
+        jnp.min(jnp.where(repair_mask, matches0, leader_last0)) + 1, horizon
     )
     repair_count = jnp.where(
-        legit, jnp.clip(leader_last - repair_ws + 1, 0, B), 0
+        legit, jnp.clip(leader_last0 - repair_ws + 1, 0, B), 0
     )
     carry = (log_term, log_payload, last_index, m_eff)
     if not ec:
-        wpos, win_p, win_t, prev_term, prev_slot = materialize(repair_ws)
-        carry = apply_window(
-            carry, repair_ws, repair_count, win_p, win_t, prev_term, prev_slot, wpos
+        # In the steady state every live replica is caught up and the repair
+        # count is 0: skip the whole gather+scatter via cond (the branch is
+        # the step's second full window of HBM traffic).
+        def do_repair(carry):
+            lt, lp = carry[0], carry[1]
+            rslot = slot_of(repair_ws, cap)
+            win_p = comm.select_row(read_window(lp, rslot, B), leader)[None]
+            win_t = comm.select_row(read_window(lt, rslot, B), leader)
+            prev_slot = slot_of(jnp.maximum(repair_ws - 1, 1), cap)
+            prev_term = leader_prev_term(lt, repair_ws, prev_slot)
+            return apply_window(
+                carry, repair_ws, repair_count, win_p, win_t, prev_term,
+                prev_slot,
+            )
+
+        carry = jax.lax.cond(
+            repair_count > 0, do_repair, lambda c: c, carry
         )
 
     # ---- 4. Frontier window: the fresh client batch ------------------------
-    fpos = slot_of(frontier_start + barange, cap)
-    if ec:
-        # Each replica receives its own shard (scatter); the leader's log
-        # cannot source peers' shards, so only fresh entries move here.
-        win_p = client_payload
-        win_t = jnp.broadcast_to(leader_term, (B,))
-        prev_slot = slot_of(jnp.maximum(frontier_start - 1, 1), cap)
-        prev_term = jnp.where(
-            frontier_start == 1,
-            0,
-            comm.select_row(carry[0][:, prev_slot], leader),
-        )
-    else:
-        _, win_p, win_t, prev_term, prev_slot = materialize(frontier_start)
+    # The window's source is the client batch itself — identical full copies
+    # per row without EC (what the reference's full-payload sends carry,
+    # main.go:344-371), each replica's own RS shard with EC (the scatter of
+    # the north star). No gather-back from the leader's log.
+    win_t = jnp.where(barange < frontier_count, leader_term, 0)
+    prev_slot = slot_of(jnp.maximum(frontier_start - 1, 1), cap)
+    prev_term = leader_prev_term(carry[0], frontier_start, prev_slot)
     carry = apply_window(
-        carry, frontier_start, frontier_count, win_p, win_t, prev_term, prev_slot, fpos
+        carry, frontier_start, frontier_count, client_payload, win_t,
+        prev_term, prev_slot, force_leader_row=True,
     )
     log_term, log_payload, last_index, m_eff = carry
 

@@ -1,0 +1,65 @@
+"""Unit tests for ring-window read/write vs a NumPy reference."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core.ring import read_window, write_window
+
+L, C, B, S = 3, 64, 16, 4
+
+
+def np_write(buf, win, s, mask):
+    out = buf.copy()
+    for l in range(L):
+        for j in range(B):
+            if mask[l, j]:
+                out[l, (s + j) % C] = win[l, j]
+    return out
+
+
+def np_read(buf, s):
+    return np.stack(
+        [[buf[l, (s + j) % C] for j in range(B)] for l in range(L)]
+    )
+
+
+@pytest.mark.parametrize("s", [0, 5, C - B, C - B + 1, C - 5, C - 1])
+class TestRing:
+    def test_write_matches_numpy(self, s):
+        rng = np.random.default_rng(s)
+        buf = rng.integers(0, 256, (L, C, S), dtype=np.uint8)
+        win = rng.integers(0, 256, (L, B, S), dtype=np.uint8)
+        mask = rng.random((L, B)) < 0.6
+        got = np.asarray(
+            write_window(jnp.asarray(buf), jnp.asarray(win), jnp.int32(s),
+                         jnp.asarray(mask))
+        )
+        np.testing.assert_array_equal(got, np_write(buf, win, s, mask))
+
+    def test_write_2d_buffer(self, s):
+        rng = np.random.default_rng(100 + s)
+        buf = rng.integers(0, 1000, (L, C), dtype=np.int32)
+        win = rng.integers(0, 1000, (L, B), dtype=np.int32)
+        mask = rng.random((L, B)) < 0.5
+        got = np.asarray(
+            write_window(jnp.asarray(buf), jnp.asarray(win), jnp.int32(s),
+                         jnp.asarray(mask))
+        )
+        np.testing.assert_array_equal(got, np_write(buf, win, s, mask))
+
+    def test_read_matches_numpy(self, s):
+        rng = np.random.default_rng(200 + s)
+        buf = rng.integers(0, 256, (L, C, S), dtype=np.uint8)
+        got = np.asarray(read_window(jnp.asarray(buf), jnp.int32(s), B))
+        np.testing.assert_array_equal(got, np_read(buf, s))
+
+    def test_read_write_roundtrip(self, s):
+        rng = np.random.default_rng(300 + s)
+        buf = rng.integers(0, 256, (L, C, S), dtype=np.uint8)
+        win = rng.integers(0, 256, (L, B, S), dtype=np.uint8)
+        mask = np.ones((L, B), bool)
+        buf2 = write_window(jnp.asarray(buf), jnp.asarray(win), jnp.int32(s),
+                            jnp.asarray(mask))
+        got = np.asarray(read_window(buf2, jnp.int32(s), B))
+        np.testing.assert_array_equal(got, win)
