@@ -53,13 +53,13 @@ def main(samples: int = 12) -> None:
     rng = np.random.default_rng(cfg.seed)
 
     def make(T):
-        payloads = jnp.asarray(
-            rng.integers(
-                0, 256,
-                (T, cfg.n_replicas, cfg.batch_size, cfg.entry_bytes),
-                dtype=np.uint8,
-            )
+        # folded device layout (core.state): i32[T, B, R*W], identical lane
+        # blocks per replica (full-copy replication, no EC)
+        words = rng.integers(
+            np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+            (T, cfg.batch_size, cfg.shard_words), dtype=np.int32,
         )
+        payloads = jnp.asarray(np.tile(words, (1, 1, cfg.n_replicas)))
         return payloads, jnp.full((T,), cfg.batch_size, jnp.int32)
 
     args_small, args_big = make(T_SMALL), make(T_BIG)

@@ -80,6 +80,9 @@ class RaftConfig:
         if self.batch_size < 1 or 2 * self.batch_size > self.log_capacity:
             # >= 2B so a window's two ring pieces never overlap (core.ring)
             raise ValueError("log_capacity must be >= 2 * batch_size")
+        if self.log_capacity % self.batch_size:
+            # core.ring's gather-free window rotation needs B | C
+            raise ValueError("log_capacity must be a multiple of batch_size")
         if (self.rs_k is None) != (self.rs_m is None):
             raise ValueError("rs_k and rs_m must be set together")
         if self.rs_k is not None:
@@ -94,9 +97,16 @@ class RaftConfig:
                 raise ValueError("ec_commit_margin must be in [0, rs_m]")
         if self.payload_shards < 1:
             raise ValueError("payload_shards must be >= 1")
-        if self.shard_bytes % self.payload_shards:
+        if self.shard_bytes % 4:
+            # device payload storage is packed as int32 lanes (core.state
+            # layout); each replica's per-entry bytes must fill whole words
             raise ValueError(
-                "per-entry stored bytes must divide evenly over payload_shards"
+                "per-entry stored bytes (entry_bytes, or entry_bytes/rs_k "
+                "under EC) must be a multiple of 4"
+            )
+        if self.shard_words % self.payload_shards:
+            raise ValueError(
+                "per-entry stored words must divide evenly over payload_shards"
             )
 
     @property
@@ -121,3 +131,8 @@ class RaftConfig:
     def shard_bytes(self) -> int:
         """Per-replica stored bytes per entry (full copy when EC is off)."""
         return self.entry_bytes // self.rs_k if self.ec_enabled else self.entry_bytes
+
+    @property
+    def shard_words(self) -> int:
+        """Per-replica stored int32 lanes per entry (device payload layout)."""
+        return self.shard_bytes // 4

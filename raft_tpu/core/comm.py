@@ -43,6 +43,16 @@ class Comm:
         """Broadcast one replica's row to all: [L, ...] -> [...] of row ``idx``."""
         raise NotImplementedError
 
+    def leader_cols(self, win: jax.Array, leader: jax.Array, w: int) -> jax.Array:
+        """Replace every replica's lane block with the leader's.
+
+        ``win``: [B, L*w] folded payload window (core.state layout); result
+        has the leader's w lanes in every local block — the payload of the
+        reference's leader->peer full/suffix sends (main.go:344-361), as a
+        collective over the lane axis.
+        """
+        raise NotImplementedError
+
 
 class SingleDeviceComm(Comm):
     """All R replica rows resident on one device (L == R)."""
@@ -58,6 +68,12 @@ class SingleDeviceComm(Comm):
 
     def select_row(self, x: jax.Array, idx) -> jax.Array:
         return x[idx]
+
+    def leader_cols(self, win: jax.Array, leader: jax.Array, w: int) -> jax.Array:
+        block = lax.dynamic_slice(
+            win, (jnp.int32(0), leader * w), (win.shape[0], w)
+        )
+        return jnp.tile(block, (1, self.n_replicas))
 
 
 class MeshComm(Comm):
@@ -79,3 +95,11 @@ class MeshComm(Comm):
 
     def select_row(self, x: jax.Array, idx) -> jax.Array:
         return lax.all_gather(x, self.axis, tiled=True)[idx]
+
+    def leader_cols(self, win: jax.Array, leader: jax.Array, w: int) -> jax.Array:
+        # gather all replicas' lane blocks over ICI, keep the leader's
+        # (w == the local lane count: L == 1 rows per device)
+        g = lax.all_gather(win, self.axis, axis=1, tiled=True)
+        return lax.dynamic_slice(
+            g, (jnp.int32(0), leader * w), (win.shape[0], w)
+        )

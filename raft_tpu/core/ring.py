@@ -2,20 +2,28 @@
 
 XLA lowers 2-D advanced-index updates (``buf.at[rows, dest].set``) on TPU
 to a *generic scatter* — a sequential per-element DMA loop (~80 ns per
-updated row; a [3, 1024] window costs ~250 us). The protocol's windows are
-contiguous-with-wraparound in slot space, so they decompose into at most
-two contiguous pieces; these helpers express every window read/write as
-``dynamic_slice`` + select + ``dynamic_update_slice`` on those pieces
-(~1 us for the same window — measured on v5e).
+updated row; a [3, 1024] window costs ~250 us). Worse, even a 1-D
+``jnp.take`` with a traced index vector becomes a generic gather: a
+``take(valid, idx)`` on a [1024] bool costs ~8 us on v5e — per call. The
+protocol's windows are contiguous-with-wraparound in slot space, so every
+window op here is expressed with only three primitives XLA compiles to
+straight-line DMA on TPU:
 
-Both helpers require ``capacity >= 2 * B`` so the two pieces cannot
-overlap (RaftConfig validates this).
+- ``dynamic_slice`` / ``dynamic_update_slice`` on contiguous pieces;
+- window-content *rotation* as ``concatenate([win, win])`` + one
+  ``dynamic_slice`` at the rotation offset (no gather);
+- validity masks as *arithmetic on an iota* (``rel < count``), never a
+  gathered mask array.
 
 Piece layout for a window of B slots starting at slot ``s``:
 - piece A at ``min(s, C - B)`` — covers the tail part (or the whole window
   when it does not wrap);
-- piece B at ``0`` — covers the wrapped head (a no-op rewrite of current
-  bytes when the window does not wrap).
+- piece B at ``0`` — covers the wrapped head (a fully-masked rewrite of
+  current bytes when the window does not wrap).
+
+Requirements (validated by RaftConfig): ``C >= 2 * B`` so the two pieces
+cannot overlap, and ``C % B == 0`` so the rotation offset
+``(base - s) mod B`` equals ``(base - s) mod C`` on in-window lanes.
 """
 
 from __future__ import annotations
@@ -25,54 +33,88 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _piece(buf: jax.Array, win: jax.Array, s: jax.Array, mask: jax.Array,
-           base: jax.Array) -> jax.Array:
-    """Read-modify-write one contiguous piece of the window.
+def _rot(win2: jax.Array, s: jax.Array, base: jax.Array, B: int,
+         axis: int) -> jax.Array:
+    """Window values aligned to piece ``base``: out[j] = win[(base+j-s) % B].
 
-    ``buf``: [L, C, ...]; ``win``: [L, B, ...] window values (win[:, j] is
-    the value for slot (s + j) % C); ``mask``: bool[L, B] which window
-    lanes actually write; ``base``: i32[] piece start slot.
+    ``win2`` is the window doubled along ``axis`` ([2B] there); the rotation
+    is one contiguous dynamic_slice — in-window lanes get the right value,
+    out-of-window lanes get junk the caller's mask discards.
     """
-    L, C = buf.shape[0], buf.shape[1]
-    B = win.shape[1]
-    zeros = (0,) * (buf.ndim - 2)
-    cur = lax.dynamic_slice(buf, (0, base) + zeros, (L, B) + buf.shape[2:])
-    # window-relative position of each covered slot; >= B when the slot is
-    # outside the window (then current bytes are written back unchanged)
-    rel = (base + jnp.arange(B, dtype=jnp.int32) - s) % C
-    safe = jnp.clip(rel, 0, B - 1)
-    win_at = jnp.take(win, safe, axis=1)
-    mask_at = jnp.take(mask, safe, axis=1)
-    sel = (rel < B)[None, :] & mask_at
-    sel = sel.reshape(sel.shape + (1,) * (buf.ndim - 2))
-    return lax.dynamic_update_slice(
-        buf, jnp.where(sel, win_at, cur), (0, base) + zeros
-    )
+    offset = (base - s) % B
+    starts = [jnp.int32(0)] * win2.ndim
+    starts[axis] = offset
+    sizes = list(win2.shape)
+    sizes[axis] = B
+    return lax.dynamic_slice(win2, starts, sizes)
 
 
-def write_window(buf: jax.Array, win: jax.Array, s: jax.Array,
-                 mask: jax.Array) -> jax.Array:
-    """Masked write of window ``win`` at slots [s, s+B) mod C into ``buf``.
+def write_window_cols(buf: jax.Array, win: jax.Array, s: jax.Array,
+                      count: jax.Array, lane_sel: jax.Array) -> jax.Array:
+    """Masked write of slot-major window ``win`` at slots [s, s+B) mod C.
 
-    buf: [L, C, ...]; win: [L, B, ...]; s: i32[] start slot; mask: bool[L, B].
+    buf: [C, M] folded payload (core.state layout); win: [B, M]; s: i32[]
+    start slot; count: i32[] window rows to write (a prefix); lane_sel:
+    bool[M] lanes (per-replica word blocks) that accept. This is the
+    hot-path payload write.
     """
-    C, B = buf.shape[1], win.shape[1]
-    buf = _piece(buf, win, s, mask, jnp.minimum(s, C - B))
-    return _piece(buf, win, s, mask, jnp.zeros_like(s))
+    C, B = buf.shape[0], win.shape[0]
+    win2 = jnp.concatenate([win, win], axis=0)
+    j = jnp.arange(B, dtype=jnp.int32)
+    for base in (jnp.minimum(s, C - B), jnp.zeros_like(s)):
+        cur = lax.dynamic_slice(buf, (base, 0), (B, buf.shape[1]))
+        rel = (base + j - s) % C
+        sel = (rel < count)[:, None] & lane_sel[None, :]
+        win_at = _rot(win2, s, base, B, axis=0)
+        buf = lax.dynamic_update_slice(
+            buf, jnp.where(sel, win_at, cur), (base, 0)
+        )
+    return buf
+
+
+def read_window_cols(buf: jax.Array, s: jax.Array, B: int) -> jax.Array:
+    """Slot-major window [s, s+B) mod C of ``buf`` [C, M] -> [B, M]."""
+    C = buf.shape[0]
+    sA = jnp.minimum(s, C - B)
+    a = lax.dynamic_slice(buf, (sA, 0), (B, buf.shape[1]))
+    b = lax.dynamic_slice(buf, (0, 0), (B, buf.shape[1]))
+    ab = jnp.concatenate([a, b], axis=0)
+    # piece A starts at sA and piece B continues at exactly sA + B == C in
+    # the wrap case, so the stitched window is ab[s - sA : s - sA + B]
+    return lax.dynamic_slice(ab, (s - sA, 0), (B, buf.shape[1]))
+
+
+def write_window_rows(buf: jax.Array, win_t: jax.Array, s: jax.Array,
+                      count: jax.Array, accept: jax.Array) -> jax.Array:
+    """Masked write of a per-slot value window into row-major ``buf``.
+
+    buf: [L, C] (the log_term array); win_t: i32[B] value per window slot
+    (identical for every accepting row — a window carries one term per
+    entry); s: start slot; count: rows-to-write prefix; accept: bool[L].
+    """
+    L, C = buf.shape
+    B = win_t.shape[0]
+    win2 = jnp.concatenate([win_t, win_t], axis=0)
+    j = jnp.arange(B, dtype=jnp.int32)
+    for base in (jnp.minimum(s, C - B), jnp.zeros_like(s)):
+        cur = lax.dynamic_slice(buf, (0, base), (L, B))
+        rel = (base + j - s) % C
+        sel = accept[:, None] & (rel < count)[None, :]
+        win_at = _rot(win2, s, base, B, axis=0)
+        buf = lax.dynamic_update_slice(
+            buf, jnp.where(sel, win_at[None, :], cur), (0, base)
+        )
+    return buf
 
 
 def read_window(buf: jax.Array, s: jax.Array, B: int) -> jax.Array:
-    """Window [s, s+B) mod C of ``buf`` -> [L, B, ...]."""
-    L, C = buf.shape[0], buf.shape[1]
+    """Window [s, s+B) mod C of row-major ``buf`` [L, C, ...] -> [L, B, ...]."""
+    C = buf.shape[1]
     zeros = (0,) * (buf.ndim - 2)
     sA = jnp.minimum(s, C - B)
-    a = lax.dynamic_slice(buf, (0, sA) + zeros, (L, B) + buf.shape[2:])
-    b = lax.dynamic_slice(buf, (0, 0) + zeros, (L, B) + buf.shape[2:])
-    j = jnp.arange(B, dtype=jnp.int32)
-    no_wrap = s + j < C                     # bool[B]
-    ia = jnp.clip(s + j - sA, 0, B - 1)
-    ib = jnp.clip(s + j - C, 0, B - 1)
-    at = jnp.take(a, ia, axis=1)
-    bt = jnp.take(b, ib, axis=1)
-    cond = no_wrap.reshape((1, B) + (1,) * (buf.ndim - 2))
-    return jnp.where(cond, at, bt)
+    a = lax.dynamic_slice(buf, (0, sA) + zeros, (buf.shape[0], B) + buf.shape[2:])
+    b = lax.dynamic_slice(buf, (0, 0) + zeros, (buf.shape[0], B) + buf.shape[2:])
+    ab = jnp.concatenate([a, b], axis=1)
+    return lax.dynamic_slice(
+        ab, (0, s - sA) + zeros, (buf.shape[0], B) + buf.shape[2:]
+    )

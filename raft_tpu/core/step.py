@@ -55,7 +55,12 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.comm import Comm
-from raft_tpu.core.ring import read_window, write_window
+from raft_tpu.core.ring import (
+    read_window,
+    read_window_cols,
+    write_window_cols,
+    write_window_rows,
+)
 from raft_tpu.core.state import NO_VOTE, ReplicaState, last_log_term, slot_of
 from raft_tpu.quorum.commit import commit_from_match
 
@@ -81,9 +86,10 @@ class VoteInfo(NamedTuple):
 def replicate_step(
     comm: Comm,
     state: ReplicaState,
-    client_payload: jax.Array,  # u8[L, B, S] new entries for each local row —
-    #   identical rows when EC is off (full copies, like the reference's
-    #   full-payload sends main.go:344-371); row r = replica r's RS shard
+    client_payload: jax.Array,  # i32[B, L*W] new entries, folded slot-major
+    #   (core.state layout; fold_batch/fold_rows build it) — identical lane
+    #   blocks when EC is off (full copies, like the reference's
+    #   full-payload sends main.go:344-371); block r = replica r's RS shard
     #   when EC is on (the scatter of the north star).
     client_count: jax.Array,    # i32[]  valid entries in client_payload (<= B)
     leader: jax.Array,          # i32[]  global replica id of the leader
@@ -118,14 +124,16 @@ def replicate_step(
     repaired by reconstruction instead — see the ``ec`` package).
     """
     cap = state.capacity
-    B = client_payload.shape[1]
+    B = client_payload.shape[0]
+    M = client_payload.shape[1]                    # L * W folded lanes
     ids = comm.replica_ids()                       # i32[L]
+    L = ids.shape[0]
+    W = M // L                                     # i32 lanes per replica
     is_leader_row = ids == leader                  # bool[L]
     alive_l = alive[ids]                           # bool[L]
     slow_l = slow[ids]                             # bool[L]
     term0 = state.term
     barange = jnp.arange(B, dtype=jnp.int32)
-    rows = jnp.arange(ids.shape[0])[:, None]
     # Harden against malformed driver inputs: a batch can only carry [0, B]
     # entries, and terms start at 1 (term 0 = "no election ever held" — an
     # unelected leader must not ingest or commit; empty ring slots hold term
@@ -184,10 +192,12 @@ def replicate_step(
         term-wise, and conflicting suffixes are truncated (§5.3). A
         zero-count window still verifies the prev point (heartbeat).
 
-        The writes go through ``core.ring.write_window`` (two contiguous
-        dynamic-update-slice pieces): a 2-D advanced-index update would
-        lower to XLA's generic scatter, a sequential per-element DMA loop
-        on TPU (~250 us per window vs ~1 us for the slice form).
+        ``win_p`` is the folded i32[B, L*W] window (core.state layout); the
+        payload write is ``ring.write_window_cols`` — two contiguous
+        dynamic-update-slice pieces over slot-major rows. A 2-D
+        advanced-index update would lower to XLA's generic scatter, a
+        sequential per-element DMA loop on TPU (~250 us per window vs ~6 us
+        for the slice form on v5e).
         """
         log_term, log_payload, last_index, m_eff = carry
         my_prev_t = log_term[:, prev_slot]                 # i32[L]
@@ -206,19 +216,13 @@ def replicate_step(
         mismatch = exists & (my_win_t != win_t[None, :]) & valid[None, :]
         any_mm = jnp.any(mismatch, axis=1)                 # bool[L]
 
-        write = accept[:, None] & valid[None, :]           # bool[L, B]
         start_slot = slot_of(ws, cap)
-        log_payload = write_window(
-            log_payload,
-            jnp.broadcast_to(win_p, (rows.shape[0], B, log_payload.shape[-1])),
-            start_slot,
-            write,
+        accept_lanes = jnp.repeat(accept, W, total_repeat_length=M)  # bool[M]
+        log_payload = write_window_cols(
+            log_payload, win_p, start_slot, count, accept_lanes
         )
-        log_term = write_window(
-            log_term,
-            jnp.broadcast_to(win_t[None, :], my_win_t.shape),
-            start_slot,
-            write,
+        log_term = write_window_rows(
+            log_term, win_t, start_slot, count, accept
         )
         we = ws + count - 1                                # = ws-1 on heartbeat
         # No conflict: keep any consistent suffix beyond the window (never
@@ -258,7 +262,7 @@ def replicate_step(
         def do_repair(carry):
             lt, lp = carry[0], carry[1]
             rslot = slot_of(repair_ws, cap)
-            win_p = comm.select_row(read_window(lp, rslot, B), leader)[None]
+            win_p = comm.leader_cols(read_window_cols(lp, rslot, B), leader, W)
             win_t = comm.select_row(read_window(lt, rslot, B), leader)
             prev_slot = slot_of(jnp.maximum(repair_ws - 1, 1), cap)
             prev_term = leader_prev_term(lt, repair_ws, prev_slot)
@@ -272,10 +276,11 @@ def replicate_step(
         )
 
     # ---- 4. Frontier window: the fresh client batch ------------------------
-    # The window's source is the client batch itself — identical full copies
-    # per row without EC (what the reference's full-payload sends carry,
-    # main.go:344-371), each replica's own RS shard with EC (the scatter of
-    # the north star). No gather-back from the leader's log.
+    # The window's source is the client batch itself, already in the folded
+    # device layout — identical lane blocks without EC (what the reference's
+    # full-payload sends carry, main.go:344-371), each replica's own RS
+    # shard with EC (the scatter of the north star). No gather-back from the
+    # leader's log, no on-device broadcast.
     win_t = jnp.where(barange < frontier_count, leader_term, 0)
     prev_slot = slot_of(jnp.maximum(frontier_start - 1, 1), cap)
     prev_term = leader_prev_term(carry[0], frontier_start, prev_slot)
@@ -323,8 +328,11 @@ def replicate_step(
         voted_for=voted_for,
         last_index=last_index,
         commit_index=commit_index,
-        match_index=jnp.where(heard | is_leader_row, m_eff, state.match_index),
-        match_term=jnp.where(heard | is_leader_row, leader_term, state.match_term),
+        # Gated on ingest_row (leader row of a CURRENT term), not
+        # is_leader_row: a step driven for a stale/deposed leader must not
+        # clobber match state already verified for a newer term.
+        match_index=jnp.where(heard | ingest_row, m_eff, state.match_index),
+        match_term=jnp.where(heard | ingest_row, leader_term, state.match_term),
         log_term=log_term,
         log_payload=log_payload,
     )
@@ -344,7 +352,7 @@ def scan_replicate(
 ):
     """T replication steps as one compiled ``lax.scan`` — no host round-trip
     per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
-    ``payloads``: u8[T, L, B, S]; ``counts``: i32[T]."""
+    ``payloads``: i32[T, B, L*W] folded batches; ``counts``: i32[T]."""
 
     def body(st, xs):
         payload, count = xs

@@ -118,3 +118,25 @@ def encode_bitwise_xla(code: RSCode, data: jax.Array) -> jax.Array:
         (_parity_consts_key(code.n, code.k), code.m, code.k), d
     )
     return jnp.concatenate([d, parity])
+
+
+def fold_shards_device(shards: jax.Array) -> jax.Array:
+    """Device-side fold of shard rows into the log layout: u8[R, B, Sk] ->
+    i32[B, R*Wk] (same packing as core.state.fold_rows, no host round trip).
+
+    XLA's bitcast-convert packs the trailing length-4 u8 axis with element 0
+    least-significant — the same byte order as numpy's little-endian
+    ``view(np.int32)`` host fold (asserted by tests/test_ec.py)."""
+    r, b, sk = shards.shape
+    x = jnp.swapaxes(shards, 0, 1).reshape(b, r * sk // 4, 4)
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def encode_device(code: RSCode, data: jax.Array) -> jax.Array:
+    """Platform-dispatched encode: the Pallas kernel on TPU, the bitwise
+    XLA formulation elsewhere (CPU tests / interpret). This is the
+    production encode the engine's EC tick calls — the north star names the
+    Pallas RS encode as the TPU data path, so TPU must actually run it."""
+    if jax.devices()[0].platform == "tpu":
+        return encode_pallas(code, data)
+    return encode_bitwise_xla(code, data)

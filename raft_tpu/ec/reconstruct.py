@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.state import ReplicaState, slot_of
+from raft_tpu.core.state import ReplicaState, slot_of, unfold_bytes
 from raft_tpu.ec.rs import RSCode
 
 
@@ -31,7 +31,12 @@ def gather_shard_window(
     """u8[len(rows), hi-lo+1, Sk] shard slices for log indices [lo, hi]."""
     idx = np.arange(lo, hi + 1)
     slots = (idx - 1) % state.capacity
-    return np.asarray(state.log_payload[np.asarray(rows)[:, None], slots[None, :]])
+    w = state.words_per_entry
+    n_rows = state.term.shape[0]
+    lp = np.asarray(state.log_payload).reshape(state.capacity, n_rows, w)
+    return unfold_bytes(
+        np.swapaxes(lp[slots], 0, 1)[np.asarray(rows)]   # [rows, N, w]
+    )
 
 
 def reconstruct(
@@ -54,7 +59,7 @@ def install_window(
     replica: int,
     start: jax.Array,          # i32[] first log index of the window
     count: jax.Array,          # i32[] valid entries
-    payload: jax.Array,        # u8[B, Sk] re-encoded shards for ``replica``
+    payload: jax.Array,        # i32[B, Wk] re-encoded shard words for ``replica``
     terms: jax.Array,          # i32[B] entry terms
     leader_term: jax.Array,    # i32[] term the installed prefix is verified for
     commit_to: jax.Array,      # i32[] commit index covered by the install
@@ -81,10 +86,11 @@ def install_window(
     valid = barange < count
     pos = slot_of(start + barange, cap)
 
-    row_p = state.log_payload[replica]
+    w = state.words_per_entry
+    cols = state.log_payload[:, replica * w : (replica + 1) * w]  # [C, Wk]
     row_t = state.log_term[replica]
-    row_p = row_p.at[pos].set(
-        jnp.where(valid[:, None], payload, row_p[pos])
+    cols = cols.at[pos].set(
+        jnp.where(valid[:, None], payload, cols[pos])
     )
     row_t = row_t.at[pos].set(jnp.where(valid, terms, row_t[pos]))
     we = start + count - 1
@@ -101,7 +107,9 @@ def install_window(
     )
     new_match = jnp.maximum(verified, we)
     return state.replace(
-        log_payload=state.log_payload.at[replica].set(row_p),
+        log_payload=state.log_payload.at[
+            :, replica * w : (replica + 1) * w
+        ].set(cols),
         log_term=state.log_term.at[replica].set(row_t),
         last_index=state.last_index.at[replica].set(new_last),
         match_index=state.match_index.at[replica].set(new_match),
@@ -137,7 +145,7 @@ def install_entries(
             replica,
             jnp.int32(start + ofs),
             jnp.int32(m),
-            jnp.asarray(buf),
+            jnp.asarray(np.ascontiguousarray(buf).view(np.int32)),
             jnp.asarray(tbuf),
             jnp.int32(leader_term),
             jnp.int32(commit_to),

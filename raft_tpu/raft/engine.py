@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.config import RaftConfig
-from raft_tpu.core.state import ReplicaState
+from raft_tpu.core.state import ReplicaState, fold_batch
 from raft_tpu.transport.base import Transport, make_transport
 
 FOLLOWER = "follower"
@@ -342,30 +342,34 @@ class RaftEngine:
         if not self.alive[r] or self.roles[r] != LEADER or self.leader_id != r:
             return
         cfg = self.cfg
-        B, S = cfg.batch_size, cfg.shard_bytes
+        B = cfg.batch_size
         take = min(len(self._queue), B)
         if take == 0:
             if self._hb_payload is None:
-                self._hb_payload = jnp.zeros((cfg.n_replicas, B, S), jnp.uint8)
+                self._hb_payload = jnp.zeros(
+                    (B, cfg.n_replicas * cfg.shard_words), jnp.int32
+                )
             payload = self._hb_payload
         elif cfg.ec_enabled:
-            # RS-encode the batch: row r of the shard matrix is what replica
-            # r stores (the scatter of the north star). Encode rides the
-            # bit-decomposition XLA path (ec.kernels; Pallas on TPU benches).
-            from raft_tpu.ec.kernels import encode_bitwise_xla
+            # RS-encode the batch: shard row r is what replica r stores (the
+            # scatter of the north star). Encode rides the platform-dispatched
+            # kernel (ec.kernels: Pallas on TPU, bit-decomposition XLA
+            # elsewhere); the shard rows fold into the device layout without
+            # leaving the device.
+            from raft_tpu.ec.kernels import encode_device, fold_shards_device
 
             data = np.zeros((B, cfg.entry_bytes), np.uint8)
             data[:take] = np.frombuffer(
                 b"".join(p for _, p in self._queue[:take]), np.uint8
             ).reshape(take, cfg.entry_bytes)
-            payload = encode_bitwise_xla(self._code, jnp.asarray(data))
+            payload = fold_shards_device(
+                encode_device(self._code, jnp.asarray(data))
+            )
         else:
-            buf = np.zeros((cfg.n_replicas, B, S), np.uint8)
             flat = np.frombuffer(
                 b"".join(p for _, p in self._queue[:take]), np.uint8
-            ).reshape(take, S)
-            buf[:, :take] = flat[None]
-            payload = jnp.asarray(buf)
+            ).reshape(take, cfg.entry_bytes)
+            payload = fold_batch(flat, cfg.n_replicas, B)
         self.state, info = self.t.replicate(
             self.state,
             payload,

@@ -58,7 +58,8 @@ class SingleDeviceTransport:
     ) -> Tuple[ReplicaState, RepInfo]:
         """T replication steps as one compiled ``lax.scan`` — no host
         round-trip per batch (SURVEY.md §7 hard part 1). ``payloads`` is
-        u8[T, R, B, S]; ``counts`` i32[T]."""
+        i32[T, B, R*W] folded batches (core.state.fold_batch); ``counts``
+        i32[T]."""
         return self._replicate_many(
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
             alive, slow,

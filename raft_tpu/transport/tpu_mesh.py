@@ -62,23 +62,26 @@ class TpuMeshTransport:
                 f"need {need} devices ({cfg.n_replicas} replicas x "
                 f"{payload_shards} payload shards), got {len(devices)}"
             )
-        if cfg.shard_bytes % payload_shards:
+        if cfg.shard_words % payload_shards:
             raise ValueError(
-                f"per-entry stored bytes ({cfg.shard_bytes}) must divide "
+                f"per-entry stored words ({cfg.shard_words}) must divide "
                 f"evenly over {payload_shards} payload shards"
             )
         self.payload_shards = payload_shards
         grid = np.array(devices[:need]).reshape(cfg.n_replicas, payload_shards)
         self.mesh = Mesh(grid, (AXIS, PAYLOAD_AXIS))
-        pax = PAYLOAD_AXIS if payload_shards > 1 else None
+        # The folded payload's lane axis is [R x P x W_local] flattened in
+        # that (major-to-minor) order, which is exactly how PartitionSpec
+        # splits one dimension over a tuple of mesh axes.
+        lanes = (AXIS, PAYLOAD_AXIS) if payload_shards > 1 else AXIS
         self._row = NamedSharding(self.mesh, P(AXIS))
-        self._payload3 = NamedSharding(self.mesh, P(AXIS, None, pax))
+        self._payload2 = NamedSharding(self.mesh, P(None, lanes))
         comm = MeshComm(cfg.n_replicas, AXIS)
 
         state_specs = ReplicaState(
             term=P(AXIS), voted_for=P(AXIS), last_index=P(AXIS),
             commit_index=P(AXIS), match_index=P(AXIS), match_term=P(AXIS),
-            log_term=P(AXIS), log_payload=P(AXIS, None, pax),
+            log_term=P(AXIS), log_payload=P(None, lanes),
         )
         info_specs = RepInfo(
             commit_index=P(), match=P(), max_term=P(),
@@ -93,7 +96,7 @@ class TpuMeshTransport:
                     ec=cfg.ec_enabled, commit_quorum=cfg.commit_quorum,
                 ),
                 mesh=self.mesh,
-                in_specs=(state_specs, P(AXIS, None, pax), P(), P(), P(), P(), P()),
+                in_specs=(state_specs, P(None, lanes), P(), P(), P(), P(), P()),
                 out_specs=(state_specs, info_specs),
                 check_vma=False,
             )
@@ -112,7 +115,7 @@ class TpuMeshTransport:
                 partial(scan_replicate, comm, cfg.ec_enabled, cfg.commit_quorum),
                 mesh=self.mesh,
                 in_specs=(
-                    state_specs, P(None, AXIS, None, pax), P(), P(), P(), P(), P(),
+                    state_specs, P(None, None, lanes), P(), P(), P(), P(), P(),
                 ),
                 out_specs=(state_specs, info_specs),
                 check_vma=False,
@@ -125,14 +128,15 @@ class TpuMeshTransport:
             term=self._row, voted_for=self._row, last_index=self._row,
             commit_index=self._row, match_index=self._row, match_term=self._row,
             log_term=NamedSharding(self.mesh, P(AXIS, None)),
-            log_payload=self._payload3,
+            log_payload=self._payload2,
         )
         return jax.tree.map(jax.device_put, state, shardings)
 
     def shard_rows(self, payload):
-        """Place a u8[R, B, S] per-replica payload one row per device (the
-        'scatter' of the north star when rows are RS shards)."""
-        return jax.device_put(payload, self._payload3)
+        """Place a folded i32[B, R*W] batch with each replica's lane block
+        on its own device (the 'scatter' of the north star when blocks are
+        RS shards)."""
+        return jax.device_put(payload, self._payload2)
 
     def replicate(
         self, state, client_payload, client_count, leader, leader_term, alive, slow
@@ -145,7 +149,7 @@ class TpuMeshTransport:
     def replicate_many(
         self, state, payloads, counts, leader, leader_term, alive, slow
     ) -> Tuple[ReplicaState, RepInfo]:
-        """u8[T, R, B, S] payloads → T steps in one compiled scan."""
+        """i32[T, B, R*W] folded payloads → T steps in one compiled scan."""
         return self._replicate_many(
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
             alive, slow,

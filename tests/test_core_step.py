@@ -12,7 +12,13 @@ import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.comm import SingleDeviceComm
-from raft_tpu.core.state import NO_VOTE, init_state, slot_of
+from raft_tpu.core.state import (
+    NO_VOTE,
+    fold_batch,
+    init_state,
+    payload_slot_bytes,
+    slot_of,
+)
 from raft_tpu.core.step import replicate_step, vote_step
 
 CFG = RaftConfig(n_replicas=3, entry_bytes=8, batch_size=4, log_capacity=32)
@@ -22,9 +28,9 @@ QUIET = jnp.zeros(3, bool)
 
 
 def batch(vals, rows=3, entry=8):
-    """u8[rows, B, entry] batch whose entries are filled with ``vals``."""
-    b = jnp.asarray(vals, jnp.uint8)[None, :, None]
-    return jnp.broadcast_to(b, (rows, len(vals), entry))
+    """Folded i32[B, rows*W] batch; every byte of entry j is ``vals[j]``."""
+    data = np.repeat(np.asarray(vals, np.uint8)[:, None], entry, axis=1)
+    return fold_batch(data, rows)
 
 
 def rep(state, payload, count, leader=0, term=1, alive=ALIVE, slow=QUIET):
@@ -84,7 +90,7 @@ class TestReplicate:
         # payload replicated byte-identically
         for r in range(3):
             np.testing.assert_array_equal(
-                np.asarray(state.log_payload[r, :4, 0]), [10, 11, 12, 13]
+                payload_slot_bytes(state, r)[:4, 0], [10, 11, 12, 13]
             )
 
     def test_partial_batch_masks_invalid_entries(self):
@@ -164,8 +170,9 @@ class TestReplicate:
         state, _ = vote(init_state(CFG), 0, 1)
         state, _ = rep(state, batch([1, 2, 0, 0]), 2)          # common prefix @1..2
         # fabricate: replica 1 has uncommitted term-1 junk at idx 3..4
+        w = state.words_per_entry
         lt = state.log_term.at[1, 2:4].set(1)
-        lp = state.log_payload.at[1, 2:4].set(99)
+        lp = state.log_payload.at[2:4, w : 2 * w].set(99)
         state = state.replace(
             log_term=lt, log_payload=lp,
             last_index=state.last_index.at[1].set(4),
@@ -176,7 +183,7 @@ class TestReplicate:
         assert int(info.commit_index) == 3
         assert int(state.last_index[1]) == 3          # junk truncated
         assert int(state.log_term[1, 2]) == 2
-        assert int(state.log_payload[1, 2, 0]) == 42
+        assert payload_slot_bytes(state, 1)[2, 0] == 42
 
     def test_consistent_suffix_not_truncated(self):
         """Entries beyond the window that are term-consistent survive —
@@ -223,12 +230,12 @@ class TestReplicate:
         state, info = rep(state, batch([0] * 4), 0, leader=1, term=2)
         assert int(state.commit_index[0]) == 4  # advanced only after repair
         np.testing.assert_array_equal(
-            np.asarray(state.log_payload[0, :4, 0]), [21, 22, 23, 24]
+            payload_slot_bytes(state, 0)[:4, 0], [21, 22, 23, 24]
         )
         for r in range(3):
             np.testing.assert_array_equal(
-                np.asarray(state.log_payload[r, :4]),
-                np.asarray(state.log_payload[1, :4]),
+                payload_slot_bytes(state, r)[:4],
+                payload_slot_bytes(state, 1)[:4],
             )
 
     def test_ring_wraparound(self):
@@ -238,7 +245,7 @@ class TestReplicate:
             state, info = rep(state, batch([i, i, i, i]), 4)
         assert int(info.commit_index) == 20
         assert int(slot_of(jnp.int32(20), 8)) == 3
-        assert int(state.log_payload[0, slot_of(jnp.int32(20), 8), 0]) == 4
+        assert payload_slot_bytes(state, 0)[int(slot_of(jnp.int32(20), 8)), 0] == 4
 
 
 class TestSingleReplica:

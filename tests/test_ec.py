@@ -169,3 +169,17 @@ class TestKernels:
         shards = np.asarray(encode_pallas(code, jnp.asarray(data)))
         rows = [1, 3, 4]
         np.testing.assert_array_equal(code.decode(shards[rows], rows), data)
+
+    def test_device_fold_matches_host_fold(self):
+        """fold_shards_device's bitcast packing must equal the host
+        np.view(int32) little-endian fold byte for byte — the two feed the
+        same device log layout (engine EC tick vs heal/re-serve paths)."""
+        from raft_tpu.core.state import fold_rows
+        from raft_tpu.ec.kernels import fold_shards_device
+
+        rng = np.random.default_rng(7)
+        shards = rng.integers(0, 256, (5, 8, 12), dtype=np.uint8)
+        np.testing.assert_array_equal(
+            np.asarray(fold_shards_device(jnp.asarray(shards))),
+            np.asarray(fold_rows(shards)),
+        )

@@ -57,7 +57,9 @@ class TestECCommit:
         e.run_until_leader()
         seqs = [e.submit(p) for p in payloads(4, seed=3)]
         e.run_until_committed(seqs[-1])
-        assert e.state.log_payload.shape[-1] == ENTRY // 3  # shard bytes
+        # folded layout: 5 replicas x (ENTRY/3 shard bytes / 4 bytes-per-word)
+        assert e.state.log_payload.shape[-1] == 5 * (ENTRY // 3 // 4)
+        assert e.state.words_per_entry == ENTRY // 3 // 4
 
     def test_slow_follower_commit_still_advances(self):
         """Config 4: 5 replicas, 1 induced-slow, quorum 4 of the remaining."""
@@ -204,7 +206,7 @@ class TestInstallWindow:
         # the suffix 5..10 must go
         state = install_window(
             state, 1, jnp.int32(1), jnp.int32(4),
-            jnp.zeros((4, ENTRY // 3), jnp.uint8),
+            jnp.zeros((4, ENTRY // 3 // 4), jnp.int32),
             jnp.full((4,), 3, jnp.int32), jnp.int32(3), jnp.int32(4),
         )
         assert int(state.last_index[1]) == 4
@@ -231,7 +233,7 @@ class TestInstallWindow:
         )
         state = install_window(
             state, 1, jnp.int32(1), jnp.int32(4),
-            jnp.zeros((4, ENTRY // 3), jnp.uint8),
+            jnp.zeros((4, ENTRY // 3 // 4), jnp.int32),
             jnp.full((4,), 3, jnp.int32), jnp.int32(3), jnp.int32(4),
         )
         assert int(state.last_index[1]) == 10

@@ -120,15 +120,16 @@ class TestDifferential:
         leader_id = int(lead.id.removeprefix("Server"))
         state, vi = t.request_votes(state, leader_id, 1, alive)
         assert int(vi.votes) == 3
+        from raft_tpu.core.state import fold_batch
+
         flat = np.frombuffer(b"".join(payload_bytes), np.uint8).reshape(
             n_entries, ENTRY
         )
         for ofs in range(0, n_entries, B):
             chunk = flat[ofs : ofs + B]
-            buf = np.zeros((3, B, ENTRY), np.uint8)
-            buf[:, : len(chunk)] = chunk[None]
             state, info = t.replicate(
-                state, jnp.asarray(buf), len(chunk), leader_id, 1, alive, slow
+                state, fold_batch(chunk, 3, B), len(chunk), leader_id, 1,
+                alive, slow,
             )
         assert int(info.commit_index) == n_entries
 

@@ -8,12 +8,13 @@ import numpy as np
 import pytest
 
 from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import fold_batch, payload_slot_bytes
 from raft_tpu.transport import SingleDeviceTransport, TpuMeshTransport
 
 
 def batch(vals, rows, entry=8):
-    b = jnp.asarray(vals, jnp.uint8)[None, :, None]
-    return jnp.broadcast_to(b, (rows, len(vals), entry))
+    data = np.repeat(np.asarray(vals, np.uint8)[:, None], entry, axis=1)
+    return fold_batch(data, rows)
 
 
 @pytest.fixture(params=[(3, 1), (5, 1), (3, 2), (4, 2)])
@@ -55,8 +56,8 @@ def test_mesh_matches_single_device(cfg):
         )
     for r in range(n):
         np.testing.assert_array_equal(
-            np.asarray(states["mesh"].log_payload[r, :6]),
-            np.asarray(states["single"].log_payload[r, :6]),
+            payload_slot_bytes(states["mesh"], r)[:6],
+            payload_slot_bytes(states["single"], r)[:6],
         )
     assert int(infos["mesh"].commit_index) == 6
 
@@ -80,16 +81,15 @@ def test_mesh_scan_replication(cfg):
     state = t.init()
     state, _ = t.request_votes(state, 0, 1, jnp.ones(n, bool))
     T, B = 5, cfg.batch_size
-    payloads = jnp.broadcast_to(
-        jnp.arange(T * B, dtype=jnp.uint8).reshape(T, 1, B, 1),
-        (T, n, B, cfg.entry_bytes),
-    )
+    vals = np.arange(T * B, dtype=np.uint8).reshape(T, B)
+    data = np.repeat(vals[..., None], cfg.entry_bytes, axis=2)  # [T, B, S]
+    payloads = jnp.stack([fold_batch(data[i], n) for i in range(T)])
     counts = jnp.full((T,), B, jnp.int32)
     state, infos = t.replicate_many(
         state, payloads, counts, 0, 1, jnp.ones(n, bool), jnp.zeros(n, bool)
     )
     assert list(np.asarray(infos.commit_index)) == [B * (i + 1) for i in range(T)]
     np.testing.assert_array_equal(
-        np.asarray(state.log_payload[n - 1, : T * B, 0]),
+        payload_slot_bytes(state, n - 1)[: T * B, 0],
         np.arange(T * B, dtype=np.uint8),
     )

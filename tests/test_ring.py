@@ -4,62 +4,100 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from raft_tpu.core.ring import read_window, write_window
+from raft_tpu.core.ring import (
+    read_window,
+    read_window_cols,
+    write_window_cols,
+    write_window_rows,
+)
 
-L, C, B, S = 3, 64, 16, 4
+L, C, B, M = 3, 64, 16, 8
 
 
-def np_write(buf, win, s, mask):
+def np_write_cols(buf, win, s, count, lane_sel):
     out = buf.copy()
-    for l in range(L):
-        for j in range(B):
-            if mask[l, j]:
-                out[l, (s + j) % C] = win[l, j]
+    for j in range(B):
+        if j < count:
+            for m in range(M):
+                if lane_sel[m]:
+                    out[(s + j) % C, m] = win[j, m]
     return out
 
 
-def np_read(buf, s):
+def np_write_rows(buf, win_t, s, count, accept):
+    out = buf.copy()
+    for l in range(L):
+        if accept[l]:
+            for j in range(min(count, B)):
+                out[l, (s + j) % C] = win_t[j]
+    return out
+
+
+def np_read_cols(buf, s):
+    return np.stack([buf[(s + j) % C] for j in range(B)])
+
+
+def np_read_rows(buf, s):
     return np.stack(
         [[buf[l, (s + j) % C] for j in range(B)] for l in range(L)]
     )
 
 
 @pytest.mark.parametrize("s", [0, 5, C - B, C - B + 1, C - 5, C - 1])
-class TestRing:
-    def test_write_matches_numpy(self, s):
-        rng = np.random.default_rng(s)
-        buf = rng.integers(0, 256, (L, C, S), dtype=np.uint8)
-        win = rng.integers(0, 256, (L, B, S), dtype=np.uint8)
-        mask = rng.random((L, B)) < 0.6
+@pytest.mark.parametrize("count", [0, 1, B // 2, B])
+class TestRingWrite:
+    def test_write_cols_matches_numpy(self, s, count):
+        rng = np.random.default_rng(s * 100 + count)
+        buf = rng.integers(0, 1 << 20, (C, M), dtype=np.int32)
+        win = rng.integers(0, 1 << 20, (B, M), dtype=np.int32)
+        lane_sel = rng.random(M) < 0.6
         got = np.asarray(
-            write_window(jnp.asarray(buf), jnp.asarray(win), jnp.int32(s),
-                         jnp.asarray(mask))
+            write_window_cols(
+                jnp.asarray(buf), jnp.asarray(win), jnp.int32(s),
+                jnp.int32(count), jnp.asarray(lane_sel),
+            )
         )
-        np.testing.assert_array_equal(got, np_write(buf, win, s, mask))
+        np.testing.assert_array_equal(
+            got, np_write_cols(buf, win, s, count, lane_sel)
+        )
 
-    def test_write_2d_buffer(self, s):
-        rng = np.random.default_rng(100 + s)
+    def test_write_rows_matches_numpy(self, s, count):
+        rng = np.random.default_rng(s * 100 + count + 7)
         buf = rng.integers(0, 1000, (L, C), dtype=np.int32)
-        win = rng.integers(0, 1000, (L, B), dtype=np.int32)
-        mask = rng.random((L, B)) < 0.5
+        win_t = rng.integers(0, 1000, B, dtype=np.int32)
+        accept = rng.random(L) < 0.5
         got = np.asarray(
-            write_window(jnp.asarray(buf), jnp.asarray(win), jnp.int32(s),
-                         jnp.asarray(mask))
+            write_window_rows(
+                jnp.asarray(buf), jnp.asarray(win_t), jnp.int32(s),
+                jnp.int32(count), jnp.asarray(accept),
+            )
         )
-        np.testing.assert_array_equal(got, np_write(buf, win, s, mask))
+        np.testing.assert_array_equal(
+            got, np_write_rows(buf, win_t, s, count, accept)
+        )
 
-    def test_read_matches_numpy(self, s):
+
+@pytest.mark.parametrize("s", [0, 5, C - B, C - B + 1, C - 5, C - 1])
+class TestRingRead:
+    def test_read_rows_matches_numpy(self, s):
         rng = np.random.default_rng(200 + s)
-        buf = rng.integers(0, 256, (L, C, S), dtype=np.uint8)
+        buf = rng.integers(0, 256, (L, C, 4), dtype=np.uint8)
         got = np.asarray(read_window(jnp.asarray(buf), jnp.int32(s), B))
-        np.testing.assert_array_equal(got, np_read(buf, s))
+        np.testing.assert_array_equal(got, np_read_rows(buf, s))
 
-    def test_read_write_roundtrip(self, s):
+    def test_read_cols_matches_numpy(self, s):
         rng = np.random.default_rng(300 + s)
-        buf = rng.integers(0, 256, (L, C, S), dtype=np.uint8)
-        win = rng.integers(0, 256, (L, B, S), dtype=np.uint8)
-        mask = np.ones((L, B), bool)
-        buf2 = write_window(jnp.asarray(buf), jnp.asarray(win), jnp.int32(s),
-                            jnp.asarray(mask))
-        got = np.asarray(read_window(buf2, jnp.int32(s), B))
+        buf = rng.integers(0, 1 << 20, (C, M), dtype=np.int32)
+        got = np.asarray(read_window_cols(jnp.asarray(buf), jnp.int32(s), B))
+        np.testing.assert_array_equal(got, np_read_cols(buf, s))
+
+    def test_read_write_roundtrip_cols(self, s):
+        rng = np.random.default_rng(400 + s)
+        buf = rng.integers(0, 1 << 20, (C, M), dtype=np.int32)
+        win = rng.integers(0, 1 << 20, (B, M), dtype=np.int32)
+        buf2 = write_window_cols(
+            jnp.asarray(buf), jnp.asarray(win), jnp.int32(s), jnp.int32(B),
+            jnp.ones(M, bool),
+        )
+        got = np.asarray(read_window_cols(buf2, jnp.int32(s), B))
         np.testing.assert_array_equal(got, win)
