@@ -1,0 +1,102 @@
+"""Device-time measurement via ``jax.profiler`` traces.
+
+The SURVEY §5 tracing row: kernel/collective device time, not host wall
+clock. On this rig the distinction is load-bearing — dispatch crosses a
+network tunnel whose RTT (~20-100 ms) and ``block_until_ready`` semantics
+make wall-clock timing of ~10 us device programs pure noise (bench.py's
+round-1 number measured the tunnel, not the kernel). A profiler trace
+records the on-device execution span of each compiled module, which is
+exact regardless of dispatch latency.
+
+``device_seconds`` runs one call under a trace and returns the device-side
+duration of the longest compiled module in it (for a bench body that is
+one ``jit`` scan, that IS the program). ``op_breakdown`` aggregates
+per-op device durations from the same trace for kernel-level attribution.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import shutil
+import tempfile
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+
+def _load_latest_trace(trace_dir: str):
+    runs = sorted(
+        glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz")
+    )
+    if not runs:
+        return []
+    return json.load(gzip.open(runs[-1])).get("traceEvents", [])
+
+
+def _device_pids(evs) -> set:
+    return {
+        e["pid"] for e in evs
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "TPU" in str(e.get("args", {}).get("name", ""))
+    }
+
+
+def device_seconds(
+    fn: Callable, mk_args: Callable[[], tuple], warmups: int = 1,
+    trace_dir: Optional[str] = None,
+) -> float:
+    """On-device seconds of one ``fn(*mk_args())`` call; NaN if the platform
+    produced no device trace (caller falls back to wall clock).
+
+    ``mk_args`` is a factory so donated buffers are fresh per call. The
+    result is forced to host (``np.asarray``) before the trace stops —
+    ``block_until_ready`` does not guarantee completion through the tunnel.
+    """
+    for _ in range(warmups):
+        out = fn(*mk_args())
+    _ = np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    tmp = trace_dir or tempfile.mkdtemp(prefix="raft_tpu_trace_")
+    try:
+        jax.profiler.start_trace(tmp)
+        try:
+            out = fn(*mk_args())
+            _ = np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+        finally:
+            # always close the profiler session — a leaked session makes
+            # every later start_trace fail and would poison all remaining
+            # measurements, not just this one
+            jax.profiler.stop_trace()
+        evs = _load_latest_trace(tmp)
+        pids = _device_pids(evs)
+        mods = [
+            float(e["dur"]) for e in evs
+            if e.get("ph") == "X" and e.get("pid") in pids
+            and str(e.get("name", "")).startswith("jit_")
+        ]
+        return max(mods) / 1e6 if mods else float("nan")
+    except Exception:
+        return float("nan")
+    finally:
+        if trace_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def op_breakdown(trace_dir: str, top: int = 20):
+    """[(op_name, calls, total_ms)] for the latest trace in ``trace_dir``."""
+    evs = _load_latest_trace(trace_dir)
+    pids = _device_pids(evs)
+    agg = {}
+    for e in evs:
+        if e.get("ph") == "X" and e.get("pid") in pids:
+            nm = str(e.get("name", ""))
+            if nm.startswith("jit_"):
+                continue
+            c, t = agg.get(nm, (0, 0.0))
+            agg[nm] = (c + 1, t + float(e.get("dur", 0)))
+    return [
+        (nm, c, t / 1e3)
+        for nm, (c, t) in sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+    ]
