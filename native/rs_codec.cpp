@@ -43,17 +43,22 @@ void xor_mul_const(uint8_t* dst, const uint8_t* src, uint8_t c, long n) {
   uint8_t basis[8];
   bit_basis(c, basis);
   long w = n / 8;
-  const uint64_t* s64 = reinterpret_cast<const uint64_t*>(src);
-  uint64_t* d64 = reinterpret_cast<uint64_t*>(dst);
   for (long j = 0; j < w; ++j) {
-    uint64_t x = s64[j];
+    // memcpy the 8-byte lane in and out instead of casting the (possibly
+    // unaligned when row_bytes % 8 != 0) byte pointers to uint64_t* —
+    // unaligned loads through such casts are UB on strict-alignment
+    // targets; memcpy compiles to the same single load/store where legal.
+    uint64_t x, d;
+    std::memcpy(&x, src + j * 8, 8);
+    std::memcpy(&d, dst + j * 8, 8);
     uint64_t acc = 0;
     for (int i = 0; i < 8; ++i) {
       if (basis[i] == 0) continue;
       uint64_t mask = ((x >> i) & kLsb) * 0xFFULL;  // 0x00/0xFF per byte
       acc ^= mask & (kLsb * basis[i]);
     }
-    d64[j] ^= acc;
+    d ^= acc;
+    std::memcpy(dst + j * 8, &d, 8);
   }
   for (long j = w * 8; j < n; ++j) {  // tail bytes, scalar
     uint8_t x = src[j], acc = 0;

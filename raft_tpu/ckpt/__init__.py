@@ -1,0 +1,3 @@
+from raft_tpu.ckpt.snapshot import CheckpointStore, Snapshot, install_snapshot
+
+__all__ = ["CheckpointStore", "Snapshot", "install_snapshot"]

@@ -1,0 +1,141 @@
+"""Checkpoint / snapshot-install: rejoin for replicas the ring has lapped.
+
+The reference comments its per-node fields "persistent data" but never
+persists anything (main.go:18-21) — a crashed node can never rejoin. This
+framework's fixed-capacity device ring (SURVEY §7 hard part 2) makes the
+gap concrete: a replica lagging by >= log_capacity entries can never be
+log-healed, because the leader's ring no longer holds the entries its
+next consistency-checked window would need (the horizon clamp in
+core.step), and under EC every donor's ring has lapped too
+(ec.reconstruct.heal_replica raises). This module is Raft's
+InstallSnapshot for both cases:
+
+- ``CheckpointStore`` — host-side archive of committed entries (payload
+  bytes + per-entry term). The engine feeds it at commit time from its
+  ingest buffer, falling back to a device read of the just-committed
+  window; entries older than ``max_entries`` are compacted away.
+- ``Snapshot`` — a contiguous committed slice ``[base_index, last_index]``
+  with terms, serializable to one ``.npz`` file (``save``/``load``) for
+  restart/resume tests.
+- ``install_snapshot`` — writes the snapshot's ring-fitting tail into a
+  replica's lane block (re-encoding RS shards when EC is on) and advances
+  its match/commit to the snapshot index, via the same chunked window
+  install the EC heal path uses. The repair window then covers
+  (snapshot_index, leader_last] — which ring backpressure guarantees is
+  less than one capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core.state import ReplicaState
+from raft_tpu.ec.reconstruct import install_entries
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """A committed, contiguous log slice — (term, committed prefix) state.
+
+    ``entries`` are FULL entry bytes (not shards) so one snapshot serves
+    both plain and erasure-coded clusters: install re-encodes the target
+    replica's shard rows on demand.
+    """
+
+    base_index: int        # first included log index (1-based)
+    last_index: int        # last included log index
+    entries: np.ndarray    # u8[last-base+1, entry_bytes]
+    terms: np.ndarray      # i32[last-base+1]
+
+    @property
+    def last_term(self) -> int:
+        return int(self.terms[-1]) if self.terms.size else 0
+
+    def save(self, path: str) -> None:
+        np.savez_compressed(
+            path,
+            base_index=self.base_index,
+            last_index=self.last_index,
+            entries=self.entries,
+            terms=self.terms,
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "Snapshot":
+        with np.load(path) as z:
+            return cls(
+                base_index=int(z["base_index"]),
+                last_index=int(z["last_index"]),
+                entries=np.asarray(z["entries"], np.uint8),
+                terms=np.asarray(z["terms"], np.int32),
+            )
+
+
+class CheckpointStore:
+    """Append-only host archive of committed entries.
+
+    This is the durable state the reference never writes anywhere: the
+    committed log survives here even after the device ring laps it, so a
+    long-dead replica can be re-seeded. (In a multi-host deployment each
+    host would persist its own replica's feed; in this single-process
+    engine one store serves the cluster.)
+    """
+
+    def __init__(self, entry_bytes: int, max_entries: Optional[int] = None):
+        self.entry_bytes = entry_bytes
+        self.max_entries = max_entries
+        self._slots: Dict[int, Tuple[bytes, int]] = {}  # idx -> (bytes, term)
+        self.last = 0
+
+    def put(self, idx: int, payload: bytes, term: int) -> None:
+        self._slots[idx] = (payload, term)
+        self.last = max(self.last, idx)
+        if self.max_entries is not None:
+            floor = self.last - self.max_entries
+            for i in [i for i in self._slots if i <= floor]:
+                del self._slots[i]
+
+    def covers(self, lo: int, hi: int) -> bool:
+        return hi >= lo and all(i in self._slots for i in range(lo, hi + 1))
+
+    def snapshot(self, lo: int, hi: int) -> Snapshot:
+        assert self.covers(lo, hi), f"store does not cover [{lo}, {hi}]"
+        ents = np.frombuffer(
+            b"".join(self._slots[i][0] for i in range(lo, hi + 1)), np.uint8
+        ).reshape(hi - lo + 1, self.entry_bytes)
+        terms = np.asarray(
+            [self._slots[i][1] for i in range(lo, hi + 1)], np.int32
+        )
+        return Snapshot(lo, hi, ents, terms)
+
+
+def install_snapshot(
+    state: ReplicaState,
+    replica: int,
+    snap: Snapshot,
+    leader_term: int,
+    batch: int,
+    code=None,
+) -> ReplicaState:
+    """Install a snapshot into one replica's row; returns the new state.
+
+    Only the tail that fits the ring is materialized (standard log
+    compaction: slots below the installed range keep stale bytes nothing
+    will ever read — consistency probes only ever look at the window prev
+    point, which the install covers). ``code`` re-encodes the replica's RS
+    shard rows when the cluster is erasure-coded.
+    """
+    cap = state.capacity
+    n = snap.entries.shape[0]
+    keep = min(n, cap)
+    ents = snap.entries[n - keep:]
+    terms = snap.terms[n - keep:]
+    start = snap.last_index - keep + 1
+    payload = ents if code is None else code.encode_host(ents)[replica]
+    return install_entries(
+        state, replica, start, payload, terms, leader_term,
+        commit_to=snap.last_index, batch=batch,
+    )

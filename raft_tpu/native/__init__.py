@@ -9,6 +9,7 @@ path — it is the fast host data plane, not a correctness dependency.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 import threading
 from pathlib import Path
@@ -24,15 +25,22 @@ _tried = False
 
 
 def _build() -> bool:
+    # Compile to a unique temp path and rename into place: rename is atomic
+    # on POSIX, so a concurrent builder (parallel test processes) or a
+    # killed build can never leave a truncated .so that a later process
+    # would CDLL.
+    tmp = _LIB.with_suffix(f".tmp{os.getpid()}.so")
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
+        tmp.unlink(missing_ok=True)
         return False
 
 

@@ -102,14 +102,32 @@ class RaftEngine:
         else:
             self._code = None
         self._uncommitted: Dict[int, Tuple[bytes, int]] = {}
-        #   log index -> (full payload, ingest term), EC mode only. The
-        #   leader's device log holds only its own shard row, so the
-        #   uncommitted suffix is not reconstructable from fewer than
-        #   commit_quorum shard-holders; the host retains full entries until
-        #   they commit so recovered replicas can be re-served (otherwise a
-        #   dead-and-back follower pair would stall commit forever at the
-        #   k+margin quorum). Bounded by ring backpressure:
+        #   log index -> (full payload, ingest term). Two consumers: under
+        #   EC, recovered replicas are re-served the uncommitted suffix from
+        #   here (fewer than commit_quorum replicas hold those shards, so
+        #   reconstruction can't — otherwise a dead-and-back follower pair
+        #   would stall commit forever at the k+margin quorum); in both
+        #   modes, entries move from here into the checkpoint store when
+        #   they commit. Bounded by ring backpressure:
         #   leader_last - commit <= log_capacity entries.
+        from raft_tpu.ckpt import CheckpointStore
+
+        self.store = CheckpointStore(
+            cfg.entry_bytes, max_entries=2 * cfg.log_capacity
+        )
+        #   Host archive of the committed log (term + bytes per entry) —
+        #   the "persistent data" the reference comments but never writes
+        #   (main.go:18-21). Snapshot-installs for ring-lapped replicas are
+        #   served from it (raft_tpu.ckpt). Both snapshot consumers clamp
+        #   their range to the last log_capacity entries, so the store
+        #   compacts beyond 2x that instead of growing without bound.
+        self._match_stall = [0] * n
+        #   Consecutive leader ticks each replica has sat below the ring
+        #   horizon without match progress. After a leadership change every
+        #   match legitimately resets to 0 and the repair window re-verifies
+        #   healthy replicas within a tick or two; only a replica that
+        #   STAYS stalled under the horizon is truly lapped and needs a
+        #   snapshot install.
 
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
         self._next_seq = 1
@@ -322,6 +340,30 @@ class RaftEngine:
                     i: s for i, s in self._seq_at_index.items()
                     if i <= self.commit_watermark
                 }
+                # Drop ingest-buffer entries no replica's log still holds
+                # (every row's slot overwritten in a different term, or past
+                # every row's tail) — those can never commit and would
+                # otherwise be re-scanned by the EC heal every tick. An
+                # entry ANY row still holds is KEPT even if that row is
+                # currently dead: it can recover, win a later election
+                # (longest log), and need the bytes re-served — the
+                # stranded-suffix scenario tests/test_ec_integration
+                # exercises.
+                above = sorted(
+                    i for i in self._uncommitted if i > self.commit_watermark
+                )
+                if above:
+                    idx = np.asarray(above)
+                    slots = (idx - 1) % self.state.capacity
+                    terms_all = np.asarray(self.state.log_term[:, slots])
+                    lasts = np.asarray(self.state.last_index)
+                    for col, i in enumerate(above):
+                        buf_t = self._uncommitted[i][1]
+                        held = (
+                            (lasts >= i) & (terms_all[:, col] == buf_t)
+                        ).any()
+                        if not held:
+                            del self._uncommitted[i]
             self.roles[r] = LEADER
             self.leader_id = r
             self.leader_term = cand_term
@@ -406,8 +448,7 @@ class RaftEngine:
             for i, (seq, p) in enumerate(self._queue[:ingested]):
                 idx = last - ingested + 1 + i
                 self._seq_at_index[idx] = seq
-                if cfg.ec_enabled:
-                    self._uncommitted[idx] = (p, self.leader_term)
+                self._uncommitted[idx] = (p, self.leader_term)
             self._queue = self._queue[ingested:]
         commit = int(info.commit_index)
         if commit > self.commit_watermark:
@@ -415,6 +456,7 @@ class RaftEngine:
                 seq = self._seq_at_index.get(idx)
                 if seq is not None and seq not in self.commit_time:
                     self.commit_time[seq] = self.clock.now
+            self._archive_committed(r, self.commit_watermark + 1, commit)
             self.commit_watermark = commit
             self.nodelog(r, f"commit index changed to {commit}")
             for idx in [i for i in self._uncommitted if i <= commit]:
@@ -423,6 +465,8 @@ class RaftEngine:
                 del self._seq_at_index[idx]
         if cfg.ec_enabled:
             self._ec_heal(r, info)
+        else:
+            self._snapshot_heal(r, info)
         # heartbeats reset every heard follower's election timer
         for p in range(cfg.n_replicas):
             if p != r and self.alive[p] and self.roles[p] == FOLLOWER:
@@ -433,6 +477,100 @@ class RaftEngine:
                 self.roles[p] = FOLLOWER
                 self._arm_follower(p)
         self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
+
+    def _archive_committed(self, leader: int, lo: int, hi: int) -> None:
+        """Move the just-committed range [lo, hi] into the checkpoint store.
+
+        Primary source is the host ingest buffer; entries missing from it
+        (e.g. pruned across a leadership change but committed anyway by the
+        new leader, per Leader Completeness) are read back from the
+        leader's device log — the just-committed window is inside the ring
+        by construction. Under EC the device holds only shards, so missing
+        entries are reconstructed from the leader + any k-1 live holders;
+        if that fails the range is left unarchived (a later snapshot for it
+        is simply not offered)."""
+        from raft_tpu.core.state import log_entries
+
+        # The buffer entry is only trustworthy if its ingest term matches
+        # the committing leader's log at that index — a suffix superseded
+        # across leadership changes can leave a stale (bytes, term) pair at
+        # an index the new leader committed DIFFERENT bytes for (the same
+        # guard the EC re-serve path applies). Mismatches fall through to
+        # the device read below.
+        slots_all = (np.arange(lo, hi + 1) - 1) % self.state.capacity
+        lead_terms = np.asarray(self.state.log_term[leader, slots_all])
+        missing = []
+        for i, idx in enumerate(range(lo, hi + 1)):
+            ent = self._uncommitted.get(idx)
+            if ent is not None and ent[1] == int(lead_terms[i]):
+                self.store.put(idx, ent[0], ent[1])
+            else:
+                missing.append(idx)
+        if not missing:
+            return
+        mlo, mhi = min(missing), max(missing)
+        slots = (np.arange(mlo, mhi + 1) - 1) % self.state.capacity
+        terms = np.asarray(self.state.log_term[leader, slots])
+        try:
+            if self.cfg.ec_enabled:
+                from raft_tpu.ec.reconstruct import reconstruct
+
+                commits = np.asarray(self.state.commit_index)
+                donors = [leader] + [
+                    q for q in range(self.cfg.n_replicas)
+                    if q != leader and self.alive[q] and int(commits[q]) >= mhi
+                ]
+                if len(donors) < self.cfg.rs_k:
+                    return
+                data = reconstruct(
+                    self.state, self._code, donors[: self.cfg.rs_k], mlo, mhi
+                )
+            else:
+                data = log_entries(self.state, leader, mlo, mhi)
+        except ValueError:
+            return
+        for i, idx in enumerate(range(mlo, mhi + 1)):
+            if idx in missing:
+                self.store.put(idx, data[i].tobytes(), int(terms[i]))
+
+    def _snapshot_heal(self, leader: int, info) -> None:
+        """Snapshot-install for ring-lapped replicas (plain replication).
+
+        The repair window cannot heal a replica whose next needed index is
+        below the leader's ring horizon (core.step clamps it — accepting
+        wrapped bytes would corrupt). Such a replica's verified match stays
+        pinned while everyone else progresses; after two stalled ticks
+        (one leadership-change transient is forgiven — matches reset per
+        term and re-verify via the repair window within a tick), install a
+        snapshot of the committed prefix from the checkpoint store, then
+        let the repair window cover (snapshot, leader_last]."""
+        from raft_tpu.ckpt import Snapshot, install_snapshot
+
+        cap = self.state.capacity
+        match = np.asarray(info.match)
+        leader_last = int(self.state.last_index[leader])
+        horizon = leader_last - cap + 1
+        for p in range(self.cfg.n_replicas):
+            if p == leader or not self.alive[p] or self.slow[p]:
+                self._match_stall[p] = 0
+                continue
+            if int(match[p]) + 1 >= horizon:
+                self._match_stall[p] = 0
+                continue
+            self._match_stall[p] += 1
+            if self._match_stall[p] < 2:
+                continue
+            hi = self.commit_watermark
+            lo = max(int(match[p]) + 1, hi - cap + 1, 1)
+            if hi < lo or not self.store.covers(lo, hi):
+                continue
+            snap = self.store.snapshot(lo, hi)
+            self.state = install_snapshot(
+                self.state, p, snap, self.leader_term, self.cfg.batch_size,
+                self._code,
+            )
+            self._match_stall[p] = 0
+            self.nodelog(p, f"snapshot installed to {hi}")
 
     def _ec_heal(self, leader: int, info) -> None:
         """Two-phase repair for erasure-coded logs.
@@ -484,9 +622,22 @@ class RaftEngine:
                         self.state, self._code, p, donors[:k], lo, hi_rec,
                         self.leader_term, hi_rec, self.cfg.batch_size,
                     )
+                    self.nodelog(p, f"healed by reconstruction to {hi_rec}")
                 except ValueError:
-                    continue  # below donor ring horizon: snapshot territory
-                self.nodelog(p, f"healed by reconstruction to {hi_rec}")
+                    # Below every donor's ring horizon: reconstruction would
+                    # decode lapped slots into garbage. Install a snapshot
+                    # of the committed prefix from the checkpoint store
+                    # instead (the EC InstallSnapshot proper).
+                    from raft_tpu.ckpt import install_snapshot
+
+                    lo_s = max(lo, hi_rec - self.state.capacity + 1, 1)
+                    if not self.store.covers(lo_s, hi_rec):
+                        continue
+                    self.state = install_snapshot(
+                        self.state, p, self.store.snapshot(lo_s, hi_rec),
+                        self.leader_term, self.cfg.batch_size, self._code,
+                    )
+                    self.nodelog(p, f"snapshot installed to {hi_rec}")
                 lo = hi_rec + 1
             if lo <= leader_last:
                 idx = list(range(lo, leader_last + 1))
