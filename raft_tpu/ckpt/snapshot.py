@@ -89,14 +89,18 @@ class CheckpointStore:
         self.max_entries = max_entries
         self._slots: Dict[int, Tuple[bytes, int]] = {}  # idx -> (bytes, term)
         self.last = 0
+        self._first = 1  # compaction floor: indices below it were evicted
 
     def put(self, idx: int, payload: bytes, term: int) -> None:
         self._slots[idx] = (payload, term)
         self.last = max(self.last, idx)
         if self.max_entries is not None:
+            # indices arrive monotonically, so eviction is an incremental
+            # floor sweep — amortized O(1) per put
             floor = self.last - self.max_entries
-            for i in [i for i in self._slots if i <= floor]:
-                del self._slots[i]
+            while self._first <= floor:
+                self._slots.pop(self._first, None)
+                self._first += 1
 
     def covers(self, lo: int, hi: int) -> bool:
         return hi >= lo and all(i in self._slots for i in range(lo, hi + 1))

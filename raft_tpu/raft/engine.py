@@ -529,9 +529,26 @@ class RaftEngine:
                 data = log_entries(self.state, leader, mlo, mhi)
         except ValueError:
             return
-        for i, idx in enumerate(range(mlo, mhi + 1)):
-            if idx in missing:
-                self.store.put(idx, data[i].tobytes(), int(terms[i]))
+        for idx in missing:
+            self.store.put(
+                idx, data[idx - mlo].tobytes(), int(terms[idx - mlo])
+            )
+
+    def _try_install_snapshot(self, replica: int, lo: int, hi: int) -> bool:
+        """Install the committed range [lo, hi] (clamped to one ring
+        capacity) into ``replica`` from the checkpoint store; False when the
+        store does not cover it (the replica keeps waiting)."""
+        from raft_tpu.ckpt import install_snapshot
+
+        lo = max(lo, hi - self.state.capacity + 1, 1)
+        if hi < lo or not self.store.covers(lo, hi):
+            return False
+        self.state = install_snapshot(
+            self.state, replica, self.store.snapshot(lo, hi),
+            self.leader_term, self.cfg.batch_size, self._code,
+        )
+        self.nodelog(replica, f"snapshot installed to {hi}")
+        return True
 
     def _snapshot_heal(self, leader: int, info) -> None:
         """Snapshot-install for ring-lapped replicas (plain replication).
@@ -544,8 +561,6 @@ class RaftEngine:
         term and re-verify via the repair window within a tick), install a
         snapshot of the committed prefix from the checkpoint store, then
         let the repair window cover (snapshot, leader_last]."""
-        from raft_tpu.ckpt import Snapshot, install_snapshot
-
         cap = self.state.capacity
         match = np.asarray(info.match)
         leader_last = int(self.state.last_index[leader])
@@ -560,17 +575,10 @@ class RaftEngine:
             self._match_stall[p] += 1
             if self._match_stall[p] < 2:
                 continue
-            hi = self.commit_watermark
-            lo = max(int(match[p]) + 1, hi - cap + 1, 1)
-            if hi < lo or not self.store.covers(lo, hi):
-                continue
-            snap = self.store.snapshot(lo, hi)
-            self.state = install_snapshot(
-                self.state, p, snap, self.leader_term, self.cfg.batch_size,
-                self._code,
-            )
-            self._match_stall[p] = 0
-            self.nodelog(p, f"snapshot installed to {hi}")
+            if self._try_install_snapshot(
+                p, int(match[p]) + 1, self.commit_watermark
+            ):
+                self._match_stall[p] = 0
 
     def _ec_heal(self, leader: int, info) -> None:
         """Two-phase repair for erasure-coded logs.
@@ -628,16 +636,8 @@ class RaftEngine:
                     # decode lapped slots into garbage. Install a snapshot
                     # of the committed prefix from the checkpoint store
                     # instead (the EC InstallSnapshot proper).
-                    from raft_tpu.ckpt import install_snapshot
-
-                    lo_s = max(lo, hi_rec - self.state.capacity + 1, 1)
-                    if not self.store.covers(lo_s, hi_rec):
+                    if not self._try_install_snapshot(p, lo, hi_rec):
                         continue
-                    self.state = install_snapshot(
-                        self.state, p, self.store.snapshot(lo_s, hi_rec),
-                        self.leader_term, self.cfg.batch_size, self._code,
-                    )
-                    self.nodelog(p, f"snapshot installed to {hi_rec}")
                 lo = hi_rec + 1
             if lo <= leader_last:
                 idx = list(range(lo, leader_last + 1))

@@ -20,6 +20,7 @@ All device transports expose the same step signatures so the host engine
 
 from __future__ import annotations
 
+import logging
 from typing import Protocol, Tuple
 
 import jax
@@ -27,6 +28,8 @@ import jax
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import ReplicaState
 from raft_tpu.core.step import RepInfo, VoteInfo
+
+logger = logging.getLogger(__name__)
 
 
 class Transport(Protocol):
@@ -39,7 +42,7 @@ class Transport(Protocol):
     def replicate(
         self,
         state: ReplicaState,
-        client_payload: jax.Array,   # u8[R, B, S] per-replica rows (see step.py)
+        client_payload: jax.Array,   # i32[B, R*W] folded batch (see step.py)
         client_count,                # i32 valid entries
         leader,                      # i32 leader replica id
         leader_term,                 # i32
@@ -68,6 +71,14 @@ def make_transport(cfg: RaftConfig, devices=None) -> "Transport":
             )
         # Fewer chips than the mesh needs: fall back to the resident layout
         # (the program is the same; the replica axis just isn't sharded).
+        # Loud on purpose: a benchmark or test that *believes* it ran on a
+        # mesh must not silently have run resident.
+        logger.warning(
+            "tpu_mesh transport needs %d devices (%d replicas x %d payload "
+            "shards) but only %d are visible; falling back to "
+            "SingleDeviceTransport",
+            need, cfg.n_replicas, cfg.payload_shards, len(devices),
+        )
         return SingleDeviceTransport(cfg)
     if cfg.transport == "single":
         return SingleDeviceTransport(cfg)

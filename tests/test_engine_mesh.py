@@ -1,0 +1,189 @@
+"""Engine + EC over the mesh transport (virtual CPU devices).
+
+The engine is backend-agnostic behind the Transport seam; these tests run
+the same cluster lifecycles the single-device suite covers, but with the
+replica axis sharded one row per device (and the lane axis additionally
+sharded for the EC x payload_shards case) — SURVEY §4 "multi-replica
+without hardware". 8 virtual devices (tests/conftest.py) bound the shapes:
+RS(5,3) rides a 5-device mesh, EC x payload_shards=2 rides RS(4,2) on a
+4x2 mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import committed_payloads, log_entries
+from raft_tpu.ec.reconstruct import reconstruct
+from raft_tpu.ec.rs import RSCode
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport import TpuMeshTransport
+
+ENTRY = 16
+
+
+def mk_mesh_engine(seed=0, trace=None, **kw):
+    defaults = dict(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=128,
+        transport="tpu_mesh", seed=seed,
+    )
+    defaults.update(kw)
+    cfg = RaftConfig(**defaults)
+    t = TpuMeshTransport(
+        cfg, jax.devices()[: cfg.n_replicas * cfg.payload_shards]
+    )
+    return RaftEngine(cfg, t, trace=trace)
+
+
+def payloads(n, entry=ENTRY, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, entry, dtype=np.uint8).tobytes() for _ in range(n)]
+
+
+class TestEngineOnMesh:
+    def test_submit_commits_and_reads_back(self):
+        e = mk_mesh_engine(1)
+        e.run_until_leader()
+        ps = payloads(10)
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+        want = np.frombuffer(b"".join(ps), np.uint8).reshape(10, ENTRY)
+        for r in range(3):
+            np.testing.assert_array_equal(
+                committed_payloads(e.state, r)[:10], want, err_msg=f"replica {r}"
+            )
+
+    def test_failover_preserves_committed_entries(self):
+        e = mk_mesh_engine(4)
+        lead = e.run_until_leader()
+        ps = payloads(5, seed=9)
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+        e.fail(lead)
+        e.run_until_leader()
+        e.run_for(10 * e.cfg.heartbeat_period)
+        want = np.frombuffer(b"".join(ps), np.uint8).reshape(5, ENTRY)
+        np.testing.assert_array_equal(
+            committed_payloads(e.state, e.leader_id)[:5], want
+        )
+
+    def test_slow_follower_heals(self):
+        e = mk_mesh_engine(2)
+        lead = e.run_until_leader()
+        slow = (lead + 1) % 3
+        e.set_slow(slow, True)
+        seqs = [e.submit(p) for p in payloads(6, seed=5)]
+        e.run_until_committed(seqs[-1])
+        assert int(e.state.match_index[slow]) < e.commit_watermark
+        e.set_slow(slow, False)
+        e.run_for(3 * e.cfg.heartbeat_period)
+        assert int(e.state.match_index[slow]) >= 6
+
+    def test_lapped_replica_rejoins_via_snapshot(self):
+        e = mk_mesh_engine(3, log_capacity=16)
+        lead = e.run_until_leader()
+        dead = (lead + 1) % 3
+        e.fail(dead)
+        ps = payloads(48, seed=6)
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+        e.recover(dead)
+        e.run_for(8 * e.cfg.heartbeat_period)
+        assert int(e.state.match_index[dead]) >= 48
+        lo = e.commit_watermark - 16 + 1
+        want = np.frombuffer(
+            b"".join(ps[lo - 1 : e.commit_watermark]), np.uint8
+        ).reshape(-1, ENTRY)
+        np.testing.assert_array_equal(
+            log_entries(e.state, dead, lo, e.commit_watermark), want
+        )
+
+
+class TestECOnMesh:
+    """RS(5,3) with one replica row (= one shard row) per device."""
+
+    def mk(self, seed=0, **kw):
+        return mk_mesh_engine(
+            seed, n_replicas=5, entry_bytes=24, rs_k=3, rs_m=2, **kw
+        )
+
+    def test_submit_commit_reconstruct_roundtrip(self):
+        e = self.mk(1)
+        e.run_until_leader()
+        ps = payloads(12, entry=24, seed=2)
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+        want = np.frombuffer(b"".join(ps), np.uint8).reshape(12, 24)
+        for rows in ([0, 1, 2], [2, 3, 4], [0, 2, 4]):
+            got = reconstruct(e.state, RSCode(5, 3), rows, 1, 12)
+            np.testing.assert_array_equal(got, want, err_msg=f"rows={rows}")
+
+    def test_healing_by_reconstruction(self):
+        e = self.mk(4)
+        lead = e.run_until_leader()
+        slow = (lead + 2) % 5
+        e.set_slow(slow, True)
+        ps = payloads(8, entry=24, seed=6)
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+        assert int(e.state.match_index[slow]) < 8
+        e.set_slow(slow, False)
+        e.run_for(2 * e.cfg.heartbeat_period)
+        assert int(e.state.match_index[slow]) >= 8
+        want = np.frombuffer(b"".join(ps), np.uint8).reshape(8, 24)
+        rows = [slow] + [q for q in range(5) if q != slow][:2]
+        np.testing.assert_array_equal(
+            reconstruct(e.state, RSCode(5, 3), rows, 1, 8), want
+        )
+
+
+class TestECWithPayloadShardsOnMesh:
+    """EC x payload_shards: RS(4,2) with shard words split 2-way — both
+    mesh axes live (replica collectives + lane sharding) under the engine."""
+
+    def mk(self, seed=0):
+        return mk_mesh_engine(
+            seed, n_replicas=4, entry_bytes=32, rs_k=2, rs_m=2,
+            payload_shards=2,
+        )
+
+    def test_submit_commit_reconstruct_roundtrip(self):
+        e = self.mk(1)
+        e.run_until_leader()
+        ps = payloads(8, entry=32, seed=3)
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+        want = np.frombuffer(b"".join(ps), np.uint8).reshape(8, 32)
+        for rows in ([0, 1], [2, 3], [1, 2]):
+            got = reconstruct(e.state, RSCode(4, 2), rows, 1, 8)
+            np.testing.assert_array_equal(got, want, err_msg=f"rows={rows}")
+
+    def test_slow_follower_commit_and_heal(self):
+        e = self.mk(2)
+        lead = e.run_until_leader()
+        slow = (lead + 1) % 4
+        e.set_slow(slow, True)
+        ps = payloads(6, entry=32, seed=4)
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])     # quorum k+1=3 of the other 3
+        e.set_slow(slow, False)
+        e.run_for(2 * e.cfg.heartbeat_period)
+        assert int(e.state.match_index[slow]) >= 6
+
+
+class TestMeshFallbackIsLoud:
+    def test_fallback_warns(self, caplog):
+        import logging
+
+        from raft_tpu.transport import make_transport
+        from raft_tpu.transport.device import SingleDeviceTransport
+
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+            transport="tpu_mesh", payload_shards=4,   # needs 12 > 8 devices
+        )
+        with caplog.at_level(logging.WARNING, logger="raft_tpu.transport.base"):
+            t = make_transport(cfg)
+        assert isinstance(t, SingleDeviceTransport)
+        assert any("falling back" in r.message for r in caplog.records)
