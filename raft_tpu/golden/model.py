@@ -100,6 +100,9 @@ class GoldenNode:
         self.last_applied = 0          # used as "last log index" (SURVEY §2)
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
+        self.last_heard = 0.0          # virtual time of the last timer-
+        #   resetting receipt (AppendEntries receipt main.go:124-127;
+        #   granted VoteRequest main.go:162) — maintained by the cluster
         self._trace = trace
 
     # -- observability: the reference's nodelog format (main.go:399-401) ----
@@ -215,20 +218,47 @@ class GoldenCluster:
         self._q: List[Tuple[float, int, str, str]] = []  # (t, seq, kind, node)
         self._seq = 0
         self._timer_gen: Dict[str, int] = {n: 0 for n in self.nodes}
+        self._armed_at: Dict[str, float] = {n: 0.0 for n in self.nodes}
         self.client_values: List[bytes] = []   # injection queue (see inject())
+        # Fault masks (OUR extension — no node ever fails in the reference,
+        # SURVEY §5; these mirror the engine's alive/slow masks so the same
+        # fault schedule can drive both sides of a differential test).
+        # dead: timers don't fire, nothing is delivered, no votes; slow:
+        # AppendEntries are not delivered (stale matchIndex).
+        self.alive: Dict[str, bool] = {n: True for n in self.nodes}
+        self.slow: Dict[str, bool] = {n: False for n in self.nodes}
         for name in self.nodes:
             self._arm_follower_timeout(name)
+
+    # -- fault injection (engine-mask mirror, not reference behavior) -------
+    def fail(self, name: str) -> None:
+        self.alive[name] = False
+        self.nodes[name].state = FOLLOWER
+        self.nodes[name].nodelog("killed")
+
+    def recover(self, name: str) -> None:
+        self.alive[name] = True
+        self.nodes[name].state = FOLLOWER
+        self.nodes[name].nodelog("recovered")
+        self._arm_follower_timeout(name)
+
+    def set_slow(self, name: str, is_slow: bool) -> None:
+        self.slow[name] = is_slow
 
     # -- scheduling ---------------------------------------------------------
     def _push(self, t: float, kind: str, node: str) -> None:
         heapq.heappush(self._q, (t, self._seq, kind, node))
         self._seq += 1
 
-    def _arm_follower_timeout(self, name: str) -> None:
-        # rand.Intn(20) + 10 seconds, inclusive ints (main.go:114)
+    def _arm_follower_timeout(self, name: str, base: Optional[float] = None) -> None:
+        # rand.Intn(20) + 10 seconds, inclusive ints (main.go:114). ``base``
+        # is the virtual instant the reference's timer.Reset would have
+        # happened (a message receipt); the timeout runs from there.
         self._timer_gen[name] += 1
+        base = self.now if base is None else base
+        self._armed_at[name] = base
         dt = float(self.rng.randint(10, 29))
-        self._push(self.now + dt, f"etimer:{self._timer_gen[name]}", name)
+        self._push(max(self.now, base + dt), f"etimer:{self._timer_gen[name]}", name)
 
     def _arm_candidate_timeout(self, name: str) -> None:
         # rand.Intn(4) + 10 (main.go:194)
@@ -251,11 +281,21 @@ class GoldenCluster:
         for name, peer in self.nodes.items():
             if name == cand.id or cand.state != CANDIDATE:
                 continue
+            if not self.alive[name]:
+                continue                             # dead peer: no response
+            prev_state = peer.state
             res = peer.handle_request_vote(
                 VoteRequest(cand.term, cand.id)      # fields as sent, main.go:264
             )
             if res.vote:
+                # a granted vote resets the voter's election timer
+                # (main.go:162)
+                peer.last_heard = self.now
                 count += 1
+            if prev_state != FOLLOWER and peer.state == FOLLOWER:
+                # stepping down re-enters FollowerRun, which arms a fresh
+                # election timer (main.go:113-114)
+                self._arm_follower_timeout(name)
         if cand.state != CANDIDATE:
             return
         if count > len(self.nodes) / 2:              # main.go:273
@@ -272,6 +312,8 @@ class GoldenCluster:
         for name, peer in self.nodes.items():
             if name == leader.id:
                 continue
+            if not self.alive[name] or self.slow[name]:
+                continue                  # not delivered (fault masks)
             ni = leader.next_index[name]
             if ni == 1 and leader.last_applied > 0:  # never synced: full log
                 req = AppendEntriesRequest(          # main.go:343-351
@@ -293,7 +335,17 @@ class GoldenCluster:
                     if leader.last_applied > 0
                     else 0,
                 )
+            prev_state = peer.state
             res = peer.handle_append_entries(req)    # send + blocking reply
+            # every AppendEntries receipt resets the receiver's election
+            # timer, success or not (timer.Reset at the top of the handler,
+            # main.go:124-127)
+            peer.last_heard = self.now
+            if prev_state != FOLLOWER and peer.state == FOLLOWER:
+                # candidate stepped down on >=-term AppendEntries
+                # (main.go:204-217) and re-enters FollowerRun, which arms a
+                # fresh election timer (main.go:113-114)
+                self._arm_follower_timeout(name)
             if res.success:                          # main.go:375-378
                 leader.match_index[name] = res.match_index
                 leader.next_index[name] = res.match_index + 1
@@ -319,16 +371,20 @@ class GoldenCluster:
         t, _, kind, name = heapq.heappop(self._q)
         self.now = max(self.now, t)
         node = self.nodes[name]
+        if not self.alive[name] and kind != "client":
+            return True                   # a dead node's timers never fire
         if kind.startswith("etimer:"):
-            # Election timeout is armed at follower entry and *reset on every
-            # AppendEntries/vote receipt* (main.go:124-127, 162) — the oracle
-            # approximates resets by re-arming stale timers: only the newest
-            # generation fires.
+            # Election timeout is armed at follower entry and *reset on
+            # every AppendEntries receipt / granted vote* (main.go:124-127,
+            # 162). The virtual-clock equivalence: if a resetting receipt
+            # happened after this timer was armed, the reference's timer
+            # would now be running from that receipt with a fresh draw —
+            # re-arm from ``last_heard`` and skip.
             gen = int(kind.split(":")[1])
             if node.state != FOLLOWER or gen != self._timer_gen[name]:
                 return True
-            if self._heard_recently(name):
-                self._arm_follower_timeout(name)
+            if node.last_heard > self._armed_at[name]:
+                self._arm_follower_timeout(name, base=node.last_heard)
                 return True
             node.state = CANDIDATE                   # main.go:171-177
             node.term += 1
@@ -352,7 +408,10 @@ class GoldenCluster:
         elif kind == "client":
             # main.go:87-95: push queued values to every Leader-state node.
             if self.client_values:
-                leaders = [n for n in self.nodes.values() if n.state == LEADER]
+                leaders = [
+                    n for n in self.nodes.values()
+                    if n.state == LEADER and self.alive[n.id]
+                ]
                 if leaders:
                     for v in self.client_values:
                         for leader in leaders:
@@ -360,11 +419,6 @@ class GoldenCluster:
                     self.client_values.clear()
             self._push(self.now + 10.0, "client", name)
         return True
-
-    def _heard_recently(self, name: str) -> bool:
-        """A follower with a live leader keeps having its timer reset; model
-        that as: some leader exists whose next tick precedes this timeout."""
-        return any(n.state == LEADER for n in self.nodes.values())
 
     def start_client(self) -> None:
         """Arm the reference's 10 s client loop (main.go:87-95)."""

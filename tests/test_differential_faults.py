@@ -1,0 +1,154 @@
+"""Differential tests under faults (SURVEY §4): the golden oracle and the
+device engine driven with the same seeded fault schedule, committed
+prefixes compared at quiescence.
+
+Two fault shapes x three seeds:
+
+- **Slow-follower window** — no leadership change on either side, so at
+  quiescence the committed logs must be *byte-identical* across systems
+  and across every replica: injected sequence in, injected sequence out.
+
+- **Leader crash + recover** — here the reference's own quirks bite, and
+  the oracle preserves them: after a leadership change the new leader
+  resets next_index to 1 and sends the full log with PrevLogIndex 0
+  (main.go:343-351); a follower that already has entries fails the
+  PrevLogTerm probe (main.go:142-146 — in Go, GetLog(0) would read
+  Log[-1] and panic; the oracle indexes leniently and rejects), and the
+  reference's leader only moves next_index on success (main.go:375-378),
+  so replication to that follower wedges and the exact-bucket commit rule
+  (main.go:381-391) stalls at the pre-crash watermark. The assertion is
+  therefore the **prefix relation**: the oracle's stalled committed log is
+  byte-for-byte a prefix of the device engine's committed log (which,
+  implementing Raft correctly, keeps committing after failover) — and the
+  common prefix is identical on every live replica of both systems.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import committed_payloads
+from raft_tpu.golden import GoldenCluster
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport import SingleDeviceTransport
+
+ENTRY = 32
+SEEDS = [0, 1, 2]
+
+
+def payload_list(n, seed):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, ENTRY, dtype=np.uint8).tobytes() for _ in range(n)]
+
+
+def mk_engine(seed):
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=128,
+        transport="single", seed=seed,
+    )
+    return RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+
+def golden_settle(c, ticks=6):
+    for _ in range(ticks):
+        lead = c.leader()
+        if lead is None:
+            break
+        c._leader_tick(lead)
+
+
+def engine_committed(e, replica):
+    return [bytes(row) for row in committed_payloads(e.state, replica)]
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+class TestSlowFollowerDifferential:
+    """Shape A: identical committed bytes on both systems, all replicas."""
+
+    def test_committed_logs_byte_identical(self, seed):
+        ps = payload_list(10, seed + 100)
+
+        # --- golden -------------------------------------------------------
+        c = GoldenCluster(3, seed=seed)
+        g_lead = c.run_until_leader()
+        slow_name = f"Server{(int(g_lead.id.removeprefix('Server')) + 1) % 3}"
+        c.set_slow(slow_name, True)
+        for p in ps[:5]:
+            g_lead.client_append(p)
+        golden_settle(c)
+        c.set_slow(slow_name, False)      # window ends before any timeout
+        for p in ps[5:]:
+            g_lead.client_append(p)
+        golden_settle(c)
+        golden_logs = {n: node.committed_payloads() for n, node in c.nodes.items()}
+        assert golden_logs[g_lead.id] == ps
+
+        # --- engine, same shape -------------------------------------------
+        e = mk_engine(seed)
+        lead = e.run_until_leader()
+        slow = (lead + 1) % 3
+        e.set_slow(slow, True)
+        seqs = [e.submit(p) for p in ps[:5]]
+        e.run_until_committed(seqs[-1])
+        e.set_slow(slow, False)
+        seqs += [e.submit(p) for p in ps[5:]]
+        e.run_until_committed(seqs[-1])
+        e.run_for(3 * e.cfg.heartbeat_period)   # let the straggler heal
+
+        # cross-system + cross-replica byte equality
+        for r in range(3):
+            assert engine_committed(e, r) == ps, f"engine replica {r}"
+        for n, log in golden_logs.items():
+            assert log == ps[: len(log)], f"golden {n} prefix"
+        assert golden_logs[g_lead.id] == engine_committed(e, e.leader_id)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+class TestLeaderCrashDifferential:
+    """Shape B: oracle stalls at the pre-crash watermark (reference quirk),
+    engine keeps going — oracle committed must be a prefix of engine's."""
+
+    def test_oracle_prefix_of_engine(self, seed):
+        pre = payload_list(6, seed + 200)
+        post = payload_list(4, seed + 300)
+
+        # --- golden -------------------------------------------------------
+        c = GoldenCluster(3, seed=seed)
+        g_lead = c.run_until_leader()
+        for p in pre:
+            g_lead.client_append(p)
+        golden_settle(c)
+        assert g_lead.committed_payloads() == pre
+        c.fail(g_lead.id)
+        g2 = c.run_until_leader()
+        assert g2.id != g_lead.id
+        for p in post:
+            g2.client_append(p)
+        golden_settle(c, ticks=10)
+        c.recover(g_lead.id)
+        golden_settle(c, ticks=10)
+        golden_committed = c.leader().committed_payloads()
+        # the oracle's post-failover replication wedges by reference quirk:
+        # committed stays exactly the pre-crash prefix
+        assert golden_committed == pre
+
+        # --- engine, same shape -------------------------------------------
+        e = mk_engine(seed)
+        lead = e.run_until_leader()
+        seqs = [e.submit(p) for p in pre]
+        e.run_until_committed(seqs[-1])
+        e.fail(lead)
+        e.run_until_leader()
+        seqs2 = [e.submit(p) for p in post]
+        e.run_until_committed(seqs2[-1])
+        e.recover(lead)
+        e.run_for(6 * e.cfg.heartbeat_period)
+        eng = engine_committed(e, e.leader_id)
+        assert eng == pre + post
+
+        # the differential join: oracle committed is byte-for-byte a prefix
+        # of the engine's, and every live replica agrees on that prefix
+        assert eng[: len(golden_committed)] == golden_committed
+        for r in range(3):
+            got = engine_committed(e, r)
+            assert got[: len(golden_committed)] == golden_committed, f"replica {r}"
