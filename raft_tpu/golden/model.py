@@ -312,8 +312,37 @@ class GoldenCluster:
         for name, peer in self.nodes.items():
             if name == leader.id:
                 continue
-            if not self.alive[name] or self.slow[name]:
-                continue                  # not delivered (fault masks)
+            if not self.alive[name]:
+                continue                  # dead peer: not delivered
+            if self.slow[name]:
+                # Engine slow-mask semantics (engine.set_slow): the replica
+                # *receives* traffic — election timer resets, terms flow
+                # both ways — but appends nothing, so the leader's view of
+                # its match stays stale (BASELINE config 4). Without the
+                # timer reset the golden slow node would campaign during
+                # long slow windows while the engine's stays a quiet
+                # follower, and the two sides of a differential run would
+                # diverge.
+                if peer.term > leader.term:
+                    # the reply still carries the higher term (the engine's
+                    # collective max_term does the same, core/step.py) and
+                    # deposes the leader, main.go:309-321 semantics
+                    leader.step_down(peer.term)
+                    self._arm_follower_timeout(leader.id)
+                    return
+                peer.last_heard = self.now
+                if peer.state != FOLLOWER:
+                    # candidate/stale-leader steps down on hearing a
+                    # current leader (main.go:204-217): full step_down so
+                    # term adoption + vote reset match the engine's device
+                    # step for heard-but-slow replicas
+                    peer.step_down(leader.term)
+                    self._arm_follower_timeout(name)
+                elif peer.term < leader.term:
+                    # a delivered AppendEntries would adopt the leader's
+                    # term (main.go:155); keep the host mirror in step
+                    peer.term = leader.term
+                continue
             ni = leader.next_index[name]
             if ni == 1 and leader.last_applied > 0:  # never synced: full log
                 req = AppendEntriesRequest(          # main.go:343-351

@@ -268,6 +268,12 @@ class RaftEngine:
             }[ev.action](ev.replica)
         return True
 
+    def next_event_time(self) -> Optional[float]:
+        """Virtual-clock time of the next pending event, or None when the
+        heap is empty. Live drivers (raft_tpu.demo) pace this against wall
+        time instead of calling ``run_for``."""
+        return self._q[0][0] if self._q else None
+
     def run_for(self, seconds: float, max_events: int = 100_000) -> None:
         end = self.clock.now + seconds
         for _ in range(max_events):

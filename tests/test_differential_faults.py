@@ -104,6 +104,59 @@ class TestSlowFollowerDifferential:
 
 
 @pytest.mark.parametrize("seed", SEEDS)
+class TestLongSlowWindowDifferential:
+    """Shape A': the slow window *outlasts the follower election timeout*
+    with virtual time actually advancing. Slow means "receives traffic,
+    appends nothing" on both systems (engine.set_slow semantics, mirrored
+    by the golden fault masks): the slow node keeps hearing heartbeats, so
+    its election timer keeps resetting, the leader survives the window, and
+    no term changes — then the window ends, the straggler heals, and the
+    committed logs are byte-identical across systems and replicas."""
+
+    WINDOW = 120.0  # » the 10-30 s follower timeout (main.go:114)
+
+    def test_leader_survives_window_and_logs_match(self, seed):
+        ps = payload_list(10, seed + 400)
+
+        # --- golden -------------------------------------------------------
+        c = GoldenCluster(3, seed=seed)
+        g_lead = c.run_until_leader()
+        g_term = g_lead.term
+        slow_name = f"Server{(int(g_lead.id.removeprefix('Server')) + 1) % 3}"
+        c.set_slow(slow_name, True)
+        for p in ps[:5]:
+            g_lead.client_append(p)
+        c.run_until(c.now + self.WINDOW)  # time advances through the window
+        assert c.leader() is g_lead, "golden leader deposed during window"
+        assert c.nodes[slow_name].term == g_term, "golden slow node campaigned"
+        c.set_slow(slow_name, False)
+        for p in ps[5:]:
+            g_lead.client_append(p)
+        golden_settle(c)
+        assert g_lead.committed_payloads() == ps
+
+        # --- engine, same shape -------------------------------------------
+        e = mk_engine(seed)
+        lead = e.run_until_leader()
+        term = e.leader_term
+        slow = (lead + 1) % 3
+        e.set_slow(slow, True)
+        seqs = [e.submit(p) for p in ps[:5]]
+        e.run_for(self.WINDOW)
+        assert e.leader_id == lead, "engine leader deposed during window"
+        assert e.leader_term == term
+        assert all(e.is_durable(s) for s in seqs)  # 2-of-3 quorum held
+        e.set_slow(slow, False)
+        seqs += [e.submit(p) for p in ps[5:]]
+        e.run_until_committed(seqs[-1])
+        e.run_for(3 * e.cfg.heartbeat_period)   # straggler heals
+
+        for r in range(3):
+            assert engine_committed(e, r) == ps, f"engine replica {r}"
+        assert g_lead.committed_payloads() == engine_committed(e, e.leader_id)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
 class TestLeaderCrashDifferential:
     """Shape B: oracle stalls at the pre-crash watermark (reference quirk),
     engine keeps going — oracle committed must be a prefix of engine's."""
