@@ -72,14 +72,16 @@ def run_demo(
     next_client = cfg.client_period
     while True:
         t_ev = engine.next_event_time()
-        t_next = min(next_client, t_ev if t_ev is not None else next_client)
+        if t_ev is None:
+            t_ev = float("inf")
+        t_next = min(next_client, t_ev)
         if t_next > duration:
             break
         if time_scale > 0:
             wait = t_next / time_scale - (time.monotonic() - start)
             if wait > 0:
                 time.sleep(wait)
-        if next_client <= (t_ev if t_ev is not None else float("inf")):
+        if next_client <= t_ev:
             engine.clock.now = max(engine.clock.now, next_client)
             # The reference's client only injects when a leader exists
             # (main.go:90-94) — possibly to several during a dual-leader
