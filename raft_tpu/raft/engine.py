@@ -188,6 +188,127 @@ class RaftEngine:
     def is_durable(self, seq: int) -> bool:
         return seq in self.commit_time
 
+    def submit_pipelined(self, payloads: List[bytes]) -> List[int]:
+        """High-throughput ingest: replicate + commit many batches in
+        chunked compiled scans (``transport.replicate_many``), syncing to
+        host once per chunk instead of once per leader tick — the
+        "(state, batch) -> (state, committed_upto), sync watermarks
+        periodically" design SURVEY.md §7 hard part 1 calls for. A chunk is
+        as many full batches as are *guaranteed* ring room before the scan
+        starts (commits inside the scan free more; the bound is
+        conservative, never lossy).
+
+        Requires a current leader. Returns the entries' sequence numbers;
+        durability reporting matches ``submit`` (leadership loss mid-chunk
+        re-queues refused entries for later ticks; they commit later or
+        read as lost). Entries already queued via ``submit`` are folded in
+        ahead of ``payloads`` so the two APIs never reorder."""
+        cfg = self.cfg
+        r = self.leader_id
+        if r is None:
+            raise RuntimeError("submit_pipelined requires a current leader")
+        for p in payloads:
+            if len(p) != cfg.entry_bytes:
+                raise ValueError(
+                    f"payload must be exactly {cfg.entry_bytes} bytes"
+                )
+        seqs = []
+        for p in payloads:
+            seq = self._next_seq
+            self._next_seq += 1
+            self.submit_time[seq] = self.clock.now
+            seqs.append(seq)
+        pending = self._queue + list(zip(seqs, payloads))
+        self._queue = []
+        B = cfg.batch_size
+        while pending:
+            if self.leader_id != r or not self.alive[r]:
+                break
+            leader_last = int(self.state.last_index[r])
+            steps = (
+                self.state.capacity - (leader_last - self.commit_watermark)
+            ) // B
+            if steps <= 0:
+                # ring full of uncommitted entries — the regular tick path
+                # must drain commits first; leave the rest queued
+                break
+            take = min(len(pending), steps * B)
+            chunk = pending[:take]
+            # Fixed scan length: pad the chunk with zero-count (heartbeat)
+            # steps so every chunk compiles to the SAME [T, B, L] program —
+            # a varying T would trigger a fresh XLA compile per chunk
+            # length, dwarfing the scan itself.
+            T = cfg.log_capacity // B
+            used = -(-take // B)
+            counts = np.zeros(T, np.int32)
+            counts[:used] = B
+            if used:
+                counts[used - 1] = take - (used - 1) * B
+            data = np.zeros((T * B, cfg.entry_bytes), np.uint8)
+            data[:take] = np.frombuffer(
+                b"".join(p for _, p in chunk), np.uint8
+            ).reshape(take, cfg.entry_bytes)
+            if cfg.ec_enabled:
+                from raft_tpu.ec.kernels import (
+                    encode_device,
+                    fold_shards_device,
+                )
+
+                folded = fold_shards_device(
+                    encode_device(self._code, jnp.asarray(data))
+                )
+                payload_stack = folded.reshape(T, B, -1)
+            else:
+                payload_stack = fold_batch(data, cfg.n_replicas).reshape(
+                    T, B, -1
+                )
+            self.state, infos = self.t.replicate_many(
+                self.state, payload_stack, jnp.asarray(counts), r,
+                self.leader_term, jnp.asarray(self.alive),
+                jnp.asarray(self.slow),
+            )
+            # ---- one host sync for the whole chunk ----
+            frontier = np.asarray(infos.frontier_len)
+            max_term = int(np.max(np.asarray(infos.max_term)))
+            final_commit = int(np.asarray(infos.commit_index)[-1])
+            idx = leader_last
+            pos = 0
+            refused: List[Tuple[int, bytes]] = []
+            for t in range(T):
+                cnt, ing = int(counts[t]), int(frontier[t])
+                for i, (seq, p) in enumerate(chunk[pos:pos + cnt]):
+                    if i < ing:
+                        idx += 1
+                        self._seq_at_index[idx] = seq
+                        self._uncommitted[idx] = (p, self.leader_term)
+                    else:
+                        refused.append((seq, p))
+                pos += cnt
+            pending = refused + pending[take:]
+            self._advance_commit(r, final_commit)
+            # keep the host term mirror in step with on-device adoption
+            # (same sync as the tick path) so post-failover campaigns and
+            # nodelog lines start from the real term
+            self.terms[self.alive] = np.maximum(
+                self.terms[self.alive], self.leader_term
+            )
+            if max_term > self.leader_term:
+                # deposed mid-chunk (main.go:309-321): the device refused
+                # ingest/commit from the stale point on; hand the rest back
+                self.roles[r] = FOLLOWER
+                self.terms[r] = max_term
+                if self.leader_id == r:
+                    self.leader_id = None
+                self.nodelog(r, "step down to follower")
+                self._arm_follower(r)
+                break
+            if refused:
+                break  # no progress is possible right now; don't spin
+        self._queue = pending + self._queue
+        if self.leader_id == r:
+            self._reset_heard_timers(r)
+        return seqs
+
     @property
     def in_flight_count(self) -> int:
         """Entries ingested into the leader's log but not yet committed
@@ -361,7 +482,10 @@ class RaftEngine:
                 if above:
                     idx = np.asarray(above)
                     slots = (idx - 1) % self.state.capacity
-                    terms_all = np.asarray(self.state.log_term[:, slots])
+                    # host-side fetch + numpy index: jnp fancy indexing
+                    # would JIT-compile a gather per distinct slot-vector
+                    # shape (seconds each through the tunnel)
+                    terms_all = np.asarray(self.state.log_term)[:, slots]
                     lasts = np.asarray(self.state.last_index)
                     for col, i in enumerate(above):
                         buf_t = self._uncommitted[i][1]
@@ -456,33 +580,41 @@ class RaftEngine:
                 self._seq_at_index[idx] = seq
                 self._uncommitted[idx] = (p, self.leader_term)
             self._queue = self._queue[ingested:]
-        commit = int(info.commit_index)
-        if commit > self.commit_watermark:
-            for idx in range(self.commit_watermark + 1, commit + 1):
-                seq = self._seq_at_index.get(idx)
-                if seq is not None and seq not in self.commit_time:
-                    self.commit_time[seq] = self.clock.now
-            self._archive_committed(r, self.commit_watermark + 1, commit)
-            self.commit_watermark = commit
-            self.nodelog(r, f"commit index changed to {commit}")
-            for idx in [i for i in self._uncommitted if i <= commit]:
-                del self._uncommitted[idx]
-            for idx in [i for i in self._seq_at_index if i <= commit]:
-                del self._seq_at_index[idx]
+        self._advance_commit(r, int(info.commit_index))
         if cfg.ec_enabled:
             self._ec_heal(r, info)
         else:
             self._snapshot_heal(r, info)
-        # heartbeats reset every heard follower's election timer
-        for p in range(cfg.n_replicas):
+        self._reset_heard_timers(r)
+        self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
+
+    def _advance_commit(self, r: int, commit: int) -> None:
+        """Host bookkeeping for a device-reported commit advance: stamp
+        durable seqs, archive to the checkpoint store, prune buffers."""
+        if commit <= self.commit_watermark:
+            return
+        for idx in range(self.commit_watermark + 1, commit + 1):
+            seq = self._seq_at_index.get(idx)
+            if seq is not None and seq not in self.commit_time:
+                self.commit_time[seq] = self.clock.now
+        self._archive_committed(r, self.commit_watermark + 1, commit)
+        self.commit_watermark = commit
+        self.nodelog(r, f"commit index changed to {commit}")
+        for idx in [i for i in self._uncommitted if i <= commit]:
+            del self._uncommitted[idx]
+        for idx in [i for i in self._seq_at_index if i <= commit]:
+            del self._seq_at_index[idx]
+
+    def _reset_heard_timers(self, r: int) -> None:
+        """Replication traffic is the heartbeat: every heard follower's
+        election timer resets (main.go:124-127) and a candidate hearing a
+        current leader steps down (main.go:204-217)."""
+        for p in range(self.cfg.n_replicas):
             if p != r and self.alive[p] and self.roles[p] == FOLLOWER:
                 self._arm_follower(p)
             if self.alive[p] and self.roles[p] == CANDIDATE:
-                # a candidate hearing a current leader steps down
-                # (main.go:204-217)
                 self.roles[p] = FOLLOWER
                 self._arm_follower(p)
-        self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
 
     def _archive_committed(self, leader: int, lo: int, hi: int) -> None:
         """Move the just-committed range [lo, hi] into the checkpoint store.
@@ -504,7 +636,9 @@ class RaftEngine:
         # guard the EC re-serve path applies). Mismatches fall through to
         # the device read below.
         slots_all = (np.arange(lo, hi + 1) - 1) % self.state.capacity
-        lead_terms = np.asarray(self.state.log_term[leader, slots_all])
+        # whole-row fetch + numpy index (not jnp fancy indexing: that
+        # compiles a fresh gather per slot-vector shape)
+        lead_terms = np.asarray(self.state.log_term)[leader, slots_all]
         missing = []
         for i, idx in enumerate(range(lo, hi + 1)):
             ent = self._uncommitted.get(idx)
@@ -516,7 +650,7 @@ class RaftEngine:
             return
         mlo, mhi = min(missing), max(missing)
         slots = (np.arange(mlo, mhi + 1) - 1) % self.state.capacity
-        terms = np.asarray(self.state.log_term[leader, slots])
+        terms = np.asarray(self.state.log_term)[leader, slots]
         try:
             if self.cfg.ec_enabled:
                 from raft_tpu.ec.reconstruct import reconstruct
@@ -650,7 +784,7 @@ class RaftEngine:
                 if any(i not in self._uncommitted for i in idx):
                     continue  # suffix not servable (no buffer for it)
                 slots = (np.asarray(idx) - 1) % self.state.capacity
-                log_terms = np.asarray(self.state.log_term[leader, slots])
+                log_terms = np.asarray(self.state.log_term)[leader, slots]
                 if any(
                     self._uncommitted[i][1] != int(t)
                     for i, t in zip(idx, log_terms)

@@ -1,0 +1,126 @@
+"""Pipelined ingest (`RaftEngine.submit_pipelined`): many batches replicated
+and committed in chunked compiled scans with one host sync per chunk —
+SURVEY §7 hard part 1's "(state, batch) -> (state, committed_upto)" design.
+
+Covers: durability + byte-identical committed logs across replicas (both
+transports, EC and plain), ordering with the queued `submit` path, ring
+backpressure (chunk bound leaves nothing lost), and the no-leader error."""
+
+import jax
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import committed_payloads, log_entries
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport import SingleDeviceTransport, TpuMeshTransport
+
+ENTRY = 16
+
+
+def payloads(n, entry=ENTRY, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, entry, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def committed(e, r):
+    return [bytes(p) for p in committed_payloads(e.state, r)]
+
+
+def committed_tail(e, r):
+    """The in-ring committed suffix (the ring only retains the last
+    `capacity` entries once the log laps)."""
+    hi = int(e.state.commit_index[r])
+    lo = max(1, hi - e.state.capacity + 1)
+    return [bytes(p) for p in log_entries(e.state, r, lo, hi)]
+
+
+def mk(seed=0, mesh=False, **kw):
+    defaults = dict(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="tpu_mesh" if mesh else "single", seed=seed,
+    )
+    defaults.update(kw)
+    cfg = RaftConfig(**defaults)
+    if mesh:
+        t = TpuMeshTransport(cfg, jax.devices()[: cfg.n_replicas])
+    else:
+        t = SingleDeviceTransport(cfg)
+    return RaftEngine(cfg, t)
+
+
+@pytest.mark.parametrize("mesh", [False, True], ids=["single", "mesh"])
+def test_pipeline_commits_all_and_replicas_agree(mesh):
+    e = mk(mesh=mesh)
+    e.run_until_leader()
+    # 10x the per-chunk guaranteed room (capacity 64 / batch 4 = 16 steps
+    # per chunk): forces several chunks and several ring wraps
+    ps = payloads(640)
+    seqs = e.submit_pipelined(ps)
+    assert all(e.is_durable(s) for s in seqs), "pipeline left entries behind"
+    e.run_for(3 * e.cfg.heartbeat_period)  # stragglers heal via the tick path
+    assert int(e.state.commit_index[e.leader_id]) == len(ps)
+    for r in range(3):
+        got = committed_tail(e, r)
+        assert got == ps[-len(got):], f"replica {r} diverges"
+
+
+def test_pipeline_ec_five_replicas():
+    e = mk(n_replicas=5, rs_k=3, rs_m=2, entry_bytes=12, log_capacity=64)
+    e.run_until_leader()
+    ps = payloads(200, entry=12)
+    seqs = e.submit_pipelined(ps)
+    assert all(e.is_durable(s) for s in seqs)
+    # decode the committed window back from k shard rows and compare bytes
+    from raft_tpu.ec.reconstruct import reconstruct
+    from raft_tpu.ec.rs import RSCode
+
+    hi = int(e.state.commit_index[e.leader_id])
+    lo = max(1, hi - e.state.capacity + 1)
+    data = reconstruct(e.state, RSCode(5, 3), [0, 1, 2], lo, hi)
+    assert [bytes(x) for x in data] == ps[lo - 1 : hi]
+
+
+def test_pipeline_preserves_order_with_queued_submits():
+    e = mk()
+    e.run_until_leader()
+    head = payloads(3, seed=1)
+    tail = payloads(5, seed=2)
+    head_seqs = [e.submit(p) for p in head]     # queued, not yet ingested
+    tail_seqs = e.submit_pipelined(tail)        # must drain `head` first
+    assert all(e.is_durable(s) for s in head_seqs + tail_seqs)
+    got = committed(e, e.leader_id)
+    assert got == head + tail
+
+
+def test_pipeline_requires_leader():
+    e = mk()
+    with pytest.raises(RuntimeError):
+        e.submit_pipelined(payloads(1))
+
+
+def test_pipeline_rejects_bad_size():
+    e = mk()
+    e.run_until_leader()
+    with pytest.raises(ValueError):
+        e.submit_pipelined([b"short"])
+
+
+def test_pipeline_then_tick_interleaving():
+    """Pipelined and tick-driven ingest interleave without losing order or
+    durability bookkeeping."""
+    e = mk()
+    e.run_until_leader()
+    a = payloads(40, seed=3)
+    b = payloads(6, seed=4)
+    c = payloads(40, seed=5)
+    sa = e.submit_pipelined(a)
+    sb = [e.submit(p) for p in b]
+    e.run_for(4 * e.cfg.heartbeat_period)       # ticks drain the queue
+    sc = e.submit_pipelined(c)
+    assert all(e.is_durable(s) for s in sa + sb + sc)
+    full = a + b + c
+    assert int(e.state.commit_index[e.leader_id]) == len(full)
+    got = committed_tail(e, e.leader_id)
+    assert got == full[-len(got):]
