@@ -1,3 +1,13 @@
-from raft_tpu.ckpt.snapshot import CheckpointStore, Snapshot, install_snapshot
+from raft_tpu.ckpt.snapshot import (
+    CheckpointStore,
+    EngineCheckpoint,
+    Snapshot,
+    install_snapshot,
+)
 
-__all__ = ["CheckpointStore", "Snapshot", "install_snapshot"]
+__all__ = [
+    "CheckpointStore",
+    "EngineCheckpoint",
+    "Snapshot",
+    "install_snapshot",
+]

@@ -55,13 +55,16 @@ class Snapshot:
         return int(self.terms[-1]) if self.terms.size else 0
 
     def save(self, path: str) -> None:
-        np.savez_compressed(
-            path,
-            base_index=self.base_index,
-            last_index=self.last_index,
-            entries=self.entries,
-            terms=self.terms,
-        )
+        # a file handle, not a path: np.savez would append ".npz" to a bare
+        # path, and load() on the original name would then miss the file
+        with open(path, "wb") as f:
+            np.savez_compressed(
+                f,
+                base_index=self.base_index,
+                last_index=self.last_index,
+                entries=self.entries,
+                terms=self.terms,
+            )
 
     @classmethod
     def load(cls, path: str) -> "Snapshot":
@@ -71,6 +74,49 @@ class Snapshot:
                 last_index=int(z["last_index"]),
                 entries=np.asarray(z["entries"], np.uint8),
                 terms=np.asarray(z["terms"], np.int32),
+            )
+
+
+@dataclasses.dataclass
+class EngineCheckpoint:
+    """Durable whole-cluster state: the fields the reference *comments* as
+    persistent but never writes (Term/Voted/Log, main.go:18-21), actually
+    written to disk. One file restarts the whole engine process:
+    per-replica term and votedFor (the Raft persistence obligation — a
+    restarted replica must not double-vote in a term it already voted in)
+    plus the archived committed tail."""
+
+    snap: Snapshot         # committed contiguous tail (may be empty)
+    terms: np.ndarray      # i32[R] per-replica current term
+    voted_for: np.ndarray  # i32[R] per-replica votedFor (NO_VOTE = -1)
+
+    def save(self, path: str) -> None:
+        # file handle for the same reason as Snapshot.save: keep the
+        # written name exactly what load() will be handed
+        with open(path, "wb") as f:
+            np.savez_compressed(
+                f,
+                base_index=self.snap.base_index,
+                last_index=self.snap.last_index,
+                entries=self.snap.entries,
+                terms=self.snap.terms,
+                replica_terms=self.terms,
+                voted_for=self.voted_for,
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "EngineCheckpoint":
+        with np.load(path) as z:
+            snap = Snapshot(
+                base_index=int(z["base_index"]),
+                last_index=int(z["last_index"]),
+                entries=np.asarray(z["entries"], np.uint8),
+                terms=np.asarray(z["terms"], np.int32),
+            )
+            return cls(
+                snap=snap,
+                terms=np.asarray(z["replica_terms"], np.int32),
+                voted_for=np.asarray(z["voted_for"], np.int32),
             )
 
 
@@ -104,6 +150,16 @@ class CheckpointStore:
 
     def covers(self, lo: int, hi: int) -> bool:
         return hi >= lo and all(i in self._slots for i in range(lo, hi + 1))
+
+    def covered_lo(self, hi: int) -> int:
+        """Smallest ``lo`` such that [lo, hi] is contiguously archived
+        (``hi + 1`` when even ``hi`` itself is missing)."""
+        if hi not in self._slots:
+            return hi + 1
+        lo = hi
+        while lo - 1 >= 1 and (lo - 1) in self._slots:
+            lo -= 1
+        return lo
 
     def snapshot(self, lo: int, hi: int) -> Snapshot:
         assert self.covers(lo, hi), f"store does not cover [{lo}, {hi}]"
