@@ -28,12 +28,35 @@ InstallSnapshot for both cases:
 from __future__ import annotations
 
 import dataclasses
+import os
+import tempfile
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from raft_tpu.core.state import ReplicaState
 from raft_tpu.ec.reconstruct import install_entries
+
+
+def _atomic_savez(path: str, **arrays) -> None:
+    """Write an .npz to exactly ``path`` (no implicit extension), via a
+    temp file + ``os.replace``: a crash mid-write must never clobber the
+    previous good checkpoint — losing the old durable state on an
+    interrupted save is precisely the failure persistence exists to
+    prevent. A file handle (not a path) stops np.savez appending '.npz'."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 @dataclasses.dataclass
@@ -55,16 +78,13 @@ class Snapshot:
         return int(self.terms[-1]) if self.terms.size else 0
 
     def save(self, path: str) -> None:
-        # a file handle, not a path: np.savez would append ".npz" to a bare
-        # path, and load() on the original name would then miss the file
-        with open(path, "wb") as f:
-            np.savez_compressed(
-                f,
-                base_index=self.base_index,
-                last_index=self.last_index,
-                entries=self.entries,
-                terms=self.terms,
-            )
+        _atomic_savez(
+            path,
+            base_index=self.base_index,
+            last_index=self.last_index,
+            entries=self.entries,
+            terms=self.terms,
+        )
 
     @classmethod
     def load(cls, path: str) -> "Snapshot":
@@ -91,18 +111,15 @@ class EngineCheckpoint:
     voted_for: np.ndarray  # i32[R] per-replica votedFor (NO_VOTE = -1)
 
     def save(self, path: str) -> None:
-        # file handle for the same reason as Snapshot.save: keep the
-        # written name exactly what load() will be handed
-        with open(path, "wb") as f:
-            np.savez_compressed(
-                f,
-                base_index=self.snap.base_index,
-                last_index=self.snap.last_index,
-                entries=self.snap.entries,
-                terms=self.snap.terms,
-                replica_terms=self.terms,
-                voted_for=self.voted_for,
-            )
+        _atomic_savez(
+            path,
+            base_index=self.snap.base_index,
+            last_index=self.snap.last_index,
+            entries=self.snap.entries,
+            terms=self.snap.terms,
+            replica_terms=self.terms,
+            voted_for=self.voted_for,
+        )
 
     @classmethod
     def load(cls, path: str) -> "EngineCheckpoint":

@@ -1,10 +1,18 @@
 from raft_tpu.transport.base import Transport, make_transport
 from raft_tpu.transport.device import SingleDeviceTransport
+from raft_tpu.transport.multihost import (
+    initialize_multihost,
+    multihost_transport,
+    replica_devices_across_hosts,
+)
 from raft_tpu.transport.tpu_mesh import TpuMeshTransport
 
 __all__ = [
     "Transport",
     "make_transport",
     "SingleDeviceTransport",
+    "initialize_multihost",
+    "multihost_transport",
+    "replica_devices_across_hosts",
     "TpuMeshTransport",
 ]
