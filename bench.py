@@ -192,11 +192,15 @@ def bench_rs53() -> dict:
     out = bench_scan(cfg, fn)
 
     # reconstruction-on-read: decode a B-entry window from 3 shard rows
+    # (the production path: ec.kernels.decode_device — bit-sliced Pallas
+    # on TPU; rs.decode_jax's per-byte LUT gathers are the oracle only)
+    from raft_tpu.ec.kernels import decode_device
+
     rows = [1, 3, 4]
     shards = jnp.asarray(
         rng.integers(0, 256, (3, cfg.batch_size, cfg.shard_bytes), dtype=np.uint8)
     )
-    dec = jax.jit(lambda s: code.decode_jax(s, rows))
+    dec = jax.jit(lambda s: decode_device(code, s, rows))
     t_dec = device_seconds(dec, lambda: (shards,))
     if not np.isfinite(t_dec):
         dec(shards)  # warm

@@ -3,6 +3,7 @@ from raft_tpu.ckpt.snapshot import (
     EngineCheckpoint,
     Snapshot,
     install_snapshot,
+    install_snapshot_all,
 )
 
 __all__ = [
@@ -10,4 +11,5 @@ __all__ = [
     "EngineCheckpoint",
     "Snapshot",
     "install_snapshot",
+    "install_snapshot_all",
 ]

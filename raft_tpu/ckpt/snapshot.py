@@ -50,6 +50,11 @@ def _atomic_savez(path: str, **arrays) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())   # survive power loss, not just a crash:
+            # without the fsync, delayed allocation can journal the rename
+            # while the data blocks are still unflushed — a truncated file
+            # under the final name after reboot
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -189,6 +194,20 @@ class CheckpointStore:
         return Snapshot(lo, hi, ents, terms)
 
 
+def _ring_tail(snap: Snapshot, cap: int):
+    """The snapshot tail that fits a capacity-``cap`` ring: (start index,
+    entries, terms). Standard log compaction — slots below the installed
+    range keep stale bytes nothing will ever read (consistency probes only
+    look at the window prev point, which the install covers)."""
+    n = snap.entries.shape[0]
+    keep = min(n, cap)
+    return (
+        snap.last_index - keep + 1,
+        snap.entries[n - keep:],
+        snap.terms[n - keep:],
+    )
+
+
 def install_snapshot(
     state: ReplicaState,
     replica: int,
@@ -199,20 +218,35 @@ def install_snapshot(
 ) -> ReplicaState:
     """Install a snapshot into one replica's row; returns the new state.
 
-    Only the tail that fits the ring is materialized (standard log
-    compaction: slots below the installed range keep stale bytes nothing
-    will ever read — consistency probes only ever look at the window prev
-    point, which the install covers). ``code`` re-encodes the replica's RS
-    shard rows when the cluster is erasure-coded.
+    Only the ring-fitting tail is materialized (``_ring_tail``). ``code``
+    re-encodes the replica's RS shard row when the cluster is
+    erasure-coded.
     """
-    cap = state.capacity
-    n = snap.entries.shape[0]
-    keep = min(n, cap)
-    ents = snap.entries[n - keep:]
-    terms = snap.terms[n - keep:]
-    start = snap.last_index - keep + 1
+    start, ents, terms = _ring_tail(snap, state.capacity)
     payload = ents if code is None else code.encode_host(ents)[replica]
     return install_entries(
         state, replica, start, payload, terms, leader_term,
         commit_to=snap.last_index, batch=batch,
     )
+
+
+def install_snapshot_all(
+    state: ReplicaState,
+    snap: Snapshot,
+    leader_term: int,
+    batch: int,
+    code=None,
+) -> ReplicaState:
+    """``install_snapshot`` into EVERY replica row (the whole-cluster
+    restore path), encoding the tail once — per-replica ``install_snapshot``
+    would redo the full RS encode R times for R shard rows it already
+    produced."""
+    start, ents, terms = _ring_tail(snap, state.capacity)
+    shard_rows = None if code is None else code.encode_host(ents)
+    for r in range(state.term.shape[0]):
+        payload = ents if shard_rows is None else shard_rows[r]
+        state = install_entries(
+            state, r, start, payload, terms, leader_term,
+            commit_to=snap.last_index, batch=batch,
+        )
+    return state

@@ -47,11 +47,15 @@ def reconstruct(
 
     ``rows`` picks WHICH replicas serve the read (any k live ones); the
     decode matrix for that subset is formed on host (rs.decode_matrix) and
-    applied on device.
+    applied on device by the bit-sliced kernel (ec.kernels.decode_device:
+    Pallas on TPU — the per-byte LUT path in rs.py is the oracle, not the
+    data path).
     """
+    from raft_tpu.ec.kernels import decode_device
+
     assert len(rows) == code.k
     shards = gather_shard_window(state, rows, lo, hi)
-    return np.asarray(code.decode_jax(jnp.asarray(shards), list(rows)))
+    return np.asarray(decode_device(code, jnp.asarray(shards), list(rows)))
 
 
 def install_window(

@@ -844,7 +844,7 @@ class RaftEngine:
         persisted term and votedFor, then the normal election path takes
         over. Uncommitted entries are lost, as they are for the reference's
         restarting process (nothing was ever durable there, main.go:18-21)."""
-        from raft_tpu.ckpt import EngineCheckpoint, install_snapshot
+        from raft_tpu.ckpt import EngineCheckpoint, install_snapshot_all
 
         ck = EngineCheckpoint.load(path)
         if ck.terms.shape != (cfg.n_replicas,):
@@ -866,30 +866,11 @@ class RaftEngine:
                     snap.entries[i - snap.base_index].tobytes(),
                     int(snap.terms[i - snap.base_index]),
                 )
-            # Under EC, encode the snapshot tail once and deal each replica
-            # its shard row (encode_host yields all rows in one pass; doing
-            # it inside install_snapshot per replica would redo the full
-            # encode R times). Verified-for term 0: the next real leader's
-            # repair window re-verifies matches in its own term.
-            if eng._code is not None:
-                from raft_tpu.ec.reconstruct import install_entries
-
-                cap = eng.state.capacity
-                n = snap.entries.shape[0]
-                keep = min(n, cap)
-                ents, terms = snap.entries[n - keep:], snap.terms[n - keep:]
-                start = snap.last_index - keep + 1
-                shard_rows = eng._code.encode_host(ents)
-                for r in range(cfg.n_replicas):
-                    eng.state = install_entries(
-                        eng.state, r, start, shard_rows[r], terms, 0,
-                        commit_to=snap.last_index, batch=cfg.batch_size,
-                    )
-            else:
-                for r in range(cfg.n_replicas):
-                    eng.state = install_snapshot(
-                        eng.state, r, snap, 0, cfg.batch_size, None
-                    )
+            # Verified-for term 0: the next real leader's repair window
+            # re-verifies matches in its own term.
+            eng.state = install_snapshot_all(
+                eng.state, snap, 0, cfg.batch_size, eng._code
+            )
             eng.commit_watermark = snap.last_index
         # persisted term + votedFor (the Raft durability obligation: a
         # restarted replica must not vote twice in a term it voted in)

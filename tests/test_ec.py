@@ -170,6 +170,38 @@ class TestKernels:
         rows = [1, 3, 4]
         np.testing.assert_array_equal(code.decode(shards[rows], rows), data)
 
+    @pytest.mark.parametrize("n,k", [(3, 2), (5, 3)])
+    def test_bitwise_decode_matches_numpy(self, n, k):
+        from itertools import combinations
+
+        from raft_tpu.ec.kernels import decode_bitwise_xla
+
+        rng = np.random.default_rng(7 * n + k)
+        code = RSCode(n, k)
+        S = 32 * k
+        data = rng.integers(0, 256, (16, S), dtype=np.uint8)
+        shards = code.encode(data)
+        for rows in combinations(range(n), k):   # every serving subset
+            got = np.asarray(
+                decode_bitwise_xla(code, jnp.asarray(shards[list(rows)]), rows)
+            )
+            np.testing.assert_array_equal(got, data)
+
+    @pytest.mark.parametrize("n,k", [(3, 2), (5, 3)])
+    def test_pallas_decode_matches_numpy(self, n, k):
+        from raft_tpu.ec.kernels import decode_pallas, encode_pallas
+
+        rng = np.random.default_rng(9 * n + k)
+        code = RSCode(n, k)
+        S = 32 * k
+        data = rng.integers(0, 256, (16, S), dtype=np.uint8)
+        shards = np.asarray(encode_pallas(code, jnp.asarray(data)))
+        rows = [1] + list(range(n - k + 1, n))   # parity-heavy subset
+        got = np.asarray(
+            decode_pallas(code, jnp.asarray(shards[rows]), rows)
+        )
+        np.testing.assert_array_equal(got, data)
+
     def test_device_fold_matches_host_fold(self):
         """fold_shards_device's bitcast packing must equal the host
         np.view(int32) little-endian fold byte for byte — the two feed the
