@@ -267,6 +267,14 @@ def main() -> None:
     slow4[4] = True
     c4 = bench_scan(cfg4, _fixed_payload_scan(cfg4, slow4, rng))
 
+    # -- supplementary: batch-scaling throughput -------------------------
+    # Same program at batch 4096: per-step fixed op overhead amortizes over
+    # 4x the entries, showing the throughput headroom above the
+    # latency-targeted batch-1024 headline (BASELINE's configs fix B=1024;
+    # this row is extra evidence, not one of the five).
+    cfg2x = RaftConfig(batch_size=4096, log_capacity=1 << 17)
+    c2x = bench_scan(cfg2x, _fixed_payload_scan(cfg2x, np.zeros(3, bool), rng))
+
     out = {
         "metric": "commit_p50_latency",
         "value": c2["p50_us"],
@@ -283,6 +291,7 @@ def main() -> None:
         "configs": {
             "c1_loopback": bench_loopback(),
             "c2_batched": c2,
+            "c2_batch4096": c2x,
             "c3_rs53": bench_rs53(),
             "c4_slow": c4,
             "c5_storm": bench_storm(),

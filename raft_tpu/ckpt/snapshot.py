@@ -56,6 +56,17 @@ def _atomic_savez(path: str, **arrays) -> None:
             # while the data blocks are still unflushed — a truncated file
             # under the final name after reboot
         os.replace(tmp, path)
+        # fsync the directory too: without it the rename itself may not be
+        # journaled at power loss, and the path would still resolve to the
+        # old checkpoint after reboot — the caller already treated the new
+        # state (e.g. a vote) as durable by then
+        dfd = os.open(
+            os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY
+        )
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
     except BaseException:
         try:
             os.unlink(tmp)

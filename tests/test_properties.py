@@ -64,9 +64,16 @@ def run_random_schedule(e, rng, virtual_seconds=400.0, phases=8):
     snapshots = []
     e.run_until_leader()
     for _ in range(phases):
-        # random client traffic
+        # random client traffic: queued submits, and sometimes a pipelined
+        # burst (the chunked-scan ingest path must uphold the same safety
+        # properties under churn as the tick path)
         for _ in range(rng.randrange(0, 6)):
             e.submit(bytes(rng.getrandbits(8) for _ in range(ENTRY)))
+        if rng.random() < 0.4 and e.leader_id is not None:
+            e.submit_pipelined([
+                bytes(rng.getrandbits(8) for _ in range(ENTRY))
+                for _ in range(rng.randrange(1, 20))
+            ])
         # random fault action, keeping a strict majority alive
         action = rng.choice(["kill", "recover", "slow", "unslow",
                              "campaign", "none"])
