@@ -44,9 +44,8 @@ def _atomic_savez(path: str, **arrays) -> None:
     previous good checkpoint — losing the old durable state on an
     interrupted save is precisely the failure persistence exists to
     prevent. A file handle (not a path) stops np.savez appending '.npz'."""
-    fd, tmp = tempfile.mkstemp(
-        dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".tmp"
-    )
+    parent = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, **arrays)
@@ -56,23 +55,23 @@ def _atomic_savez(path: str, **arrays) -> None:
             # while the data blocks are still unflushed — a truncated file
             # under the final name after reboot
         os.replace(tmp, path)
-        # fsync the directory too: without it the rename itself may not be
-        # journaled at power loss, and the path would still resolve to the
-        # old checkpoint after reboot — the caller already treated the new
-        # state (e.g. a vote) as durable by then
-        dfd = os.open(
-            os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY
-        )
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
     except BaseException:
         try:
             os.unlink(tmp)
         except OSError:
             pass
         raise
+    # fsync the directory too: without it the rename itself may not be
+    # journaled at power loss, and the path would still resolve to the old
+    # checkpoint after reboot — the caller already treated the new state
+    # (e.g. a vote) as durable by then. Outside the cleanup try: the
+    # replace has succeeded, so tmp must not be unlinked on a dir-fsync
+    # error.
+    dfd = os.open(parent, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 @dataclasses.dataclass

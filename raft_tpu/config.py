@@ -59,6 +59,8 @@ class RaftConfig:
     # --- transport selection: the plugin boundary named by the north star ---
     # "tpu_mesh": one replica row per device over a Mesh axis (falls back to
     #   "single" when fewer chips than replicas are available);
+    # "multihost": tpu_mesh with the replica axis placed across processes /
+    #   failure domains (transport.multihost; pod deployments);
     # "single": all replica rows resident on one device.
     # The host-side golden model (reference semantics, for differential
     # tests) is not a device transport — see raft_tpu.golden.

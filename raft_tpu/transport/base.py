@@ -80,6 +80,21 @@ def make_transport(cfg: RaftConfig, devices=None) -> "Transport":
             need, cfg.n_replicas, cfg.payload_shards, len(devices),
         )
         return SingleDeviceTransport(cfg)
+    if cfg.transport == "multihost":
+        # replica axis across processes/failure domains (pod deployments);
+        # a single-process fabric degrades to the flat local device list,
+        # and an under-provisioned one falls back to the resident layout
+        # with the same loud warning as tpu_mesh
+        from raft_tpu.transport.multihost import multihost_transport
+
+        try:
+            return multihost_transport(cfg, devices=devices)
+        except ValueError as e:
+            logger.warning(
+                "multihost transport unavailable (%s); falling back to "
+                "SingleDeviceTransport", e,
+            )
+            return SingleDeviceTransport(cfg)
     if cfg.transport == "single":
         return SingleDeviceTransport(cfg)
     if cfg.transport == "loopback":

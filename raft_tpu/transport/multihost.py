@@ -127,9 +127,13 @@ def replica_devices_across_hosts(
 
 
 def multihost_transport(
-    cfg: RaftConfig, payload_shards: Optional[int] = None
+    cfg: RaftConfig,
+    payload_shards: Optional[int] = None,
+    devices: Optional[Sequence] = None,
 ) -> TpuMeshTransport:
-    """A mesh transport whose replica axis spans hosts (see module doc)."""
+    """A mesh transport whose replica axis spans hosts (see module doc).
+    ``devices`` restricts placement to a subset of the global device list
+    (default: all of ``jax.devices()``)."""
     shards = cfg.payload_shards if payload_shards is None else payload_shards
-    devs = replica_devices_across_hosts(cfg.n_replicas, shards)
+    devs = replica_devices_across_hosts(cfg.n_replicas, shards, devices)
     return TpuMeshTransport(cfg, devs, payload_shards=shards)

@@ -88,6 +88,17 @@ class TestPlacement:
         assert {b[0].process_index for b in blocks} == {0, 1}
 
 
+def test_make_transport_routes_multihost():
+    from raft_tpu.transport import TpuMeshTransport, make_transport
+
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="multihost",
+    )
+    t = make_transport(cfg)
+    assert isinstance(t, TpuMeshTransport)
+
+
 class TestEndToEnd:
     def test_multihost_transport_runs_cluster(self):
         """Single-process path on the virtual CPU mesh: the transport the
