@@ -795,6 +795,55 @@ class RaftEngine:
                 )
                 self.nodelog(p, f"suffix re-served to {leader_last}")
 
+    def committed_entries(self, lo: int, hi: int) -> np.ndarray:
+        """Read committed entries [lo, hi] (1-based, inclusive) as
+        u8[hi-lo+1, entry_bytes] — the client read API the reference never
+        offers (its values are stored and never read back, SURVEY.md §2
+        "there is no state machine").
+
+        Plain replication reads straight from a live replica's log; under
+        EC the window is decoded from any k live shard rows
+        (reconstruction-on-read, BASELINE config 3). Indices must be
+        committed and still within the ring horizon; older history lives in
+        the checkpoint store (``save_checkpoint``)."""
+        if not (1 <= lo <= hi <= self.commit_watermark):
+            raise ValueError(
+                f"range [{lo}, {hi}] not committed "
+                f"(watermark {self.commit_watermark})"
+            )
+        from raft_tpu.core.state import log_entries
+
+        # A holder can only serve indices its ring still retains: slot
+        # (i-1) % capacity is overwritten once last_index passes
+        # i + capacity - 1, so reading below last_index - capacity + 1
+        # would silently return a NEWER entry's bytes for an old index.
+        commits = np.asarray(self.state.commit_index)
+        lasts = np.asarray(self.state.last_index)
+        holders = [
+            r for r in range(self.cfg.n_replicas)
+            if self.alive[r]
+            and int(commits[r]) >= hi
+            and int(lasts[r]) - self.state.capacity + 1 <= lo
+        ]
+        if not holders:
+            raise ValueError(
+                f"no live replica both committed {hi} and still retains "
+                f"index {lo} in its ring; read the checkpoint store for "
+                "compacted history"
+            )
+        if not self.cfg.ec_enabled:
+            return log_entries(self.state, holders[0], lo, hi)
+        from raft_tpu.ec.reconstruct import reconstruct
+
+        if len(holders) < self.cfg.rs_k:
+            raise ValueError(
+                f"need {self.cfg.rs_k} live shard holders to decode, "
+                f"have {len(holders)}"
+            )
+        return reconstruct(
+            self.state, self._code, holders[: self.cfg.rs_k], lo, hi
+        )
+
     # -------------------------------------------------------- persistence
     def save_checkpoint(self, path: str) -> None:
         """Write the cluster's durable state to one file: per-replica term

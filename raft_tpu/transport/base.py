@@ -85,16 +85,25 @@ def make_transport(cfg: RaftConfig, devices=None) -> "Transport":
         # a single-process fabric degrades to the flat local device list,
         # and an under-provisioned one falls back to the resident layout
         # with the same loud warning as tpu_mesh
-        from raft_tpu.transport.multihost import multihost_transport
+        from raft_tpu.transport.multihost import (
+            replica_devices_across_hosts,
+        )
 
         try:
-            return multihost_transport(cfg, devices=devices)
+            # only device provisioning may fall back; a config error from
+            # transport construction itself must propagate like tpu_mesh's
+            devs = replica_devices_across_hosts(
+                cfg.n_replicas, cfg.payload_shards, devices
+            )
         except ValueError as e:
             logger.warning(
-                "multihost transport unavailable (%s); falling back to "
+                "multihost placement unavailable (%s); falling back to "
                 "SingleDeviceTransport", e,
             )
             return SingleDeviceTransport(cfg)
+        return TpuMeshTransport(
+            cfg, devs, payload_shards=cfg.payload_shards
+        )
     if cfg.transport == "single":
         return SingleDeviceTransport(cfg)
     if cfg.transport == "loopback":
