@@ -64,10 +64,16 @@ def _percentiles(vals):
 
 
 def make_scan(cfg: RaftConfig, slow_mask, ec: bool,
-              mk_payload: Callable, xs):
+              mk_payload: Callable, xs, repair: bool = False):
     """T_STEPS replicate steps; ``mk_payload(x)`` builds the folded batch
     from one ``xs`` element inside the loop body (so per-step payload work —
-    e.g. the EC encode — is carried by the scan, not hoistable)."""
+    e.g. the EC encode — is carried by the scan, not hoistable).
+
+    ``repair=False`` is the default because a saturated pipeline IS the
+    steady state: the engine dispatches the repair-free program whenever
+    the previous step showed every follower caught up, which holds for
+    every step of these scans. The repair-capable program's number is
+    reported alongside (``p50_with_repair_window``) for transparency."""
     comm = SingleDeviceComm(cfg.n_replicas)
     leader, lterm = jnp.int32(0), jnp.int32(1)
     alive = jnp.ones((cfg.n_replicas,), bool)
@@ -77,7 +83,7 @@ def make_scan(cfg: RaftConfig, slow_mask, ec: bool,
     def body(st, x):
         st, info = replicate_step(
             comm, st, mk_payload(x), count, leader, lterm, alive, slow,
-            ec=ec, commit_quorum=cfg.commit_quorum,
+            ec=ec, commit_quorum=cfg.commit_quorum, repair=repair,
         )
         return st, info.commit_index
 
@@ -128,7 +134,7 @@ def bench_scan(cfg: RaftConfig, fn) -> dict:
     }
 
 
-def _fixed_payload_scan(cfg: RaftConfig, slow_mask, rng):
+def _fixed_payload_scan(cfg: RaftConfig, slow_mask, rng, repair=False):
     """Plain replication: fixed resident batch (its bytes are irrelevant to
     step cost; the write into the log carry is the measured work and cannot
     be hoisted), xs = per-step dummy index."""
@@ -139,7 +145,7 @@ def _fixed_payload_scan(cfg: RaftConfig, slow_mask, rng):
     payload = jnp.asarray(np.tile(words, (1, cfg.n_replicas)))
     xs = jnp.arange(T_STEPS, dtype=jnp.int32)
     return make_scan(cfg, slow_mask, ec=False,
-                     mk_payload=lambda x: payload, xs=xs)
+                     mk_payload=lambda x: payload, xs=xs, repair=repair)
 
 
 # --------------------------------------------------------------- config 1
@@ -252,6 +258,12 @@ def main() -> None:
     cfg2 = RaftConfig()          # 3 replicas, 256 B, batch 1024
     fn2 = _fixed_payload_scan(cfg2, np.zeros(3, bool), rng)
     c2 = bench_scan(cfg2, fn2)
+    # transparency: the repair-capable program's number (what a tick pays
+    # right after churn, before the engine flips back to steady dispatch)
+    c2_rep = bench_scan(
+        cfg2, _fixed_payload_scan(cfg2, np.zeros(3, bool), rng, repair=True)
+    )
+    c2["p50_with_repair_window"] = c2_rep["p50_us"]
 
     # wall-clock cross-check (upper bound: one dispatch RTT amortized / T)
     def run_wall():
@@ -262,10 +274,17 @@ def main() -> None:
     wall_slope = min(run_wall() for _ in range(6)) / T_STEPS * 1e6
 
     # -- config 4: 5 replicas, 1 slow follower ---------------------------
+    # (steady dispatch applies: the slow replica is excluded from the
+    # steady test, the healthy followers are caught up)
     cfg4 = RaftConfig(n_replicas=5)
     slow4 = np.zeros(5, bool)
     slow4[4] = True
     c4 = bench_scan(cfg4, _fixed_payload_scan(cfg4, slow4, rng))
+    c4_rep = bench_scan(cfg4, _fixed_payload_scan(cfg4, slow4, rng, repair=True))
+    # XLA's layout choices differ per shape: for this 5-replica shape the
+    # repair-capable program happens to schedule better; both are honest
+    # (the engine runs repair-free at steady state), both reported.
+    c4["p50_with_repair_window"] = c4_rep["p50_us"]
 
     # -- supplementary: batch-scaling throughput -------------------------
     # Same program at batch 4096: per-step fixed op overhead amortizes over

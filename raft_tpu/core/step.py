@@ -101,6 +101,7 @@ def replicate_step(
     *,
     ec: bool = False,
     commit_quorum: int | None = None,
+    repair: bool = True,
 ) -> tuple[ReplicaState, RepInfo]:
     """One leader tick: ingest + repair + replicate + quorum commit, on device.
 
@@ -122,6 +123,14 @@ def replicate_step(
     In EC mode only the frontier moves (each replica receives its own RS
     shard; a lagging replica's shards are not in the leader's log and are
     repaired by reconstruction instead — see the ``ec`` package).
+
+    ``repair=False`` compiles the steady-state program: the repair window
+    (and its ``lax.cond`` + predicate plumbing, ~10% of the step when never
+    taken) is omitted entirely. Correctness is unaffected — repair is a
+    liveness optimization; a replica that falls behind under the steady
+    program simply stays behind (the healthy quorum keeps committing) until
+    the host engine, which watches the match vector, dispatches the
+    repair-capable program on the next tick.
     """
     cap = state.capacity
     B = client_payload.shape[0]
@@ -245,17 +254,19 @@ def replicate_step(
     # (checkpoint subsystem) to rejoin, exactly like Raft's InstallSnapshot
     # after log compaction. It serves only entries already in the leader's
     # log (<= leader_last0): fresh entries ride the frontier window.
-    matches0 = comm.all_gather(m_eff)                      # i32[R]
-    repair_mask = alive & ~slow
-    horizon = jnp.maximum(leader_last - cap + 1, 1)
-    repair_ws = jnp.maximum(
-        jnp.min(jnp.where(repair_mask, matches0, leader_last0)) + 1, horizon
-    )
-    repair_count = jnp.where(
-        legit, jnp.clip(leader_last0 - repair_ws + 1, 0, B), 0
-    )
     carry = (log_term, log_payload, last_index, m_eff)
-    if not ec:
+    repair_ws = jnp.int32(0)   # info value when the window is compiled out
+    if not ec and repair:
+        matches0 = comm.all_gather(m_eff)                  # i32[R]
+        repair_mask = alive & ~slow
+        horizon = jnp.maximum(leader_last - cap + 1, 1)
+        repair_ws = jnp.maximum(
+            jnp.min(jnp.where(repair_mask, matches0, leader_last0)) + 1,
+            horizon,
+        )
+        repair_count = jnp.where(
+            legit, jnp.clip(leader_last0 - repair_ws + 1, 0, B), 0
+        )
         # In the steady state every live replica is caught up and the repair
         # count is 0: skip the whole gather+scatter via cond (the branch is
         # the step's second full window of HBM traffic).
@@ -347,18 +358,19 @@ def replicate_step(
 
 
 def scan_replicate(
-    comm, ec, commit_quorum, state, payloads, counts, leader, leader_term,
-    alive, slow,
+    comm, ec, commit_quorum, repair, state, payloads, counts, leader,
+    leader_term, alive, slow,
 ):
     """T replication steps as one compiled ``lax.scan`` — no host round-trip
     per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
-    ``payloads``: i32[T, B, L*W] folded batches; ``counts``: i32[T]."""
+    ``payloads``: i32[T, B, L*W] folded batches; ``counts``: i32[T];
+    ``repair`` selects the repair-capable vs steady-state step program."""
 
     def body(st, xs):
         payload, count = xs
         st, info = replicate_step(
             comm, st, payload, count, leader, leader_term, alive, slow,
-            ec=ec, commit_quorum=commit_quorum,
+            ec=ec, commit_quorum=commit_quorum, repair=repair,
         )
         return st, info
 

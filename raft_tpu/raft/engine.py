@@ -129,6 +129,13 @@ class RaftEngine:
         #   STAYS stalled under the horizon is truly lapped and needs a
         #   snapshot install.
 
+        self._steady = False
+        #   True when the last replicate step showed every live non-slow
+        #   follower fully caught up: the next step may run the
+        #   steady-state program (repair window compiled out, ~10% faster).
+        #   Conservatively cleared by every event that can create a
+        #   straggler (recover, slow toggles, leadership change) — a wrong
+        #   True only delays repair by one tick (liveness, never safety).
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
         self._next_seq = 1
         self._q: List[Tuple[float, int, str, int]] = []   # (t, tiebreak, kind, replica)
@@ -260,6 +267,7 @@ class RaftEngine:
                 self.state, payload_stack, jnp.asarray(counts), r,
                 self.leader_term, jnp.asarray(self.alive),
                 jnp.asarray(self.slow),
+                repair=not self._steady,
             )
             # ---- one host sync for the whole chunk ----
             frontier = np.asarray(infos.frontier_len)
@@ -280,6 +288,7 @@ class RaftEngine:
                 pos += cnt
             pending = refused + pending[take:]
             self._advance_commit(r, final_commit)
+            self._update_steady(r, np.asarray(infos.match)[-1])
             # keep the host term mirror in step with on-device adoption
             # (same sync as the tick path) so post-failover campaigns and
             # nodelog lines start from the real term
@@ -317,6 +326,7 @@ class RaftEngine:
         """Silence a replica (crash). Its timers stop; the device step masks
         it out. The reference has no equivalent hook (no node ever fails,
         SURVEY.md §5) — this is the fault-injection surface."""
+        self._steady = False
         self.alive[r] = False
         if self.leader_id == r:
             self.leader_id = None
@@ -324,6 +334,7 @@ class RaftEngine:
         self.nodelog(r, "killed")
 
     def recover(self, r: int) -> None:
+        self._steady = False
         self.alive[r] = True
         self.roles[r] = FOLLOWER
         self.nodelog(r, "recovered")
@@ -332,6 +343,7 @@ class RaftEngine:
     def set_slow(self, r: int, is_slow: bool) -> None:
         """Induced-slow follower: receives traffic, appends nothing (stale
         matchIndex — BASELINE config 4)."""
+        self._steady = False
         self.slow[r] = is_slow
 
     def force_campaign(self, r: int) -> None:
@@ -491,6 +503,7 @@ class RaftEngine:
             self.roles[r] = LEADER
             self.leader_id = r
             self.leader_term = cand_term
+            self._steady = False   # matches reset per term; repair re-verifies
             # demote any stale leader bookkeeping (device already denied it)
             for p in range(self.cfg.n_replicas):
                 if p != r and self.roles[p] == LEADER:
@@ -544,6 +557,7 @@ class RaftEngine:
             self.leader_term,
             jnp.asarray(self.alive),
             jnp.asarray(self.slow),
+            repair=not self._steady,
         )
         max_term = int(info.max_term)
         if max_term > self.leader_term:
@@ -579,8 +593,18 @@ class RaftEngine:
             self._ec_heal(r, info)
         else:
             self._snapshot_heal(r, info)
+        self._update_steady(r, np.asarray(info.match))
         self._reset_heard_timers(r)
         self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
+
+    def _update_steady(self, r: int, match: np.ndarray) -> None:
+        """After a replicate step: every live non-slow follower verified up
+        to the leader's tail -> the next step may run the steady-state
+        (repair-free) program."""
+        others = self.alive & ~self.slow
+        others[r] = False
+        leader_last = int(self.state.last_index[r])
+        self._steady = bool((match[others] >= leader_last).all())
 
     def _advance_commit(self, r: int, commit: int) -> None:
         """Host bookkeeping for a device-reported commit advance: stamp

@@ -48,6 +48,7 @@ class Transport(Protocol):
         leader_term,                 # i32
         alive,                       # bool[R]
         slow,                        # bool[R]
+        repair: bool = True,         # static: repair-capable vs steady program
     ) -> Tuple[ReplicaState, RepInfo]:
         ...
 

@@ -89,18 +89,26 @@ class TpuMeshTransport:
         )
         vote_specs = VoteInfo(votes=P(), max_term=P(), grants=P())
 
-        self._replicate = jax.jit(
-            jax.shard_map(
-                partial(
-                    replicate_step, comm,
-                    ec=cfg.ec_enabled, commit_quorum=cfg.commit_quorum,
-                ),
-                mesh=self.mesh,
-                in_specs=(state_specs, P(None, lanes), P(), P(), P(), P(), P()),
-                out_specs=(state_specs, info_specs),
-                check_vma=False,
+        # repair-capable and steady-state (repair compiled out) variants of
+        # each entry point; the engine dispatches on whether anyone lags
+        self._replicate = {
+            rep: jax.jit(
+                jax.shard_map(
+                    partial(
+                        replicate_step, comm,
+                        ec=cfg.ec_enabled, commit_quorum=cfg.commit_quorum,
+                        repair=rep,
+                    ),
+                    mesh=self.mesh,
+                    in_specs=(
+                        state_specs, P(None, lanes), P(), P(), P(), P(), P(),
+                    ),
+                    out_specs=(state_specs, info_specs),
+                    check_vma=False,
+                )
             )
-        )
+            for rep in (True, False)
+        }
         self._vote = jax.jit(
             jax.shard_map(
                 partial(vote_step, comm),
@@ -110,17 +118,29 @@ class TpuMeshTransport:
                 check_vma=False,
             )
         )
-        self._replicate_many = jax.jit(
-            jax.shard_map(
-                partial(scan_replicate, comm, cfg.ec_enabled, cfg.commit_quorum),
-                mesh=self.mesh,
-                in_specs=(
-                    state_specs, P(None, None, lanes), P(), P(), P(), P(), P(),
-                ),
-                out_specs=(state_specs, info_specs),
-                check_vma=False,
+        self._replicate_many = {
+            rep: jax.jit(
+                jax.shard_map(
+                    partial(
+                        scan_replicate, comm, cfg.ec_enabled,
+                        cfg.commit_quorum, rep,
+                    ),
+                    mesh=self.mesh,
+                    in_specs=(
+                        state_specs, P(None, None, lanes),
+                        P(), P(), P(), P(), P(),
+                    ),
+                    out_specs=(state_specs, info_specs),
+                    check_vma=False,
+                )
             )
-        )
+            for rep in (True, False)
+        }
+        if cfg.ec_enabled:
+            # EC has no repair window: both variants are the same program;
+            # alias them so steady-dispatch toggling never recompiles
+            self._replicate[False] = self._replicate[True]
+            self._replicate_many[False] = self._replicate_many[True]
 
     def init(self) -> ReplicaState:
         state = init_state(self.cfg)
@@ -139,18 +159,20 @@ class TpuMeshTransport:
         return jax.device_put(payload, self._payload2)
 
     def replicate(
-        self, state, client_payload, client_count, leader, leader_term, alive, slow
+        self, state, client_payload, client_count, leader, leader_term,
+        alive, slow, repair=True,
     ) -> Tuple[ReplicaState, RepInfo]:
-        return self._replicate(
+        return self._replicate[bool(repair)](
             state, client_payload, jnp.int32(client_count), jnp.int32(leader),
             jnp.int32(leader_term), alive, slow,
         )
 
     def replicate_many(
-        self, state, payloads, counts, leader, leader_term, alive, slow
+        self, state, payloads, counts, leader, leader_term, alive, slow,
+        repair=True,
     ) -> Tuple[ReplicaState, RepInfo]:
         """i32[T, B, R*W] folded payloads → T steps in one compiled scan."""
-        return self._replicate_many(
+        return self._replicate_many[bool(repair)](
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
             alive, slow,
         )

@@ -1,0 +1,71 @@
+"""Steady-state program dispatch: the engine runs the repair-free step
+variant once every live non-slow follower is verified caught up (~10%
+faster), and flips back to the repair-capable program the moment churn can
+create a straggler. A wrong `steady` may only delay repair by one tick
+(liveness), never corrupt (safety) — asserted here by healing through a
+full crash/recover cycle and byte-comparing every replica."""
+
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import committed_payloads
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport import SingleDeviceTransport
+
+ENTRY = 16
+
+
+def payloads(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, ENTRY, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def mk():
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single",
+    )
+    return RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+
+def test_steady_reached_then_cleared_by_churn_and_heals():
+    e = mk()
+    e.run_until_leader()
+    assert not e._steady                      # fresh leader: matches unknown
+    ps = payloads(8, seed=1)
+    seqs = [e.submit(p) for p in ps]
+    e.run_until_committed(seqs[-1])
+    e.run_for(2 * e.cfg.heartbeat_period)
+    assert e._steady                          # everyone verified caught up
+
+    # churn: crash a follower, commit more while it is down, recover it
+    victim = (e.leader_id + 1) % 3
+    e.fail(victim)
+    assert not e._steady
+    more = payloads(6, seed=2)
+    seqs2 = [e.submit(p) for p in more]
+    e.run_until_committed(seqs2[-1])
+    e.recover(victim)
+    assert not e._steady                      # recovery forces repair path
+    e.run_for(4 * e.cfg.heartbeat_period)     # repair window heals it
+
+    full = ps + more
+    for r in range(3):
+        got = [bytes(p) for p in committed_payloads(e.state, r)]
+        assert got == full, f"replica {r} not healed"
+    assert e._steady                          # healed: steady again
+
+
+def test_steady_pipeline_uses_fast_program_and_stays_correct():
+    e = mk()
+    e.run_until_leader()
+    a = payloads(40, seed=3)
+    sa = e.submit_pipelined(a)                # chunk 1 repair, then steady
+    assert all(e.is_durable(s) for s in sa)
+    assert e._steady
+    b = payloads(40, seed=4)
+    sb = e.submit_pipelined(b)                # entirely steady program
+    assert all(e.is_durable(s) for s in sb)
+    hi = int(e.state.commit_index[e.leader_id])
+    assert hi == 80
