@@ -175,7 +175,7 @@ def bench_loopback(n_entries: int = 400) -> dict:
 
 # --------------------------------------------------------------- config 3
 def bench_rs53() -> dict:
-    from raft_tpu.ec.kernels import encode_device, fold_shards_device
+    from raft_tpu.ec.kernels import encode_fold_device
     from raft_tpu.ec.rs import RSCode
 
     cfg = RaftConfig(
@@ -190,8 +190,22 @@ def bench_rs53() -> dict:
         0, 256, (T_STEPS, cfg.batch_size, cfg.entry_bytes), dtype=np.uint8
     ))
 
+    # hardware equivalence gate for the fused kernel: CI only exercises the
+    # interpret path, so the non-tile-aligned column slices (sk=88) are
+    # asserted against the unfused reference here, on the real chip
+    from raft_tpu.ec.kernels import encode_device, fold_shards_device
+
+    probe = jnp.asarray(rng.integers(
+        0, 256, (cfg.batch_size, cfg.entry_bytes), dtype=np.uint8
+    ))
+    np.testing.assert_array_equal(
+        np.asarray(encode_fold_device(code, probe)),
+        np.asarray(fold_shards_device(encode_device(code, probe))),
+        err_msg="fused encode+fold diverges from reference on this backend",
+    )
+
     def mk_payload(x):
-        return fold_shards_device(encode_device(code, x))
+        return encode_fold_device(code, x)
 
     fn = make_scan(cfg, np.zeros(5, bool), ec=True,
                    mk_payload=mk_payload, xs=stream)

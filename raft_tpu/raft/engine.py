@@ -250,14 +250,9 @@ class RaftEngine:
                 b"".join(p for _, p in chunk), np.uint8
             ).reshape(take, cfg.entry_bytes)
             if cfg.ec_enabled:
-                from raft_tpu.ec.kernels import (
-                    encode_device,
-                    fold_shards_device,
-                )
+                from raft_tpu.ec.kernels import encode_fold_device
 
-                folded = fold_shards_device(
-                    encode_device(self._code, jnp.asarray(data))
-                )
+                folded = encode_fold_device(self._code, jnp.asarray(data))
                 payload_stack = folded.reshape(T, B, -1)
             else:
                 payload_stack = fold_batch(data, cfg.n_replicas).reshape(
@@ -535,15 +530,13 @@ class RaftEngine:
             # kernel (ec.kernels: Pallas on TPU, bit-decomposition XLA
             # elsewhere); the shard rows fold into the device layout without
             # leaving the device.
-            from raft_tpu.ec.kernels import encode_device, fold_shards_device
+            from raft_tpu.ec.kernels import encode_fold_device
 
             data = np.zeros((B, cfg.entry_bytes), np.uint8)
             data[:take] = np.frombuffer(
                 b"".join(p for _, p in self._queue[:take]), np.uint8
             ).reshape(take, cfg.entry_bytes)
-            payload = fold_shards_device(
-                encode_device(self._code, jnp.asarray(data))
-            )
+            payload = encode_fold_device(self._code, jnp.asarray(data))
         else:
             flat = np.frombuffer(
                 b"".join(p for _, p in self._queue[:take]), np.uint8

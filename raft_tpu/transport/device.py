@@ -33,7 +33,10 @@ class SingleDeviceTransport:
         comm = SingleDeviceComm(cfg.n_replicas)
         # two compiled variants per entry point: repair-capable, and the
         # steady-state program with the repair window compiled out (~10%
-        # faster; the engine dispatches on whether anyone lags)
+        # faster; the engine dispatches on whether anyone lags). EC has no
+        # repair window, so both keys alias one program (no dead wrapper,
+        # no recompile on dispatch toggles).
+        reps = (True,) if cfg.ec_enabled else (True, False)
         self._replicate = {
             rep: jax.jit(
                 partial(
@@ -42,7 +45,7 @@ class SingleDeviceTransport:
                     repair=rep,
                 )
             )
-            for rep in (True, False)
+            for rep in reps
         }
         self._vote = jax.jit(partial(vote_step, comm))
         self._replicate_many = {
@@ -52,11 +55,9 @@ class SingleDeviceTransport:
                     rep,
                 )
             )
-            for rep in (True, False)
+            for rep in reps
         }
         if cfg.ec_enabled:
-            # EC has no repair window: both variants are the same program;
-            # alias them so steady-dispatch toggling never recompiles
             self._replicate[False] = self._replicate[True]
             self._replicate_many[False] = self._replicate_many[True]
 

@@ -90,7 +90,9 @@ class TpuMeshTransport:
         vote_specs = VoteInfo(votes=P(), max_term=P(), grants=P())
 
         # repair-capable and steady-state (repair compiled out) variants of
-        # each entry point; the engine dispatches on whether anyone lags
+        # each entry point; the engine dispatches on whether anyone lags.
+        # EC has no repair window: both keys alias one program.
+        reps = (True,) if cfg.ec_enabled else (True, False)
         self._replicate = {
             rep: jax.jit(
                 jax.shard_map(
@@ -107,7 +109,7 @@ class TpuMeshTransport:
                     check_vma=False,
                 )
             )
-            for rep in (True, False)
+            for rep in reps
         }
         self._vote = jax.jit(
             jax.shard_map(
@@ -134,11 +136,9 @@ class TpuMeshTransport:
                     check_vma=False,
                 )
             )
-            for rep in (True, False)
+            for rep in reps
         }
         if cfg.ec_enabled:
-            # EC has no repair window: both variants are the same program;
-            # alias them so steady-dispatch toggling never recompiles
             self._replicate[False] = self._replicate[True]
             self._replicate_many[False] = self._replicate_many[True]
 

@@ -202,6 +202,27 @@ class TestKernels:
         )
         np.testing.assert_array_equal(got, data)
 
+    @pytest.mark.parametrize("n,k", [(3, 2), (5, 3)])
+    def test_fused_encode_fold_matches_unfused(self, n, k):
+        """The fused encode+fold kernel (production EC ingest path on TPU)
+        must be byte-identical to fold_shards_device(encode_device(...));
+        exercised here through the Pallas interpret path."""
+        from raft_tpu.ec.kernels import (
+            _encode_fold_pallas,
+            _parity_consts_key,
+            encode_device,
+            fold_shards_device,
+        )
+
+        rng = np.random.default_rng(11 * n + k)
+        code = RSCode(n, k)
+        data = rng.integers(0, 256, (16, 32 * k), dtype=np.uint8)
+        want = np.asarray(fold_shards_device(encode_device(code, jnp.asarray(data))))
+        got = np.asarray(_encode_fold_pallas(
+            code.k, code.m, _parity_consts_key(n, k), jnp.asarray(data)
+        ))
+        np.testing.assert_array_equal(got, want)
+
     def test_device_fold_matches_host_fold(self):
         """fold_shards_device's bitcast packing must equal the host
         np.view(int32) little-endian fold byte for byte — the two feed the
