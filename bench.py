@@ -15,7 +15,7 @@ Configs (BASELINE.json / BASELINE.md "Targets"):
 4. ``c4_slow``       — 5 replicas, 1 induced-slow follower: straggler
    quorum (commit must advance at 4-of-5).
 5. ``c5_storm``      — election storm: disruptive candidacies at ~5 s mean
-   intervals for 300 virtual seconds against the engine; commit progress
+   intervals for 120 virtual seconds against the engine; commit progress
    and virtual-clock p50 commit latency.
 
 Methodology. Device timing uses ``raft_tpu.obs.profiling.device_seconds``
@@ -42,6 +42,13 @@ import time
 from typing import Callable
 
 import jax
+
+# Persistent XLA compilation cache: the suite compiles ~6 scan programs
+# (~60 s each through the tunnel); cached compiles bring a fresh-process
+# run from ~5 min down to ~1 min. Harmless if the backend ignores it.
+jax.config.update("jax_compilation_cache_dir", "/tmp/raft_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -103,8 +110,10 @@ def _timed_wall_call(fn, *args) -> float:
     return time.perf_counter() - t0
 
 
-def bench_scan(cfg: RaftConfig, fn) -> dict:
-    """p50/p99 per-step time for one traced scan fn + commit sanity."""
+def bench_scan(cfg: RaftConfig, fn, reps: int = REPS) -> dict:
+    """p50/p99 per-step time for one traced scan fn + commit sanity.
+    ``reps`` can be lowered for supplementary (non-headline) rows to keep
+    the whole suite inside the driver's budget."""
     # the measured pipeline must actually commit its entries
     _, commits = fn(init_state(cfg))
     got = int(np.asarray(commits)[-1])
@@ -114,14 +123,14 @@ def bench_scan(cfg: RaftConfig, fn) -> dict:
 
     per_step = [
         device_seconds(fn, lambda: (init_state(cfg),)) * 1e6 / T_STEPS
-        for _ in range(REPS)
+        for _ in range(reps)
     ]
     method = "device"
     if not any(np.isfinite(per_step)):
         # no device trace on this platform: wall-clock whole-scan fallback
         method = "wall"
         per_step = []
-        for _ in range(REPS):
+        for _ in range(reps):
             st = init_state(cfg)
             _ = np.asarray(st.term)
             per_step.append(_timed_wall_call(fn, st) * 1e6 / T_STEPS)
@@ -243,11 +252,16 @@ def bench_storm() -> dict:
     e = RaftEngine(cfg, SingleDeviceTransport(cfg))
     e.run_until_leader()
     t_start = e.clock.now
-    plan = FaultPlan.election_storm(3, t_start, t_start + 300.0, 5.0, seed=3)
+    # 120 virtual seconds (~24 disruptive candidacies): every engine
+    # event costs several host<->device round trips through the tunnel,
+    # so the window is sized to keep the whole suite in the driver's
+    # budget while still showing commit progress through heavy churn
+    window = 120.0
+    plan = FaultPlan.election_storm(3, t_start, t_start + window, 5.0, seed=3)
     e.schedule_faults(plan)
     seqs = []
     next_submit = t_start
-    while e.clock.now < t_start + 300.0 and e._q:
+    while e.clock.now < t_start + window and e._q:
         if e.clock.now >= next_submit:
             seqs.append(e.submit(np.random.default_rng(len(seqs))
                                  .integers(0, 256, 256, np.uint8).tobytes()))
@@ -275,7 +289,8 @@ def main() -> None:
     # transparency: the repair-capable program's number (what a tick pays
     # right after churn, before the engine flips back to steady dispatch)
     c2_rep = bench_scan(
-        cfg2, _fixed_payload_scan(cfg2, np.zeros(3, bool), rng, repair=True)
+        cfg2, _fixed_payload_scan(cfg2, np.zeros(3, bool), rng, repair=True),
+        reps=3,
     )
     c2["p50_with_repair_window"] = c2_rep["p50_us"]
 
@@ -294,7 +309,9 @@ def main() -> None:
     slow4 = np.zeros(5, bool)
     slow4[4] = True
     c4 = bench_scan(cfg4, _fixed_payload_scan(cfg4, slow4, rng))
-    c4_rep = bench_scan(cfg4, _fixed_payload_scan(cfg4, slow4, rng, repair=True))
+    c4_rep = bench_scan(
+        cfg4, _fixed_payload_scan(cfg4, slow4, rng, repair=True), reps=3
+    )
     # XLA's layout choices differ per shape: for this 5-replica shape the
     # repair-capable program happens to schedule better; both are honest
     # (the engine runs repair-free at steady state), both reported.
@@ -306,7 +323,9 @@ def main() -> None:
     # latency-targeted batch-1024 headline (BASELINE's configs fix B=1024;
     # this row is extra evidence, not one of the five).
     cfg2x = RaftConfig(batch_size=4096, log_capacity=1 << 17)
-    c2x = bench_scan(cfg2x, _fixed_payload_scan(cfg2x, np.zeros(3, bool), rng))
+    c2x = bench_scan(
+        cfg2x, _fixed_payload_scan(cfg2x, np.zeros(3, bool), rng), reps=3
+    )
 
     out = {
         "metric": "commit_p50_latency",
