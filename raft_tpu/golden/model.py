@@ -393,6 +393,26 @@ class GoldenCluster:
         self._push(self.now + 2.0, "ltick", leader.id)   # main.go:394
 
     # -- event loop ---------------------------------------------------------
+    def force_campaign(self, name: str) -> None:
+        """Disruptive candidacy regardless of a live leader — the
+        election-storm injection (BASELINE config 5), mirroring
+        ``RaftEngine.force_campaign`` so the same storm schedule can drive
+        both sides of a differential run. The reference has no such hook;
+        the campaign itself then follows reference semantics exactly
+        (candidate term bump + serial poll, main.go:253-284, including the
+        sticky-``Voted`` quirk that can wedge golden elections)."""
+        node = self.nodes[name]
+        if not self.alive[name]:
+            return
+        if node.state == LEADER:
+            return  # a leader bumping itself is a no-op disruption
+        node.state = CANDIDATE
+        node.term += 1
+        node.nodelog("state changed to candidate (injected)")
+        self._campaign(node)
+        if node.state == CANDIDATE:
+            self._arm_candidate_timeout(name)
+
     def step_event(self) -> bool:
         """Dispatch one scheduled event; False when the queue is empty."""
         if not self._q:

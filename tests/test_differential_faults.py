@@ -157,6 +157,73 @@ class TestLongSlowWindowDifferential:
 
 
 @pytest.mark.parametrize("seed", SEEDS)
+class TestElectionStormDifferential:
+    """Shape C: the same seeded storm schedule (disruptive candidacies at
+    fixed virtual times on fixed replicas) drives both systems. The golden
+    oracle preserves the reference's sticky-``Voted`` quirk (main.go:160 —
+    a follower that ever voted denies votes forever), so golden elections
+    can wedge and its commit stalls; the engine implements per-term
+    votedFor and keeps committing. The differential join is the prefix
+    relation, plus Election Safety on the engine trace."""
+
+    def test_storm_prefix_relation(self, seed):
+        rng = np.random.default_rng(seed + 500)
+        pre = payload_list(6, seed + 600)
+        post = payload_list(6, seed + 700)
+        # one storm schedule for both sides: (delay, victim) pairs
+        storm = [(float(rng.uniform(5, 40)), int(rng.integers(0, 3)))
+                 for _ in range(4)]
+
+        # --- golden -------------------------------------------------------
+        c = GoldenCluster(3, seed=seed)
+        g_lead = c.run_until_leader()
+        for p in pre:
+            g_lead.client_append(p)
+        golden_settle(c)
+        assert g_lead.committed_payloads() == pre
+        for delay, victim in storm:
+            c.run_until(c.now + delay)
+            c.force_campaign(f"Server{victim}")
+        c.run_until(c.now + 120.0)
+        lead_after = c.leader()
+        if lead_after is not None:       # storms may wedge golden elections
+            for p in post:
+                lead_after.client_append(p)
+            golden_settle(c)
+        golden_committed = max(
+            (n.committed_payloads() for n in c.nodes.values()), key=len
+        )
+
+        # --- engine, same schedule ---------------------------------------
+        from raft_tpu.obs import TraceRecorder
+
+        tr = TraceRecorder()
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=128,
+            transport="single", seed=seed,
+        )
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr)
+        e.run_until_leader()
+        seqs = [e.submit(p) for p in pre]
+        e.run_until_committed(seqs[-1])
+        for delay, victim in storm:
+            e.run_for(delay)
+            e.force_campaign(victim)
+        e.run_for(120.0)
+        seqs2 = [e.submit(p) for p in post]
+        e.run_until_committed(seqs2[-1], limit=600.0)
+        eng = engine_committed(e, e.leader_id)
+        assert eng[: len(pre)] == pre
+        assert eng == pre + post
+
+        # differential join: golden committed is a byte-prefix of engine's
+        assert eng[: len(golden_committed)] == golden_committed
+        # Election Safety held on the engine through the storm
+        for term, leaders in tr.leaders_by_term().items():
+            assert len(leaders) <= 1, f"two leaders in term {term}"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
 class TestLeaderCrashDifferential:
     """Shape B: oracle stalls at the pre-crash watermark (reference quirk),
     engine keeps going — oracle committed must be a prefix of engine's."""
