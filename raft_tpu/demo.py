@@ -24,6 +24,7 @@ Run:  python -m raft_tpu.demo [--duration 120] [--time-scale 1] [--replicas 3]
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import time
 from typing import Optional
@@ -48,10 +49,16 @@ def run_demo(
     rs_k: Optional[int] = None,
     rs_m: Optional[int] = None,
     entry_bytes: int = 256,
+    checkpoint: Optional[str] = None,
     emit=print,
 ) -> RaftEngine:
     """Run a live cluster for ``duration`` virtual seconds; returns the
-    engine so callers (tests) can inspect final state."""
+    engine so callers (tests) can inspect final state.
+
+    ``checkpoint``: path for durable cluster state — resumed from if the
+    file exists (the committed log, terms, and votes survive the process
+    restart the reference never could, main.go:18-21) and written on
+    session end, including an interrupted (Ctrl-C) one."""
     cfg = RaftConfig(
         n_replicas=n_replicas,
         seed=seed,
@@ -60,7 +67,12 @@ def run_demo(
         entry_bytes=entry_bytes,
         transport="single",  # a live demo is a one-process, one-chip affair
     )
-    engine = RaftEngine(cfg, trace=emit)
+    if checkpoint is not None and os.path.exists(checkpoint):
+        engine = RaftEngine.restore(cfg, checkpoint, trace=emit)
+        emit(f"# resumed from {checkpoint}: "
+             f"{engine.commit_watermark} committed entries")
+    else:
+        engine = RaftEngine(cfg, trace=emit)
     client_rng = random.Random(seed ^ 0xC11E47)  # distinct client stream
     emit(
         f"# raft_tpu live demo: {n_replicas} replicas, "
@@ -70,45 +82,53 @@ def run_demo(
 
     start = time.monotonic()
     next_client = cfg.client_period
-    while True:
-        t_ev = engine.next_event_time()
-        if t_ev is None:
-            t_ev = float("inf")
-        t_next = min(next_client, t_ev)
-        if t_next > duration:
-            break
-        if time_scale > 0:
-            wait = t_next / time_scale - (time.monotonic() - start)
-            if wait > 0:
-                time.sleep(wait)
-        if next_client <= t_ev:
-            engine.clock.now = max(engine.clock.now, next_client)
-            # The reference's client only injects when a leader exists
-            # (main.go:90-94) — possibly to several during a dual-leader
-            # window; the engine has one authoritative leader at a time.
-            if engine.leader_id is not None:
-                seq = engine.submit(_payload(client_rng, cfg.entry_bytes))
-                emit(
-                    f"[client] submit seq={seq} -> "
-                    f"Server{engine.leader_id}"
-                )
+    try:
+        while True:
+            t_ev = engine.next_event_time()
+            if t_ev is None:
+                t_ev = float("inf")
+            t_next = min(next_client, t_ev)
+            if t_next > duration:
+                break
+            if time_scale > 0:
+                wait = t_next / time_scale - (time.monotonic() - start)
+                if wait > 0:
+                    time.sleep(wait)
+            if next_client <= t_ev:
+                engine.clock.now = max(engine.clock.now, next_client)
+                # The reference's client only injects when a leader exists
+                # (main.go:90-94) — possibly to several during a dual-leader
+                # window; the engine has one authoritative leader at a time.
+                if engine.leader_id is not None:
+                    seq = engine.submit(_payload(client_rng, cfg.entry_bytes))
+                    emit(
+                        f"[client] submit seq={seq} -> "
+                        f"Server{engine.leader_id}"
+                    )
+                else:
+                    emit("[client] no leader; skipping injection")
+                next_client += cfg.client_period
             else:
-                emit("[client] no leader; skipping injection")
-            next_client += cfg.client_period
-        else:
-            engine.step_event()
-
-    lat = engine.commit_latencies()
-    committed = len(lat)
-    emit(
-        f"# done: {committed} entries durable, commit watermark "
-        f"{engine.commit_watermark}"
-        + (
-            f", p50 commit latency {1e3 * float(sorted(lat)[committed // 2]):.0f} ms"
-            if committed
-            else ""
+                engine.step_event()
+    finally:
+        # entries already reported durable must survive even a Ctrl-C'd
+        # session — an interrupted run that skipped the save would roll
+        # the cluster back to the PREVIOUS checkpoint on the next resume
+        lat = engine.commit_latencies()
+        committed = len(lat)
+        emit(
+            f"# done: {committed} entries durable, commit watermark "
+            f"{engine.commit_watermark}"
+            + (
+                f", p50 commit latency "
+                f"{1e3 * float(sorted(lat)[committed // 2]):.0f} ms"
+                if committed
+                else ""
+            )
         )
-    )
+        if checkpoint is not None:
+            engine.save_checkpoint(checkpoint)
+            emit(f"# checkpoint written to {checkpoint}")
     return engine
 
 
@@ -130,6 +150,9 @@ def main(argv=None) -> None:
     ap.add_argument("--entry-bytes", type=int, default=256,
                     help="client entry payload size (default 256; must be "
                     "divisible by K under --rs, e.g. 264 for --rs 3,2)")
+    ap.add_argument("--checkpoint", type=str, default=None, metavar="PATH",
+                    help="resume from PATH if it exists; write durable "
+                    "cluster state there on session end")
     args = ap.parse_args(argv)
     rs_k = rs_m = None
     if args.rs:
@@ -142,6 +165,7 @@ def main(argv=None) -> None:
         rs_k=rs_k,
         rs_m=rs_m,
         entry_bytes=args.entry_bytes,
+        checkpoint=args.checkpoint,
     )
 
 

@@ -31,6 +31,24 @@ def test_demo_session_elects_and_commits():
     assert len(lat) >= 5 and max(lat) < 4.5
 
 
+def test_demo_checkpoint_resume(tmp_path):
+    """Two demo sessions with the same checkpoint path: the second resumes
+    the first's committed log and keeps committing on top."""
+    path = str(tmp_path / "demo.ckpt")
+    lines = []
+    e1 = run_demo(duration=60.0, time_scale=0.0, checkpoint=path,
+                  emit=lines.append)
+    first = e1.commit_watermark
+    assert first >= 3
+    assert any("checkpoint written" in ln for ln in lines)
+
+    lines2 = []
+    e2 = run_demo(duration=60.0, time_scale=0.0, checkpoint=path,
+                  emit=lines2.append)
+    assert any("resumed from" in ln for ln in lines2)
+    assert e2.commit_watermark > first     # resumed AND kept committing
+
+
 def test_demo_ec_session():
     lines = []
     eng = run_demo(duration=90.0, time_scale=0.0, n_replicas=5,

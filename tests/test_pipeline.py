@@ -82,6 +82,22 @@ def test_pipeline_ec_five_replicas():
     assert [bytes(x) for x in data] == ps[lo - 1 : hi]
 
 
+def test_pipeline_ec_over_mesh():
+    """Pipelined ingest with RS(5,3) over a 5-device replica mesh: the
+    fused encode + chunked scan must land each replica's shard row on its
+    own device, and reconstruction must read the same bytes back."""
+    e = mk(mesh=True, n_replicas=5, rs_k=3, rs_m=2, entry_bytes=12,
+           log_capacity=64)
+    e.run_until_leader()
+    ps = payloads(120, entry=12, seed=7)
+    seqs = e.submit_pipelined(ps)
+    assert all(e.is_durable(s) for s in seqs)
+    hi = int(e.state.commit_index[e.leader_id])
+    lo = max(1, hi - e.state.capacity + 1)
+    got = e.committed_entries(lo, hi)
+    assert [bytes(x) for x in got] == ps[lo - 1: hi]
+
+
 def test_pipeline_preserves_order_with_queued_submits():
     e = mk()
     e.run_until_leader()
