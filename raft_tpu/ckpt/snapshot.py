@@ -180,6 +180,11 @@ class CheckpointStore:
                 self._slots.pop(self._first, None)
                 self._first += 1
 
+    def get(self, idx: int) -> Optional[Tuple[bytes, int]]:
+        """(payload, term) for one archived index; None when compacted
+        away or never archived."""
+        return self._slots.get(idx)
+
     def covers(self, lo: int, hi: int) -> bool:
         return hi >= lo and all(i in self._slots for i in range(lo, hi + 1))
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import os
 import random
+import sys
 import time
 from typing import Optional
 
@@ -127,8 +128,20 @@ def run_demo(
             )
         )
         if checkpoint is not None:
-            engine.save_checkpoint(checkpoint)
-            emit(f"# checkpoint written to {checkpoint}")
+            if sys.exc_info()[0] is None:
+                # clean exit: a persistence failure must be loud (an
+                # exit-0 session whose durable state silently regressed
+                # would roll back on the next resume)
+                engine.save_checkpoint(checkpoint)
+                emit(f"# checkpoint written to {checkpoint}")
+            else:
+                # already-propagating exception (e.g. Ctrl-C): save on a
+                # best-effort basis but never mask the original exit reason
+                try:
+                    engine.save_checkpoint(checkpoint)
+                    emit(f"# checkpoint written to {checkpoint}")
+                except Exception as ex:
+                    emit(f"# checkpoint NOT written: {ex}")
     return engine
 
 

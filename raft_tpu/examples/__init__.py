@@ -1,0 +1,5 @@
+"""Worked examples built on the public raft_tpu API."""
+
+from raft_tpu.examples.kv import ReplicatedKV
+
+__all__ = ["ReplicatedKV"]

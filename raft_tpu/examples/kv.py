@@ -1,0 +1,88 @@
+"""A replicated key-value store — the canonical Raft application, built
+entirely on the public engine API.
+
+The reference replicates bare random ints and never applies them to
+anything (SURVEY §2: "there is no state machine"; main.go:92,149). This
+example is what the missing layer looks like: operations are encoded into
+fixed-size log entries, submitted through the engine, and applied to a
+dict **only once committed** — so every replica of the state machine
+(here, every process that replays the same log) converges to the same
+map, and a read served from the applied state never shows an
+un-durable write.
+
+Usage:
+
+    eng = RaftEngine(cfg)
+    kv = ReplicatedKV(eng)
+    eng.run_until_leader()
+    seq = kv.set(b"color", b"green")
+    eng.run_until_committed(seq)
+    kv.get(b"color")                      # b"green"
+
+Restart: build the engine with ``RaftEngine.restore`` and pass
+``replay=True`` — the store rebuilds from the archived committed tail.
+
+Entry encoding (fits one fixed-size log entry, entry_bytes >= 6):
+``[op u8][klen u16][vlen u16][key][value]`` zero-padded; op 1 = SET,
+op 2 = DELETE. Zero padding is self-delimiting because op 0 is invalid
+(an all-zero heartbeat entry is ignored).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional
+
+from raft_tpu.raft.engine import RaftEngine
+
+_SET, _DELETE = 1, 2
+_HDR = struct.Struct("<BHH")
+
+
+class ReplicatedKV:
+    """Dict-shaped state machine over the replicated log."""
+
+    def __init__(self, engine: RaftEngine, replay: bool = False):
+        self.engine = engine
+        self._data: Dict[bytes, bytes] = {}
+        self.last_applied = 0
+        engine.register_apply(self._apply, replay=replay)
+
+    # ------------------------------------------------------------ client
+    def _encode(self, op: int, key: bytes, value: bytes) -> bytes:
+        size = self.engine.cfg.entry_bytes
+        body = _HDR.pack(op, len(key), len(value)) + key + value
+        if len(body) > size:
+            raise ValueError(
+                f"op needs {len(body)} bytes, entries are {size}"
+            )
+        return body + bytes(size - len(body))
+
+    def set(self, key: bytes, value: bytes) -> int:
+        """Queue a SET; returns the engine seq. Durable (and visible to
+        ``get``) once the engine commits it — check
+        ``engine.is_durable(seq)`` or run until committed."""
+        return self.engine.submit(self._encode(_SET, key, value))
+
+    def delete(self, key: bytes) -> int:
+        return self.engine.submit(self._encode(_DELETE, key, b""))
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        """Read from APPLIED (committed) state — never shows a write that
+        could still be lost to a leadership change."""
+        return self._data.get(key)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    # ------------------------------------------------------ state machine
+    def _apply(self, index: int, payload: bytes) -> None:
+        op, klen, vlen = _HDR.unpack_from(payload)
+        if op == _SET:
+            k = payload[_HDR.size:_HDR.size + klen]
+            v = payload[_HDR.size + klen:_HDR.size + klen + vlen]
+            self._data[k] = v
+        elif op == _DELETE:
+            self._data.pop(payload[_HDR.size:_HDR.size + klen], None)
+        # op 0 = padding/heartbeat entry: ignore
+        self.last_applied = index

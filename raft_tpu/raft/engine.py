@@ -136,6 +136,16 @@ class RaftEngine:
         #   Conservatively cleared by every event that can create a
         #   straggler (recover, slow toggles, leadership change) — a wrong
         #   True only delays repair by one tick (liveness, never safety).
+        self._apply_fns: List[Tuple[Callable[[int, bytes], None], int]] = []
+        #   (callback, first index it receives) — per-registrant starts so
+        #   a late replay=False joiner never sees history that was merely
+        #   paused behind an archive gap at its registration time
+        self.applied_index = 0
+        self._lost_gaps: set = set()   # unrecoverable apply gaps, logged once
+        #   State-machine apply cursor (see register_apply). The reference
+        #   HAS no state machine — values are stored, never applied
+        #   (SURVEY §2, main.go:149) — so this hook is what turns the
+        #   replicated log into a replicated state machine.
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
         self._next_seq = 1
         self._q: List[Tuple[float, int, str, int]] = []   # (t, tiebreak, kind, replica)
@@ -615,6 +625,7 @@ class RaftEngine:
             del self._uncommitted[idx]
         for idx in [i for i in self._seq_at_index if i <= commit]:
             del self._seq_at_index[idx]
+        self._drain_apply()
 
     def _reset_heard_timers(self, r: int) -> None:
         """Replication traffic is the heartbeat: every heard follower's
@@ -811,6 +822,125 @@ class RaftEngine:
                     self.cfg.batch_size,
                 )
                 self.nodelog(p, f"suffix re-served to {leader_last}")
+
+    # ---------------------------------------------------- state machine
+    def register_apply(
+        self, fn: Callable[[int, bytes], None], replay: bool = False
+    ) -> int:
+        """Register a state-machine apply callback: ``fn(index, payload)``
+        is invoked for every committed entry, in log order, exactly once
+        per engine lifetime. The reference stores values and never applies
+        them (no state machine exists, SURVEY §2); this hook completes the
+        replicated-state-machine story.
+
+        ``replay=True`` first replays the archived committed tail (from
+        the oldest contiguously archived index up to the watermark) —
+        the restart path: after ``RaftEngine.restore`` a fresh state
+        machine rebuilds from the restored log. Returns the first index
+        the callback will have seen (1 = full history). The archive
+        retains ``2 * log_capacity`` entries, so a log longer than that
+        replays PARTIALLY (returns > 1, with a nodelog warning) — an
+        application needing full history beyond that must snapshot its own
+        state-machine state, the standard Raft compaction contract. If the
+        watermark entry itself is unarchived (the EC archive's give-up
+        path) a replay cannot even anchor and raises. With
+        ``replay=False`` the callback sees only entries committed after
+        registration."""
+        # Replay ends where the shared stream takes over: the watermark for
+        # the first registrant (which also sets the cursor there), the
+        # current cursor for later registrants — the shared stream then
+        # delivers everything past it exactly once, in order, so a late
+        # joiner never sees duplicates even while the cursor is paused
+        # behind an archive gap.
+        end = self.commit_watermark if not self._apply_fns else self.applied_index
+        if replay and end > 0:
+            lo = self.store.covered_lo(end)
+            # A gap below the covered range may be a *transient* archive
+            # give-up rather than compaction — recoverable from the device
+            # log; extend coverage downward before declaring history lost.
+            # (quiet probe: hitting the compaction floor here is expected,
+            # not an apply-stream wedge)
+            while lo > 1 and self._backfill_archive(lo - 1, quiet=True):
+                lo = self.store.covered_lo(end)
+            if lo > end:
+                raise ValueError(
+                    f"cannot replay: committed entry {end} is not archived"
+                )
+            if lo > 1:
+                self.nodelog(
+                    0, f"apply replay is partial: history starts at {lo} "
+                    "(older entries compacted or unrecoverable)"
+                )
+            for idx in range(lo, end + 1):
+                fn(idx, self.store.get(idx)[0])
+            start = end + 1
+        else:
+            # without replay the callback sees only entries committed
+            # after registration — even ones currently paused behind an
+            # archive gap must not be delivered to it later
+            start = self.commit_watermark + 1
+            lo = start
+        if not self._apply_fns:
+            self.applied_index = max(self.applied_index, self.commit_watermark)
+        self._apply_fns.append((fn, start))
+        return lo
+
+    def _drain_apply(self) -> None:
+        """Feed newly committed entries to the apply callbacks, in order.
+        Bytes come from the archive (populated by ``_archive_committed``);
+        a gap (the EC archive's documented give-up path) pauses the
+        cursor. Each drain retries the gap by re-running the archive
+        fallback (device read / reconstruction — donors that were short
+        may have recovered since); a gap below the leader's ring horizon
+        is unrecoverable and is reported loudly once."""
+        if not self._apply_fns:
+            return
+        while self.applied_index < self.commit_watermark:
+            nxt = self.applied_index + 1
+            ent = self.store.get(nxt)
+            if ent is None:
+                if not self._backfill_archive(nxt):
+                    break
+                ent = self.store.get(nxt)  # backfill True => present
+            # Advance first, then deliver to every eligible callback even
+            # if one raises (collect + re-raise): a raising callback must
+            # not make OTHER registrants miss this index, and must not
+            # cause re-delivery to them on the next drain.
+            self.applied_index += 1
+            err: Optional[BaseException] = None
+            for fn, fn_start in self._apply_fns:
+                if self.applied_index >= fn_start:
+                    try:
+                        fn(self.applied_index, ent[0])
+                    except Exception as ex:
+                        err = err if err is not None else ex
+            if err is not None:
+                raise err
+
+    def _backfill_archive(self, idx: int, quiet: bool = False) -> bool:
+        """Try to fill an archive gap at committed index ``idx`` from the
+        current leader's log (or shard reconstruction under EC). False if
+        still unavailable this tick; permanently-lost gaps (below the ring
+        horizon) get one loud nodelog — unless ``quiet`` (the replay
+        probe, where hitting the compaction floor is expected)."""
+        r = self.leader_id
+        if r is None:
+            return False
+        horizon = int(self.state.last_index[r]) - self.state.capacity + 1
+        if idx < horizon:
+            if not quiet and idx not in self._lost_gaps:
+                self._lost_gaps.add(idx)
+                self.nodelog(
+                    r, f"apply stream gap at {idx} is below the ring "
+                    "horizon and was never archived: unrecoverable; "
+                    "apply is wedged at this index"
+                )
+            return False
+        hi = idx
+        while hi + 1 <= self.commit_watermark and self.store.get(hi + 1) is None:
+            hi += 1
+        self._archive_committed(r, idx, hi)
+        return self.store.get(idx) is not None
 
     def committed_entries(self, lo: int, hi: int) -> np.ndarray:
         """Read committed entries [lo, hi] (1-based, inclusive) as

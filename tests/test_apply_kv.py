@@ -1,0 +1,189 @@
+"""State-machine apply hook (`engine.register_apply`) and the replicated
+KV example built on it.
+
+The reference has no state machine — values are stored, never applied
+(SURVEY §2, main.go:149). Here the apply stream is ordered, exactly-once
+per lifetime, committed-only, and survives restart via replay.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.examples import ReplicatedKV
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport import SingleDeviceTransport
+
+ENTRY = 64
+
+
+def mk(**kw):
+    defaults = dict(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single",
+    )
+    defaults.update(kw)
+    cfg = RaftConfig(**defaults)
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+
+class TestApplyHook:
+    def test_ordered_exactly_once(self):
+        cfg, e = mk()
+        seen = []
+        e.register_apply(lambda i, p: seen.append((i, bytes(p))))
+        e.run_until_leader()
+        ps = [bytes([i]) * ENTRY for i in range(1, 9)]
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+        assert [i for i, _ in seen] == list(range(1, 9))   # ordered, once
+        assert [p for _, p in seen] == ps
+
+    def test_applies_only_committed(self):
+        cfg, e = mk()
+        seen = []
+        e.register_apply(lambda i, p: seen.append(i))
+        e.run_until_leader()
+        e.submit(bytes(ENTRY))            # queued, not yet committed
+        assert seen == []                 # nothing applied before commit
+
+    def test_late_registration_skips_history_without_replay(self):
+        cfg, e = mk()
+        e.run_until_leader()
+        seqs = [e.submit(bytes([i]) * ENTRY) for i in range(1, 4)]
+        e.run_until_committed(seqs[-1])
+        seen = []
+        e.register_apply(lambda i, p: seen.append(i))
+        assert seen == []
+        s = e.submit(bytes([9]) * ENTRY)
+        e.run_until_committed(s)
+        assert seen == [4]                # only the post-registration entry
+
+    def test_gap_backfills_and_resumes(self):
+        """A transient archive gap (the EC give-up path) pauses the apply
+        cursor; the next drain backfills it from the device log and
+        delivery resumes in order — no permanently wedged stream."""
+        cfg, e = mk()
+        seen = []
+        e.register_apply(lambda i, p: seen.append(i))
+        e.run_until_leader()
+        orig = e._archive_committed
+        fail_left = [2]   # commit-time archive AND the same-tick backfill
+
+        def flaky(r, lo, hi):
+            if fail_left[0] > 0:
+                fail_left[0] -= 1
+                return                     # simulate the archive giving up
+            orig(r, lo, hi)
+
+        e._archive_committed = flaky
+        s1 = [e.submit(bytes([i]) * ENTRY) for i in range(1, 4)]
+        e.run_until_committed(s1[-1])
+        assert seen == []                  # gap persists: nothing applied
+        s2 = [e.submit(bytes([i]) * ENTRY) for i in range(4, 7)]
+        e.run_until_committed(s2[-1])
+        assert seen == list(range(1, 7))   # backfilled, ordered, complete
+
+    def test_late_replay_registrant_is_exactly_once_behind_a_gap(self):
+        """A second registrant joining with replay=True while the shared
+        cursor is paused behind an archive gap must still see every entry
+        exactly once, in order: replay covers [..cursor], the shared
+        stream delivers the rest after the gap backfills."""
+        cfg, e = mk()
+        first = []
+        e.register_apply(lambda i, p: first.append(i))
+        e.run_until_leader()
+        orig = e._archive_committed
+        fail_left = [2]
+
+        def flaky(r, lo, hi):
+            if fail_left[0] > 0:
+                fail_left[0] -= 1
+                return
+            orig(r, lo, hi)
+
+        e._archive_committed = flaky
+        s1 = [e.submit(bytes([i]) * ENTRY) for i in range(1, 4)]
+        e.run_until_committed(s1[-1])
+        assert first == []                    # cursor paused behind gap
+
+        late = []
+        e.register_apply(lambda i, p: late.append(i), replay=True)
+        s2 = [e.submit(bytes([i]) * ENTRY) for i in range(4, 7)]
+        e.run_until_committed(s2[-1])
+        assert first == list(range(1, 7))
+        assert late == sorted(set(late))      # no dup, no reorder
+        assert late[-1] == 6
+
+    def test_replay_rebuilds_from_archive(self):
+        cfg, e = mk()
+        e.run_until_leader()
+        ps = [bytes([i]) * ENTRY for i in range(1, 6)]
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+        seen = []
+        e.register_apply(lambda i, p: seen.append((i, bytes(p))),
+                         replay=True)
+        assert seen == list(enumerate(ps, start=1))
+
+
+class TestReplicatedKV:
+    def test_set_get_delete(self):
+        cfg, e = mk()
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        s1 = kv.set(b"color", b"green")
+        s2 = kv.set(b"shape", b"hexagon")
+        e.run_until_committed(s2)
+        assert kv.get(b"color") == b"green"
+        assert kv.get(b"shape") == b"hexagon"
+        s3 = kv.delete(b"color")
+        s4 = kv.set(b"shape", b"circle")   # overwrite
+        e.run_until_committed(s4)
+        assert kv.get(b"color") is None
+        assert kv.get(b"shape") == b"circle"
+        assert len(kv) == 1
+
+    def test_read_never_shows_uncommitted_write(self):
+        cfg, e = mk()
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        kv.set(b"k", b"v")                # queued only
+        assert kv.get(b"k") is None       # not durable -> not visible
+
+    def test_rejects_oversized_op(self):
+        cfg, e = mk()
+        kv = ReplicatedKV(e)
+        with pytest.raises(ValueError):
+            kv.set(b"k" * 40, b"v" * 40)  # header+80 > 64-byte entries
+
+    def test_restart_replays_state(self, tmp_path):
+        cfg, e = mk()
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        s1 = kv.set(b"a", b"1")
+        s2 = kv.set(b"b", b"2")
+        s3 = kv.delete(b"a")
+        e.run_until_committed(s3)
+        path = str(tmp_path / "kv.ckpt")
+        e.save_checkpoint(path)
+
+        e2 = RaftEngine.restore(cfg, path, SingleDeviceTransport(cfg))
+        kv2 = ReplicatedKV(e2, replay=True)
+        assert kv2.get(b"a") is None
+        assert kv2.get(b"b") == b"2"
+        assert kv2.last_applied == e2.commit_watermark
+        # and the restored store keeps serving new ops
+        e2.run_until_leader()
+        s = kv2.set(b"c", b"3")
+        e2.run_until_committed(s)
+        assert kv2.get(b"c") == b"3"
+
+    def test_kv_over_ec_cluster(self):
+        cfg, e = mk(n_replicas=5, rs_k=3, rs_m=2, entry_bytes=60)
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        seqs = [kv.set(f"k{i}".encode(), f"v{i}".encode()) for i in range(12)]
+        e.run_until_committed(seqs[-1])
+        for i in range(12):
+            assert kv.get(f"k{i}".encode()) == f"v{i}".encode()
