@@ -128,20 +128,20 @@ def run_demo(
             )
         )
         if checkpoint is not None:
-            if sys.exc_info()[0] is None:
-                # clean exit: a persistence failure must be loud (an
-                # exit-0 session whose durable state silently regressed
-                # would roll back on the next resume)
+            propagating = sys.exc_info()[0] is not None
+            try:
                 engine.save_checkpoint(checkpoint)
                 emit(f"# checkpoint written to {checkpoint}")
-            else:
-                # already-propagating exception (e.g. Ctrl-C): save on a
-                # best-effort basis but never mask the original exit reason
-                try:
-                    engine.save_checkpoint(checkpoint)
-                    emit(f"# checkpoint written to {checkpoint}")
-                except Exception as ex:
+            except Exception as ex:
+                # with an exception already propagating (e.g. Ctrl-C),
+                # never mask the original exit reason; on a clean exit a
+                # persistence failure must be loud — an exit-0 session
+                # whose durable state silently regressed would roll back
+                # on the next resume
+                if propagating:
                     emit(f"# checkpoint NOT written: {ex}")
+                else:
+                    raise
     return engine
 
 

@@ -141,11 +141,11 @@ class RaftEngine:
         #   a late replay=False joiner never sees history that was merely
         #   paused behind an archive gap at its registration time
         self.applied_index = 0
-        self._lost_gaps: set = set()   # unrecoverable apply gaps, logged once
         #   State-machine apply cursor (see register_apply). The reference
         #   HAS no state machine — values are stored, never applied
         #   (SURVEY §2, main.go:149) — so this hook is what turns the
         #   replicated log into a replicated state machine.
+        self._lost_gaps: set = set()   # unrecoverable apply gaps, logged once
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
         self._next_seq = 1
         self._q: List[Tuple[float, int, str, int]] = []   # (t, tiebreak, kind, replica)
