@@ -1,0 +1,22 @@
+"""CI-scale north-star certification (northstar.py): the device pipeline
+and the reference-semantics oracle consume the same entry stream and must
+produce byte-identical committed logs (compared via SHA-256 over the
+follower-read-back bytes vs the oracle's stored log). The full 1M-entry
+run executes on TPU (`python northstar.py`); CI certifies 20k on CPU."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from northstar import run_device, run_golden  # noqa: E402
+from raft_tpu.config import RaftConfig  # noqa: E402
+
+N = 20_480
+
+
+def test_device_and_oracle_commit_byte_identical_logs():
+    cfg = RaftConfig()                     # the north-star config
+    dev_hash, *_ = run_device(cfg, N, seed=3)
+    gold_hash = run_golden(N, cfg.entry_bytes, seed=3)
+    assert dev_hash == gold_hash
