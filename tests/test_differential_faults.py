@@ -41,11 +41,17 @@ def payload_list(n, seed):
     return [rng.integers(0, 256, ENTRY, dtype=np.uint8).tobytes() for _ in range(n)]
 
 
-def mk_engine(seed):
+def mk_engine(seed, mesh=False):
     cfg = RaftConfig(
         n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=128,
-        transport="single", seed=seed,
+        transport="tpu_mesh" if mesh else "single", seed=seed,
     )
+    if mesh:
+        import jax
+
+        from raft_tpu.transport import TpuMeshTransport
+
+        return RaftEngine(cfg, TpuMeshTransport(cfg, jax.devices()[:3]))
     return RaftEngine(cfg, SingleDeviceTransport(cfg))
 
 
@@ -61,11 +67,14 @@ def engine_committed(e, replica):
     return [bytes(row) for row in committed_payloads(e.state, replica)]
 
 
+@pytest.mark.parametrize("mesh", [False, True], ids=["single", "mesh"])
 @pytest.mark.parametrize("seed", SEEDS)
 class TestSlowFollowerDifferential:
-    """Shape A: identical committed bytes on both systems, all replicas."""
+    """Shape A: identical committed bytes on both systems, all replicas.
+    Parametrized over both device transports — the program body is shared,
+    only placement differs, so the differential result must be too."""
 
-    def test_committed_logs_byte_identical(self, seed):
+    def test_committed_logs_byte_identical(self, seed, mesh):
         ps = payload_list(10, seed + 100)
 
         # --- golden -------------------------------------------------------
@@ -84,7 +93,7 @@ class TestSlowFollowerDifferential:
         assert golden_logs[g_lead.id] == ps
 
         # --- engine, same shape -------------------------------------------
-        e = mk_engine(seed)
+        e = mk_engine(seed, mesh=mesh)
         lead = e.run_until_leader()
         slow = (lead + 1) % 3
         e.set_slow(slow, True)

@@ -110,6 +110,39 @@ def run_random_schedule(e, rng, virtual_seconds=400.0, phases=8):
     return snapshots
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_safety_across_whole_process_restart(seed, tmp_path):
+    """A checkpoint/restore boundary in the middle of a random schedule:
+    everything committed before the restart must survive it (Leader
+    Completeness across process lifetimes), and the restarted cluster must
+    uphold the same invariants while it keeps committing."""
+    n = 3
+    rng = random.Random(9000 + seed)
+    e = mk_engine(seed, n)
+    run_random_schedule(e, rng, virtual_seconds=200.0, phases=4)
+    pre = [bytes(p) for p in
+           committed_payloads(e.state, e.leader_id)]
+    assert pre, "schedule committed nothing before the restart"
+    path = str(tmp_path / "mid.ckpt")
+    e.save_checkpoint(path)
+
+    e2 = RaftEngine.restore(
+        e.cfg, path, SingleDeviceTransport(e.cfg)
+    )
+    assert [bytes(p) for p in committed_payloads(e2.state, 0)] == pre
+    run_random_schedule(e2, rng, virtual_seconds=200.0, phases=4)
+
+    committed = {r: [bytes(p) for p in committed_payloads(e2.state, r)]
+                 for r in range(n)}
+    final = committed[e2.leader_id]
+    assert final[: len(pre)] == pre, "restart lost committed entries"
+    for a in range(n):
+        for b in range(a + 1, n):
+            m = min(len(committed[a]), len(committed[b]))
+            assert committed[a][:m] == committed[b][:m]
+    assert len(final) > len(pre)   # the restarted cluster kept committing
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 @pytest.mark.parametrize("n", [3, 5])
 def test_safety_properties_under_random_schedule(seed, n):
