@@ -22,9 +22,20 @@ Capability map to the reference (SURVEY.md §1, §3):
   a reply — comment main.go:330), ``submit`` returns a sequence number and
   ``commit_watermark`` tells the client when it is durable.
 
+Beyond reference parity, the client surface the reference never offers:
+
+- ``submit_pipelined``   — chunked compiled-scan ingest, one host sync per
+  ~capacity/batch steps (SURVEY §7 hard part 1);
+- ``committed_entries``  — committed-range reads (EC decodes from any k
+  live shard rows);
+- ``register_apply``     — ordered exactly-once apply stream (the state
+  machine the reference lacks; see raft_tpu.examples.ReplicatedKV);
+- ``save_checkpoint`` / ``restore`` — whole-process durable restart (the
+  persistence main.go:18-21 only comments about).
+
 Timers run on a virtual clock by default — tests and differential runs are
-deterministic and fast (no 10-29 s waits); a live demo can pass a wall
-clock (``time.monotonic``).
+deterministic and fast (no 10-29 s waits); the live demo (raft_tpu.demo)
+paces the event heap against wall time.
 """
 
 from __future__ import annotations
