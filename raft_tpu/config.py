@@ -53,6 +53,15 @@ class RaftConfig:
     # --- loopback-transport fidelity (golden model only) ---
     channel_depth: int = 10             # reference channel buffer (main.go:68-72)
 
+    # --- steady-state program dispatch ---
+    # "auto": run the repair-free step program whenever the last step showed
+    #   every live non-slow follower caught up (~11% faster on the 3-replica
+    #   batch-1024 headline shape);
+    # "off": always run the repair-capable program — XLA's layout choices
+    #   differ per shape, and for some (5-replica, batch>=4096 on v5e) the
+    #   repair-capable program schedules better; docs/PERF.md has numbers.
+    steady_dispatch: str = "auto"
+
     # --- determinism ---
     seed: int = 0
 
@@ -99,6 +108,8 @@ class RaftConfig:
                 raise ValueError("ec_commit_margin must be in [0, rs_m]")
         if self.payload_shards < 1:
             raise ValueError("payload_shards must be >= 1")
+        if self.steady_dispatch not in ("auto", "off"):
+            raise ValueError('steady_dispatch must be "auto" or "off"')
         if self.shard_bytes % 4:
             # device payload storage is packed as int32 lanes (core.state
             # layout); each replica's per-entry bytes must fill whole words

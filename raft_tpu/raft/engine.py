@@ -283,7 +283,7 @@ class RaftEngine:
                 self.state, payload_stack, jnp.asarray(counts), r,
                 self.leader_term, jnp.asarray(self.alive),
                 jnp.asarray(self.slow),
-                repair=not self._steady,
+                repair=self._repair_program(),
             )
             # ---- one host sync for the whole chunk ----
             frontier = np.asarray(infos.frontier_len)
@@ -571,7 +571,7 @@ class RaftEngine:
             self.leader_term,
             jnp.asarray(self.alive),
             jnp.asarray(self.slow),
-            repair=not self._steady,
+            repair=self._repair_program(),
         )
         max_term = int(info.max_term)
         if max_term > self.leader_term:
@@ -611,10 +611,20 @@ class RaftEngine:
         self._reset_heard_timers(r)
         self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
 
+    def _repair_program(self) -> bool:
+        """Which step program the next replicate runs: the repair-capable
+        one unless the cluster is verified steady AND the config opts into
+        the steady-dispatch fast path (cfg.steady_dispatch)."""
+        if self.cfg.steady_dispatch == "off":
+            return True
+        return not self._steady
+
     def _update_steady(self, r: int, match: np.ndarray) -> None:
         """After a replicate step: every live non-slow follower verified up
         to the leader's tail -> the next step may run the steady-state
         (repair-free) program."""
+        if self.cfg.steady_dispatch == "off":
+            return  # _repair_program never reads _steady; skip the sync
         others = self.alive & ~self.slow
         others[r] = False
         leader_last = int(self.state.last_index[r])
