@@ -304,7 +304,7 @@ class RaftEngine:
                 pos += cnt
             pending = refused + pending[take:]
             self._advance_commit(r, final_commit)
-            self._update_steady(r, np.asarray(infos.match)[-1])
+            self._update_steady(r, infos.match[-1])
             # keep the host term mirror in step with on-device adoption
             # (same sync as the tick path) so post-failover campaigns and
             # nodelog lines start from the real term
@@ -607,7 +607,7 @@ class RaftEngine:
             self._ec_heal(r, info)
         else:
             self._snapshot_heal(r, info)
-        self._update_steady(r, np.asarray(info.match))
+        self._update_steady(r, info.match)
         self._reset_heard_timers(r)
         self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
 
@@ -619,12 +619,14 @@ class RaftEngine:
             return True
         return not self._steady
 
-    def _update_steady(self, r: int, match: np.ndarray) -> None:
+    def _update_steady(self, r: int, match) -> None:
         """After a replicate step: every live non-slow follower verified up
         to the leader's tail -> the next step may run the steady-state
-        (repair-free) program."""
+        (repair-free) program. ``match`` arrives as the un-materialized
+        device array so the "off" mode really skips the host sync."""
         if self.cfg.steady_dispatch == "off":
-            return  # _repair_program never reads _steady; skip the sync
+            return  # _repair_program never reads _steady
+        match = np.asarray(match)
         others = self.alive & ~self.slow
         others[r] = False
         leader_last = int(self.state.last_index[r])

@@ -57,6 +57,32 @@ def test_steady_reached_then_cleared_by_churn_and_heals():
     assert e._steady                          # healed: steady again
 
 
+def test_steady_dispatch_off_pins_repair_program():
+    """cfg.steady_dispatch="off" must run the repair-capable program on
+    every step, even after the cluster is verifiably steady."""
+    from raft_tpu.config import RaftConfig
+    from raft_tpu.transport import SingleDeviceTransport
+
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single", steady_dispatch="off",
+    )
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    seen = []
+    orig = e.t.replicate
+
+    def spy(*a, repair=True, **kw):
+        seen.append(repair)
+        return orig(*a, repair=repair, **kw)
+
+    e.t.replicate = spy
+    e.run_until_leader()
+    seqs = [e.submit(p) for p in payloads(8, seed=5)]
+    e.run_until_committed(seqs[-1])
+    e.run_for(6 * cfg.heartbeat_period)   # well past steady detection
+    assert seen and all(seen), "a step ran the steady program under 'off'"
+
+
 def test_steady_pipeline_uses_fast_program_and_stays_correct():
     e = mk()
     e.run_until_leader()
