@@ -55,6 +55,13 @@ def reconstruct(
 
     assert len(rows) == code.k
     shards = gather_shard_window(state, rows, lo, hi)
+    if sorted(rows) == list(range(code.k)):
+        # Systematic fast path (SURVEY §7 hard part 6: the read path must
+        # not pay decode cost unless shards are actually missing): rows
+        # 0..k-1 hold the raw byte-slices in SOME order — reorder to shard
+        # id and stitch; no decode. Order-insensitive so the heal path's
+        # leader-first donor lists ([2, 0, 1]) hit it too.
+        return code.unsplit(shards[np.argsort(np.asarray(rows))])
     return np.asarray(decode_device(code, jnp.asarray(shards), list(rows)))
 
 

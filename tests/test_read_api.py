@@ -68,6 +68,41 @@ def test_read_rejects_uncommitted_and_compacted():
     assert got.shape[0] == 21
 
 
+def test_ec_systematic_read_skips_decode():
+    """With the systematic rows alive, the read path must not pay decode
+    cost (SURVEY §7 hard part 6) — and must return the same bytes the
+    decode path would."""
+    import raft_tpu.ec.kernels as kernels
+
+    e = mk(n_replicas=5, rs_k=3, rs_m=2)
+    e.run_until_leader()
+    ps = payloads(8, seed=8)
+    seqs = [e.submit(p) for p in ps]
+    e.run_until_committed(seqs[-1])
+
+    called = []
+    orig = kernels.decode_device
+    kernels.decode_device = lambda *a, **k: (called.append(1), orig(*a, **k))[1]
+    try:
+        got = e.committed_entries(1, 8)      # all systematic rows alive
+        assert not called, "systematic read paid a decode"
+        assert [bytes(x) for x in got] == ps
+        # order-insensitive: a leader-first donor ordering is still the
+        # systematic set
+        from raft_tpu.ec.reconstruct import reconstruct
+        from raft_tpu.ec.rs import RSCode
+
+        got_shuffled = reconstruct(e.state, RSCode(5, 3), [2, 0, 1], 1, 8)
+        assert not called, "shuffled systematic read paid a decode"
+        assert [bytes(x) for x in got_shuffled] == ps
+        e.fail(0 if e.leader_id != 0 else 1)  # kill a systematic holder
+        got2 = e.committed_entries(1, 8)
+        assert called, "degraded read did not decode"
+        assert [bytes(x) for x in got2] == ps
+    finally:
+        kernels.decode_device = orig
+
+
 def test_ec_read_survives_systematic_holder_death():
     e = mk(n_replicas=5, rs_k=3, rs_m=2)
     e.run_until_leader()
