@@ -19,16 +19,16 @@ Usage on each host of a pod (standard JAX multi-process setup):
     t = multihost_transport(cfg)       # replica axis across processes
     eng = RaftEngine(cfg, t)           # every process runs the same program
 
-Every process executes the same engine event loop over globally-sharded
-arrays (standard JAX SPMD: one controller per host, identical programs).
-Determinism comes from the shared config seed — all hosts draw identical
-timer schedules, so their event loops stay in lockstep the way a single
-host's does.
-
-This module is device-layout logic only; it is exercised in CI by unit
-tests over fake device handles plus the virtual-CPU mesh (a single
-process), since no multi-host fabric exists in CI. On real pods the same
-code paths receive real ``jax.Device`` objects.
+The protocol DATA PLANE (vote rounds, replication, quorum commit — all
+`shard_map` collectives whose info outputs are replicated) is fully
+multi-process; CI proves it with a real two-OS-process cluster over the
+JAX distributed runtime (tests/test_multiprocess.py). The engine's HOST
+bookkeeping (durability archive, committed reads, nodelog peeks) touches
+sharded rows and is single-controller by design: on a pod, run the
+engine's control plane on one host — or give each host its own archive of
+its replica's feed — while every process executes the identical device
+program. Placement rules are additionally covered by fake-fabric unit
+tests and the single-process virtual mesh.
 """
 
 from __future__ import annotations
