@@ -19,7 +19,7 @@ import pytest
 
 CHILD = r'''
 import os, sys
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=3"
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.distributed.initialize(coordinator_address=sys.argv[1],
@@ -33,31 +33,54 @@ from raft_tpu.transport.multihost import (
     multihost_transport, replica_devices_across_hosts,
 )
 
-cfg = RaftConfig(n_replicas=3, entry_bytes=16, batch_size=4,
+R = 3
+cfg = RaftConfig(n_replicas=R, entry_bytes=16, batch_size=4,
                  log_capacity=64, transport="multihost")
-devs = replica_devices_across_hosts(3, 1)
+devs = replica_devices_across_hosts(R, 1)
 procs = sorted({d.process_index for d in devs})
 assert procs == [0, 1], f"replicas not spread across processes: {procs}"
 t = multihost_transport(cfg)
 state = t.init()
-alive = jnp.ones(3, bool)
-slow = jnp.zeros(3, bool)
+alive = jnp.ones(R, bool)
+slow = jnp.zeros(R, bool)
 
 # election across the process boundary
 state, vi = t.request_votes(state, 0, 1, alive)
-assert int(vi.votes) == 3, f"votes {int(vi.votes)}"
+assert int(vi.votes) == R, f"votes {int(vi.votes)}"
 
 # replicate + quorum-commit three batches across the boundary
 rng = np.random.default_rng(0)
 commit = 0
 for step in range(3):
     batch = rng.integers(0, 256, (4, 16), dtype=np.uint8)
-    payload = fold_batch(batch, 3)
+    payload = fold_batch(batch, R)
     state, info = t.replicate(state, payload, 4, 0, 1, alive, slow)
     commit = int(info.commit_index)
     assert commit == 4 * (step + 1), f"commit {commit} at step {step}"
 
-print(f"MPOK proc={jax.process_index()} commit={commit} votes={int(vi.votes)}")
+# erasure-coded cluster: each replica stores its own shard ROW; the
+# scatter + k+margin quorum also cross the process boundary
+from raft_tpu.ec.kernels import encode_fold_device
+from raft_tpu.ec.rs import RSCode
+
+ecfg = RaftConfig(n_replicas=R, rs_k=2, rs_m=1, entry_bytes=16,
+                  batch_size=4, log_capacity=64, transport="multihost",
+                  ec_commit_margin=1)
+et = multihost_transport(ecfg)
+es = et.init()
+es, evi = et.request_votes(es, 0, 1, alive)
+assert int(evi.votes) == R, f"ec votes {int(evi.votes)}"
+edata = rng.integers(0, 256, (4, 16), dtype=np.uint8)
+ecode = RSCode(ecfg.n_replicas, ecfg.rs_k)
+es, einfo = et.replicate(
+    es, np.asarray(encode_fold_device(ecode, jnp.asarray(edata))),
+    4, 0, 1, alive, slow,
+)
+ecommit = int(einfo.commit_index)
+assert ecommit == 4, f"ec commit {ecommit}"
+
+print(f"MPOK proc={jax.process_index()} commit={commit} "
+      f"votes={int(vi.votes)} ec_commit={ecommit}")
 '''
 
 
@@ -92,4 +115,5 @@ def test_two_process_cluster_data_plane(tmp_path):
         outs.append(out)
     for i, (p, out) in enumerate(zip(ps, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
-        assert f"MPOK proc={i} commit=12 votes=3" in out, out[-500:]
+        assert f"MPOK proc={i} commit=12 votes=3 ec_commit=4" in out, \
+            out[-500:]
