@@ -252,7 +252,22 @@ def bench_rs53() -> dict:
         dec(shards)  # warm
         t_dec = min(_timed_wall_call(dec, shards) for _ in range(4))
     out["entry_bytes"] = cfg.entry_bytes
+    # Degraded read (a parity row serves): DEVICE time of the bit-sliced
+    # decode kernel for the window. Systematic read (the k data rows
+    # serve): HOST wall of the no-decode reorder+stitch — different units
+    # by nature; in the engine the systematic path additionally avoids the
+    # device round-trip entirely.
     out["reconstruct_window_us"] = round(t_dec * 1e6, 1)
+    sys_shards = np.asarray(shards)
+    code.unsplit(sys_shards)  # warm
+    # plain perf_counter singles: _timed_wall_call's pytree readback adds
+    # ~250 us of overhead, an order of magnitude above this pure-host op
+    stitch = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        code.unsplit(sys_shards)
+        stitch.append(time.perf_counter() - t0)
+    out["systematic_stitch_host_us"] = round(min(stitch) * 1e6, 1)
     return out
 
 
