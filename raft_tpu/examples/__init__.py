@@ -1,5 +1,9 @@
 """Worked examples built on the public raft_tpu API."""
 
 from raft_tpu.examples.kv import ReplicatedKV
+from raft_tpu.examples.sessions import (
+    ReplicatedCounter,
+    SessionedStateMachine,
+)
 
-__all__ = ["ReplicatedKV"]
+__all__ = ["ReplicatedKV", "ReplicatedCounter", "SessionedStateMachine"]

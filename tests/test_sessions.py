@@ -1,0 +1,118 @@
+"""Exactly-once client sessions (`raft_tpu.examples.sessions`): blind
+retries of a non-idempotent operation apply once, including retries that
+BOTH commit, and the dedup table survives restart via log replay."""
+
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.examples import ReplicatedCounter
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport import SingleDeviceTransport
+
+ENTRY = 24
+
+
+def mk(**kw):
+    defaults = dict(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single",
+    )
+    defaults.update(kw)
+    cfg = RaftConfig(**defaults)
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+
+def test_increments_apply_exactly_once():
+    cfg, e = mk()
+    ctr = ReplicatedCounter(e)
+    e.run_until_leader()
+    seqs = [ctr.add(client_id=7, amount=5)[0] for _ in range(4)]
+    e.run_until_committed(seqs[-1])
+    assert ctr.value == 20
+    assert ctr.duplicates_dropped == 0
+
+
+def test_committed_retry_is_deduplicated():
+    """The dangerous case: the client retries because it never saw the
+    ack, but the original DID commit — both copies are in the log; the
+    session layer must apply the amount once."""
+    cfg, e = mk()
+    ctr = ReplicatedCounter(e)
+    e.run_until_leader()
+    s1, req = ctr.add(client_id=3, amount=10)
+    # blind retry with the same request id (ack presumed lost)
+    s2, _ = ctr.add(client_id=3, amount=10, request_id=req)
+    e.run_until_committed(s2)
+    assert e.is_durable(s1) and e.is_durable(s2)   # both committed
+    assert ctr.value == 10                          # applied once
+    assert ctr.duplicates_dropped == 1
+
+
+def test_distinct_clients_do_not_collide():
+    cfg, e = mk()
+    ctr = ReplicatedCounter(e)
+    e.run_until_leader()
+    s1, _ = ctr.add(client_id=1, amount=2, request_id=1)
+    s2, _ = ctr.add(client_id=2, amount=3, request_id=1)  # same req id
+    e.run_until_committed(s2)
+    assert ctr.value == 5
+    assert ctr.duplicates_dropped == 0
+
+
+def test_retry_after_leader_crash_applies_once(tmp_path):
+    """End-to-end session story: a crash window makes the ack uncertain;
+    the client retries; exactly one increment lands."""
+    cfg, e = mk()
+    ctr = ReplicatedCounter(e)
+    lead = e.run_until_leader()
+    s1, req = ctr.add(client_id=9, amount=100)
+    e.run_until_committed(s1)          # committed...
+    e.fail(lead)                       # ...but say the ack never arrived
+    e.run_until_leader()
+    s2, _ = ctr.add(client_id=9, amount=100, request_id=req)  # blind retry
+    e.run_until_committed(s2)
+    assert ctr.value == 100
+    assert ctr.duplicates_dropped == 1
+
+
+def test_dedup_table_survives_restart(tmp_path):
+    cfg, e = mk()
+    ctr = ReplicatedCounter(e)
+    e.run_until_leader()
+    s1, req = ctr.add(client_id=4, amount=7)
+    s2, _ = ctr.add(client_id=4, amount=7, request_id=req)   # committed dup
+    e.run_until_committed(s2)
+    assert ctr.value == 7
+    path = str(tmp_path / "ctr.ckpt")
+    e.save_checkpoint(path)
+
+    e2 = RaftEngine.restore(cfg, path, SingleDeviceTransport(cfg))
+    ctr2 = ReplicatedCounter(e2, replay=True)
+    assert ctr2.value == 7                      # replay dedups too
+    assert ctr2.duplicates_dropped == 1
+    e2.run_until_leader()
+    # a LATE retry of the same old request after restart is still dropped
+    s3, _ = ctr2.add(client_id=4, amount=7, request_id=req)
+    e2.run_until_committed(s3)
+    assert ctr2.value == 7
+    # but a FRESH auto-id add after restart must NOT collide with the
+    # replayed history (the allocator is seeded from the dedup table)
+    s4, req4 = ctr2.add(client_id=4, amount=5)
+    assert req4 > req
+    e2.run_until_committed(s4)
+    assert ctr2.value == 12
+
+
+def test_retry_does_not_regress_id_allocator():
+    """Retrying an old request id must not make the allocator hand out
+    already-used ids for NEW operations."""
+    cfg, e = mk()
+    ctr = ReplicatedCounter(e)
+    e.run_until_leader()
+    s1, r1 = ctr.add(client_id=5, amount=1)
+    s2, r2 = ctr.add(client_id=5, amount=2)
+    ctr.add(client_id=5, amount=1, request_id=r1)   # late retry of r1
+    s4, r4 = ctr.add(client_id=5, amount=4)         # fresh op
+    assert r4 > r2
+    e.run_until_committed(s4)
+    assert ctr.value == 7                           # 1 + 2 + 4, no losses
