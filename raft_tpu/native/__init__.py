@@ -1,4 +1,5 @@
-"""ctypes bindings for the C++ host codec (native/rs_codec.cpp).
+"""ctypes bindings for the C++ host codec (rs_codec.cpp, shipped
+inside this package so installed copies keep the native fast path).
 
 The library is built lazily with g++ on first use and cached next to the
 source; every entry point degrades to the NumPy oracle when the toolchain
@@ -17,7 +18,7 @@ from typing import Optional
 
 import numpy as np
 
-_SRC = Path(__file__).resolve().parents[2] / "native" / "rs_codec.cpp"
+_SRC = Path(__file__).resolve().parent / "rs_codec.cpp"
 _LIB = _SRC.with_suffix(".so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

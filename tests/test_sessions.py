@@ -103,6 +103,58 @@ def test_dedup_table_survives_restart(tmp_path):
     assert ctr2.value == 12
 
 
+def test_counter_under_churn_with_blind_retries(tmp_path):
+    """Random crashes/elections while clients blind-retry non-idempotent
+    increments: the live value must (a) count every (client, request) at
+    most once, bounded by the durable and submitted sums, and (b) equal a
+    fresh replay of the log from a checkpoint — the log itself proves
+    exactly-once."""
+    import random
+
+    rng = random.Random(77)
+    cfg, e = mk(log_capacity=256)
+    ctr = ReplicatedCounter(e)
+    e.run_until_leader()
+    pair_amount = {}           # (client, req) -> amount
+    pair_seqs = {}             # (client, req) -> [engine seqs]
+    for phase in range(8):
+        for _ in range(rng.randrange(1, 4)):
+            client = rng.randrange(1, 4)
+            amount = rng.randrange(1, 10)
+            seq, req = ctr.add(client, amount)
+            pair_amount[(client, req)] = amount
+            pair_seqs.setdefault((client, req), []).append(seq)
+            if rng.random() < 0.5:   # blind retry (ack presumed lost)
+                s2, _ = ctr.add(client, amount, request_id=req)
+                pair_seqs[(client, req)].append(s2)
+        action = rng.choice(["kill_leader", "campaign", "none"])
+        if action == "kill_leader" and e.leader_id is not None:
+            victim = e.leader_id
+            e.fail(victim)
+            e.run_until_leader()
+            e.recover(victim)
+        elif action == "campaign":
+            e.force_campaign(rng.randrange(3))
+        e.run_for(60.0)
+    # quiesce with fresh progress
+    s, _ = ctr.add(client_id=9, amount=0)
+    e.run_until_committed(s, limit=600.0)
+    e.run_for(4 * cfg.heartbeat_period)
+
+    durable_sum = sum(
+        a for (c, r), a in pair_amount.items()
+        if any(e.is_durable(s) for s in pair_seqs[(c, r)])
+    )
+    total_sum = sum(pair_amount.values())
+    assert durable_sum <= ctr.value <= total_sum
+
+    path = str(tmp_path / "churn.ckpt")
+    e.save_checkpoint(path)
+    e2 = RaftEngine.restore(cfg, path, SingleDeviceTransport(cfg))
+    ctr2 = ReplicatedCounter(e2, replay=True)
+    assert ctr2.value == ctr.value, "replayed log disagrees with live value"
+
+
 def test_retry_does_not_regress_id_allocator():
     """Retrying an old request id must not make the allocator hand out
     already-used ids for NEW operations."""
