@@ -158,6 +158,7 @@ class RaftEngine:
         #   replicated log into a replicated state machine.
         self._lost_gaps: set = set()   # unrecoverable apply gaps, logged once
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
+        self._fault_events: list = []              # FaultPlan merge targets
         self._next_seq = 1
         self._q: List[Tuple[float, int, str, int]] = []   # (t, tiebreak, kind, replica)
         self._seq_events = 0
@@ -216,6 +217,27 @@ class RaftEngine:
     def is_durable(self, seq: int) -> bool:
         return seq in self.commit_time
 
+    def _pack_entries(self, entries, padded_len: int) -> np.ndarray:
+        """(seq, payload) pairs -> u8[padded_len, entry_bytes], zero-padded
+        past the real entries (shared by the tick and pipelined ingest)."""
+        data = np.zeros((padded_len, self.cfg.entry_bytes), np.uint8)
+        if entries:
+            data[:len(entries)] = np.frombuffer(
+                b"".join(p for _, p in entries), np.uint8
+            ).reshape(len(entries), self.cfg.entry_bytes)
+        return data
+
+    def _step_down_leader(self, r: int, max_term: int) -> None:
+        """A higher term exists: the leader reverts to follower
+        (main.go:309-321); the device step already refused ingest/commit
+        for the stale term."""
+        self.roles[r] = FOLLOWER
+        self.terms[r] = max_term
+        if self.leader_id == r:
+            self.leader_id = None
+        self.nodelog(r, "step down to follower")
+        self._arm_follower(r)
+
     def submit_pipelined(self, payloads: List[bytes]) -> List[int]:
         """High-throughput ingest: replicate + commit many batches in
         chunked compiled scans (``transport.replicate_many``), syncing to
@@ -266,10 +288,7 @@ class RaftEngine:
             counts[:used] = B
             if used:
                 counts[used - 1] = take - (used - 1) * B
-            data = np.zeros((T * B, cfg.entry_bytes), np.uint8)
-            data[:take] = np.frombuffer(
-                b"".join(p for _, p in chunk), np.uint8
-            ).reshape(take, cfg.entry_bytes)
+            data = self._pack_entries(chunk, T * B)
             if cfg.ec_enabled:
                 from raft_tpu.ec.kernels import encode_fold_device
 
@@ -312,14 +331,8 @@ class RaftEngine:
                 self.terms[self.alive], self.leader_term
             )
             if max_term > self.leader_term:
-                # deposed mid-chunk (main.go:309-321): the device refused
-                # ingest/commit from the stale point on; hand the rest back
-                self.roles[r] = FOLLOWER
-                self.terms[r] = max_term
-                if self.leader_id == r:
-                    self.leader_id = None
-                self.nodelog(r, "step down to follower")
-                self._arm_follower(r)
+                # deposed mid-chunk: hand the rest back to the queue
+                self._step_down_leader(r, max_term)
                 break
             if refused:
                 break  # no progress is possible right now; don't spin
@@ -378,7 +391,6 @@ class RaftEngine:
         """Merge a ``faults.FaultPlan`` into the event heap; events fire at
         their absolute virtual-clock times, interleaved deterministically
         with protocol timers."""
-        self._fault_events = getattr(self, "_fault_events", [])
         base = len(self._fault_events)
         self._fault_events.extend(plan.events)
         for i, ev in enumerate(plan.events):
@@ -553,16 +565,15 @@ class RaftEngine:
             # leaving the device.
             from raft_tpu.ec.kernels import encode_fold_device
 
-            data = np.zeros((B, cfg.entry_bytes), np.uint8)
-            data[:take] = np.frombuffer(
-                b"".join(p for _, p in self._queue[:take]), np.uint8
-            ).reshape(take, cfg.entry_bytes)
+            data = self._pack_entries(self._queue[:take], B)
             payload = encode_fold_device(self._code, jnp.asarray(data))
         else:
-            flat = np.frombuffer(
-                b"".join(p for _, p in self._queue[:take]), np.uint8
-            ).reshape(take, cfg.entry_bytes)
-            payload = fold_batch(flat, cfg.n_replicas, B)
+            # pack only the real entries; fold_batch pads to B in the int32
+            # buffer (one copy of `take` rows, not B)
+            payload = fold_batch(
+                self._pack_entries(self._queue[:take], take),
+                cfg.n_replicas, B,
+            )
         self.state, info = self.t.replicate(
             self.state,
             payload,
@@ -575,15 +586,9 @@ class RaftEngine:
         )
         max_term = int(info.max_term)
         if max_term > self.leader_term:
-            # A higher term exists: step down (main.go:309-321). The device
-            # step refused ingest/commit for the stale term, so nothing was
-            # consumed from the queue.
-            self.roles[r] = FOLLOWER
-            self.terms[r] = max_term
-            if self.leader_id == r:
-                self.leader_id = None
-            self.nodelog(r, "step down to follower")
-            self._arm_follower(r)
+            # nothing was consumed from the queue: the device step refused
+            # ingest/commit for the stale term
+            self._step_down_leader(r, max_term)
             return
         # Heard replicas adopted the leader's term on device (core.step);
         # keep the host mirror in sync so post-failover campaigns start from
