@@ -220,6 +220,11 @@ class RaftEngine:
     def _pack_entries(self, entries, padded_len: int) -> np.ndarray:
         """(seq, payload) pairs -> u8[padded_len, entry_bytes], zero-padded
         past the real entries (shared by the tick and pipelined ingest)."""
+        if entries and len(entries) == padded_len:
+            # no padding needed: zero-copy view over the joined bytes
+            return np.frombuffer(
+                b"".join(p for _, p in entries), np.uint8
+            ).reshape(padded_len, self.cfg.entry_bytes)
         data = np.zeros((padded_len, self.cfg.entry_bytes), np.uint8)
         if entries:
             data[:len(entries)] = np.frombuffer(
