@@ -185,6 +185,26 @@ class CheckpointStore:
         away or never archived."""
         return self._slots.get(idx)
 
+    @property
+    def first(self) -> int:
+        """Compaction floor: indices below it were evicted by the
+        ``max_entries`` sweep. An absent index AT or ABOVE this floor was
+        never archived (a hole), not compacted."""
+        return self._first
+
+    def set_floor(self, first: int) -> None:
+        """Raise the compaction floor explicitly (never lowers). The
+        restore path uses this to record that history below a restored
+        snapshot's ``base_index`` was compacted BEFORE the checkpoint was
+        written — without it, a later ``save_checkpoint`` would treat the
+        absent indices as a recoverable hole and try to backfill them
+        from ring slots that never held those entries."""
+        if first <= self._first:
+            return
+        for k in [k for k in self._slots if k < first]:
+            del self._slots[k]
+        self._first = first
+
     def covers(self, lo: int, hi: int) -> bool:
         return hi >= lo and all(i in self._slots for i in range(lo, hi + 1))
 

@@ -51,7 +51,13 @@ class RaftConfig:
     client_period: float = 10.0
 
     # --- loopback-transport fidelity (golden model only) ---
-    channel_depth: int = 10             # reference channel buffer (main.go:68-72)
+    # Capacity of the oracle's bounded LogReq channels (the reference's
+    # buffered channels, all cap 10, main.go:68-72): a full channel blocks
+    # the golden client mid-send until a leader tick drains it. Consumed
+    # by ``GoldenCluster.from_config`` / ``GoldenCluster(channel_depth=)``;
+    # the device engine deliberately has no channel analogue — its
+    # backpressure point is the ring (core.step's room clamp).
+    channel_depth: int = 10
 
     # --- steady-state program dispatch ---
     # "auto": run the repair-free step program whenever the last step showed
@@ -108,6 +114,8 @@ class RaftConfig:
                 raise ValueError("ec_commit_margin must be in [0, rs_m]")
         if self.payload_shards < 1:
             raise ValueError("payload_shards must be >= 1")
+        if self.channel_depth < 1:
+            raise ValueError("channel_depth must be >= 1")
         if self.steady_dispatch not in ("auto", "off"):
             raise ValueError('steady_dispatch must be "auto" or "off"')
         if self.shard_bytes % 4:

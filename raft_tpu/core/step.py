@@ -73,7 +73,11 @@ class RepInfo(NamedTuple):
     max_term: jax.Array      # i32[]  highest term seen in the cluster; if this
     #                                 exceeds the leader's term the host engine
     #                                 steps the leader down (main.go:312-321)
-    repair_start: jax.Array  # i32[]  first index the repair window covered
+    repair_start: jax.Array  # i32[]  first index the repair window covered.
+    #                                 Only meaningful for the non-EC
+    #                                 repair-capable program; hardwired 0
+    #                                 when the window is compiled out
+    #                                 (ec=True or repair=False).
     frontier_len: jax.Array  # i32[]  client entries ingested this step
 
 

@@ -100,6 +100,10 @@ class GoldenNode:
         self.last_applied = 0          # used as "last log index" (SURVEY §2)
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
+        self.logreq: List[bytes] = []  # the buffered LogReq channel
+        #   (main.go:36, 72): the client writes here; only LeaderRun reads
+        #   it (main.go:327), so values buffered while the node is not a
+        #   leader sit until it (re)wins — a faithful reference quirk.
         self.last_heard = 0.0          # virtual time of the last timer-
         #   resetting receipt (AppendEntries receipt main.go:124-127;
         #   granted VoteRequest main.go:162) — maintained by the cluster
@@ -209,7 +213,16 @@ class GoldenCluster:
         n_nodes: int = 3,
         seed: int = 0,
         trace: Optional[Callable[[str], None]] = None,
+        channel_depth: int = 10,
     ):
+        # ``channel_depth`` models the reference's buffered channels (all
+        # capacity 10, main.go:68-72): a full LogReq channel BLOCKS the
+        # client goroutine mid-send (main.go:92) until the leader drains.
+        # Wire ``RaftConfig.channel_depth`` here when driving differential
+        # runs from a config.
+        self.channel_depth = channel_depth
+        self._client_blocked: Optional[Tuple[bytes, List[str]]] = None
+        #   (value, remaining targets) of a send the client is blocked on
         self.rng = random.Random(seed)
         self.nodes: Dict[str, GoldenNode] = {
             f"Server{i}": GoldenNode(f"Server{i}", trace) for i in range(n_nodes)
@@ -229,6 +242,20 @@ class GoldenCluster:
         self.slow: Dict[str, bool] = {n: False for n in self.nodes}
         for name in self.nodes:
             self._arm_follower_timeout(name)
+
+    @classmethod
+    def from_config(
+        cls,
+        cfg,
+        trace: Optional[Callable[[str], None]] = None,
+    ) -> "GoldenCluster":
+        """Build the oracle for one side of a differential run from the
+        same ``RaftConfig`` that builds the engine: cluster size, seed and
+        the LogReq channel depth (main.go:68-72) come from the config."""
+        return cls(
+            cfg.n_replicas, seed=cfg.seed, trace=trace,
+            channel_depth=cfg.channel_depth,
+        )
 
     # -- fault injection (engine-mask mirror, not reference behavior) -------
     def fail(self, name: str) -> None:
@@ -272,6 +299,42 @@ class GoldenCluster:
         nodes)."""
         self.client_values.append(payload)
 
+    def _deliver_client(self) -> None:
+        """Push queued client values into every current leader's bounded
+        LogReq channel (capacity ``channel_depth``, main.go:68-72).
+
+        A full channel blocks the client goroutine mid-send (main.go:92):
+        delivery stops entirely — later values and later targets wait —
+        until a leader tick drains the full channel, then resumes with the
+        SAME value and its remaining targets (targets already sent to do
+        not receive the value twice). A blocked-on target that has died is
+        dropped (our fault extension; reference nodes never die)."""
+        while True:
+            if self._client_blocked is not None:
+                v, targets = self._client_blocked
+            else:
+                if not self.client_values:
+                    return
+                targets = [
+                    n.id for n in self.nodes.values()
+                    if n.state == LEADER and self.alive[n.id]
+                ]
+                if not targets:
+                    return  # no leader: values wait for a later tick
+                v = self.client_values.pop(0)
+            while targets:
+                name = targets[0]
+                if not self.alive[name]:
+                    targets.pop(0)
+                    continue
+                node = self.nodes[name]
+                if len(node.logreq) >= self.channel_depth:
+                    self._client_blocked = (v, targets)
+                    return  # blocked: the drain in _leader_tick resumes us
+                node.logreq.append(v)
+                targets.pop(0)
+            self._client_blocked = None
+
     # -- the role bodies that need the cluster (send/recv) ------------------
     def _campaign(self, cand: GoldenNode) -> None:
         """One election round: vote for self then poll every peer
@@ -309,6 +372,15 @@ class GoldenCluster:
 
     def _leader_tick(self, leader: GoldenNode) -> None:
         """One pass of the leader default branch (main.go:332-395)."""
+        # Drain the LogReq channel first: the select loop consumes pending
+        # client entries between ticks (main.go:327-331), so everything
+        # buffered since the last tick is appended before this replication
+        # pass. Freed capacity unblocks a client stuck mid-send.
+        if leader.logreq:
+            for v in leader.logreq:
+                leader.client_append(v)
+            leader.logreq.clear()
+            self._deliver_client()
         for name, peer in self.nodes.items():
             if name == leader.id:
                 continue
@@ -455,17 +527,10 @@ class GoldenCluster:
             else:
                 self._arm_follower_timeout(name)
         elif kind == "client":
-            # main.go:87-95: push queued values to every Leader-state node.
-            if self.client_values:
-                leaders = [
-                    n for n in self.nodes.values()
-                    if n.state == LEADER and self.alive[n.id]
-                ]
-                if leaders:
-                    for v in self.client_values:
-                        for leader in leaders:
-                            leader.client_append(v)
-                    self.client_values.clear()
+            # main.go:87-95: push queued values into every Leader-state
+            # node's bounded LogReq channel (blocking semantics in
+            # _deliver_client); the leader appends them at its next tick.
+            self._deliver_client()
             self._push(self.now + 10.0, "client", name)
         return True
 

@@ -132,6 +132,13 @@ class RaftEngine:
         #   served from it (raft_tpu.ckpt). Both snapshot consumers clamp
         #   their range to the last log_capacity entries, so the store
         #   compacts beyond 2x that instead of growing without bound.
+        self._ring_floor = np.ones(n, np.int64)
+        #   Per-replica smallest log index whose ring slot is guaranteed to
+        #   hold that entry's real bytes. Normally 1 (rings fill from
+        #   index 1), but a snapshot install seeds a replica's ring only
+        #   from the snapshot tail's start: slots below it still hold init
+        #   zeros (or pre-install leftovers), and a committed-range read
+        #   from them would return garbage labeled as committed data.
         self._match_stall = [0] * n
         #   Consecutive leader ticks each replica has sat below the ring
         #   horizon without match progress. After a leadership change every
@@ -711,9 +718,15 @@ class RaftEngine:
                 from raft_tpu.ec.reconstruct import reconstruct
 
                 commits = np.asarray(self.state.commit_index)
-                donors = [leader] + [
-                    q for q in range(self.cfg.n_replicas)
-                    if q != leader and self.alive[q] and int(commits[q]) >= mhi
+                # A donor's ring must actually HOLD the range: slots below
+                # its ring floor were never written (snapshot installs).
+                donors = [
+                    q
+                    for q in ([leader] + [
+                        p for p in range(self.cfg.n_replicas) if p != leader
+                    ])
+                    if self.alive[q] and int(commits[q]) >= mhi
+                    and int(self._ring_floor[q]) <= mlo
                 ]
                 if len(donors) < self.cfg.rs_k:
                     return
@@ -721,6 +734,8 @@ class RaftEngine:
                     self.state, self._code, donors[: self.cfg.rs_k], mlo, mhi
                 )
             else:
+                if int(self._ring_floor[leader]) > mlo:
+                    return  # ring never held the range; archive stays short
                 data = log_entries(self.state, leader, mlo, mhi)
         except ValueError:
             return
@@ -742,6 +757,8 @@ class RaftEngine:
             self.state, replica, self.store.snapshot(lo, hi),
             self.leader_term, self.cfg.batch_size, self._code,
         )
+        # Only [lo, hi] was written; slots below keep whatever they held.
+        self._ring_floor[replica] = max(self._ring_floor[replica], lo)
         self.nodelog(replica, f"snapshot installed to {hi}")
         return True
 
@@ -886,6 +903,16 @@ class RaftEngine:
         # joiner never sees duplicates even while the cursor is paused
         # behind an archive gap.
         end = self.commit_watermark if not self._apply_fns else self.applied_index
+        if replay and end == 0 and self.commit_watermark > 0:
+            # Non-first registrant while the shared cursor is still at 0
+            # (the first registrant joined pre-commit and _drain_apply is
+            # paused at an archive gap): silently downgrading to no-replay
+            # would skip indices 1..watermark for this registrant forever.
+            # Anchor the replay at the watermark instead — the shared
+            # stream only delivers indices >= this registrant's start, so
+            # no duplicates when the gap later heals, and the replay probe
+            # below may even backfill the gap.
+            end = self.commit_watermark
         if replay and end > 0:
             lo = self.store.covered_lo(end)
             # A gap below the covered range may be a *transient* archive
@@ -959,14 +986,35 @@ class RaftEngine:
         r = self.leader_id
         if r is None:
             return False
-        horizon = int(self.state.last_index[r]) - self.state.capacity + 1
-        if idx < horizon:
+        # A replica's ring can serve ``idx`` only between its floor (below
+        # it the slot was never written — snapshot installs seed only from
+        # the snapshot base) and its horizon (below it the slot was
+        # overwritten). Under EC recovery needs k such shard holders that
+        # also committed the entry; plain replication reads the leader.
+        lasts = np.asarray(self.state.last_index)
+
+        def serves(q: int) -> bool:
+            return idx >= max(
+                int(lasts[q]) - self.state.capacity + 1,
+                int(self._ring_floor[q]),
+            )
+
+        if self.cfg.ec_enabled:
+            commits = np.asarray(self.state.commit_index)
+            holders = sum(
+                1 for q in range(self.cfg.n_replicas)
+                if self.alive[q] and int(commits[q]) >= idx and serves(q)
+            )
+            recoverable = holders >= self.cfg.rs_k
+        else:
+            recoverable = serves(r)
+        if not recoverable:
             if not quiet and idx not in self._lost_gaps:
                 self._lost_gaps.add(idx)
                 self.nodelog(
-                    r, f"apply stream gap at {idx} is below the ring "
-                    "horizon and was never archived: unrecoverable; "
-                    "apply is wedged at this index"
+                    r, f"apply stream gap at {idx} is outside every "
+                    "serving ring range and was never archived: "
+                    "unrecoverable; apply is wedged at this index"
                 )
             return False
         hi = idx
@@ -1004,6 +1052,12 @@ class RaftEngine:
             if self.alive[r]
             and int(commits[r]) >= hi
             and int(lasts[r]) - self.state.capacity + 1 <= lo
+            # A snapshot-installed ring is only seeded from the snapshot
+            # base: slots below self._ring_floor[r] hold init zeros /
+            # pre-install leftovers, NOT old entries (after
+            # RaftEngine.restore every replica's floor is the checkpoint's
+            # base_index).
+            and int(self._ring_floor[r]) <= lo
         ]
         if not holders:
             raise ValueError(
@@ -1035,17 +1089,24 @@ class RaftEngine:
 
         hi = self.commit_watermark
         lo = self.store.covered_lo(hi)
-        if hi >= lo:
-            snap = self.store.snapshot(lo, hi)
-        elif hi == 0:  # nothing committed yet: empty snapshot
+        # An interior archive hole (the EC archive path gives up when
+        # donors are short; later ranges archive fine) would make the
+        # contiguous coverage start ABOVE the hole — snapshotting just
+        # [lo, hi] would silently drop acked-durable entries below it.
+        # Probe downward first (holes are often transient: donors may have
+        # recovered), then refuse loudly if committed entries above the
+        # compaction floor are still missing.
+        floor = max(1, self.store.first)
+        while lo > floor and self._backfill_archive(lo - 1, quiet=True):
+            lo = self.store.covered_lo(hi)
+        if hi == 0:  # nothing committed yet: empty snapshot
             snap = Snapshot(
                 1, 0,
                 np.zeros((0, self.cfg.entry_bytes), np.uint8),
                 np.zeros(0, np.int32),
             )
-        else:
-            # The watermark itself is missing from the archive (the EC
-            # archive path can give up when donors are short). Writing an
+        elif lo > hi:
+            # The watermark itself is missing from the archive. Writing an
             # empty checkpoint here would silently drop committed,
             # client-acknowledged entries across a restart — refuse loudly
             # instead; the caller can retry after the archive catches up.
@@ -1053,6 +1114,23 @@ class RaftEngine:
                 f"committed entry {hi} is not archived; refusing to write "
                 "a checkpoint that would lose committed entries"
             )
+        elif lo > floor:
+            holes = [
+                i for i in range(floor, lo) if self.store.get(i) is None
+            ]
+            shown = ", ".join(map(str, holes[:8])) + (
+                f", ... ({len(holes)} total)" if len(holes) > 8 else ""
+            )
+            raise RuntimeError(
+                f"committed entries {{{shown}}} are not archived and could "
+                "not be recovered; refusing to write a checkpoint that "
+                "would lose committed entries"
+            )
+        else:
+            # lo == compaction floor: everything below was evicted by the
+            # archive's retention sweep — recorded explicitly as compacted
+            # history via the snapshot's base_index, not silent loss.
+            snap = self.store.snapshot(lo, hi)
         EngineCheckpoint(
             snap=snap,
             terms=np.asarray(self.state.term, np.int32),
@@ -1089,6 +1167,11 @@ class RaftEngine:
         eng = cls(cfg, transport, trace=trace)
         snap = ck.snap
         if snap.last_index >= snap.base_index:
+            # History below the snapshot base was compacted before the
+            # checkpoint was written; record that so a later
+            # save_checkpoint treats the absence as compaction, not as a
+            # hole to backfill from ring slots that never held it.
+            eng.store.set_floor(snap.base_index)
             for i in range(snap.base_index, snap.last_index + 1):
                 eng.store.put(
                     i,
@@ -1101,6 +1184,12 @@ class RaftEngine:
                 eng.state, snap, 0, cfg.batch_size, eng._code
             )
             eng.commit_watermark = snap.last_index
+            # Rings are seeded only from the snapshot tail that fits one
+            # capacity; reads below that start must go to the checkpoint
+            # store, not the (zero-filled) ring slots.
+            eng._ring_floor[:] = max(
+                snap.base_index, snap.last_index - eng.state.capacity + 1
+            )
         # persisted term + votedFor (the Raft durability obligation: a
         # restarted replica must not vote twice in a term it voted in)
         eng.state = eng.state.replace(

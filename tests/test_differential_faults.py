@@ -312,3 +312,95 @@ class TestLeaderCrashDifferential:
         for r in range(3):
             got = engine_committed(e, r)
             assert got[: len(golden_committed)] == golden_committed, f"replica {r}"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+class TestStaleLeaderClientDifferential:
+    """Shape D (VERDICT r2 #6): a dual-leader window seeded on both sides,
+    with client traffic driven AT the deposed leader.
+
+    The reference's client pushes to *every* node in Leader state
+    (main.go:87-95), so during the window a stale leader double-ingests;
+    the oracle reproduces that via its bounded LogReq channels. The device
+    engine's step refuses stale ingest instead (core/step.py leader_current
+    gate) — driving a replicate step for the deposed leader with its old
+    term must ingest nothing and corrupt nothing. The differential join is
+    the committed-prefix relation through and after the window."""
+
+    def test_dual_leader_window(self, seed):
+        pre = payload_list(5, seed + 800)
+        post = payload_list(4, seed + 810)
+        extra = payload_list(1, seed + 820)[0]   # the window's client entry
+
+        # --- golden: seed a second self-identified leader ------------------
+        c = GoldenCluster(3, seed=seed)
+        a = c.run_until_leader()
+        for p in pre:
+            a.client_append(p)
+        golden_settle(c)
+        assert a.committed_payloads() == pre
+        names = list(c.nodes)
+        b = c.nodes[names[(names.index(a.id) + 1) % 3]]
+        b.state = "leader"                       # stale-window second leader
+        b.term = a.term + 1
+        for n in names:                          # main.go:275-284
+            if n != b.id:
+                b.match_index[n] = 0
+                b.next_index[n] = 1
+        # the client pushes the entry into BOTH leaders' LogReq channels
+        c.inject(extra)
+        c._deliver_client()
+        assert c.nodes[a.id].logreq == [extra]
+        assert c.nodes[b.id].logreq == [extra]
+        # both append it at their next tick: the double-ingest window
+        c._leader_tick(a)                        # also deposes a (b's term)
+        assert a.state == "follower"
+        c._leader_tick(b)
+        assert a.log[-1].payload == extra        # stale leader ingested it
+        assert b.log[-1].payload == extra        # real leader too
+        golden_settle(c, ticks=8)
+        golden_committed = max(
+            (n.committed_payloads() for n in c.nodes.values()), key=len
+        )
+        # committed never regressed or diverged through the window
+        assert golden_committed[: len(pre)] == pre
+
+        # --- engine: same window, stale ingest refused on device -----------
+        import jax.numpy as jnp
+
+        from raft_tpu.core.state import fold_batch
+
+        e = mk_engine(seed)
+        lead = e.run_until_leader()
+        seqs = [e.submit(p) for p in pre]
+        e.run_until_committed(seqs[-1])
+        e.run_for(3 * e.cfg.heartbeat_period)    # everyone caught up
+        stale_term = e.leader_term
+        new_lead = (lead + 1) % 3
+        e.force_campaign(new_lead)               # deposes `lead` at term+1
+        assert e.leader_id == new_lead and e.leader_term > stale_term
+        before_last = int(e.state.last_index[lead])
+        # the "client" drives a submission at the deposed leader: a
+        # replicate step in its old term carrying a fresh entry
+        payload = fold_batch(
+            np.frombuffer(extra, np.uint8).reshape(1, ENTRY), 3,
+            e.cfg.batch_size,
+        )
+        e.state, info = e.t.replicate(
+            e.state, payload, 1, lead, stale_term,
+            jnp.asarray(e.alive), jnp.asarray(e.slow),
+        )
+        assert int(info.frontier_len) == 0       # stale ingest refused
+        assert int(info.max_term) > stale_term   # and the step says why
+        assert int(e.state.last_index[lead]) == before_last
+        # the committed prefix survives the window and the cluster keeps
+        # committing under the real leader
+        seqs2 = [e.submit(p) for p in post]
+        e.run_until_committed(seqs2[-1])
+        eng = engine_committed(e, e.leader_id)
+        assert eng == pre + post                 # extra never committed
+        # differential join: golden committed is a byte-prefix of engine's
+        assert eng[: len(golden_committed)] == golden_committed
+        for r in range(3):
+            got = engine_committed(e, r)
+            assert got[: len(golden_committed)] == golden_committed

@@ -140,3 +140,57 @@ class TestDifferential:
         for r in range(3):
             got = committed_payloads(state, r)
             np.testing.assert_array_equal(got, want, err_msg=f"replica {r}")
+
+
+class TestChannelBackpressure:
+    """The reference's buffered channels (all capacity 10, main.go:68-72):
+    a full LogReq channel blocks the client goroutine mid-send until the
+    leader's select loop drains it. ``channel_depth`` wires the capacity."""
+
+    def test_full_logreq_channel_blocks_client(self):
+        c = GoldenCluster(3, seed=0, channel_depth=2)
+        lead = c.run_until_leader()
+        vals = [bytes([i]) * ENTRY for i in range(1, 6)]
+        for v in vals:
+            c.inject(v)
+        c._deliver_client()                   # one client tick's delivery
+        assert len(lead.logreq) == 2          # channel full at capacity
+        assert c._client_blocked is not None  # client stuck mid-send on v3
+        assert len(c.client_values) == 2      # v4, v5 queued behind it
+        # each leader tick drains the channel, unblocking the client;
+        # every value arrives, in order, nothing lost or duplicated
+        for _ in range(3):
+            c._leader_tick(lead)
+        assert c._client_blocked is None and not c.client_values
+        assert [e.payload for e in lead.log][-5:] == vals
+
+    def test_from_config_wires_depth(self):
+        from raft_tpu.config import RaftConfig
+
+        cfg = RaftConfig(n_replicas=3, entry_bytes=ENTRY, batch_size=4,
+                         log_capacity=64, channel_depth=3, seed=7)
+        c = GoldenCluster.from_config(cfg)
+        assert c.channel_depth == 3
+        assert len(c.nodes) == 3
+
+    def test_values_buffered_at_nonleader_append_when_it_wins(self):
+        """Reference quirk kept faithfully: only LeaderRun reads LogReq
+        (main.go:327), so values buffered in a node's channel while it is
+        not leader are appended when it becomes leader."""
+        c = GoldenCluster(3, seed=1, channel_depth=10)
+        lead = c.run_until_leader()
+        v = b"\x42" * ENTRY
+        lead.logreq.append(v)
+        lead.state = "follower"     # deposed with a buffered value
+        other = [n for n in c.nodes.values() if n is not lead]
+        # nothing drains it while follower
+        c.run_until(c.now + 5.0)
+        assert lead.logreq == [v]
+        # it re-wins (seed the win directly) and the value is appended
+        lead.state = "leader"
+        for n in other:
+            lead.match_index[n.id] = 0
+            lead.next_index[n.id] = 1
+        c._leader_tick(lead)
+        assert lead.logreq == []
+        assert lead.log[-1].payload == v

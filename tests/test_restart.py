@@ -217,3 +217,118 @@ def test_empty_checkpoint_round_trips(tmp_path):
     e2.run_until_leader()
     s = [e2.submit(p) for p in payloads(3, seed=10)]
     e2.run_until_committed(s[-1])
+
+
+class TestArchiveHoles:
+    """save_checkpoint vs interior archive holes (ADVICE r2): a hole
+    below the contiguous coverage of the watermark must be backfilled
+    from the device log, or the save refused — never silently dropped."""
+
+    def test_save_checkpoint_backfills_interior_hole(self, tmp_path):
+        cfg, e = mk(seed=11)
+        e.run_until_leader()
+        orig = e._archive_committed
+        skip = [True]
+
+        def flaky(r, lo, hi):
+            if skip[0]:          # the commit-time archive gives up once
+                skip[0] = False
+                return
+            orig(r, lo, hi)
+
+        e._archive_committed = flaky
+        s1 = [e.submit(p) for p in payloads(4, seed=12)]
+        e.run_until_committed(s1[-1])
+        s2 = [e.submit(p) for p in payloads(4, seed=13)]
+        e.run_until_committed(s2[-1])
+        # the drain's backfill may have healed the early hole already;
+        # what matters is the checkpoint covers from index 1 either way
+        path = str(tmp_path / "hole.npz")
+        e.save_checkpoint(path)
+        e2 = RaftEngine.restore(cfg, path, SingleDeviceTransport(cfg))
+        assert e2.store.covers(1, e.commit_watermark)
+
+    def test_save_checkpoint_refuses_unrecoverable_hole(self, tmp_path):
+        cfg, e = mk(seed=14)
+        e.run_until_leader()
+        s1 = [e.submit(p) for p in payloads(6, seed=15)]
+        e.run_until_committed(s1[-1])
+        # carve a permanent hole: drop archived entries 2-3 and disable
+        # recovery (as if the ring had lapped them)
+        del e.store._slots[2], e.store._slots[3]
+        e._backfill_archive = lambda idx, quiet=False: False
+        with pytest.raises(RuntimeError, match="not archived"):
+            e.save_checkpoint(str(tmp_path / "refused.npz"))
+
+
+class TestRestoreReadFloor:
+    def test_read_below_snapshot_base_rejected(self, tmp_path):
+        """ADVICE r2: after restoring a checkpoint whose snapshot starts
+        above index 1 (compacted history) with fewer than log_capacity
+        entries, ring slots below the base hold init zeros — a committed
+        read of them must be refused, not served as zero bytes."""
+        from raft_tpu.ckpt import EngineCheckpoint, Snapshot
+
+        cfg, _ = mk(seed=16, log_capacity=16)
+        ps = payloads(8, seed=17)
+        snap = Snapshot(
+            base_index=5, last_index=12,
+            entries=np.frombuffer(b"".join(ps), np.uint8).reshape(8, ENTRY),
+            terms=np.full(8, 3, np.int32),
+        )
+        path = str(tmp_path / "based.npz")
+        EngineCheckpoint(
+            snap=snap,
+            terms=np.full(3, 3, np.int32),
+            voted_for=np.full(3, -1, np.int32),
+        ).save(path)
+        e = RaftEngine.restore(cfg, path, SingleDeviceTransport(cfg))
+        assert e.commit_watermark == 12
+        # the restored range reads back correctly...
+        got = e.committed_entries(5, 12)
+        np.testing.assert_array_equal(
+            got, np.frombuffer(b"".join(ps), np.uint8).reshape(8, ENTRY)
+        )
+        # ...but anything below the snapshot base is refused loudly
+        with pytest.raises(ValueError, match="checkpoint store"):
+            e.committed_entries(1, 12)
+        with pytest.raises(ValueError, match="checkpoint store"):
+            e.committed_entries(4, 6)
+
+    def test_resave_after_restore_never_fabricates_history(self, tmp_path):
+        """code-review r3: resaving a checkpoint after restoring one with
+        base_index > 1 must keep the base (compacted history), not
+        backfill the missing range from ring slots that never held it —
+        that would write all-zero entries labeled as committed data."""
+        from raft_tpu.ckpt import EngineCheckpoint, Snapshot
+
+        cfg, _ = mk(seed=18, log_capacity=16)
+        ps = payloads(8, seed=19)
+        snap = Snapshot(
+            base_index=5, last_index=12,
+            entries=np.frombuffer(b"".join(ps), np.uint8).reshape(8, ENTRY),
+            terms=np.full(8, 3, np.int32),
+        )
+        path = str(tmp_path / "b.npz")
+        EngineCheckpoint(
+            snap=snap, terms=np.full(3, 3, np.int32),
+            voted_for=np.full(3, -1, np.int32),
+        ).save(path)
+        for elect in (False, True):
+            e = RaftEngine.restore(cfg, path, SingleDeviceTransport(cfg))
+            if elect:
+                e.run_until_leader()
+            out = str(tmp_path / f"resave{elect}.npz")
+            e.save_checkpoint(out)         # no spurious refusal either way
+            ck = EngineCheckpoint.load(out)
+            assert ck.snap.base_index == 5
+            np.testing.assert_array_equal(ck.snap.entries, snap.entries)
+        # and a replaying state machine sees only the real history
+        e = RaftEngine.restore(cfg, path, SingleDeviceTransport(cfg))
+        e.run_until_leader()
+        seen = []
+        start = e.register_apply(
+            lambda i, b: seen.append((i, bytes(b))), replay=True
+        )
+        assert start == 5
+        assert seen == list(zip(range(5, 13), ps))
