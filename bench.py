@@ -273,6 +273,13 @@ def bench_rs53() -> dict:
 
 # --------------------------------------------------------------- config 5
 def bench_storm() -> dict:
+    """Election churn: commit progress through a disruptive-candidacy
+    storm, PLUS the election-timing distributions the reference's
+    constants imply (BASELINE.md rows 5-6): time-to-first-leader (the
+    follower timeout draw, uniform 10-29 s, main.go:114) and
+    re-election convergence after a leader crash (timeout draw + the
+    10-13 s candidate retry cadence, main.go:194), measured over >= 1k
+    virtual seconds with periodic leader kills layered on the storm."""
     from raft_tpu.faults import FaultPlan
     from raft_tpu.raft import RaftEngine
     from raft_tpu.transport import SingleDeviceTransport
@@ -281,38 +288,149 @@ def bench_storm() -> dict:
         n_replicas=3, entry_bytes=256, batch_size=64, log_capacity=1 << 12,
         transport="single", seed=2,
     )
-    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    t = SingleDeviceTransport(cfg)   # one compiled program set, reused
+
+    # -- time to first leader over many seeds (the 10-29 s draw) ---------
+    first_leader = []
+    for seed in range(16):
+        e = RaftEngine(
+            RaftConfig(
+                n_replicas=3, entry_bytes=256, batch_size=64,
+                log_capacity=1 << 12, transport="single", seed=seed,
+            ),
+            t,
+        )
+        e.run_until_leader()
+        first_leader.append(e.clock.now)
+
+    # -- storm + crash/recover over >= 1000 virtual seconds --------------
+    e = RaftEngine(cfg, t)
     e.run_until_leader()
     t_start = e.clock.now
-    # 120 virtual seconds (~24 disruptive candidacies): every engine
-    # event costs several host<->device round trips through the tunnel,
-    # so the window is sized to keep the whole suite in the driver's
-    # budget while still showing commit progress through heavy churn
-    window = 120.0
+    window = 1000.0
     plan = FaultPlan.election_storm(3, t_start, t_start + window, 5.0, seed=3)
     e.schedule_faults(plan)
+    # a leader kill every ~100 s (recover 30 s later): each creates a
+    # real leaderless gap the followers must close by timing out — the
+    # reference's re-election scenario. The victim is whoever leads at
+    # kill time, so the kills are driven inline rather than scheduled.
+    kills = [(t_start + 50.0 + 100.0 * k, t_start + 80.0 + 100.0 * k)
+             for k in range(9)]
     seqs = []
     next_submit = t_start
+    lost_at = None
+    gaps = []           # leaderless gap durations (re-election convergence)
+    ki = 0
     while e.clock.now < t_start + window and e._q:
+        if ki < len(kills) and e.clock.now >= kills[ki][0]:
+            victim = e.leader_id
+            if victim is not None:
+                e.fail(victim)
+                lost_at = e.clock.now   # e.fail cleared leader_id itself
+                # recover later so the cluster is whole for the next kill
+                from raft_tpu.faults import FaultEvent, FaultPlan as FP
+
+                e.schedule_faults(FP([FaultEvent(kills[ki][1], "recover",
+                                                 victim)]))
+            ki += 1
         if e.clock.now >= next_submit:
             seqs.append(e.submit(np.random.default_rng(len(seqs))
                                  .integers(0, 256, 256, np.uint8).tobytes()))
             next_submit += 1.0
+        had = e.leader_id
         e.step_event()
+        if had is not None and e.leader_id is None:
+            lost_at = e.clock.now
+        elif had is None and e.leader_id is not None and lost_at is not None:
+            gaps.append(e.clock.now - lost_at)
+            lost_at = None
     lat = e.commit_latencies()
-    return {
+    out = {
         "storm_campaigns": len(plan.events),
+        "leader_kills": ki,
+        "virtual_window_s": window,
         "submitted": len(seqs),
         "committed": int(len(lat)),
         "commit_ratio": round(len(lat) / max(len(seqs), 1), 3),
         "virtual_commit_p50_s": (
             round(float(np.percentile(lat, 50)), 3) if len(lat) else None
         ),
+        # reference-comparable election timings (BASELINE.md rows 5-6:
+        # first leader ~10-29 s; re-election multiples of 10-13 s draws)
+        "time_to_first_leader_s": {
+            "p50": round(float(np.percentile(first_leader, 50)), 2),
+            "p95": round(float(np.percentile(first_leader, 95)), 2),
+            "min": round(float(np.min(first_leader)), 2),
+            "max": round(float(np.max(first_leader)), 2),
+            "samples": len(first_leader),
+        },
+        "reelection_convergence_s": {
+            "p50": round(float(np.percentile(gaps, 50)), 2) if gaps else None,
+            "p99": round(float(np.percentile(gaps, 99)), 2) if gaps else None,
+            "max": round(float(np.max(gaps)), 2) if gaps else None,
+            "samples": len(gaps),
+        },
     }
+    return out
+
+
+def _ring_kernel_gate(rng) -> None:
+    """Hardware equivalence gate for the fused Pallas ring-write kernel:
+    CI exercises only interpret mode, so wrap/partial-count/conflict cases
+    are asserted against the XLA formulation here, on the real chip."""
+    if jax.default_backend() != "tpu":
+        return
+    from raft_tpu.core.ring import write_window_cols_xla, write_window_rows
+    from raft_tpu.core.ring_pallas import write_window_both_tpu
+
+    C, B, M, L = 1 << 15, 1024, 192, 3
+    for s, count in [(0, B), (77, 1000), (C - B + 511, B), (C - 1, 300),
+                     (9, 0)]:
+        buf_p = rng.integers(-2**31, 2**31 - 1, (C, M), dtype=np.int32)
+        buf_t = rng.integers(1, 6, (L, C), dtype=np.int32)
+        win = rng.integers(-2**31, 2**31 - 1, (B, M), dtype=np.int32)
+        win_t = rng.integers(1, 6, B, dtype=np.int32)
+        accept = rng.random(L) < 0.7
+        lanes = np.repeat(accept, M // L)
+        ws = s + 1
+        last = rng.integers(0, ws + B, L).astype(np.int32)
+        gp, gt, gmm = write_window_both_tpu(
+            jnp.asarray(buf_p), jnp.asarray(buf_t), jnp.asarray(win),
+            jnp.asarray(win_t), jnp.int32(s), jnp.int32(count),
+            jnp.int32(ws), jnp.asarray(accept), jnp.asarray(last),
+        )
+        wp = write_window_cols_xla(
+            jnp.asarray(buf_p), jnp.asarray(win), jnp.int32(s),
+            jnp.int32(count), jnp.asarray(lanes),
+        )
+        wt = write_window_rows(
+            jnp.asarray(buf_t), jnp.asarray(win_t), jnp.int32(s),
+            jnp.int32(count), jnp.asarray(accept),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(gp), np.asarray(wp),
+            err_msg=f"ring kernel payload diverges at s={s}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(gt), np.asarray(wt),
+            err_msg=f"ring kernel terms diverge at s={s}",
+        )
+        widx = ws + np.arange(B)
+        my_win_t = buf_t[:, (s + np.arange(B)) % C]
+        want_mm = (
+            (widx[None, :] <= last[:, None])
+            & (my_win_t != win_t[None, :])
+            & (np.arange(B) < count)[None, :]
+        ).any(axis=1)
+        np.testing.assert_array_equal(
+            np.asarray(gmm)[0] != 0, want_mm,
+            err_msg=f"ring kernel conflict check diverges at s={s}",
+        )
 
 
 def main() -> None:
     rng = np.random.default_rng(0)
+    _ring_kernel_gate(rng)
 
     # -- config 2: the headline ------------------------------------------
     cfg2 = RaftConfig()          # 3 replicas, 256 B, batch 1024

@@ -5,11 +5,14 @@ from raft_tpu.ckpt.snapshot import (
     install_snapshot,
     install_snapshot_all,
 )
+from raft_tpu.ckpt.votelog import VoteLog, merge_restored
 
 __all__ = [
     "CheckpointStore",
     "EngineCheckpoint",
     "Snapshot",
+    "VoteLog",
     "install_snapshot",
     "install_snapshot_all",
+    "merge_restored",
 ]

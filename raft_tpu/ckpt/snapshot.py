@@ -119,13 +119,23 @@ class EngineCheckpoint:
     written to disk. One file restarts the whole engine process:
     per-replica term and votedFor (the Raft persistence obligation — a
     restarted replica must not double-vote in a term it already voted in)
-    plus the archived committed tail."""
+    plus the archived committed tail. This captures checkpoint-TIME
+    state; the transition-time half of the obligation (a crash between a
+    vote and the next checkpoint) is ``ckpt.votelog.VoteLog``, which the
+    engine appends to before acting on any vote/term transition."""
 
     snap: Snapshot         # committed contiguous tail (may be empty)
     terms: np.ndarray      # i32[R] per-replica current term
     voted_for: np.ndarray  # i32[R] per-replica votedFor (NO_VOTE = -1)
+    member: Optional[np.ndarray] = None  # bool[R] configuration at save
+    #   time (membership-change clusters); None on older checkpoints or
+    #   fixed-membership clusters (= all rows are members)
 
     def save(self, path: str) -> None:
+        member = (
+            self.member if self.member is not None
+            else np.ones_like(self.terms, bool)
+        )
         _atomic_savez(
             path,
             base_index=self.snap.base_index,
@@ -134,6 +144,7 @@ class EngineCheckpoint:
             terms=self.snap.terms,
             replica_terms=self.terms,
             voted_for=self.voted_for,
+            member=np.asarray(member, bool),
         )
 
     @classmethod
@@ -149,6 +160,9 @@ class EngineCheckpoint:
                 snap=snap,
                 terms=np.asarray(z["replica_terms"], np.int32),
                 voted_for=np.asarray(z["voted_for"], np.int32),
+                member=(
+                    np.asarray(z["member"], bool) if "member" in z else None
+                ),
             )
 
 

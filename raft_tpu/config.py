@@ -29,6 +29,11 @@ class RaftConfig:
     entry_bytes: int = 256              # north-star entry payload size
     batch_size: int = 1024              # entries per replication step (config 2)
     log_capacity: int = 1 << 15         # fixed device ring-buffer capacity
+    # Membership-change headroom: device arrays are statically shaped, so
+    # live add/remove (RaftEngine.add_server / remove_server — the
+    # dissertation-§4 single-server change) needs rows allocated up front.
+    # None = fixed membership at n_replicas (no spare rows, no change).
+    max_replicas: Optional[int] = None
 
     # --- erasure coding (config 3); k = data shards, m = parity shards ---
     # None disables EC: every replica stores the full payload, like the
@@ -116,6 +121,16 @@ class RaftConfig:
             raise ValueError("payload_shards must be >= 1")
         if self.channel_depth < 1:
             raise ValueError("channel_depth must be >= 1")
+        if self.max_replicas is not None:
+            if self.max_replicas < self.n_replicas:
+                raise ValueError("max_replicas must be >= n_replicas")
+            if self.ec_enabled:
+                # RS(n,k) ties the shard layout to the replica count;
+                # membership change under EC would re-shard the whole log
+                raise ValueError(
+                    "membership change (max_replicas) is not supported "
+                    "for erasure-coded clusters"
+                )
         if self.steady_dispatch not in ("auto", "off"):
             raise ValueError('steady_dispatch must be "auto" or "off"')
         if self.shard_bytes % 4:
@@ -129,6 +144,12 @@ class RaftConfig:
             raise ValueError(
                 "per-entry stored words must divide evenly over payload_shards"
             )
+
+    @property
+    def rows(self) -> int:
+        """Device replica rows allocated (>= n_replicas when membership
+        headroom is configured)."""
+        return self.max_replicas if self.max_replicas is not None else self.n_replicas
 
     @property
     def majority(self) -> int:

@@ -35,6 +35,12 @@ class Comm:
         """Global replica id of each local row — i32[L]."""
         raise NotImplementedError
 
+    def local(self, x: jax.Array) -> jax.Array:
+        """Rows of a replicated [R, ...] vector held locally -> [L, ...].
+        Identity on the resident layout (avoids the generic gather XLA
+        emits for ``x[replica_ids()]`` — ~0.35 us per call on v5e)."""
+        raise NotImplementedError
+
     def all_gather(self, x: jax.Array) -> jax.Array:
         """[L, ...] per-replica values -> full [R, ...] on every participant."""
         raise NotImplementedError
@@ -63,6 +69,9 @@ class SingleDeviceComm(Comm):
     def replica_ids(self) -> jax.Array:
         return jnp.arange(self.n_replicas, dtype=jnp.int32)
 
+    def local(self, x: jax.Array) -> jax.Array:
+        return x
+
     def all_gather(self, x: jax.Array) -> jax.Array:
         return x
 
@@ -89,6 +98,9 @@ class MeshComm(Comm):
 
     def replica_ids(self) -> jax.Array:
         return lax.axis_index(self.axis).astype(jnp.int32)[None]
+
+    def local(self, x: jax.Array) -> jax.Array:
+        return lax.dynamic_slice_in_dim(x, lax.axis_index(self.axis), 1)
 
     def all_gather(self, x: jax.Array) -> jax.Array:
         return lax.all_gather(x, self.axis, tiled=True)

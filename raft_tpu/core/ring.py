@@ -33,6 +33,17 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _pallas_ok(C: int, B: int) -> bool:
+    """Whether the Pallas TPU window-write kernel serves this shape: a
+    TPU backend and 128-row blocks dividing both the window and the ring
+    (the term buffer's column blocks put the block size in the LANE
+    dimension, which Mosaic requires to be a multiple of 128). Everything
+    else uses the XLA reference formulation below."""
+    if jax.default_backend() != "tpu":
+        return False
+    return B % 128 == 0 and C % 128 == 0
+
+
 def _rot(win2: jax.Array, s: jax.Array, base: jax.Array, B: int,
          axis: int) -> jax.Array:
     """Window values aligned to piece ``base``: out[j] = win[(base+j-s) % B].
@@ -57,31 +68,78 @@ def write_window_cols(buf: jax.Array, win: jax.Array, s: jax.Array,
     start slot; count: i32[] window rows to write (a prefix); lane_sel:
     bool[M] lanes (per-replica word blocks) that accept. This is the
     hot-path payload write.
+
+    Fast path: when the window does not wrap (``s <= C - B`` — all but 1
+    in C/B steps), the write is ONE read-merge-update at ``s`` with no
+    rotation and no doubled window. The generic two-piece rotated path
+    runs only under ``lax.cond`` for the wrapping minority — measured on
+    v5e this halves the payload path's HBM traffic (the doubled-window
+    concat and the always-on fully-masked piece-B merge were ~8 us/step
+    of the 31 us headline step).
     """
     C, B = buf.shape[0], win.shape[0]
-    win2 = jnp.concatenate([win, win], axis=0)
+    M = buf.shape[1]
+    if _pallas_ok(C, B):
+        # TPU: one pallas_call does the whole masked merge in place with
+        # modular-block wraparound — minimum HBM traffic, one launch
+        # (core.ring_pallas; pinned to this XLA path by tests).
+        from raft_tpu.core.ring_pallas import write_window_cols_tpu
+
+        return write_window_cols_tpu(buf, win, s, count, lane_sel)
+    return write_window_cols_xla(buf, win, s, count, lane_sel)
+
+
+def write_window_cols_xla(buf: jax.Array, win: jax.Array, s: jax.Array,
+                          count: jax.Array, lane_sel: jax.Array) -> jax.Array:
+    """The pure-XLA formulation (reference semantics for the Pallas
+    kernel, and the non-TPU execution path)."""
+    C, B = buf.shape[0], win.shape[0]
+    M = buf.shape[1]
     j = jnp.arange(B, dtype=jnp.int32)
-    for base in (jnp.minimum(s, C - B), jnp.zeros_like(s)):
-        cur = lax.dynamic_slice(buf, (base, 0), (B, buf.shape[1]))
-        rel = (base + j - s) % C
-        sel = (rel < count)[:, None] & lane_sel[None, :]
-        win_at = _rot(win2, s, base, B, axis=0)
-        buf = lax.dynamic_update_slice(
-            buf, jnp.where(sel, win_at, cur), (base, 0)
-        )
-    return buf
+
+    def fast(buf):
+        cur = lax.dynamic_slice(buf, (s, 0), (B, M))
+        sel = (j < count)[:, None] & lane_sel[None, :]
+        return lax.dynamic_update_slice(buf, jnp.where(sel, win, cur), (s, 0))
+
+    def wrap(buf):
+        win2 = jnp.concatenate([win, win], axis=0)
+        for base in (jnp.minimum(s, C - B), jnp.zeros_like(s)):
+            cur = lax.dynamic_slice(buf, (base, 0), (B, M))
+            rel = (base + j - s) % C
+            sel = (rel < count)[:, None] & lane_sel[None, :]
+            win_at = _rot(win2, s, base, B, axis=0)
+            buf = lax.dynamic_update_slice(
+                buf, jnp.where(sel, win_at, cur), (base, 0)
+            )
+        return buf
+
+    # NOTE both branches must WRITE buf (DUS): an identity branch breaks
+    # XLA's donated-buffer aliasing through the cond and forces a full
+    # ring-buffer copy (~100 us for the 25 MB headline ring — measured).
+    return lax.cond(s <= C - B, fast, wrap, buf)
 
 
 def read_window_cols(buf: jax.Array, s: jax.Array, B: int) -> jax.Array:
-    """Slot-major window [s, s+B) mod C of ``buf`` [C, M] -> [B, M]."""
+    """Slot-major window [s, s+B) mod C of ``buf`` [C, M] -> [B, M].
+    One dynamic_slice when the window does not wrap; the three-copy
+    stitch only under ``lax.cond`` for the wrapping minority."""
     C = buf.shape[0]
-    sA = jnp.minimum(s, C - B)
-    a = lax.dynamic_slice(buf, (sA, 0), (B, buf.shape[1]))
-    b = lax.dynamic_slice(buf, (0, 0), (B, buf.shape[1]))
-    ab = jnp.concatenate([a, b], axis=0)
-    # piece A starts at sA and piece B continues at exactly sA + B == C in
-    # the wrap case, so the stitched window is ab[s - sA : s - sA + B]
-    return lax.dynamic_slice(ab, (s - sA, 0), (B, buf.shape[1]))
+
+    def fast(buf):
+        return lax.dynamic_slice(buf, (s, 0), (B, buf.shape[1]))
+
+    def wrap(buf):
+        sA = jnp.minimum(s, C - B)
+        a = lax.dynamic_slice(buf, (sA, 0), (B, buf.shape[1]))
+        b = lax.dynamic_slice(buf, (0, 0), (B, buf.shape[1]))
+        ab = jnp.concatenate([a, b], axis=0)
+        # piece A starts at sA and piece B continues at exactly
+        # sA + B == C in the wrap case, so the stitched window is
+        # ab[s - sA : s - sA + B]
+        return lax.dynamic_slice(ab, (s - sA, 0), (B, buf.shape[1]))
+
+    return lax.cond(s <= C - B, fast, wrap, buf)
 
 
 def write_window_rows(buf: jax.Array, win_t: jax.Array, s: jax.Array,
@@ -94,27 +152,45 @@ def write_window_rows(buf: jax.Array, win_t: jax.Array, s: jax.Array,
     """
     L, C = buf.shape
     B = win_t.shape[0]
-    win2 = jnp.concatenate([win_t, win_t], axis=0)
     j = jnp.arange(B, dtype=jnp.int32)
-    for base in (jnp.minimum(s, C - B), jnp.zeros_like(s)):
-        cur = lax.dynamic_slice(buf, (0, base), (L, B))
-        rel = (base + j - s) % C
-        sel = accept[:, None] & (rel < count)[None, :]
-        win_at = _rot(win2, s, base, B, axis=0)
-        buf = lax.dynamic_update_slice(
-            buf, jnp.where(sel, win_at[None, :], cur), (0, base)
+
+    def fast(buf):
+        cur = lax.dynamic_slice(buf, (0, s), (L, B))
+        sel = accept[:, None] & (j < count)[None, :]
+        return lax.dynamic_update_slice(
+            buf, jnp.where(sel, win_t[None, :], cur), (0, s)
         )
-    return buf
+
+    def wrap(buf):
+        win2 = jnp.concatenate([win_t, win_t], axis=0)
+        for base in (jnp.minimum(s, C - B), jnp.zeros_like(s)):
+            cur = lax.dynamic_slice(buf, (0, base), (L, B))
+            rel = (base + j - s) % C
+            sel = accept[:, None] & (rel < count)[None, :]
+            win_at = _rot(win2, s, base, B, axis=0)
+            buf = lax.dynamic_update_slice(
+                buf, jnp.where(sel, win_at[None, :], cur), (0, base)
+            )
+        return buf
+
+    return lax.cond(s <= C - B, fast, wrap, buf)
 
 
 def read_window(buf: jax.Array, s: jax.Array, B: int) -> jax.Array:
-    """Window [s, s+B) mod C of row-major ``buf`` [L, C, ...] -> [L, B, ...]."""
+    """Window [s, s+B) mod C of row-major ``buf`` [L, C, ...] -> [L, B, ...].
+    One dynamic_slice in the (common) non-wrapping case."""
     C = buf.shape[1]
     zeros = (0,) * (buf.ndim - 2)
-    sA = jnp.minimum(s, C - B)
-    a = lax.dynamic_slice(buf, (0, sA) + zeros, (buf.shape[0], B) + buf.shape[2:])
-    b = lax.dynamic_slice(buf, (0, 0) + zeros, (buf.shape[0], B) + buf.shape[2:])
-    ab = jnp.concatenate([a, b], axis=1)
-    return lax.dynamic_slice(
-        ab, (0, s - sA) + zeros, (buf.shape[0], B) + buf.shape[2:]
-    )
+    size = (buf.shape[0], B) + buf.shape[2:]
+
+    def fast(buf):
+        return lax.dynamic_slice(buf, (0, s) + zeros, size)
+
+    def wrap(buf):
+        sA = jnp.minimum(s, C - B)
+        a = lax.dynamic_slice(buf, (0, sA) + zeros, size)
+        b = lax.dynamic_slice(buf, (0, 0) + zeros, size)
+        ab = jnp.concatenate([a, b], axis=1)
+        return lax.dynamic_slice(ab, (0, s - sA) + zeros, size)
+
+    return lax.cond(s <= C - B, fast, wrap, buf)

@@ -96,12 +96,14 @@ class ReplicaState:
 
 
 def init_state(cfg: RaftConfig, rows: Optional[int] = None) -> ReplicaState:
-    """Zero state for ``rows`` replica rows (default: the whole cluster).
+    """Zero state for ``rows`` replica rows (default: every allocated row
+    — ``cfg.rows`` includes membership-change headroom above the initial
+    ``n_replicas``; spare rows sit masked out until ``add_server``).
 
     Mirrors ``NewNode`` (main.go:59-76): term 0, no vote, empty log,
     commit 0 — but batched across replicas.
     """
-    r = cfg.n_replicas if rows is None else rows
+    r = cfg.rows if rows is None else rows
     c, w = cfg.log_capacity, cfg.shard_words
     return ReplicaState(
         term=jnp.zeros((r,), jnp.int32),
@@ -154,9 +156,12 @@ def unfold_bytes(words: np.ndarray) -> np.ndarray:
     return w.view(np.uint8).reshape(w.shape[:-1] + (w.shape[-1] * 4,))
 
 
-def log_entries(state: ReplicaState, replica: int, lo: int, hi: int) -> np.ndarray:
+def log_entries(state: ReplicaState, replica: int, lo: int, hi: int,
+                fetch=np.asarray) -> np.ndarray:
     """Host-side read of payload bytes u8[hi-lo+1, S] for indices [lo, hi]
-    on one replica row.
+    on one replica row. ``fetch`` resolves device values to host numpy —
+    pass the transport's collective fetch when rows live on other
+    processes (multihost engine).
 
     Debug/verification path (differential tests compare committed prefixes at
     quiescence, SURVEY.md §7 hard part 4) — not the hot path.
@@ -165,20 +170,22 @@ def log_entries(state: ReplicaState, replica: int, lo: int, hi: int) -> np.ndarr
         return np.zeros((0, 4 * state.words_per_entry), np.uint8)
     idx = np.arange(lo, hi + 1)
     slots = (idx - 1) % state.capacity
-    return payload_slot_bytes(state, replica)[slots]
+    return payload_slot_bytes(state, replica, fetch)[slots]
 
 
-def payload_slot_bytes(state: ReplicaState, replica: int) -> np.ndarray:
+def payload_slot_bytes(state: ReplicaState, replica: int,
+                       fetch=np.asarray) -> np.ndarray:
     """Host view of one replica's whole ring as bytes — u8[C, S]."""
     w = state.words_per_entry
-    cols = np.asarray(state.log_payload[:, replica * w : (replica + 1) * w])
+    cols = fetch(state.log_payload[:, replica * w : (replica + 1) * w])
     return unfold_bytes(cols)
 
 
-def committed_payloads(state: ReplicaState, replica: int) -> np.ndarray:
+def committed_payloads(state: ReplicaState, replica: int,
+                       fetch=np.asarray) -> np.ndarray:
     """The committed log prefix of one replica as raw bytes [n_committed, S]."""
-    hi = int(state.commit_index[replica])
-    return log_entries(state, replica, 1, hi)
+    hi = int(fetch(state.commit_index)[replica])
+    return log_entries(state, replica, 1, hi, fetch)
 
 
 def last_log_term(state: ReplicaState) -> jax.Array:

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.comm import Comm
 from raft_tpu.core.ring import (
+    _pallas_ok,
     read_window,
     read_window_cols,
     write_window_cols,
@@ -102,6 +103,13 @@ def replicate_step(
     slow: jax.Array,            # bool[R] fault mask: slow replicas receive but
     #                                     do not append (stale matchIndex,
     #                                     BASELINE config 4)
+    member: jax.Array | None = None,  # bool[R] current configuration
+    #   (membership change). None = every row is a member and the commit
+    #   quorum is the static ``commit_quorum``; an array makes the quorum
+    #   DYNAMIC: strict majority of members (dead members still count in
+    #   the denominator — Raft quorums are over the configuration). The
+    #   engine composes membership into the ``alive`` mask it passes, so
+    #   non-member rows also neither hear windows nor contribute acks.
     *,
     ec: bool = False,
     commit_quorum: int | None = None,
@@ -143,8 +151,8 @@ def replicate_step(
     L = ids.shape[0]
     W = M // L                                     # i32 lanes per replica
     is_leader_row = ids == leader                  # bool[L]
-    alive_l = alive[ids]                           # bool[L]
-    slow_l = slow[ids]                             # bool[L]
+    alive_l = comm.local(alive)                    # bool[L]
+    slow_l = comm.local(slow)                      # bool[L]
     term0 = state.term
     barange = jnp.arange(B, dtype=jnp.int32)
     # Harden against malformed driver inputs: a batch can only carry [0, B]
@@ -222,21 +230,34 @@ def replicate_step(
             # the leader always accepts its own fresh batch (it IS the
             # window's source); its prev point is its own log tail
             accept = accept | ingest_row
-        valid = barange < count                            # bool[B]
-        widx = ws + barange                                # i32[B] global idx
-        my_win_t = read_window(log_term, slot_of(ws, cap), B)  # i32[L, B]
-        exists = widx[None, :] <= last_index[:, None]      # bool[L, B]
-        mismatch = exists & (my_win_t != win_t[None, :]) & valid[None, :]
-        any_mm = jnp.any(mismatch, axis=1)                 # bool[L]
-
         start_slot = slot_of(ws, cap)
-        accept_lanes = jnp.repeat(accept, W, total_repeat_length=M)  # bool[M]
-        log_payload = write_window_cols(
-            log_payload, win_p, start_slot, count, accept_lanes
-        )
-        log_term = write_window_rows(
-            log_term, win_t, start_slot, count, accept
-        )
+        if _pallas_ok(cap, B):
+            # TPU: payload + term window writes AND the §5.3 conflict
+            # check fused into ONE in-place pallas_call
+            # (core.ring_pallas) — the XLA formulation below splits into
+            # a window read, compare+reduce, cond + DUS ops and staging
+            # copies (~8 us of the headline step; docs/PERF.md).
+            from raft_tpu.core.ring_pallas import write_window_both_tpu
+
+            log_payload, log_term, mm = write_window_both_tpu(
+                log_payload, log_term, win_p, win_t, start_slot, count,
+                ws, accept, last_index,
+            )
+            any_mm = mm[0] != 0                            # bool[L]
+        else:
+            valid = barange < count                        # bool[B]
+            widx = ws + barange                            # i32[B] global idx
+            my_win_t = read_window(log_term, start_slot, B)     # i32[L, B]
+            exists = widx[None, :] <= last_index[:, None]  # bool[L, B]
+            mismatch = exists & (my_win_t != win_t[None, :]) & valid[None, :]
+            any_mm = jnp.any(mismatch, axis=1)             # bool[L]
+            accept_lanes = jnp.repeat(accept, W, total_repeat_length=M)
+            log_payload = write_window_cols(
+                log_payload, win_p, start_slot, count, accept_lanes
+            )
+            log_term = write_window_rows(
+                log_term, win_t, start_slot, count, accept
+            )
         we = ws + count - 1                                # = ws-1 on heartbeat
         # No conflict: keep any consistent suffix beyond the window (never
         # truncate committed entries). Conflict: truncate to the window end.
@@ -316,8 +337,16 @@ def replicate_step(
     # (main.go:381-391) — stalls while followers disagree and ignores the
     # leader's own log. Paper-correct rule: k-th largest of the verified
     # match vector, restricted to current-term entries (§5.4.2).
+    if member is None:
+        quorum = commit_quorum
+    else:
+        mcount = jnp.sum(member.astype(jnp.int32))
+        quorum = mcount // 2 + 1
+        if ec and commit_quorum is not None:
+            # EC durability floor (k + margin shard-holders) is static
+            quorum = jnp.maximum(quorum, commit_quorum)
     match = jnp.where(alive, comm.all_gather(m_eff), 0)    # i32[R]
-    commit_cand = commit_from_match(match, commit_quorum)
+    commit_cand = commit_from_match(match, quorum)
     cand_slot = slot_of(jnp.maximum(commit_cand, 1), cap)
     cand_term = comm.select_row(log_term[:, cand_slot], leader)
     commit_ok = legit & (commit_cand >= 1) & (cand_term == leader_term)
@@ -354,7 +383,12 @@ def replicate_step(
     info = RepInfo(
         commit_index=global_commit,
         match=match,
-        max_term=jnp.max(comm.all_gather(term)),
+        # Max over rows the step could actually hear (the alive mask —
+        # which the engine composes from liveness AND link reachability):
+        # a crashed or partitioned-away replica cannot report its term,
+        # so its higher term must not depose this leader through the
+        # collective. It deposes the leader the moment it is heard again.
+        max_term=jnp.max(jnp.where(alive, comm.all_gather(term), 0)),
         repair_start=repair_ws,
         frontier_len=frontier_count,
     )
@@ -363,7 +397,7 @@ def replicate_step(
 
 def scan_replicate(
     comm, ec, commit_quorum, repair, state, payloads, counts, leader,
-    leader_term, alive, slow,
+    leader_term, alive, slow, member=None,
 ):
     """T replication steps as one compiled ``lax.scan`` — no host round-trip
     per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
@@ -374,7 +408,7 @@ def scan_replicate(
         payload, count = xs
         st, info = replicate_step(
             comm, st, payload, count, leader, leader_term, alive, slow,
-            ec=ec, commit_quorum=commit_quorum, repair=repair,
+            member, ec=ec, commit_quorum=commit_quorum, repair=repair,
         )
         return st, info
 
@@ -400,7 +434,7 @@ def vote_step(
     naturally: its own row grants.
     """
     ids = comm.replica_ids()
-    alive_l = alive[ids]
+    alive_l = comm.local(alive)
 
     lasts = comm.all_gather(state.last_index)
     my_lterm = last_log_term(state)
@@ -430,7 +464,9 @@ def vote_step(
     new_state = state.replace(term=term, voted_for=voted_for)
     info = VoteInfo(
         votes=jnp.sum(grants.astype(jnp.int32)),
-        max_term=jnp.max(comm.all_gather(term)),
+        # masked like RepInfo.max_term: only rows the candidate could
+        # reach report their term back
+        max_term=jnp.max(jnp.where(alive, comm.all_gather(term), 0)),
         grants=grants,
     )
     return new_state, info

@@ -12,26 +12,33 @@ Actions:
 - ``campaign``            — force a disruptive candidacy: term bump + vote
   round regardless of a live leader (the randomized term bumps of
   BASELINE config 5's election storm)
+- ``partition`` / ``heal_partition`` — link-level split: replicas talk
+  only within their group (``groups``); the classic split-brain
+  adversary the reference's always-delivering channels cannot express
 """
 
 from __future__ import annotations
 
 import dataclasses
 import random
-from typing import List
+from typing import List, Optional, Tuple
 
-ACTIONS = ("kill", "recover", "slow", "unslow", "campaign")
+ACTIONS = ("kill", "recover", "slow", "unslow", "campaign",
+           "partition", "heal_partition")
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultEvent:
     t: float
     action: str
-    replica: int
+    replica: int = 0          # unused by partition/heal_partition
+    groups: Optional[Tuple[Tuple[int, ...], ...]] = None  # partition only
 
     def __post_init__(self):
         if self.action not in ACTIONS:
             raise ValueError(f"unknown fault action {self.action!r}")
+        if self.action == "partition" and not self.groups:
+            raise ValueError("partition events need non-empty groups")
 
 
 @dataclasses.dataclass
@@ -52,6 +59,15 @@ class FaultPlan:
     def crash_recover(cls, replica: int, t_kill: float, t_recover: float) -> "FaultPlan":
         return cls([FaultEvent(t_kill, "kill", replica),
                     FaultEvent(t_recover, "recover", replica)])
+
+    @classmethod
+    def split(cls, groups, start: float, stop: float) -> "FaultPlan":
+        """Link-level partition into ``groups`` over [start, stop)."""
+        return cls([
+            FaultEvent(start, "partition",
+                       groups=tuple(tuple(g) for g in groups)),
+            FaultEvent(stop, "heal_partition"),
+        ])
 
     @classmethod
     def election_storm(

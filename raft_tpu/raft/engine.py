@@ -31,7 +31,11 @@ Beyond reference parity, the client surface the reference never offers:
 - ``register_apply``     — ordered exactly-once apply stream (the state
   machine the reference lacks; see raft_tpu.examples.ReplicatedKV);
 - ``save_checkpoint`` / ``restore`` — whole-process durable restart (the
-  persistence main.go:18-21 only comments about).
+  persistence main.go:18-21 only comments about);
+- ``vote_log=`` — transition-time (term, votedFor) durability: a
+  write-ahead record fsync'd before the engine acts on any vote round,
+  term adoption, or step-down, so a crash between a vote and the next
+  checkpoint cannot double-vote (ckpt.votelog has the fence argument).
 
 Timers run on a virtual clock by default — tests and differential runs are
 deterministic and fast (no 10-29 s waits); the live demo (raft_tpu.demo)
@@ -48,7 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.config import RaftConfig
-from raft_tpu.core.state import ReplicaState, fold_batch
+from raft_tpu.core.state import NO_VOTE, ReplicaState, fold_batch
 from raft_tpu.transport.base import Transport, make_transport
 
 FOLLOWER = "follower"
@@ -82,19 +86,46 @@ class RaftEngine:
         cfg: RaftConfig,
         transport: Optional[Transport] = None,
         trace: Optional[Callable[[str], None]] = None,
+        vote_log: Optional[str] = None,
     ):
         self.cfg = cfg
         self.t: Transport = transport if transport is not None else make_transport(cfg)
+        self._fetch = getattr(self.t, "fetch", np.asarray)
+        #   Host view of device values. On a multi-process (multihost)
+        #   transport this is a COLLECTIVE (reshard-to-replicated), legal
+        #   because every process runs this engine as a mirrored
+        #   deterministic event loop — same seed, same heap, identical
+        #   launches (see transport.multihost / tests/test_multiprocess).
         self.state: ReplicaState = self.t.init()
         self.rng = random.Random(cfg.seed)
         self.clock = VirtualClock()
         self._trace = trace
 
-        n = cfg.n_replicas
+        n = cfg.rows
+        self.member = np.zeros(n, bool)
+        self.member[: cfg.n_replicas] = True
+        #   Current configuration (dissertation-§4 single-server change):
+        #   rows beyond the initial n_replicas idle masked-out until
+        #   add_server commits them in. Quorums are counted over members
+        #   (the device step receives the mask for its denominator; the
+        #   engine composes it into every reach mask).
         self.roles: List[str] = [FOLLOWER] * n
         self.terms = np.zeros(n, np.int64)     # host mirror for timer logic
+        self.lead_terms = np.zeros(n, np.int64)
+        #   The term each replica last won an election in. Distinct from
+        #   ``terms`` (highest term SEEN): a split-brain stale leader keeps
+        #   ticking in its lead term, and hearing any higher term — which
+        #   raises ``terms[r]`` past ``lead_terms[r]`` via another step's
+        #   adoption — is exactly the step-down condition (main.go:309-321).
         self.alive = np.ones(n, bool)
         self.slow = np.zeros(n, bool)
+        self.connectivity = np.ones((n, n), bool)
+        #   Link-level reachability (partition fault mode): replica a can
+        #   exchange messages with b iff connectivity[a, b]. Composed with
+        #   ``alive`` into each step's effective mask — the device program
+        #   is unchanged; a partitioned-away row neither hears windows or
+        #   votes nor reports acks or terms back (core.step masks
+        #   max_term by the same mask).
         self.leader_id: Optional[int] = None
         self.leader_term = 0
         self.commit_watermark = 0                  # committed LOG INDEX
@@ -165,25 +196,91 @@ class RaftEngine:
         #   replicated log into a replicated state machine.
         self._lost_gaps: set = set()   # unrecoverable apply gaps, logged once
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
+        self._config_seqs: Dict[int, Tuple[tuple, tuple]] = {}
+        #   seq -> (old member mask, new member mask) for in-flight
+        #   configuration-change entries (add_server / remove_server)
+        self._pending_config: Optional[Tuple[int, tuple, tuple]] = None
+        #   (log index, old mask, new mask) of the one uncommitted change
         self._fault_events: list = []              # FaultPlan merge targets
         self._next_seq = 1
         self._q: List[Tuple[float, int, str, int]] = []   # (t, tiebreak, kind, replica)
         self._seq_events = 0
         self._timer_gen = [0] * n
+        self._votelog = None
+        self._persisted_terms = np.zeros(n, np.int64)
+        self._persisted_vf = np.full(n, NO_VOTE, np.int64)
+        if vote_log is not None:
+            # Transition-time durability (ckpt.votelog): replay any
+            # existing records into the fresh state — a restarted process
+            # must not vote twice in a term it voted in, even with no
+            # checkpoint between the vote and the crash — then keep
+            # appending at every (term, votedFor) transition.
+            from raft_tpu.ckpt import VoteLog, merge_restored
+
+            terms = self.terms.copy()
+            vf = self._fetch(self.state.voted_for).astype(np.int64)
+            terms, vf = merge_restored(n, terms, vf, vote_log)
+            if (terms != self.terms).any() or (
+                vf != self._fetch(self.state.voted_for)
+            ).any():
+                self.state = self.state.replace(
+                    term=jnp.asarray(terms, self.state.term.dtype),
+                    voted_for=jnp.asarray(vf, self.state.voted_for.dtype),
+                )
+                self.terms = terms
+                for r in range(n):
+                    self.nodelog(r, "vote log replayed")
+            self._attach_votelog(vote_log)
         for r in range(n):
-            self._arm_follower(r)
+            if self.member[r]:
+                self._arm_follower(r)
 
     # ------------------------------------------------------------------ util
     def nodelog(self, r: int, msg: str) -> str:
         """The reference's trace schema (main.go:399-401) — the differential
         join key: [Id:Term:CommitIndex:LastApplied][state]msg."""
+        ci_li = self._fetch(
+            jnp.stack([self.state.commit_index, self.state.last_index])
+        )   # one fetch (a collective on multihost) for both fields
         line = (
-            f"[Server{r}:{self.terms[r]}:{int(self.state.commit_index[r])}:"
-            f"{int(self.state.last_index[r])}][{self.roles[r]}]{msg}"
+            f"[Server{r}:{self.terms[r]}:{int(ci_li[0, r])}:"
+            f"{int(ci_li[1, r])}][{self.roles[r]}]{msg}"
         )
         if self._trace is not None:  # not truthiness: empty sinks are falsy
             self._trace(line)
         return line
+
+    def _attach_votelog(self, path: str) -> None:
+        from raft_tpu.ckpt import VoteLog
+
+        self._votelog = VoteLog(path)
+        self._persisted_terms = self.terms.astype(np.int64).copy()
+        self._persisted_vf = self._fetch(self.state.voted_for).astype(np.int64)
+
+    def _persist_votes(self, vf: Optional[np.ndarray] = None) -> None:
+        """Durably record every (term, votedFor) row that changed since
+        the last record — called BEFORE the engine acts on the transition
+        (the fence argument in ckpt.votelog). ``vf`` is the device
+        voted_for when the caller has it (vote rounds); without it,
+        adoption semantics apply: a row whose term advanced holds NO_VOTE
+        in the new term (core.step resets voted_for on adoption)."""
+        if self._votelog is None:
+            return
+        rows = []
+        for r in range(self.cfg.rows):
+            t = int(self.terms[r])
+            if vf is not None:
+                v = int(vf[r])
+            elif t == self._persisted_terms[r]:
+                v = int(self._persisted_vf[r])
+            else:
+                v = NO_VOTE
+            if t != self._persisted_terms[r] or v != self._persisted_vf[r]:
+                rows.append((r, t, v))
+                self._persisted_terms[r] = t
+                self._persisted_vf[r] = v
+        if rows:
+            self._votelog.record_many(rows)
 
     def _push(self, t: float, kind: str, replica: int) -> None:
         heapq.heappush(self._q, (t, self._seq_events, kind, replica))
@@ -245,6 +342,7 @@ class RaftEngine:
         for the stale term."""
         self.roles[r] = FOLLOWER
         self.terms[r] = max_term
+        self._persist_votes()   # adopt the term durably before acting on it
         if self.leader_id == r:
             self.leader_id = None
         self.nodelog(r, "step down to follower")
@@ -280,7 +378,7 @@ class RaftEngine:
         while pending:
             if self.leader_id != r or not self.alive[r]:
                 break
-            leader_last = int(self.state.last_index[r])
+            leader_last = int(self._fetch(self.state.last_index)[r])
             steps = (
                 self.state.capacity - (leader_last - self.commit_watermark)
             ) // B
@@ -307,14 +405,16 @@ class RaftEngine:
                 folded = encode_fold_device(self._code, jnp.asarray(data))
                 payload_stack = folded.reshape(T, B, -1)
             else:
-                payload_stack = fold_batch(data, cfg.n_replicas).reshape(
+                payload_stack = fold_batch(data, cfg.rows).reshape(
                     T, B, -1
                 )
+            eff = self._reach(r)
             self.state, infos = self.t.replicate_many(
                 self.state, payload_stack, jnp.asarray(counts), r,
-                self.leader_term, jnp.asarray(self.alive),
+                self.leader_term, jnp.asarray(eff),
                 jnp.asarray(self.slow),
                 repair=self._repair_program(),
+                member=self._member_arg(),
             )
             # ---- one host sync for the whole chunk ----
             frontier = np.asarray(infos.frontier_len)
@@ -330,18 +430,18 @@ class RaftEngine:
                         idx += 1
                         self._seq_at_index[idx] = seq
                         self._uncommitted[idx] = (p, self.leader_term)
+                        self._note_config_ingest(idx, seq)
                     else:
                         refused.append((seq, p))
                 pos += cnt
             pending = refused + pending[take:]
             self._advance_commit(r, final_commit)
-            self._update_steady(r, infos.match[-1])
+            self._update_steady(r, infos.match[-1], eff)
             # keep the host term mirror in step with on-device adoption
             # (same sync as the tick path) so post-failover campaigns and
             # nodelog lines start from the real term
-            self.terms[self.alive] = np.maximum(
-                self.terms[self.alive], self.leader_term
-            )
+            self.terms[eff] = np.maximum(self.terms[eff], self.leader_term)
+            self._persist_votes()
             if max_term > self.leader_term:
                 # deposed mid-chunk: hand the rest back to the queue
                 self._step_down_leader(r, max_term)
@@ -361,6 +461,101 @@ class RaftEngine:
             1 for seq in self._seq_at_index.values()
             if seq not in self.commit_time
         )
+
+    # ------------------------------------------------------------- membership
+    def _member_arg(self):
+        """The member mask for device steps — None on fixed-membership
+        clusters (their programs compile the static quorum)."""
+        if self.cfg.max_replicas is None:
+            return None
+        return jnp.asarray(self.member)
+
+    def _config_payload(self, new_mask: np.ndarray) -> bytes:
+        """Configuration entries ride the log like data (the §4 approach:
+        a config change IS a log entry): magic + the member bitmap,
+        padded to entry_bytes."""
+        bits = int(sum(1 << i for i in np.flatnonzero(new_mask)))
+        body = b"RCFG" + bits.to_bytes(8, "little")
+        if len(body) > self.cfg.entry_bytes:
+            raise ValueError(
+                "entry_bytes too small to carry a configuration entry"
+            )
+        return body + bytes(self.cfg.entry_bytes - len(body))
+
+    def _change_membership(self, new_mask: np.ndarray) -> int:
+        if self.cfg.max_replicas is None:
+            raise ValueError(
+                "membership change needs max_replicas headroom in RaftConfig"
+            )
+        if self._pending_config is not None:
+            raise RuntimeError(
+                "a configuration change is already in flight; one at a "
+                "time (dissertation §4.1's single-server rule)"
+            )
+        if self.leader_id is None:
+            raise RuntimeError("membership change needs a current leader")
+        seq = self.submit(self._config_payload(new_mask))
+        self._config_seqs[seq] = (
+            tuple(bool(x) for x in self.member),
+            tuple(bool(x) for x in new_mask),
+        )
+        return seq
+
+    def add_server(self, r: int) -> int:
+        """Grow the cluster by one server (dissertation §4: a log-committed
+        configuration entry; the new config takes effect when APPENDED,
+        commits under its own majority). Returns the config entry's seq —
+        durable via ``is_durable`` like any entry. The new row joins empty
+        and is healed by the repair window / snapshot install."""
+        if not (0 <= r < self.cfg.rows):
+            raise ValueError(f"replica {r} out of range (rows={self.cfg.rows})")
+        if self.member[r]:
+            raise ValueError(f"replica {r} is already a member")
+        new = self.member.copy()
+        new[r] = True
+        return self._change_membership(new)
+
+    def remove_server(self, r: int) -> int:
+        """Shrink the cluster by one server. Removing the current leader
+        is allowed: it keeps leading until the entry commits, then steps
+        down (dissertation §4.2.2)."""
+        if not self.member[r]:
+            raise ValueError(f"replica {r} is not a member")
+        new = self.member.copy()
+        new[r] = False
+        if int(new.sum()) < 1:
+            raise ValueError("cannot remove the last member")
+        return self._change_membership(new)
+
+    def _note_config_ingest(self, idx: int, seq: int) -> None:
+        """A configuration entry reached the leader's log: activate the
+        new configuration NOW (append-time activation, dissertation §4.1 —
+        the entry then commits under the NEW majority)."""
+        ch = self._config_seqs.get(seq)
+        if ch is None:
+            return
+        _, new = ch
+        self._pending_config = (idx, ch[0], new)
+        self._apply_membership(np.array(new, bool))
+
+    def _apply_membership(self, new: np.ndarray) -> None:
+        added = new & ~self.member
+        removed = self.member & ~new
+        self.member = new
+        self._steady = False
+        for p in np.flatnonzero(added):
+            p = int(p)
+            self.roles[p] = FOLLOWER
+            self.nodelog(p, "added to configuration")
+            self._arm_follower(p)
+        for p in np.flatnonzero(removed):
+            p = int(p)
+            self.nodelog(p, "removed from configuration")
+            # a removed LEADER keeps serving until the entry commits
+            # (the _advance_commit hook demotes it); everyone else's
+            # timers simply stop firing (gated on member)
+            if self.roles[p] != LEADER:
+                self.roles[p] = FOLLOWER
 
     # ---------------------------------------------------------- fault toggles
     def fail(self, r: int) -> None:
@@ -390,7 +585,7 @@ class RaftEngine:
     def force_campaign(self, r: int) -> None:
         """Disruptive candidacy regardless of a live leader: term bump +
         vote round (the election-storm injection, BASELINE config 5)."""
-        if not self.alive[r]:
+        if not self.alive[r] or not self.member[r]:
             return
         if self.roles[r] == LEADER and self.leader_id == r:
             return  # a leader bumping itself is a no-op disruption
@@ -398,6 +593,48 @@ class RaftEngine:
         self.terms[r] += 1
         self.nodelog(r, "state changed to candidate (injected)")
         self._campaign(r)  # every _campaign outcome re-arms the right timer
+
+    def _reach(self, src: int) -> np.ndarray:
+        """Effective alive mask for a step sourced at ``src``: a member,
+        live, AND link-reachable from it (``src`` itself included — a
+        just-removed leader is the one non-member source; its row rides
+        ingest_row on device, not this mask)."""
+        return self.alive & self.connectivity[src] & self.member
+
+    def partition(self, groups) -> None:
+        """Install a link-level partition: replicas exchange messages only
+        within their group (every replica in exactly one group). The
+        classic Raft split-brain adversary — a quorum-side group keeps
+        electing and committing; a minority group cannot commit and its
+        leader, if any, keeps ticking in its own term until heal deposes
+        it. The reference cannot express this (its channels always
+        deliver, SURVEY §5)."""
+        n = self.cfg.rows
+        listed = sorted(x for g in groups for x in g)
+        if len(set(listed)) != len(listed) or not all(
+            0 <= x < n for x in listed
+        ):
+            raise ValueError("groups must not repeat or exceed row range")
+        missing = [x for x in range(n) if x not in set(listed)]
+        if any(self.member[x] for x in missing):
+            raise ValueError(
+                f"groups must cover every member; missing {missing}"
+            )
+        # spare non-member rows are auto-isolated (they carry no traffic)
+        groups = list(groups) + [[x] for x in missing]
+        self._steady = False
+        self.connectivity = np.zeros((n, n), bool)
+        for g in groups:
+            for a in g:
+                for b in g:
+                    self.connectivity[a, b] = True
+        self.nodelog(0, f"partition installed: {[sorted(g) for g in groups]}")
+
+    def heal_partition(self) -> None:
+        n = self.cfg.rows
+        self._steady = False
+        self.connectivity = np.ones((n, n), bool)
+        self.nodelog(0, "partition healed")
 
     def schedule_faults(self, plan) -> None:
         """Merge a ``faults.FaultPlan`` into the event heap; events fire at
@@ -432,6 +669,8 @@ class RaftEngine:
                 "slow": lambda p: self.set_slow(p, True),
                 "unslow": lambda p: self.set_slow(p, False),
                 "campaign": self.force_campaign,
+                "partition": lambda p: self.partition(ev.groups),
+                "heal_partition": lambda p: self.heal_partition(),
             }[ev.action](ev.replica)
         return True
 
@@ -468,7 +707,7 @@ class RaftEngine:
     # ----------------------------------------------------------- role actions
     def _fire_follower(self, r: int) -> None:
         """Election timeout (main.go:171-177): follower -> candidate."""
-        if not self.alive[r] or self.roles[r] != FOLLOWER:
+        if not self.alive[r] or self.roles[r] != FOLLOWER or not self.member[r]:
             return
         # A live current leader keeps resetting follower timers via its
         # heartbeats (main.go:124-127); replicate steps re-arm heard
@@ -481,7 +720,7 @@ class RaftEngine:
 
     def _fire_candidate(self, r: int) -> None:
         """Candidate re-election timeout (main.go:248-251): term+1, retry."""
-        if not self.alive[r] or self.roles[r] != CANDIDATE:
+        if not self.alive[r] or self.roles[r] != CANDIDATE or not self.member[r]:
             return
         self.terms[r] += 1
         self._campaign(r)
@@ -490,25 +729,40 @@ class RaftEngine:
         """One collective vote round (replaces the serial poll,
         main.go:253-284)."""
         cand_term = int(self.terms[r])
+        eff = self._reach(r)   # votes travel only inside the partition
         self.state, info = self.t.request_votes(
-            self.state, r, cand_term, jnp.asarray(self.alive)
+            self.state, r, cand_term, jnp.asarray(eff)
         )
         votes = int(info.votes)
         max_term = int(info.max_term)
-        self.terms[self.alive] = np.maximum(self.terms[self.alive], cand_term)
+        self.terms[eff] = np.maximum(self.terms[eff], cand_term)
+        # Durability fence: every replica's (term, votedFor) transition
+        # from this vote round reaches disk before the engine acts on the
+        # outcome (promotion, timers, further steps) — ckpt.votelog.
+        self._persist_votes(self._fetch(self.state.voted_for))
         if max_term > cand_term:
             # someone is ahead; fall back to follower in the newer term
             self.terms[r] = max_term
+            self._persist_votes()
             self.roles[r] = FOLLOWER
             self._arm_follower(r)
             return
-        if votes > self.cfg.n_replicas // 2:       # main.go:273
+        if votes > int(self.member.sum()) // 2:   # main.go:273, over members
             # A different leader's log may differ above the commit watermark,
             # so index->seq mappings for uncommitted entries are no longer
             # trustworthy: drop them (their seqs read as lost — conservative;
             # the reference silently loses such entries too, main.go:330).
             # The same replica re-winning keeps its own log, mappings intact.
             if self.leader_id != r:
+                if (self._pending_config is not None
+                        and self._pending_config[0] > self.commit_watermark):
+                    # the in-flight configuration entry is above the new
+                    # leader's trusted prefix: conservatively revert (the
+                    # operator's seq never reads durable; they retry)
+                    _, old_mask, _ = self._pending_config
+                    self._pending_config = None
+                    self._apply_membership(np.array(old_mask, bool))
+                    self.nodelog(r, "uncommitted configuration rolled back")
                 self._seq_at_index = {
                     i: s for i, s in self._seq_at_index.items()
                     if i <= self.commit_watermark
@@ -531,8 +785,8 @@ class RaftEngine:
                     # host-side fetch + numpy index: jnp fancy indexing
                     # would JIT-compile a gather per distinct slot-vector
                     # shape (seconds each through the tunnel)
-                    terms_all = np.asarray(self.state.log_term)[:, slots]
-                    lasts = np.asarray(self.state.last_index)
+                    terms_all = self._fetch(self.state.log_term)[:, slots]
+                    lasts = self._fetch(self.state.last_index)
                     for col, i in enumerate(above):
                         buf_t = self._uncommitted[i][1]
                         held = (
@@ -543,10 +797,14 @@ class RaftEngine:
             self.roles[r] = LEADER
             self.leader_id = r
             self.leader_term = cand_term
+            self.lead_terms[r] = cand_term
             self._steady = False   # matches reset per term; repair re-verifies
-            # demote any stale leader bookkeeping (device already denied it)
-            for p in range(self.cfg.n_replicas):
-                if p != r and self.roles[p] == LEADER:
+            # demote any stale leader bookkeeping (device already denied
+            # it) — but only leaders this election could REACH: across a
+            # partition a deposed-in-name leader keeps ticking in its own
+            # term (true split-brain) until heal lets a step depose it
+            for p in range(self.cfg.rows):
+                if p != r and self.roles[p] == LEADER and self.connectivity[r, p]:
                     self.roles[p] = FOLLOWER
                     self._arm_follower(p)
             self.nodelog(r, "state changed to leader")
@@ -557,16 +815,32 @@ class RaftEngine:
     def _fire_leader_tick(self, r: int) -> None:
         """One leader tick (main.go:332-395): batch ingest + replicate +
         commit, then re-arm. Also the followers' heartbeat: every heard
-        replica's election timer resets."""
-        if not self.alive[r] or self.roles[r] != LEADER or self.leader_id != r:
+        replica's election timer resets.
+
+        Ticks fire for ANY replica in the leader role, in ITS OWN term:
+        under a partition a stale leader keeps ticking on its side of the
+        split (heartbeating its group, committing nothing without quorum)
+        until a heal lets a step report the higher term and depose it.
+        Only the engine's routed leader (``leader_id`` — where ``submit``
+        sends traffic) drains the client queue and runs heal bookkeeping;
+        a stale leader's ticks are heartbeats."""
+        if not self.alive[r] or self.roles[r] != LEADER:
+            return
+        term = int(self.lead_terms[r])
+        if int(self.terms[r]) > term:
+            # heard a higher term since winning (adoption rode another
+            # source's step or vote round): step down instead of ticking
+            self._step_down_leader(r, int(self.terms[r]))
             return
         cfg = self.cfg
         B = cfg.batch_size
-        take = min(len(self._queue), B)
+        routed = self.leader_id == r
+        eff = self._reach(r)
+        take = min(len(self._queue), B) if routed else 0
         if take == 0:
             if self._hb_payload is None:
                 self._hb_payload = jnp.zeros(
-                    (B, cfg.n_replicas * cfg.shard_words), jnp.int32
+                    (B, cfg.rows * cfg.shard_words), jnp.int32
                 )
             payload = self._hb_payload
         elif cfg.ec_enabled:
@@ -584,20 +858,21 @@ class RaftEngine:
             # buffer (one copy of `take` rows, not B)
             payload = fold_batch(
                 self._pack_entries(self._queue[:take], take),
-                cfg.n_replicas, B,
+                cfg.rows, B,
             )
         self.state, info = self.t.replicate(
             self.state,
             payload,
             take,
             r,
-            self.leader_term,
-            jnp.asarray(self.alive),
+            term,
+            jnp.asarray(eff),
             jnp.asarray(self.slow),
             repair=self._repair_program(),
+            member=self._member_arg(),
         )
         max_term = int(info.max_term)
-        if max_term > self.leader_term:
+        if max_term > term:
             # nothing was consumed from the queue: the device step refused
             # ingest/commit for the stale term
             self._step_down_leader(r, max_term)
@@ -605,26 +880,30 @@ class RaftEngine:
         # Heard replicas adopted the leader's term on device (core.step);
         # keep the host mirror in sync so post-failover campaigns start from
         # the real term, not a stale one.
-        self.terms[self.alive] = np.maximum(
-            self.terms[self.alive], self.leader_term
-        )
+        self.terms[eff] = np.maximum(self.terms[eff], term)
+        self._persist_votes()   # term adoptions reach disk before commit acts
         # Ring backpressure: the device step ingests at most `room` entries
         # (never overwriting uncommitted slots); anything it left behind
         # stays queued for a later tick.
         ingested = int(info.frontier_len)
         if ingested:
-            last = int(self.state.last_index[r])        # post-ingest
+            last = int(self._fetch(self.state.last_index)[r])  # post-ingest
             for i, (seq, p) in enumerate(self._queue[:ingested]):
                 idx = last - ingested + 1 + i
                 self._seq_at_index[idx] = seq
-                self._uncommitted[idx] = (p, self.leader_term)
+                self._uncommitted[idx] = (p, term)
+                self._note_config_ingest(idx, seq)
             self._queue = self._queue[ingested:]
         self._advance_commit(r, int(info.commit_index))
-        if cfg.ec_enabled:
-            self._ec_heal(r, info)
-        else:
-            self._snapshot_heal(r, info)
-        self._update_steady(r, info.match)
+        if routed:
+            if cfg.ec_enabled:
+                self._ec_heal(r, info)
+            else:
+                self._snapshot_heal(r, info)
+        if routed:
+            # a stale split-brain leader must not poison the shared
+            # steady flag with its own group's view
+            self._update_steady(r, info.match, eff)
         self._reset_heard_timers(r)
         self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
 
@@ -636,17 +915,19 @@ class RaftEngine:
             return True
         return not self._steady
 
-    def _update_steady(self, r: int, match) -> None:
+    def _update_steady(self, r: int, match, eff=None) -> None:
         """After a replicate step: every live non-slow follower verified up
         to the leader's tail -> the next step may run the steady-state
         (repair-free) program. ``match`` arrives as the un-materialized
-        device array so the "off" mode really skips the host sync."""
+        device array so the "off" mode really skips the host sync.
+        ``eff`` is the step's effective reach (partition-aware); rows the
+        leader cannot reach are not the repair window's business."""
         if self.cfg.steady_dispatch == "off":
             return  # _repair_program never reads _steady
         match = np.asarray(match)
-        others = self.alive & ~self.slow
+        others = (self.alive if eff is None else eff) & ~self.slow
         others[r] = False
-        leader_last = int(self.state.last_index[r])
+        leader_last = int(self._fetch(self.state.last_index)[r])
         self._steady = bool((match[others] >= leader_last).all())
 
     def _advance_commit(self, r: int, commit: int) -> None:
@@ -661,6 +942,18 @@ class RaftEngine:
         self._archive_committed(r, self.commit_watermark + 1, commit)
         self.commit_watermark = commit
         self.nodelog(r, f"commit index changed to {commit}")
+        if self._pending_config is not None and self._pending_config[0] <= commit:
+            idx, _, _ = self._pending_config
+            self._pending_config = None
+            self.nodelog(r, f"configuration committed at {idx}")
+            lead = self.leader_id
+            if lead is not None and not self.member[lead]:
+                # the leader managed itself out of the cluster; now that
+                # the change is durable it steps down (dissertation
+                # §4.2.2) and the remaining members elect
+                self.roles[lead] = FOLLOWER
+                self.leader_id = None
+                self.nodelog(lead, "step down to follower (removed)")
         for idx in [i for i in self._uncommitted if i <= commit]:
             del self._uncommitted[idx]
         for idx in [i for i in self._seq_at_index if i <= commit]:
@@ -671,11 +964,20 @@ class RaftEngine:
         """Replication traffic is the heartbeat: every heard follower's
         election timer resets (main.go:124-127) and a candidate hearing a
         current leader steps down (main.go:204-217)."""
-        for p in range(self.cfg.n_replicas):
-            if p != r and self.alive[p] and self.roles[p] == FOLLOWER:
+        for p in range(self.cfg.rows):
+            if p == r or not self.alive[p] or not self.connectivity[r, p]\
+                    or not self.member[p]:
+                continue   # unreachable replicas hear nothing
+            if self.roles[p] == FOLLOWER:
                 self._arm_follower(p)
-            if self.alive[p] and self.roles[p] == CANDIDATE:
+            elif self.roles[p] == CANDIDATE:
                 self.roles[p] = FOLLOWER
+                self._arm_follower(p)
+            elif self.roles[p] == LEADER and self.lead_terms[r] > self.lead_terms[p]:
+                # a stale leader hearing a newer leader's traffic steps
+                # down (main.go:309-321); its device row already adopted
+                self.roles[p] = FOLLOWER
+                self.nodelog(p, "step down to follower")
                 self._arm_follower(p)
 
     def _archive_committed(self, leader: int, lo: int, hi: int) -> None:
@@ -700,7 +1002,7 @@ class RaftEngine:
         slots_all = (np.arange(lo, hi + 1) - 1) % self.state.capacity
         # whole-row fetch + numpy index (not jnp fancy indexing: that
         # compiles a fresh gather per slot-vector shape)
-        lead_terms = np.asarray(self.state.log_term)[leader, slots_all]
+        lead_terms = self._fetch(self.state.log_term)[leader, slots_all]
         missing = []
         for i, idx in enumerate(range(lo, hi + 1)):
             ent = self._uncommitted.get(idx)
@@ -712,21 +1014,22 @@ class RaftEngine:
             return
         mlo, mhi = min(missing), max(missing)
         slots = (np.arange(mlo, mhi + 1) - 1) % self.state.capacity
-        terms = np.asarray(self.state.log_term)[leader, slots]
+        terms = self._fetch(self.state.log_term)[leader, slots]
         try:
             if self.cfg.ec_enabled:
                 from raft_tpu.ec.reconstruct import reconstruct
 
-                commits = np.asarray(self.state.commit_index)
+                commits = self._fetch(self.state.commit_index)
                 # A donor's ring must actually HOLD the range: slots below
                 # its ring floor were never written (snapshot installs).
                 donors = [
                     q
                     for q in ([leader] + [
-                        p for p in range(self.cfg.n_replicas) if p != leader
+                        p for p in range(self.cfg.rows) if p != leader
                     ])
                     if self.alive[q] and int(commits[q]) >= mhi
                     and int(self._ring_floor[q]) <= mlo
+                    and self.connectivity[leader, q]
                 ]
                 if len(donors) < self.cfg.rs_k:
                     return
@@ -736,7 +1039,8 @@ class RaftEngine:
             else:
                 if int(self._ring_floor[leader]) > mlo:
                     return  # ring never held the range; archive stays short
-                data = log_entries(self.state, leader, mlo, mhi)
+                data = log_entries(self.state, leader, mlo, mhi,
+                                   fetch=self._fetch)
         except ValueError:
             return
         for idx in missing:
@@ -775,10 +1079,12 @@ class RaftEngine:
         let the repair window cover (snapshot, leader_last]."""
         cap = self.state.capacity
         match = np.asarray(info.match)
-        leader_last = int(self.state.last_index[leader])
+        leader_last = int(self._fetch(self.state.last_index)[leader])
         horizon = leader_last - cap + 1
-        for p in range(self.cfg.n_replicas):
-            if p == leader or not self.alive[p] or self.slow[p]:
+        for p in range(self.cfg.rows):
+            if (p == leader or not self.alive[p] or self.slow[p]
+                    or not self.member[p]
+                    or not self.connectivity[leader, p]):
                 self._match_stall[p] = 0
                 continue
             if int(match[p]) + 1 >= horizon:
@@ -814,10 +1120,11 @@ class RaftEngine:
 
         match = np.asarray(info.match)
         n, k = self.cfg.n_replicas, self.cfg.rs_k
-        leader_last = int(self.state.last_index[leader])
+        leader_last = int(self._fetch(self.state.last_index)[leader])
         hi_rec = self.commit_watermark
         for p in range(n):
-            if p == leader or not self.alive[p] or self.slow[p]:
+            if (p == leader or not self.alive[p] or self.slow[p]
+                    or not self.connectivity[leader, p]):
                 continue
             if match[p] >= leader_last:
                 continue
@@ -830,10 +1137,11 @@ class RaftEngine:
                 # leadership change (otherwise healing wedges after failover:
                 # every follower's match is 0 in the new term although all
                 # of them hold the committed shards).
-                commits = np.asarray(self.state.commit_index)
+                commits = self._fetch(self.state.commit_index)
                 donors = [
                     q for q in range(n)
                     if self.alive[q] and int(commits[q]) >= hi_rec
+                    and self.connectivity[leader, q]
                 ]
                 if len(donors) < k:
                     continue
@@ -856,7 +1164,7 @@ class RaftEngine:
                 if any(i not in self._uncommitted for i in idx):
                     continue  # suffix not servable (no buffer for it)
                 slots = (np.asarray(idx) - 1) % self.state.capacity
-                log_terms = np.asarray(self.state.log_term)[leader, slots]
+                log_terms = self._fetch(self.state.log_term)[leader, slots]
                 if any(
                     self._uncommitted[i][1] != int(t)
                     for i, t in zip(idx, log_terms)
@@ -991,7 +1299,7 @@ class RaftEngine:
         # the snapshot base) and its horizon (below it the slot was
         # overwritten). Under EC recovery needs k such shard holders that
         # also committed the entry; plain replication reads the leader.
-        lasts = np.asarray(self.state.last_index)
+        lasts = self._fetch(self.state.last_index)
 
         def serves(q: int) -> bool:
             return idx >= max(
@@ -1000,10 +1308,11 @@ class RaftEngine:
             )
 
         if self.cfg.ec_enabled:
-            commits = np.asarray(self.state.commit_index)
+            commits = self._fetch(self.state.commit_index)
             holders = sum(
-                1 for q in range(self.cfg.n_replicas)
+                1 for q in range(self.cfg.rows)
                 if self.alive[q] and int(commits[q]) >= idx and serves(q)
+                and self.connectivity[r, q]
             )
             recoverable = holders >= self.cfg.rs_k
         else:
@@ -1045,10 +1354,10 @@ class RaftEngine:
         # (i-1) % capacity is overwritten once last_index passes
         # i + capacity - 1, so reading below last_index - capacity + 1
         # would silently return a NEWER entry's bytes for an old index.
-        commits = np.asarray(self.state.commit_index)
-        lasts = np.asarray(self.state.last_index)
+        commits = self._fetch(self.state.commit_index)
+        lasts = self._fetch(self.state.last_index)
         holders = [
-            r for r in range(self.cfg.n_replicas)
+            r for r in range(self.cfg.rows)
             if self.alive[r]
             and int(commits[r]) >= hi
             and int(lasts[r]) - self.state.capacity + 1 <= lo
@@ -1066,7 +1375,8 @@ class RaftEngine:
                 "compacted history"
             )
         if not self.cfg.ec_enabled:
-            return log_entries(self.state, holders[0], lo, hi)
+            return log_entries(self.state, holders[0], lo, hi,
+                               fetch=self._fetch)
         from raft_tpu.ec.reconstruct import reconstruct
 
         if len(holders) < self.cfg.rs_k:
@@ -1133,9 +1443,14 @@ class RaftEngine:
             snap = self.store.snapshot(lo, hi)
         EngineCheckpoint(
             snap=snap,
-            terms=np.asarray(self.state.term, np.int32),
-            voted_for=np.asarray(self.state.voted_for, np.int32),
+            terms=self._fetch(self.state.term).astype(np.int32),
+            voted_for=self._fetch(self.state.voted_for).astype(np.int32),
+            member=self.member.copy(),
         ).save(path)
+        if self._votelog is not None:
+            # WAL rotation: the checkpoint just captured (term, votedFor),
+            # so the accumulated transition records are redundant.
+            self._votelog.truncate()
 
     @classmethod
     def restore(
@@ -1144,6 +1459,7 @@ class RaftEngine:
         path: str,
         transport: Optional[Transport] = None,
         trace: Optional[Callable[[str], None]] = None,
+        vote_log: Optional[str] = None,
     ) -> "RaftEngine":
         """Rebuild an engine from ``save_checkpoint`` output: every replica
         restarts as a follower holding the archived committed tail (RS
@@ -1154,10 +1470,10 @@ class RaftEngine:
         from raft_tpu.ckpt import EngineCheckpoint, install_snapshot_all
 
         ck = EngineCheckpoint.load(path)
-        if ck.terms.shape != (cfg.n_replicas,):
+        if ck.terms.shape != (cfg.rows,):
             raise ValueError(
-                f"checkpoint has {ck.terms.shape[0]} replicas, "
-                f"config has {cfg.n_replicas}"
+                f"checkpoint has {ck.terms.shape[0]} replica rows, "
+                f"config has {cfg.rows}"
             )
         if ck.snap.entries.size and ck.snap.entries.shape[1] != cfg.entry_bytes:
             raise ValueError(
@@ -1191,14 +1507,33 @@ class RaftEngine:
                 snap.base_index, snap.last_index - eng.state.capacity + 1
             )
         # persisted term + votedFor (the Raft durability obligation: a
-        # restarted replica must not vote twice in a term it voted in)
+        # restarted replica must not vote twice in a term it voted in).
+        # A vote log holds transitions NEWER than the checkpoint (crash
+        # between a vote and the next save_checkpoint): overlay them.
+        from raft_tpu.ckpt import merge_restored
+
+        terms = ck.terms.astype(np.int64).copy()
+        vf = ck.voted_for.astype(np.int64).copy()
+        terms, vf = merge_restored(cfg.rows, terms, vf, vote_log)
         eng.state = eng.state.replace(
-            term=jnp.asarray(ck.terms),
-            voted_for=jnp.asarray(ck.voted_for),
+            term=jnp.asarray(terms, eng.state.term.dtype),
+            voted_for=jnp.asarray(vf, eng.state.voted_for.dtype),
         )
-        eng.terms = ck.terms.astype(np.int64).copy()
-        for r in range(cfg.n_replicas):
-            eng.nodelog(r, f"restored from checkpoint to {eng.commit_watermark}")
+        eng.terms = terms
+        if vote_log is not None:
+            eng._attach_votelog(vote_log)
+        if ck.member is not None and ck.member.shape == (cfg.rows,):
+            # the committed configuration outranks cfg.n_replicas: a
+            # server removed before the checkpoint must NOT resurrect as
+            # a voting member on restore
+            eng.member = ck.member.copy()
+            for r in range(cfg.rows):
+                # rows that joined after the initial config need timers
+                if eng.member[r] and r >= cfg.n_replicas:
+                    eng._arm_follower(r)
+        for r in range(cfg.rows):
+            if eng.member[r]:
+                eng.nodelog(r, f"restored from checkpoint to {eng.commit_watermark}")
         return eng
 
     def commit_latencies(self) -> np.ndarray:

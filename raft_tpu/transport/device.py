@@ -30,7 +30,8 @@ from raft_tpu.core.step import (
 class SingleDeviceTransport:
     def __init__(self, cfg: RaftConfig):
         self.cfg = cfg
-        comm = SingleDeviceComm(cfg.n_replicas)
+        comm = SingleDeviceComm(cfg.rows)
+        self._member_mode = cfg.max_replicas is not None
         # two compiled variants per entry point: repair-capable, and the
         # steady-state program with the repair window compiled out (~10%
         # faster; the engine dispatches on whether anyone lags). EC has no
@@ -64,10 +65,25 @@ class SingleDeviceTransport:
     def init(self) -> ReplicaState:
         return init_state(self.cfg)
 
+    def fetch(self, x):
+        """Host view of a device value (everything is addressable on a
+        single device)."""
+        import numpy as np
+
+        return np.asarray(x)
+
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
-        alive, slow, repair=True,
+        alive, slow, repair=True, member=None,
     ) -> Tuple[ReplicaState, RepInfo]:
+        if self._member_mode:
+            if member is None:
+                member = jnp.ones(self.cfg.rows, bool)
+            return self._replicate[bool(repair)](
+                state, client_payload, jnp.int32(client_count),
+                jnp.int32(leader), jnp.int32(leader_term), alive, slow,
+                member,
+            )
         return self._replicate[bool(repair)](
             state, client_payload, jnp.int32(client_count), jnp.int32(leader),
             jnp.int32(leader_term), alive, slow,
@@ -75,12 +91,19 @@ class SingleDeviceTransport:
 
     def replicate_many(
         self, state, payloads, counts, leader, leader_term, alive, slow,
-        repair=True,
+        repair=True, member=None,
     ) -> Tuple[ReplicaState, RepInfo]:
         """T replication steps as one compiled ``lax.scan`` — no host
         round-trip per batch (SURVEY.md §7 hard part 1). ``payloads`` is
         i32[T, B, R*W] folded batches (core.state.fold_batch); ``counts``
         i32[T]."""
+        if self._member_mode:
+            if member is None:
+                member = jnp.ones(self.cfg.rows, bool)
+            return self._replicate_many[bool(repair)](
+                state, payloads, counts, jnp.int32(leader),
+                jnp.int32(leader_term), alive, slow, member,
+            )
         return self._replicate_many[bool(repair)](
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
             alive, slow,

@@ -21,14 +21,20 @@ Usage on each host of a pod (standard JAX multi-process setup):
 
 The protocol DATA PLANE (vote rounds, replication, quorum commit — all
 `shard_map` collectives whose info outputs are replicated) is fully
-multi-process; CI proves it with a real two-OS-process cluster over the
-JAX distributed runtime (tests/test_multiprocess.py). The engine's HOST
-bookkeeping (durability archive, committed reads, nodelog peeks) touches
-sharded rows and is single-controller by design: on a pod, run the
-engine's control plane on one host — or give each host its own archive of
-its replica's feed — while every process executes the identical device
-program. Placement rules are additionally covered by fake-fabric unit
-tests and the single-process virtual mesh.
+multi-process, and so is the FULL ENGINE: every process runs
+``RaftEngine`` as a **mirrored deterministic event loop** — same config
+seed, same timer heap, same decisions — so all processes issue identical
+collective launches, which makes host reads of sharded rows legal as
+collectives too (``TpuMeshTransport.fetch``: a jit identity resharded to
+fully-replicated). CI proves both layers with real two-OS-process
+clusters over the JAX distributed runtime (tests/test_multiprocess.py):
+transport-level steps, and the complete engine driving client traffic
+and a leadership change end-to-end with byte-identical committed logs on
+every process. Mirroring is the control-plane replication strategy: a
+host crash kills one replica row's device shards, not the cluster's only
+brain — any surviving process still holds the full control state.
+Placement rules are additionally covered by fake-fabric unit tests and
+the single-process virtual mesh.
 """
 
 from __future__ import annotations

@@ -56,10 +56,12 @@ class TpuMeshTransport:
         if payload_shards is None:
             payload_shards = cfg.payload_shards
         devices = list(devices) if devices is not None else jax.devices()
-        need = cfg.n_replicas * payload_shards
+        # membership headroom allocates (and shards) cfg.rows replica
+        # rows; spare rows idle behind the member mask until add_server
+        need = cfg.rows * payload_shards
         if len(devices) < need:
             raise ValueError(
-                f"need {need} devices ({cfg.n_replicas} replicas x "
+                f"need {need} devices ({cfg.rows} replica rows x "
                 f"{payload_shards} payload shards), got {len(devices)}"
             )
         if cfg.shard_words % payload_shards:
@@ -68,7 +70,7 @@ class TpuMeshTransport:
                 f"evenly over {payload_shards} payload shards"
             )
         self.payload_shards = payload_shards
-        grid = np.array(devices[:need]).reshape(cfg.n_replicas, payload_shards)
+        grid = np.array(devices[:need]).reshape(cfg.rows, payload_shards)
         self.mesh = Mesh(grid, (AXIS, PAYLOAD_AXIS))
         # The folded payload's lane axis is [R x P x W_local] flattened in
         # that (major-to-minor) order, which is exactly how PartitionSpec
@@ -76,7 +78,7 @@ class TpuMeshTransport:
         lanes = (AXIS, PAYLOAD_AXIS) if payload_shards > 1 else AXIS
         self._row = NamedSharding(self.mesh, P(AXIS))
         self._payload2 = NamedSharding(self.mesh, P(None, lanes))
-        comm = MeshComm(cfg.n_replicas, AXIS)
+        comm = MeshComm(cfg.rows, AXIS)
 
         state_specs = ReplicaState(
             term=P(AXIS), voted_for=P(AXIS), last_index=P(AXIS),
@@ -93,6 +95,8 @@ class TpuMeshTransport:
         # each entry point; the engine dispatches on whether anyone lags.
         # EC has no repair window: both keys alias one program.
         reps = (True,) if cfg.ec_enabled else (True, False)
+        self._member_mode = cfg.max_replicas is not None
+        mem_spec = (P(),) if self._member_mode else ()
         self._replicate = {
             rep: jax.jit(
                 jax.shard_map(
@@ -104,7 +108,7 @@ class TpuMeshTransport:
                     mesh=self.mesh,
                     in_specs=(
                         state_specs, P(None, lanes), P(), P(), P(), P(), P(),
-                    ),
+                    ) + mem_spec,
                     out_specs=(state_specs, info_specs),
                     check_vma=False,
                 )
@@ -131,7 +135,7 @@ class TpuMeshTransport:
                     in_specs=(
                         state_specs, P(None, None, lanes),
                         P(), P(), P(), P(), P(),
-                    ),
+                    ) + mem_spec,
                     out_specs=(state_specs, info_specs),
                     check_vma=False,
                 )
@@ -152,6 +156,21 @@ class TpuMeshTransport:
         )
         return jax.tree.map(jax.device_put, state, shardings)
 
+    def fetch(self, x):
+        """Host view of a (possibly cross-process sharded) device value.
+
+        Single process: plain ``np.asarray``. Multi-process: a jit
+        identity resharded to fully-replicated — a collective, so EVERY
+        process must call it at the same point, which the engine's
+        mirrored deterministic event loops guarantee (each process runs
+        the identical control plane and issues identical launches)."""
+        if jax.process_count() == 1:
+            return np.asarray(x)
+        if not hasattr(self, "_fetch_jit"):
+            rep = NamedSharding(self.mesh, P())
+            self._fetch_jit = jax.jit(lambda a: a, out_shardings=rep)
+        return np.asarray(self._fetch_jit(x))
+
     def shard_rows(self, payload):
         """Place a folded i32[B, R*W] batch with each replica's lane block
         on its own device (the 'scatter' of the north star when blocks are
@@ -160,21 +179,29 @@ class TpuMeshTransport:
 
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
-        alive, slow, repair=True,
+        alive, slow, repair=True, member=None,
     ) -> Tuple[ReplicaState, RepInfo]:
+        extra = ()
+        if self._member_mode:
+            extra = (jnp.ones(self.cfg.rows, bool) if member is None
+                     else member,)
         return self._replicate[bool(repair)](
             state, client_payload, jnp.int32(client_count), jnp.int32(leader),
-            jnp.int32(leader_term), alive, slow,
+            jnp.int32(leader_term), alive, slow, *extra,
         )
 
     def replicate_many(
         self, state, payloads, counts, leader, leader_term, alive, slow,
-        repair=True,
+        repair=True, member=None,
     ) -> Tuple[ReplicaState, RepInfo]:
         """i32[T, B, R*W] folded payloads → T steps in one compiled scan."""
+        extra = ()
+        if self._member_mode:
+            extra = (jnp.ones(self.cfg.rows, bool) if member is None
+                     else member,)
         return self._replicate_many[bool(repair)](
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
-            alive, slow,
+            alive, slow, *extra,
         )
 
     def request_votes(

@@ -4,10 +4,12 @@ them by `replica_devices_across_hosts`, and the protocol collectives
 (vote round + replication steps with quorum commit) executed over the
 process boundary — the CI stand-in for DCN between TPU slices.
 
-Scope is the DATA PLANE (transport-level steps, whose RepInfo/VoteInfo
-outputs are replicated and therefore addressable everywhere). The host
-engine's bookkeeping (archive reads, nodelog state peeks) reads sharded
-rows and is single-controller by design — see transport/multihost.py.
+Two layers are proven: the DATA PLANE (transport-level steps, whose
+RepInfo/VoteInfo outputs are replicated and therefore addressable
+everywhere), and the FULL ENGINE as mirrored deterministic event loops —
+each process runs the identical control plane and issues identical
+collective launches, with host reads of sharded rows riding the
+transport's collective ``fetch`` (see transport/multihost.py).
 """
 
 import os
@@ -117,3 +119,100 @@ def test_two_process_cluster_data_plane(tmp_path):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"MPOK proc={i} commit=12 votes=3 ec_commit=4" in out, \
             out[-500:]
+
+
+ENGINE_CHILD = r'''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=3"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=2, process_id=int(sys.argv[2]))
+import hashlib
+import numpy as np
+sys.path.insert(0, os.getcwd())
+from raft_tpu.config import RaftConfig
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport.multihost import multihost_transport
+
+# The FULL engine as mirrored deterministic event loops: every process
+# runs the identical control plane (same seed -> same timers, same
+# decisions) and therefore issues identical collective launches; host
+# reads of sharded rows ride the transport's collective fetch.
+cfg = RaftConfig(n_replicas=3, entry_bytes=16, batch_size=4,
+                 log_capacity=64, transport="multihost", seed=7)
+t = multihost_transport(cfg)
+assert sorted({d.process_index for d in t.mesh.devices.ravel()}) == [0, 1]
+e = RaftEngine(cfg, t)
+lead1 = e.run_until_leader()
+rng = np.random.default_rng(42)
+ps = [rng.integers(0, 256, 16, np.uint8).tobytes() for _ in range(8)]
+seqs = [e.submit(p) for p in ps]
+e.run_until_committed(seqs[-1])
+term1 = e.leader_term
+
+# leadership change end-to-end: crash the leader, elect in a higher
+# term, keep committing, then heal the rejoiner — all across the
+# process boundary
+e.fail(lead1)
+lead2 = e.run_until_leader()
+assert lead2 != lead1 and e.leader_term > term1
+ps2 = [rng.integers(0, 256, 16, np.uint8).tobytes() for _ in range(4)]
+seqs2 = [e.submit(p) for p in ps2]
+e.run_until_committed(seqs2[-1])
+e.recover(lead1)
+e.run_for(8 * cfg.heartbeat_period)
+
+got = e.committed_entries(1, e.commit_watermark)
+assert [bytes(x) for x in got] == ps + ps2, "committed bytes diverged"
+# the archive (commit stamping + durability bookkeeping) ran everywhere
+assert e.store.covers(1, e.commit_watermark)
+h = hashlib.sha256(got.tobytes()).hexdigest()[:16]
+print(f"ENGOK proc={jax.process_index()} wm={e.commit_watermark} "
+      f"lead={e.leader_id} term={e.leader_term} sha={h}")
+'''
+
+
+def test_two_process_full_engine(tmp_path):
+    """VERDICT r2 #3: the complete RaftEngine — elections, client
+    traffic, commit stamping, archive, heal — with control split across
+    two OS processes as mirrored deterministic event loops. Both
+    processes must drive the same leadership change and finish with
+    byte-identical committed logs."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    coord = f"127.0.0.1:{port}"
+
+    script = tmp_path / "engine_child.py"
+    script.write_text(ENGINE_CHILD)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ps = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord, str(i)],
+            env=env, cwd=here, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in ps:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in ps:
+                q.kill()
+            pytest.fail("full-engine multiprocess child timed out")
+        outs.append(out)
+    marks = []
+    for i, (p, out) in enumerate(zip(ps, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        mark = [l for l in out.splitlines() if l.startswith("ENGOK")]
+        assert mark, out[-500:]
+        marks.append(mark[0].split(" ", 1)[1])   # drop proc=i prefix
+    # both processes converged on the identical cluster state
+    assert marks[0].split("wm=")[1] == marks[1].split("wm=")[1]
+    assert "wm=12" in marks[0]

@@ -1,0 +1,265 @@
+"""Link-level partition faults (VERDICT r2 #4): the classic Raft
+split-brain adversary, which the reference's always-delivering channels
+cannot express (SURVEY §5).
+
+Mechanics under test (engine.partition / faults.FaultPlan.split):
+- the majority side keeps electing and committing;
+- a minority-side leader keeps ticking in its own term but CANNOT commit
+  (no quorum of reachable acks) — true split-brain, two simultaneous
+  self-identified leaders;
+- an isolated minority cannot elect at all (terms climb, no leadership);
+- on heal, the stale leader is deposed by the first step that reaches the
+  higher term, divergent uncommitted suffixes are truncated by the repair
+  window, and every replica converges on the majority's committed log.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import committed_payloads
+from raft_tpu.faults import FaultPlan
+from raft_tpu.obs import TraceRecorder
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport import SingleDeviceTransport
+
+ENTRY = 16
+
+
+def payloads(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, ENTRY, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def mk(seed=0, n=3, trace=None, **kw):
+    defaults = dict(
+        n_replicas=n, entry_bytes=ENTRY, batch_size=4, log_capacity=256,
+        transport="single", seed=seed,
+    )
+    defaults.update(kw)
+    cfg = RaftConfig(**defaults)
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=trace)
+
+
+def committed(e, r):
+    return [bytes(p) for p in committed_payloads(e.state, r)]
+
+
+class TestSplitBrain:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_minority_leader_cannot_commit_majority_can(self, seed):
+        """5 replicas; the leader is cut off with one friend (2-side).
+        It keeps leading its side but commits nothing; the 3-side elects
+        a new leader and commits; heal reconciles every log."""
+        cfg, e = mk(seed=seed, n=5)
+        old = e.run_until_leader()
+        pre = payloads(5, seed + 10)
+        seqs = [e.submit(p) for p in pre]
+        e.run_until_committed(seqs[-1])
+        e.run_for(4 * cfg.heartbeat_period)            # all caught up
+        friend = (old + 1) % 5
+        minority = [old, friend]
+        majority = [r for r in range(5) if r not in minority]
+        e.partition([minority, majority])
+
+        # traffic routed at the (minority) leader must NOT become durable
+        stranded = [e.submit(p) for p in payloads(3, seed + 20)]
+        e.run_for(120.0)                               # many ticks + timeouts
+        assert e.roles[old] == "leader", "stale leader stopped ticking"
+        assert not any(e.is_durable(s) for s in stranded)
+        # the majority elected its own leader in a higher term
+        new = e.leader_id
+        assert new in majority
+        assert e.terms[new] > e.terms[old]
+        watermark_before = e.commit_watermark
+        post = [e.submit(p) for p in payloads(4, seed + 30)]
+        e.run_until_committed(post[-1])
+        assert e.commit_watermark > watermark_before   # majority commits
+
+        e.heal_partition()
+        e.run_for(10 * cfg.heartbeat_period)
+        assert e.roles[old] == "follower", "stale leader survived heal"
+        # queued-at-stale-leader entries either commit under the new
+        # leader or stay non-durable — but are never silently reported
+        # durable without being in the log (checked via prefix relation)
+        final = committed(e, e.leader_id)
+        assert final[: len(pre)] == pre
+        for r in range(5):
+            got = committed(e, r)
+            assert got == final[: len(got)], f"replica {r} diverged"
+            assert int(e.state.commit_index[r]) >= len(pre)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_isolated_minority_cannot_elect(self, seed):
+        cfg, e = mk(seed=seed, n=3)
+        lead = e.run_until_leader()
+        loner = (lead + 1) % 3
+        rest = [r for r in range(3) if r != loner]
+        e.partition([[loner], rest])
+        term_before = int(e.terms[loner])
+        e.run_for(300.0)                               # many timeouts
+        # the loner campaigned (terms climbed) but never won
+        assert e.terms[loner] > term_before
+        assert e.roles[loner] != "leader"
+        # the connected majority kept a working leader throughout
+        assert e.leader_id in rest
+        s = [e.submit(p) for p in payloads(3, seed + 40)]
+        e.run_until_committed(s[-1])
+        e.heal_partition()
+        e.run_for(10 * cfg.heartbeat_period)
+        # the loner's inflated term forces a re-election on heal, but
+        # nothing committed is lost and the cluster reconverges
+        probe = e.submit(payloads(1, seed + 50)[0])
+        e.run_until_committed(probe, limit=600.0)
+        final = committed(e, e.leader_id)
+        for r in range(3):
+            got = committed(e, r)
+            assert got == final[: len(got)]
+
+    def test_divergent_uncommitted_suffix_truncated_on_heal(self):
+        """The defining split-brain hazard: the stale leader ingests
+        entries on its side (driven directly at the transport, as the
+        routed queue refuses a non-leader_id drain) that a healed cluster
+        must discard in favor of the majority's committed suffix."""
+        import jax.numpy as jnp
+
+        from raft_tpu.core.state import fold_batch, log_entries
+
+        cfg, e = mk(seed=3, n=5)
+        old = e.run_until_leader()
+        pre = payloads(4, 60)
+        seqs = [e.submit(p) for p in pre]
+        e.run_until_committed(seqs[-1])
+        e.run_for(4 * cfg.heartbeat_period)
+        friend = (old + 1) % 5
+        minority = [old, friend]
+        majority = [r for r in range(5) if r not in minority]
+        e.partition([minority, majority])
+        # stale-side ingest: drive one batch at the stale leader in ITS
+        # term; its side accepts (2 rows) but cannot commit (quorum 3)
+        junk = payloads(2, 61)
+        pl = fold_batch(
+            np.frombuffer(b"".join(junk), np.uint8).reshape(2, ENTRY), 5,
+            cfg.batch_size,
+        )
+        eff = e._reach(old)
+        e.state, info = e.t.replicate(
+            e.state, pl, 2, old, int(e.terms[old]), jnp.asarray(eff),
+            jnp.asarray(e.slow),
+        )
+        assert int(info.frontier_len) == 2             # minority ingested
+        assert int(info.commit_index) == len(pre)      # but didn't commit
+        stale_last = int(e.state.last_index[old])
+        assert stale_last == len(pre) + 2
+        # majority elects + commits different entries at those indices
+        e.run_for(120.0)
+        assert e.leader_id in majority
+        post = payloads(3, 62)
+        s2 = [e.submit(p) for p in post]
+        e.run_until_committed(s2[-1])
+
+        e.heal_partition()
+        e.run_for(12 * cfg.heartbeat_period)
+        final = committed(e, e.leader_id)
+        assert final == pre + post
+        for r in range(5):
+            got = committed(e, r)
+            assert got == final[: len(got)], f"replica {r}"
+        # the stale suffix is gone from the old leader's log: its entries
+        # at the contested indices now byte-match the majority's
+        healed = [bytes(p) for p in
+                  log_entries(e.state, old, len(pre) + 1,
+                              min(int(e.state.last_index[old]),
+                                  len(pre) + len(post)))]
+        assert healed == post[: len(healed)]
+        assert junk[0] not in committed(e, old)
+
+    def test_fault_plan_split_schedules(self):
+        """FaultPlan.split merges into the event heap like other faults."""
+        cfg, e = mk(seed=5, n=3)
+        lead = e.run_until_leader()
+        pre = [e.submit(p) for p in payloads(3, 70)]
+        e.run_until_committed(pre[-1])
+        loner = (lead + 2) % 3
+        rest = [r for r in range(3) if r != loner]
+        now = e.clock.now
+        e.schedule_faults(
+            FaultPlan.split([[loner], rest], now + 5.0, now + 80.0)
+        )
+        e.run_for(4.0)
+        assert e.connectivity.all()                    # not yet
+        e.run_for(3.0)
+        assert not e.connectivity[loner, rest[0]]      # installed
+        e.run_for(100.0)
+        assert e.connectivity.all()                    # healed
+        probe = e.submit(payloads(1, 71)[0])
+        e.run_until_committed(probe, limit=600.0)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("n", [3, 5])
+def test_safety_properties_under_partition_schedule(seed, n):
+    """The four Raft safety properties under randomized schedules that
+    now include partitions (extends test_properties' fault space)."""
+    from tests.test_properties import replica_log, run_random_schedule
+
+    rng = random.Random(7000 * n + seed)
+    tr = TraceRecorder()
+    cfg, e = mk(seed=seed, n=n, trace=tr)
+
+    snapshots = []
+    e.run_until_leader()
+    partitioned = False
+    for phase in range(8):
+        for _ in range(rng.randrange(0, 6)):
+            e.submit(bytes(rng.getrandbits(8) for _ in range(ENTRY)))
+        roll = rng.random()
+        if roll < 0.35 and not partitioned:
+            # random split: one or two replicas cut off from the rest
+            cut = rng.sample(range(n), rng.choice([1, max(1, (n - 1) // 2)]))
+            rest = [r for r in range(n) if r not in cut]
+            if rest:
+                e.partition([cut, rest])
+                partitioned = True
+        elif roll < 0.55 and partitioned:
+            e.heal_partition()
+            partitioned = False
+        elif roll < 0.7:
+            e.force_campaign(rng.randrange(n))
+        e.run_for(50.0)
+        if e.leader_id is not None and e.connectivity[e.leader_id].sum() > n // 2:
+            snapshots.append(
+                [bytes(p) for p in committed_payloads(e.state, e.leader_id)]
+            )
+    e.heal_partition()
+    probe = e.submit(bytes(ENTRY))
+    e.run_until_committed(probe, limit=900.0)
+    e.run_for(6 * cfg.heartbeat_period)
+
+    # Election Safety: at most one leader per term, across the whole run
+    for term, leaders in tr.leaders_by_term().items():
+        assert len(leaders) <= 1, f"two leaders in term {term}: {leaders}"
+    # Log Matching
+    logs = {r: replica_log(e, r) for r in range(n)}
+    for a in range(n):
+        for b in range(a + 1, n):
+            la, lb = logs[a], logs[b]
+            agree = [i for i in range(min(len(la), len(lb)))
+                     if la[i][0] == lb[i][0]]
+            if agree:
+                hi = max(agree)
+                assert la[: hi + 1] == lb[: hi + 1], f"replicas {a},{b}"
+    # State-Machine Safety
+    comm = {r: committed(e, r) for r in range(n)}
+    for a in range(n):
+        for b in range(a + 1, n):
+            m = min(len(comm[a]), len(comm[b]))
+            assert comm[a][:m] == comm[b][:m], f"replicas {a},{b}"
+    # Leader Completeness over majority-side snapshots
+    final = comm[e.leader_id]
+    for i, snap in enumerate(snapshots):
+        assert final[: len(snap)] == snap, f"phase-{i} prefix lost"
+    assert len(final) >= 1

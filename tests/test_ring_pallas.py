@@ -1,0 +1,134 @@
+"""Pallas ring-window write kernel vs the XLA reference formulation.
+
+The kernel (core.ring_pallas) is the TPU hot path for the payload window
+write; core.ring's dynamic-slice formulation is the semantic reference.
+CI runs the kernel in interpret mode (no TPU); bench.py re-asserts
+equality on real hardware before timing it.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core.ring import write_window_cols
+from raft_tpu.core.ring_pallas import write_window_cols_tpu
+
+C, B, M = 512, 128, 24
+
+
+def ref_write(buf, win, s, count, lanes):
+    return np.asarray(write_window_cols(
+        jnp.asarray(buf), jnp.asarray(win), jnp.int32(s), jnp.int32(count),
+        jnp.asarray(lanes),
+    ))
+
+
+def pallas_write(buf, win, s, count, lanes):
+    return np.asarray(write_window_cols_tpu(
+        jnp.asarray(buf), jnp.asarray(win), jnp.int32(s), jnp.int32(count),
+        jnp.asarray(lanes), interpret=True,
+    ))
+
+
+@pytest.mark.parametrize("s", [0, 1, 7, 63, 64, 100, C - B, C - B + 1,
+                               C - B + 37, C - 1])
+@pytest.mark.parametrize("count", [0, 1, 17, B - 1, B])
+def test_matches_reference_across_starts_and_counts(s, count):
+    rng = np.random.default_rng(s * 1000 + count)
+    buf = rng.integers(-2**31, 2**31 - 1, (C, M), dtype=np.int32)
+    win = rng.integers(-2**31, 2**31 - 1, (B, M), dtype=np.int32)
+    lanes = rng.random(M) < 0.7
+    np.testing.assert_array_equal(
+        pallas_write(buf.copy(), win, s, count, lanes),
+        ref_write(buf.copy(), win, s, count, lanes),
+    )
+
+
+def test_all_lanes_reject_is_noop():
+    rng = np.random.default_rng(0)
+    buf = rng.integers(-2**31, 2**31 - 1, (C, M), dtype=np.int32)
+    win = rng.integers(-2**31, 2**31 - 1, (B, M), dtype=np.int32)
+    out = pallas_write(buf.copy(), win, 5, B, np.zeros(M, bool))
+    np.testing.assert_array_equal(out, buf)
+
+
+def test_headline_shape_block_pick():
+    from raft_tpu.core.ring_pallas import _pick_block_rows
+
+    assert _pick_block_rows(1024, 1 << 15) == 128
+    assert _pick_block_rows(128, 512) == 128
+    with pytest.raises(ValueError):
+        _pick_block_rows(64, 256)   # lane-dim constraint: XLA path instead
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_randomized_fuzz(seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(8):
+        s = int(rng.integers(0, C))
+        count = int(rng.integers(0, B + 1))
+        buf = rng.integers(-2**31, 2**31 - 1, (C, M), dtype=np.int32)
+        win = rng.integers(-2**31, 2**31 - 1, (B, M), dtype=np.int32)
+        lanes = rng.random(M) < rng.random()
+        np.testing.assert_array_equal(
+            pallas_write(buf.copy(), win, s, count, lanes),
+            ref_write(buf.copy(), win, s, count, lanes),
+            err_msg=f"s={s} count={count}",
+        )
+
+
+class TestFusedBothWrite:
+    """write_window_both_tpu vs the two XLA reference writes."""
+
+    L = 3
+
+    def run_both(self, s, count, seed=0):
+        from raft_tpu.core.ring import write_window_rows
+        from raft_tpu.core.ring_pallas import write_window_both_tpu
+
+        rng = np.random.default_rng(seed)
+        buf_p = rng.integers(-2**31, 2**31 - 1, (C, M), dtype=np.int32)
+        buf_t = rng.integers(1, 6, (self.L, C), dtype=np.int32)
+        win = rng.integers(-2**31, 2**31 - 1, (B, M), dtype=np.int32)
+        win_t = rng.integers(1, 6, B, dtype=np.int32)
+        accept = rng.random(self.L) < 0.7
+        lanes = np.repeat(accept, M // self.L)
+        # window starts at global index ws; its row 0 lives in slot s
+        ws = s + 1 + int(rng.integers(0, 3)) * C
+        last_index = rng.integers(0, ws + B + 4, self.L).astype(np.int32)
+        got_p, got_t, got_mm = write_window_both_tpu(
+            jnp.asarray(buf_p), jnp.asarray(buf_t), jnp.asarray(win),
+            jnp.asarray(win_t), jnp.int32(s), jnp.int32(count),
+            jnp.int32(ws), jnp.asarray(accept), jnp.asarray(last_index),
+            interpret=True,
+        )
+        want_p = ref_write(buf_p, win, s, count, lanes)
+        want_t = np.asarray(write_window_rows(
+            jnp.asarray(buf_t), jnp.asarray(win_t), jnp.int32(s),
+            jnp.int32(count), jnp.asarray(accept),
+        ))
+        # the XLA step's conflict check, re-derived in numpy
+        widx = ws + np.arange(B)
+        slots = (widx - 1 + 1 - ws + s) % C          # slot of window row j
+        my_win_t = buf_t[:, (s + np.arange(B)) % C]
+        exists = widx[None, :] <= last_index[:, None]
+        valid = (np.arange(B) < count)[None, :]
+        want_mm = (exists & (my_win_t != win_t[None, :]) & valid).any(axis=1)
+        np.testing.assert_array_equal(np.asarray(got_p), want_p,
+                                      err_msg=f"payload s={s} count={count}")
+        np.testing.assert_array_equal(np.asarray(got_t), want_t,
+                                      err_msg=f"term s={s} count={count}")
+        np.testing.assert_array_equal(np.asarray(got_mm)[0] != 0, want_mm,
+                                      err_msg=f"mismatch s={s} count={count}")
+
+    @pytest.mark.parametrize("s", [0, 3, 63, 64, C - B, C - B + 11, C - 1])
+    @pytest.mark.parametrize("count", [0, 1, 29, B])
+    def test_matches_references(self, s, count):
+        self.run_both(s, count, seed=s * 7 + count)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_fuzz(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        for _ in range(6):
+            self.run_both(int(rng.integers(0, C)),
+                          int(rng.integers(0, B + 1)), seed=seed)
