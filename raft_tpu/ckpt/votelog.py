@@ -37,6 +37,14 @@ _MAGIC = b"RTVL1\n"
 _REC = struct.Struct("<iqi")
 
 
+def _fsync_dir(path: str) -> None:
+    fd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class VoteLog:
     """Append-only fsync'd log of (replica, term, voted_for) transitions."""
 
@@ -63,6 +71,10 @@ class VoteLog:
             self._f.write(_MAGIC)
             self._f.flush()
             os.fsync(self._f.fileno())
+            _fsync_dir(path)   # pin the dirent too: data fsync alone does
+            # not survive a crash that loses the directory entry, and a
+            # vanished log replays as {} — the double-vote this file exists
+            # to prevent
 
     def record_many(self, rows) -> None:
         """Durably append transitions for several replicas at once:
@@ -94,6 +106,7 @@ class VoteLog:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        _fsync_dir(self.path)
         self._f = open(self.path, "ab")
 
     @staticmethod

@@ -78,7 +78,13 @@ class RaftEngine:
     plane (all replicas' state transitions) is the batched device program.
     Fault masks (``alive``/``slow``) are first-class: a "dead" replica's
     timers do not fire and the device step ignores it, which is exactly how
-    the reference's only failure mode (a silent node) manifests.
+    the reference's only failure mode (a silent node) manifests. Beyond
+    those, ``connectivity`` expresses link-level partitions (split-brain;
+    see ``partition``/``heal_partition``) and ``member`` the current
+    configuration (live add/remove via ``add_server``/``remove_server``).
+    On a multihost transport, run one engine per process with the same
+    config: mirrored deterministic event loops issue identical collective
+    launches (transport.multihost).
     """
 
     def __init__(
@@ -374,6 +380,16 @@ class RaftEngine:
                 )
         seqs = [self.submit(p) for p in payloads]
         pending, self._queue = self._queue, []
+        # Configuration entries do not ride pipelined scans: a chunk would
+        # keep committing batches beyond the entry under the stale member
+        # mask. Stop the pipeline before the first config entry; the tick
+        # path ingests it with the new mask (see _fire_leader_tick).
+        cut = next((i for i, (q, _) in enumerate(pending)
+                    if q in self._config_seqs), None)
+        deferred: List[Tuple[int, bytes]] = []
+        if cut is not None:
+            deferred = pending[cut:]
+            pending = pending[:cut]
         B = cfg.batch_size
         while pending:
             if self.leader_id != r or not self.alive[r]:
@@ -448,7 +464,7 @@ class RaftEngine:
                 break
             if refused:
                 break  # no progress is possible right now; don't spin
-        self._queue = pending + self._queue
+        self._queue = pending + deferred + self._queue
         if self.leader_id == r:
             self._reset_heard_timers(r)
         return seqs
@@ -756,16 +772,35 @@ class RaftEngine:
             if self.leader_id != r:
                 if (self._pending_config is not None
                         and self._pending_config[0] > self.commit_watermark):
-                    # the in-flight configuration entry is above the new
-                    # leader's trusted prefix: conservatively revert (the
-                    # operator's seq never reads durable; they retry)
-                    _, old_mask, _ = self._pending_config
-                    self._pending_config = None
-                    self._apply_membership(np.array(old_mask, bool))
-                    self.nodelog(r, "uncommitted configuration rolled back")
+                    # Raft rule: a server uses the latest configuration
+                    # entry IN ITS LOG, committed or not. If the winner's
+                    # log still holds the in-flight entry (same slot,
+                    # same ingest term), the change stays active and
+                    # commits later under the winner (Leader
+                    # Completeness); only an entry the winner does NOT
+                    # hold is rolled back (its seq never reads durable;
+                    # the operator retries).
+                    cidx, old_mask, _ = self._pending_config
+                    ent = self._uncommitted.get(cidx)
+                    holds = False
+                    if ent is not None:
+                        cslot = (cidx - 1) % self.state.capacity
+                        holds = bool(
+                            int(self._fetch(self.state.last_index)[r]) >= cidx
+                            and int(self._fetch(
+                                self.state.log_term)[r, cslot]) == ent[1]
+                        )
+                    if not holds:
+                        self._pending_config = None
+                        self._apply_membership(np.array(old_mask, bool))
+                        self.nodelog(r, "uncommitted configuration rolled back")
+                kept_cfg = (
+                    self._pending_config[0]
+                    if self._pending_config is not None else None
+                )
                 self._seq_at_index = {
                     i: s for i, s in self._seq_at_index.items()
-                    if i <= self.commit_watermark
+                    if i <= self.commit_watermark or i == kept_cfg
                 }
                 # Drop ingest-buffer entries no replica's log still holds
                 # (every row's slot overwritten in a different term, or past
@@ -837,6 +872,20 @@ class RaftEngine:
         routed = self.leader_id == r
         eff = self._reach(r)
         take = min(len(self._queue), B) if routed else 0
+        step_member = None
+        if take:
+            for qi, (qseq, _) in enumerate(self._queue[:take]):
+                ch = self._config_seqs.get(qseq)
+                if ch is not None:
+                    # §4.1 append-time activation, for real: the step that
+                    # APPENDS a configuration entry must already decide
+                    # commits under the NEW configuration. Clamp the batch
+                    # so the entry is its last element and hand the device
+                    # step the new mask (host-side activation follows in
+                    # _note_config_ingest once the append is confirmed).
+                    take = qi + 1
+                    step_member = np.array(ch[1], bool)
+                    break
         if take == 0:
             if self._hb_payload is None:
                 self._hb_payload = jnp.zeros(
@@ -869,7 +918,8 @@ class RaftEngine:
             jnp.asarray(eff),
             jnp.asarray(self.slow),
             repair=self._repair_program(),
-            member=self._member_arg(),
+            member=(jnp.asarray(step_member) if step_member is not None
+                    else self._member_arg()),
         )
         max_term = int(info.max_term)
         if max_term > term:
@@ -896,13 +946,13 @@ class RaftEngine:
             self._queue = self._queue[ingested:]
         self._advance_commit(r, int(info.commit_index))
         if routed:
+            # heal bookkeeping and the shared steady flag belong to the
+            # routed leader only — a stale split-brain leader must not
+            # poison either with its own group's view
             if cfg.ec_enabled:
                 self._ec_heal(r, info)
             else:
                 self._snapshot_heal(r, info)
-        if routed:
-            # a stale split-brain leader must not poison the shared
-            # steady flag with its own group's view
             self._update_steady(r, info.match, eff)
         self._reset_heard_timers(r)
         self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)

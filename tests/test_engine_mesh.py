@@ -187,3 +187,46 @@ class TestMeshFallbackIsLoud:
             t = make_transport(cfg)
         assert isinstance(t, SingleDeviceTransport)
         assert any("falling back" in r.message for r in caplog.records)
+
+
+class TestMembershipOverMesh:
+    """Membership change with the replica axis sharded one row per
+    device: spare rows occupy devices from the start (static mesh), the
+    member mask + dynamic quorum ride shard_map as replicated inputs."""
+
+    def test_grow_and_shrink_on_virtual_mesh(self):
+        cfg = RaftConfig(
+            n_replicas=3, max_replicas=5, entry_bytes=ENTRY, batch_size=4,
+            log_capacity=256, transport="tpu_mesh", seed=11,
+        )
+        t = TpuMeshTransport(cfg, jax.devices()[: cfg.rows])
+        e = RaftEngine(cfg, t)
+        e.run_until_leader()
+        ps = payloads(6, seed=12)
+        seqs = [e.submit(p) for p in ps]
+        e.run_until_committed(seqs[-1])
+
+        s_add = e.add_server(3)
+        e.run_until_committed(s_add)
+        assert e.member[3] and int(e.member.sum()) == 4
+        mid = [e.submit(p) for p in payloads(4, seed=13)]
+        e.run_until_committed(mid[-1])
+        e.run_for(6 * cfg.heartbeat_period)     # joiner heals over the mesh
+        assert int(e.state.commit_index[3]) >= e.commit_watermark - 4
+
+        # 4-member quorum is 3: one dead member must not stall
+        e.fail((e.leader_id + 1) % 3)
+        probe = e.submit(payloads(1, seed=14)[0])
+        e.run_until_committed(probe)
+        e.recover((e.leader_id + 1) % 3)
+
+        s_rm = e.remove_server(3)
+        e.run_until_committed(s_rm)
+        assert not e.member[3] and int(e.member.sum()) == 3
+        tail = [e.submit(p) for p in payloads(2, seed=15)]
+        e.run_until_committed(tail[-1])
+        final = [bytes(p) for p in
+                 committed_payloads(e.state, e.leader_id)]
+        for r in range(3):
+            got = [bytes(p) for p in committed_payloads(e.state, r)]
+            assert got == final[: len(got)], f"replica {r}"

@@ -60,15 +60,24 @@ class TestConfigValidation:
 
     def test_one_change_at_a_time(self):
         cfg, e = mk(seed=1)
-        e.run_until_leader()
-        s1 = e.add_server(3)
-        # activation happens at the leader's next tick; until the entry
-        # commits a second change is refused
+        lead = e.run_until_leader()
+        # keep the entry from committing so the in-flight window is open:
+        # only the leader is reachable, quorum (3-of-4 post-activation)
+        # cannot form
+        others = [r for r in range(3) if r != lead]
+        e.partition([[lead, 3, 4], others])
+        e.add_server(3)
+        e.run_for(2 * cfg.heartbeat_period)   # leader tick appends it
+        assert e._pending_config is not None  # genuinely in flight
         with pytest.raises(RuntimeError, match="already in flight"):
-            e.run_for(2 * cfg.heartbeat_period)
-            if e._pending_config is None:     # committed already: force
-                raise RuntimeError("already in flight")  # vacuous guard
             e.add_server(4)
+        # heal: the change commits and a follow-up change is accepted
+        e.heal_partition()
+        e.run_for(6 * cfg.heartbeat_period)
+        assert e._pending_config is None and e.member[3]
+        s2 = e.add_server(4)
+        e.run_until_committed(s2)
+        assert int(e.member.sum()) == 5
 
     def test_bounds_and_duplicates(self):
         cfg, e = mk(seed=2)
@@ -222,3 +231,59 @@ class TestLifecycle:
         e2.fail((e2.leader_id + 1) % 3)
         probe = e2.submit(payloads(1, 73)[0])
         e2.run_until_committed(probe)
+
+
+class TestNewQuorumSemantics:
+    def test_config_entry_commits_under_new_majority(self):
+        """code-review r3: the step that APPENDS a config entry must
+        already decide commits under the NEW configuration — 2 acks (the
+        old 3-member majority) must NOT commit a 3->4 add whose new
+        majority is 3."""
+        cfg, e = mk(seed=8, rows=4)
+        lead = e.run_until_leader()
+        drain(e, payloads(3, 80))
+        f1 = next(r for r in range(3) if r != lead)
+        e.fail(f1)          # old members alive: leader + one follower
+        e.fail(3)           # the joining row is down too: 2 acks max
+        s_add = e.add_server(3)
+        e.run_for(6 * cfg.heartbeat_period)
+        assert e._pending_config is not None     # appended, activated...
+        assert not e.is_durable(s_add)           # ...but NOT committed
+        assert int(e.member.sum()) == 4
+        # a third member ack arrives: the new majority forms and commits
+        e.recover(f1)
+        e.run_until_committed(s_add)
+        assert e._pending_config is None
+
+    def test_winner_holding_config_entry_keeps_it(self):
+        """code-review r3: Raft uses the latest config entry IN THE LOG,
+        committed or not — a new leader whose log holds the in-flight
+        entry must keep the new configuration and commit it, not roll it
+        back."""
+        cfg, e = mk(seed=9, rows=4)
+        lead = e.run_until_leader()
+        drain(e, payloads(3, 90))
+        e.run_for(3 * cfg.heartbeat_period)      # everyone caught up
+        others = [r for r in range(3) if r != lead]
+        e.fail(others[1])                        # only one follower acks
+        e.fail(3)                                # joiner down: 2 acks max
+        s_add = e.add_server(3)
+        e.run_for(3 * cfg.heartbeat_period)      # appended on lead+others[0]
+        assert e._pending_config is not None
+        assert not e.is_durable(s_add)           # 3-of-4 quorum not met
+        e.fail(lead)
+        e.recover(others[1])
+        e.recover(3)
+        e.run_until_leader()
+        # the winner must be the follower that HOLDS the config entry
+        # (longest log wins the up-to-date check)
+        assert e.leader_id == others[0]
+        assert int(e.member.sum()) == 4, "held config entry rolled back"
+        assert e._pending_config is not None or e.is_durable(s_add)
+        # §5.4.2: the old-term entry commits transitively with the first
+        # current-term commit above it (the engine appends no term-start
+        # no-op — that would break byte-identical differentials)
+        post = [e.submit(p) for p in payloads(2, 91)]
+        e.run_until_committed(post[-1])
+        assert e.is_durable(s_add)               # committed under the winner
+        assert e.member[3] and e._pending_config is None
