@@ -205,8 +205,9 @@ class RaftEngine:
         self._config_seqs: Dict[int, Tuple[tuple, tuple]] = {}
         #   seq -> (old member mask, new member mask) for in-flight
         #   configuration-change entries (add_server / remove_server)
-        self._pending_config: Optional[Tuple[int, tuple, tuple]] = None
-        #   (log index, old mask, new mask) of the one uncommitted change
+        self._pending_config: Optional[Tuple[int, tuple, tuple, int]] = None
+        #   (log index, old mask, new mask, ingest term) of the one
+        #   uncommitted change
         self._fault_events: list = []              # FaultPlan merge targets
         self._next_seq = 1
         self._q: List[Tuple[float, int, str, int]] = []   # (t, tiebreak, kind, replica)
@@ -446,7 +447,7 @@ class RaftEngine:
                         idx += 1
                         self._seq_at_index[idx] = seq
                         self._uncommitted[idx] = (p, self.leader_term)
-                        self._note_config_ingest(idx, seq)
+                        self._note_config_ingest(idx, seq, self.leader_term)
                     else:
                         refused.append((seq, p))
                 pos += cnt
@@ -503,7 +504,12 @@ class RaftEngine:
             raise ValueError(
                 "membership change needs max_replicas headroom in RaftConfig"
             )
-        if self._pending_config is not None:
+        if self._pending_config is not None or any(
+            q in self._config_seqs for q, _ in self._queue
+        ):
+            # one at a time (dissertation §4.1's single-server rule) —
+            # including a change still queued before its ingest tick,
+            # whose mask capture would otherwise go stale
             raise RuntimeError(
                 "a configuration change is already in flight; one at a "
                 "time (dissertation §4.1's single-server rule)"
@@ -543,15 +549,17 @@ class RaftEngine:
             raise ValueError("cannot remove the last member")
         return self._change_membership(new)
 
-    def _note_config_ingest(self, idx: int, seq: int) -> None:
+    def _note_config_ingest(self, idx: int, seq: int, term: int) -> None:
         """A configuration entry reached the leader's log: activate the
         new configuration NOW (append-time activation, dissertation §4.1 —
         the entry then commits under the NEW majority)."""
-        ch = self._config_seqs.get(seq)
+        ch = self._config_seqs.pop(seq, None)   # consumed exactly once
         if ch is None:
             return
-        _, new = ch
-        self._pending_config = (idx, ch[0], new)
+        old, new = ch
+        self._pending_config = (idx, old, new, term)
+        #   (index, old mask, new mask, ingest term) — the term makes the
+        #   keep-if-held check self-contained across later elections
         self._apply_membership(np.array(new, bool))
 
     def _apply_membership(self, new: np.ndarray) -> None:
@@ -780,16 +788,13 @@ class RaftEngine:
                     # Completeness); only an entry the winner does NOT
                     # hold is rolled back (its seq never reads durable;
                     # the operator retries).
-                    cidx, old_mask, _ = self._pending_config
-                    ent = self._uncommitted.get(cidx)
-                    holds = False
-                    if ent is not None:
-                        cslot = (cidx - 1) % self.state.capacity
-                        holds = bool(
-                            int(self._fetch(self.state.last_index)[r]) >= cidx
-                            and int(self._fetch(
-                                self.state.log_term)[r, cslot]) == ent[1]
-                        )
+                    cidx, old_mask, _, cterm = self._pending_config
+                    cslot = (cidx - 1) % self.state.capacity
+                    holds = bool(
+                        int(self._fetch(self.state.last_index)[r]) >= cidx
+                        and int(self._fetch(
+                            self.state.log_term)[r, cslot]) == cterm
+                    )
                     if not holds:
                         self._pending_config = None
                         self._apply_membership(np.array(old_mask, bool))
@@ -883,8 +888,18 @@ class RaftEngine:
                     # so the entry is its last element and hand the device
                     # step the new mask (host-side activation follows in
                     # _note_config_ingest once the append is confirmed).
-                    take = qi + 1
-                    step_member = np.array(ch[1], bool)
+                    # If ring backpressure would REFUSE the append this
+                    # tick, the entry stays queued and the step keeps the
+                    # old mask — the new quorum must never govern a step
+                    # whose logs do not hold the entry.
+                    last0 = int(self._fetch(self.state.last_index)[r])
+                    commit0 = int(self._fetch(self.state.commit_index)[r])
+                    room = self.state.capacity - (last0 - commit0)
+                    if room >= qi + 1:
+                        take = qi + 1
+                        step_member = np.array(ch[1], bool)
+                    else:
+                        take = qi    # everything before the entry only
                     break
         if take == 0:
             if self._hb_payload is None:
@@ -942,7 +957,7 @@ class RaftEngine:
                 idx = last - ingested + 1 + i
                 self._seq_at_index[idx] = seq
                 self._uncommitted[idx] = (p, term)
-                self._note_config_ingest(idx, seq)
+                self._note_config_ingest(idx, seq, term)
             self._queue = self._queue[ingested:]
         self._advance_commit(r, int(info.commit_index))
         if routed:
@@ -993,7 +1008,7 @@ class RaftEngine:
         self.commit_watermark = commit
         self.nodelog(r, f"commit index changed to {commit}")
         if self._pending_config is not None and self._pending_config[0] <= commit:
-            idx, _, _ = self._pending_config
+            idx = self._pending_config[0]
             self._pending_config = None
             self.nodelog(r, f"configuration committed at {idx}")
             lead = self.leader_id

@@ -287,3 +287,59 @@ class TestNewQuorumSemantics:
         e.run_until_committed(post[-1])
         assert e.is_durable(s_add)               # committed under the winner
         assert e.member[3] and e._pending_config is None
+
+
+def test_partition_auto_isolates_spare_rows():
+    """code-review r3: a partition written over the visible members must
+    not crash on a headroom cluster — spare non-member rows are
+    auto-isolated."""
+    cfg, e = mk(seed=10)
+    lead = e.run_until_leader()
+    loner = (lead + 1) % 3
+    rest = [r for r in range(3) if r != loner]
+    e.partition([[loner], rest])          # rows 3, 4 not listed
+    assert not e.connectivity[loner, rest[0]]
+    assert not e.connectivity[3, 0]       # spares isolated, not crashed
+    e.heal_partition()
+    probe = e.submit(payloads(1, 100)[0])
+    e.run_until_committed(probe, limit=600.0)
+    # but a partition that omits an actual MEMBER is refused
+    with pytest.raises(ValueError, match="every member"):
+        e.partition([[0, 1]])
+
+
+class TestInFlightWindows:
+    def test_second_change_refused_before_ingest_tick(self):
+        """code-review r3: two changes submitted back-to-back before any
+        leader tick must not both capture masks — the second is refused
+        while the first is still queued."""
+        cfg, e = mk(seed=12)
+        e.run_until_leader()
+        e.add_server(3)                     # queued, not yet ingested
+        with pytest.raises(RuntimeError, match="already in flight"):
+            e.add_server(4)
+
+    def test_ring_backpressure_defers_config_entry_and_mask(self):
+        """code-review r3: when the ring cannot take the config entry,
+        the step must keep the OLD quorum — the new mask only ever
+        governs a step whose log holds the entry."""
+        cfg, e = mk(seed=13, rows=4, batch_size=4, log_capacity=8)
+        lead = e.run_until_leader()
+        others = [r for r in range(3) if r != lead]
+        for f in others:
+            e.fail(f)                       # commits stall: ring fills
+        for p in payloads(8, 130):
+            e.submit(p)
+        e.run_for(6 * cfg.heartbeat_period) # ring now full of uncommitted
+        assert e.in_flight_count == 8
+        s_add = e.add_server(3)
+        e.run_for(6 * cfg.heartbeat_period)
+        # the entry could not append: membership must NOT have activated
+        assert e._pending_config is None
+        assert int(e.member.sum()) == 3
+        assert not e.is_durable(s_add)
+        # backpressure clears: the entry appends, activates, commits
+        for f in others:
+            e.recover(f)
+        e.run_until_committed(s_add, limit=900.0)
+        assert int(e.member.sum()) == 4 and e.member[3]
