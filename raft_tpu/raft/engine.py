@@ -1226,8 +1226,19 @@ class RaftEngine:
                 lo = hi_rec + 1
             if lo <= leader_last:
                 idx = list(range(lo, leader_last + 1))
-                if any(i not in self._uncommitted for i in idx):
-                    continue  # suffix not servable (no buffer for it)
+                missing = [i for i in idx if i not in self._uncommitted]
+                if missing:
+                    # The host buffer lost these bytes across leadership
+                    # changes, but every replica whose CURRENT-term
+                    # verified match covers the suffix holds consistent
+                    # shards (Log Matching) — k of those reconstruct the
+                    # full entries and refill the buffer. Without this, a
+                    # single unservable index wedges the quorum forever
+                    # (found by the EC chaos sweep).
+                    self._refill_uncommitted_from_shards(leader, missing)
+                    missing = [i for i in idx if i not in self._uncommitted]
+                if missing:
+                    continue  # suffix not servable (no buffer, < k holders)
                 slots = (np.asarray(idx) - 1) % self.state.capacity
                 log_terms = self._fetch(self.state.log_term)[leader, slots]
                 if any(
@@ -1245,6 +1256,43 @@ class RaftEngine:
                     self.cfg.batch_size,
                 )
                 self.nodelog(p, f"suffix re-served to {leader_last}")
+
+    def _refill_uncommitted_from_shards(self, leader: int, indices) -> None:
+        """Rebuild lost ingest-buffer bytes for UNCOMMITTED indices from
+        k replicas whose current-term verified match covers them (their
+        shards are consistent with the leader's log by Log Matching).
+        Quietly does nothing when fewer than k such holders exist — the
+        caller's give-up path handles that."""
+        from raft_tpu.ec.reconstruct import reconstruct
+
+        k = self.cfg.rs_k
+        lo, hi = min(indices), max(indices)
+        matches = self._fetch(self.state.match_index)
+        mterms = self._fetch(self.state.match_term)
+        lasts = self._fetch(self.state.last_index)
+        donors = [
+            q for q in range(self.cfg.rows)
+            if self.alive[q] and self.connectivity[leader, q]
+            and int(mterms[q]) == self.leader_term
+            and int(matches[q]) >= hi
+            # the donor's ring must still HOLD the range: neither lapped
+            # (slot overwritten past one capacity) nor below its install
+            # floor — gather_shard_window itself checks nothing
+            and int(lasts[q]) - self.state.capacity + 1 <= lo
+            and int(self._ring_floor[q]) <= lo
+        ]
+        if len(donors) < k:
+            return
+        data = reconstruct(self.state, self._code, donors[:k], lo, hi)
+        slots = (np.arange(lo, hi + 1) - 1) % self.state.capacity
+        terms = self._fetch(self.state.log_term)[leader, slots]
+        for i in indices:
+            self._uncommitted[i] = (
+                data[i - lo].tobytes(), int(terms[i - lo])
+            )
+        self.nodelog(
+            leader, f"uncommitted suffix [{lo}, {hi}] rebuilt from shards"
+        )
 
     # ---------------------------------------------------- state machine
     def register_apply(
