@@ -1238,7 +1238,15 @@ class RaftEngine:
                     self._refill_uncommitted_from_shards(leader, missing)
                     missing = [i for i in idx if i not in self._uncommitted]
                 if missing:
-                    continue  # suffix not servable (no buffer, < k holders)
+                    # Still unservable. If an index's shards survive on
+                    # fewer than k rows ANYWHERE (dead included), its
+                    # bytes are gone for good and the whole suffix above
+                    # it can never commit — abandon it (it was never
+                    # acked durable) instead of wedging the quorum
+                    # forever. Otherwise a dead holder may recover: wait.
+                    if self._ec_abandon_lost_suffix(leader, missing):
+                        return
+                    continue  # transient: dead shard holders may recover
                 slots = (np.asarray(idx) - 1) % self.state.capacity
                 log_terms = self._fetch(self.state.log_term)[leader, slots]
                 if any(
@@ -1256,6 +1264,61 @@ class RaftEngine:
                     self.cfg.batch_size,
                 )
                 self.nodelog(p, f"suffix re-served to {leader_last}")
+
+    def _ec_abandon_lost_suffix(self, leader: int, missing) -> bool:
+        """Liveness escape for permanently unrecoverable UNCOMMITTED
+        entries: if some missing index's shards survive on fewer than k
+        rows in total (aliveness aside), RS decode can never rebuild its
+        bytes, no follower can ever pass the prev-check above it, and the
+        k+margin quorum is wedged for good. The leader abandons the
+        suffix from the first such index: truncates every row's tail
+        back, drops the mappings (those seqs read as lost — they were
+        never durable), and re-queues the dropped entries whose bytes the
+        host still holds so they commit at fresh indices. Returns True if
+        a truncation happened."""
+        cap = self.state.capacity
+        lasts = self._fetch(self.state.last_index)
+        lterms = self._fetch(self.state.log_term)
+        first_lost = None
+        for i in sorted(missing):
+            slot = (i - 1) % cap
+            want = int(lterms[leader, slot])
+            holders = sum(
+                1 for q in range(self.cfg.rows)
+                if int(lasts[q]) >= i
+                and int(lterms[q, slot]) == want
+                and int(lasts[q]) - cap + 1 <= i
+                and int(self._ring_floor[q]) <= i
+            )
+            if holders < self.cfg.rs_k:
+                first_lost = i
+                break
+        if first_lost is None:
+            return False
+        cut = first_lost - 1
+        old_last = int(lasts[leader])
+        # committed entries are never abandoned: the suffix range starts
+        # above the watermark by construction (caller's lo > hi_rec)
+        assert cut >= self.commit_watermark
+        requeue = []
+        for i in range(first_lost, old_last + 1):
+            ent = self._uncommitted.pop(i, None)
+            seq = self._seq_at_index.pop(i, None)
+            if ent is not None and seq is not None:
+                requeue.append((seq, ent[0]))
+        self._queue = requeue + self._queue
+        cut_arr = jnp.asarray(cut, self.state.last_index.dtype)
+        self.state = self.state.replace(
+            last_index=jnp.minimum(self.state.last_index, cut_arr),
+            match_index=jnp.minimum(self.state.match_index, cut_arr),
+        )
+        self.nodelog(
+            leader,
+            f"unrecoverable uncommitted suffix [{first_lost}, {old_last}] "
+            f"abandoned (< {self.cfg.rs_k} shard holders); "
+            f"{len(requeue)} entries re-queued",
+        )
+        return True
 
     def _refill_uncommitted_from_shards(self, leader: int, indices) -> None:
         """Rebuild lost ingest-buffer bytes for UNCOMMITTED indices from
