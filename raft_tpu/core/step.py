@@ -103,6 +103,21 @@ def replicate_step(
     slow: jax.Array,            # bool[R] fault mask: slow replicas receive but
     #                                     do not append (stale matchIndex,
     #                                     BASELINE config 4)
+    floor_prev_term: jax.Array | int = 0,  # i32[] attested term of entry
+    #   ``repair_floor - 1`` (from the host archive). A window whose prev
+    #   index falls below the floor must not read the prev term from the
+    #   leader's ring (those slots hold wrapped-generation junk whose tag
+    #   can collide); it uses this attested value instead. 0 = "cannot
+    #   attest": no follower's real entry carries term 0, so they stall
+    #   into snapshot install — safe, never wrong.
+    repair_floor: jax.Array | int = 0,  # i32[] lowest index the LEADER's
+    #   ring physically holds the true bytes for. A row that ever wrapped
+    #   its ring past committed slots and was later truncated (a deposed
+    #   minority leader healing back) keeps wrapped-generation bytes in
+    #   slots BELOW its truncated tail — with term tags that can collide.
+    #   The repair window must never serve from that region (followers
+    #   below it rejoin via snapshot install); the engine passes its
+    #   host-tracked ring-validity floor for the current leader.
     member: jax.Array | None = None,  # bool[R] current configuration
     #   (membership change). None = every row is a member and the commit
     #   quorum is the static ``commit_quorum``; an array makes the quorum
@@ -199,9 +214,16 @@ def replicate_step(
     )
 
     def leader_prev_term(lt, ws, prev_slot):
-        return jnp.where(
-            ws == 1, 0, comm.select_row(lt[:, prev_slot], leader)
+        ring_term = comm.select_row(lt[:, prev_slot], leader)
+        # prev index ws-1 below the leader's validity floor: the ring slot
+        # holds junk — use the attested term (see floor_prev_term). Both
+        # windows satisfy ws-1 >= floor-1, so "below" means exactly
+        # floor-1 and one attested scalar suffices.
+        attested = jnp.where(
+            ws - 1 < jnp.int32(repair_floor), jnp.int32(floor_prev_term),
+            ring_term,
         )
+        return jnp.where(ws == 1, 0, attested)
 
     def apply_window(carry, ws, count, win_p, win_t, prev_term, prev_slot,
                      force_leader_row=False):
@@ -285,6 +307,7 @@ def replicate_step(
         matches0 = comm.all_gather(m_eff)                  # i32[R]
         repair_mask = alive & ~slow
         horizon = jnp.maximum(leader_last - cap + 1, 1)
+        horizon = jnp.maximum(horizon, jnp.int32(repair_floor))
         repair_ws = jnp.maximum(
             jnp.min(jnp.where(repair_mask, matches0, leader_last0)) + 1,
             horizon,
@@ -397,7 +420,8 @@ def replicate_step(
 
 def scan_replicate(
     comm, ec, commit_quorum, repair, state, payloads, counts, leader,
-    leader_term, alive, slow, member=None,
+    leader_term, alive, slow, floor_prev_term=0, repair_floor=0,
+    member=None,
 ):
     """T replication steps as one compiled ``lax.scan`` — no host round-trip
     per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
@@ -408,7 +432,8 @@ def scan_replicate(
         payload, count = xs
         st, info = replicate_step(
             comm, st, payload, count, leader, leader_term, alive, slow,
-            member, ec=ec, commit_quorum=commit_quorum, repair=repair,
+            floor_prev_term, repair_floor, member, ec=ec,
+            commit_quorum=commit_quorum, repair=repair,
         )
         return st, info
 

@@ -169,6 +169,7 @@ class RaftEngine:
         #   served from it (raft_tpu.ckpt). Both snapshot consumers clamp
         #   their range to the last log_capacity entries, so the store
         #   compacts beyond 2x that instead of growing without bound.
+        self._lasts_snapshot = None   # see _pre_lasts
         self._ring_floor = np.ones(n, np.int64)
         #   Per-replica smallest log index whose ring slot is guaranteed to
         #   hold that entry's real bytes. Normally 1 (rings fill from
@@ -426,13 +427,18 @@ class RaftEngine:
                     T, B, -1
                 )
             eff = self._reach(r)
+            pre_lasts = self._pre_lasts()
+            floor, fpt = self._floor_attest(r)
             self.state, infos = self.t.replicate_many(
                 self.state, payload_stack, jnp.asarray(counts), r,
                 self.leader_term, jnp.asarray(eff),
                 jnp.asarray(self.slow),
                 repair=self._repair_program(),
                 member=self._member_arg(),
+                repair_floor=floor,
+                floor_prev_term=fpt,
             )
+            self._note_truncations(pre_lasts)
             # ---- one host sync for the whole chunk ----
             frontier = np.asarray(infos.frontier_len)
             max_term = int(np.max(np.asarray(infos.max_term)))
@@ -624,6 +630,51 @@ class RaftEngine:
         just-removed leader is the one non-member source; its row rides
         ingest_row on device, not this mask)."""
         return self.alive & self.connectivity[src] & self.member
+
+    def _pre_lasts(self):
+        """last_index as of the previous step's end — the cached copy
+        from _note_truncations when no host-side mutation touched
+        last_index since (installs/abandons invalidate it), else one
+        fresh fetch. Keeps truncation detection to a single extra sync
+        per step on the steady path."""
+        if self._lasts_snapshot is not None:
+            return self._lasts_snapshot
+        return self._fetch(self.state.last_index)
+
+    def _floor_attest(self, r: int):
+        """(repair_floor, attested term of floor-1) for leader ``r``.
+        The attested term comes from the archive — the device must not
+        read a below-floor ring slot for the prev-check (junk tags can
+        collide). 0 when unattestable: followers at the boundary then
+        stall into snapshot install rather than accept on a junk match."""
+        floor = int(self._ring_floor[r])
+        if floor <= 1:
+            return floor, 0
+        ent = self.store.get(floor - 1)
+        return floor, (ent[1] if ent is not None else 0)
+
+    def _note_truncations(self, pre_lasts) -> None:
+        """Bump a row's ring-validity floor when a step truncated its log
+        (§5.3 conflict). A row that ever wrapped its ring past committed
+        slots while leading (legal: committed = consumed) and is later
+        truncated keeps WRAPPED-GENERATION bytes in slots below its new
+        tail — with term tags that can collide with the true entries'.
+        Indices above ``pre_last - capacity`` were provably never
+        overwritten by that generation, so the floor lands at
+        ``pre_last - capacity + 1`` (<= commit+1 by the row's own ingest
+        backpressure, so snapshot installs always bridge the gap). Every
+        read path and the device repair window respect the floor; a
+        net-grown row needs no bump — its junk sits below the ordinary
+        lap horizon already."""
+        post = self._fetch(self.state.last_index)
+        shrunk = np.flatnonzero(post < np.asarray(pre_lasts))
+        for q in shrunk:
+            q = int(q)
+            self._ring_floor[q] = max(
+                self._ring_floor[q],
+                int(pre_lasts[q]) - self.state.capacity + 1,
+            )
+        self._lasts_snapshot = post
 
     def partition(self, groups) -> None:
         """Install a link-level partition: replicas exchange messages only
@@ -924,6 +975,8 @@ class RaftEngine:
                 self._pack_entries(self._queue[:take], take),
                 cfg.rows, B,
             )
+        pre_lasts = self._pre_lasts()
+        floor, fpt = self._floor_attest(r)
         self.state, info = self.t.replicate(
             self.state,
             payload,
@@ -935,7 +988,10 @@ class RaftEngine:
             repair=self._repair_program(),
             member=(jnp.asarray(step_member) if step_member is not None
                     else self._member_arg()),
+            repair_floor=floor,
+            floor_prev_term=fpt,
         )
+        self._note_truncations(pre_lasts)
         max_term = int(info.max_term)
         if max_term > term:
             # nothing was consumed from the queue: the device step refused
@@ -1128,6 +1184,7 @@ class RaftEngine:
         )
         # Only [lo, hi] was written; slots below keep whatever they held.
         self._ring_floor[replica] = max(self._ring_floor[replica], lo)
+        self._lasts_snapshot = None   # last_index changed outside a step
         self.nodelog(replica, f"snapshot installed to {hi}")
         return True
 
@@ -1145,7 +1202,10 @@ class RaftEngine:
         cap = self.state.capacity
         match = np.asarray(info.match)
         leader_last = int(self._fetch(self.state.last_index)[leader])
-        horizon = leader_last - cap + 1
+        # the repair window cannot serve below the leader's ring-validity
+        # floor either (truncated-after-wrap slots hold junk): such
+        # followers also need a snapshot install from the archive
+        horizon = max(leader_last - cap + 1, int(self._ring_floor[leader]))
         for p in range(self.cfg.rows):
             if (p == leader or not self.alive[p] or self.slow[p]
                     or not self.member[p]
@@ -1215,6 +1275,7 @@ class RaftEngine:
                         self.state, self._code, p, donors[:k], lo, hi_rec,
                         self.leader_term, hi_rec, self.cfg.batch_size,
                     )
+                    self._lasts_snapshot = None
                     self.nodelog(p, f"healed by reconstruction to {hi_rec}")
                 except ValueError:
                     # Below every donor's ring horizon: reconstruction would
@@ -1263,6 +1324,7 @@ class RaftEngine:
                     self.leader_term, self.commit_watermark,
                     self.cfg.batch_size,
                 )
+                self._lasts_snapshot = None
                 self.nodelog(p, f"suffix re-served to {leader_last}")
 
     def _ec_abandon_lost_suffix(self, leader: int, missing) -> bool:
@@ -1307,11 +1369,19 @@ class RaftEngine:
             if ent is not None and seq is not None:
                 requeue.append((seq, ent[0]))
         self._queue = requeue + self._queue
+        # this truncation happens outside a replicate step, so bump the
+        # ring-validity floors here (same rule as _note_truncations)
+        for q in range(self.cfg.rows):
+            if int(lasts[q]) > cut:
+                self._ring_floor[q] = max(
+                    self._ring_floor[q], int(lasts[q]) - cap + 1
+                )
         cut_arr = jnp.asarray(cut, self.state.last_index.dtype)
         self.state = self.state.replace(
             last_index=jnp.minimum(self.state.last_index, cut_arr),
             match_index=jnp.minimum(self.state.match_index, cut_arr),
         )
+        self._lasts_snapshot = None
         self.nodelog(
             leader,
             f"unrecoverable uncommitted suffix [{first_lost}, {old_last}] "

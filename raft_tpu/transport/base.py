@@ -49,6 +49,9 @@ class Transport(Protocol):
         alive,                       # bool[R]
         slow,                        # bool[R]
         repair: bool = True,         # static: repair-capable vs steady program
+        member=None,                 # bool[R] configuration (dynamic quorum)
+        repair_floor=0,              # i32 leader ring-validity floor
+        floor_prev_term=0,           # i32 attested term of floor-1
     ) -> Tuple[ReplicaState, RepInfo]:
         ...
 

@@ -74,39 +74,44 @@ class SingleDeviceTransport:
 
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
-        alive, slow, repair=True, member=None,
+        alive, slow, repair=True, member=None, repair_floor=0,
+        floor_prev_term=0,
     ) -> Tuple[ReplicaState, RepInfo]:
+        fpt = jnp.int32(floor_prev_term)
+        rf = jnp.int32(repair_floor)
         if self._member_mode:
             if member is None:
                 member = jnp.ones(self.cfg.rows, bool)
             return self._replicate[bool(repair)](
                 state, client_payload, jnp.int32(client_count),
                 jnp.int32(leader), jnp.int32(leader_term), alive, slow,
-                member,
+                fpt, rf, member,
             )
         return self._replicate[bool(repair)](
             state, client_payload, jnp.int32(client_count), jnp.int32(leader),
-            jnp.int32(leader_term), alive, slow,
+            jnp.int32(leader_term), alive, slow, fpt, rf,
         )
 
     def replicate_many(
         self, state, payloads, counts, leader, leader_term, alive, slow,
-        repair=True, member=None,
+        repair=True, member=None, repair_floor=0, floor_prev_term=0,
     ) -> Tuple[ReplicaState, RepInfo]:
         """T replication steps as one compiled ``lax.scan`` — no host
         round-trip per batch (SURVEY.md §7 hard part 1). ``payloads`` is
         i32[T, B, R*W] folded batches (core.state.fold_batch); ``counts``
         i32[T]."""
+        fpt = jnp.int32(floor_prev_term)
+        rf = jnp.int32(repair_floor)
         if self._member_mode:
             if member is None:
                 member = jnp.ones(self.cfg.rows, bool)
             return self._replicate_many[bool(repair)](
                 state, payloads, counts, jnp.int32(leader),
-                jnp.int32(leader_term), alive, slow, member,
+                jnp.int32(leader_term), alive, slow, fpt, rf, member,
             )
         return self._replicate_many[bool(repair)](
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
-            alive, slow,
+            alive, slow, fpt, rf,
         )
 
     def request_votes(

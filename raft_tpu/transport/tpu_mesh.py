@@ -108,6 +108,7 @@ class TpuMeshTransport:
                     mesh=self.mesh,
                     in_specs=(
                         state_specs, P(None, lanes), P(), P(), P(), P(), P(),
+                        P(), P(),
                     ) + mem_spec,
                     out_specs=(state_specs, info_specs),
                     check_vma=False,
@@ -134,7 +135,7 @@ class TpuMeshTransport:
                     mesh=self.mesh,
                     in_specs=(
                         state_specs, P(None, None, lanes),
-                        P(), P(), P(), P(), P(),
+                        P(), P(), P(), P(), P(), P(), P(),
                     ) + mem_spec,
                     out_specs=(state_specs, info_specs),
                     check_vma=False,
@@ -179,7 +180,8 @@ class TpuMeshTransport:
 
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
-        alive, slow, repair=True, member=None,
+        alive, slow, repair=True, member=None, repair_floor=0,
+        floor_prev_term=0,
     ) -> Tuple[ReplicaState, RepInfo]:
         extra = ()
         if self._member_mode:
@@ -187,12 +189,13 @@ class TpuMeshTransport:
                      else member,)
         return self._replicate[bool(repair)](
             state, client_payload, jnp.int32(client_count), jnp.int32(leader),
-            jnp.int32(leader_term), alive, slow, *extra,
+            jnp.int32(leader_term), alive, slow,
+            jnp.int32(floor_prev_term), jnp.int32(repair_floor), *extra,
         )
 
     def replicate_many(
         self, state, payloads, counts, leader, leader_term, alive, slow,
-        repair=True, member=None,
+        repair=True, member=None, repair_floor=0, floor_prev_term=0,
     ) -> Tuple[ReplicaState, RepInfo]:
         """i32[T, B, R*W] folded payloads → T steps in one compiled scan."""
         extra = ()
@@ -201,7 +204,8 @@ class TpuMeshTransport:
                      else member,)
         return self._replicate_many[bool(repair)](
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
-            alive, slow, *extra,
+            alive, slow, jnp.int32(floor_prev_term), jnp.int32(repair_floor),
+            *extra,
         )
 
     def request_votes(

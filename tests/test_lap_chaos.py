@@ -1,0 +1,113 @@
+"""Ring-lap chaos: tiny capacity + heavy traffic, so logs wrap repeatedly
+while the adversary (crashes, partitions, storms, membership changes)
+runs — exercising snapshot installs, archive compaction, and the
+truncated-after-wrap hazard.
+
+Seeds 22/25 reproduced a real byte-level safety bug this suite caught: a
+minority leader legally wraps its ring over committed slots; when it is
+later truncated back (§5.3) and heals, slots inside its "retained"
+window still hold wrapped-generation bytes whose term tags collide with
+the true entries', and verification fast-forwards over them — reads then
+served junk as committed data. The fix tracks a per-row ring-validity
+floor (bumped to ``pre_last - capacity + 1`` on any observed
+truncation), which every read path respects and which clamps the device
+repair window for the leader's ring (followers below it rejoin via
+snapshot install from the archive; the floor provably sits at most one
+past the row's own commit, so the install always bridges the gap).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import log_entries
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport import SingleDeviceTransport
+
+ENTRY = 16
+CAP = 32
+
+
+def run_lap_chaos(seed):
+    rng = random.Random(71000 + seed)
+    cfg = RaftConfig(
+        n_replicas=3, max_replicas=5, entry_bytes=ENTRY, batch_size=8,
+        log_capacity=CAP, transport="single", seed=seed,
+    )
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e.run_until_leader()
+    for _ in range(8):
+        for _ in range(rng.randrange(10, 30)):
+            e.submit(bytes(rng.getrandbits(8) for _ in range(ENTRY)))
+        action = rng.choice(["kill", "recover", "partition", "heal",
+                             "campaign", "add", "remove", "none"])
+        victim = rng.randrange(cfg.rows)
+        members = [r for r in range(cfg.rows) if e.member[r]]
+        dead = sum(1 for r in members if not e.alive[r])
+        partitioned = not e.connectivity.all()
+        if (action == "kill" and e.alive[victim] and e.member[victim]
+                and dead + 1 <= (len(members) - 1) // 2):
+            e.fail(victim)
+        elif action == "recover" and not e.alive[victim]:
+            e.recover(victim)
+        elif action == "partition" and not partitioned:
+            cut = rng.sample(members, 1)
+            e.partition([cut, [r for r in range(cfg.rows) if r not in cut]])
+        elif action == "heal" and partitioned:
+            e.heal_partition()
+        elif action == "campaign":
+            e.force_campaign(victim)
+        elif action == "add":
+            spares = [r for r in range(cfg.rows) if not e.member[r]]
+            if (spares and e._pending_config is None and not partitioned
+                    and dead == 0 and e.leader_id is not None):
+                try:
+                    e.add_server(spares[0])
+                except RuntimeError:
+                    pass
+        elif action == "remove":
+            cands = [r for r in members if r != e.leader_id and e.alive[r]]
+            if (len(members) > 3 and cands and not partitioned and dead == 0
+                    and e._pending_config is None
+                    and e.leader_id is not None):
+                try:
+                    e.remove_server(rng.choice(cands))
+                except RuntimeError:
+                    pass
+        e.run_for(40.0)
+    e.heal_partition()
+    for r in range(cfg.rows):
+        if not e.alive[r]:
+            e.recover(r)
+        e.set_slow(r, False)
+    probe = e.submit(bytes(ENTRY))
+    e.run_until_committed(probe, limit=1200.0)
+    e.run_for(6 * cfg.heartbeat_period)
+    return e
+
+
+# 22/25 are the pre-fix divergence reproducers
+@pytest.mark.parametrize("seed", [0, 5, 22, 25])
+def test_ring_bytes_match_archive_after_lap_chaos(seed):
+    e = run_lap_chaos(seed)
+    assert e.commit_watermark > CAP, "ring never lapped — schedule too light"
+    lasts = np.asarray(e.state.last_index)
+    commits = np.asarray(e.state.commit_index)
+    wm = e.commit_watermark
+    checked = 0
+    for r in range(e.cfg.rows):
+        hi = min(int(commits[r]), wm)
+        lo = max(1, int(lasts[r]) - CAP + 1, int(e._ring_floor[r]))
+        if hi < lo:
+            continue
+        got = log_entries(e.state, r, lo, hi)
+        for i in range(lo, hi + 1):
+            ent = e.store.get(i)
+            if ent is not None:
+                assert ent[0] == got[i - lo].tobytes(), (
+                    f"replica {r} serves wrong bytes for committed {i}"
+                )
+                checked += 1
+    assert checked > 0
