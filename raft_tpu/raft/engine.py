@@ -927,6 +927,11 @@ class RaftEngine:
         B = cfg.batch_size
         routed = self.leader_id == r
         eff = self._reach(r)
+        if routed:
+            # must run BEFORE the batch is taken from the queue: it may
+            # prepend re-queued entries, and the post-step bookkeeping
+            # maps self._queue[:ingested] to the appended indices
+            self._make_room_for_current_term(r, term)
         take = min(len(self._queue), B) if routed else 0
         step_member = None
         if take:
@@ -1027,6 +1032,70 @@ class RaftEngine:
             self._update_steady(r, info.match, eff)
         self._reset_heard_timers(r)
         self._push(self.clock.now + cfg.heartbeat_period, "l:x", r)
+
+    def _truncate_uncommitted_tail(self, cut: int, lasts) -> int:
+        """Shared truncation machinery: drop every row's uncommitted
+        entries above ``cut`` (re-queuing the bytes the host still holds
+        so they commit at fresh indices), bump ring-validity floors for
+        every truncated row, clamp device last/match everywhere, and
+        invalidate the lasts cache. ``lasts`` is the pre-truncation
+        last_index vector. Returns the number of re-queued entries.
+        Callers guarantee cut >= commit_watermark (never touches
+        committed entries)."""
+        assert cut >= self.commit_watermark
+        cap = self.state.capacity
+        old_max = int(np.max(np.asarray(lasts)))
+        requeue = []
+        for i in range(cut + 1, old_max + 1):
+            ent = self._uncommitted.pop(i, None)
+            seq = self._seq_at_index.pop(i, None)
+            if ent is not None and seq is not None:
+                requeue.append((seq, ent[0]))
+        self._queue = requeue + self._queue
+        for q in range(self.cfg.rows):
+            if int(lasts[q]) > cut:
+                self._ring_floor[q] = max(
+                    self._ring_floor[q], int(lasts[q]) - cap + 1
+                )
+        cut_arr = jnp.asarray(cut, self.state.last_index.dtype)
+        self.state = self.state.replace(
+            last_index=jnp.minimum(self.state.last_index, cut_arr),
+            match_index=jnp.minimum(self.state.match_index, cut_arr),
+        )
+        self._lasts_snapshot = None
+        self._steady = False
+        return len(requeue)
+
+    def _make_room_for_current_term(self, r: int, term: int) -> None:
+        """Escape the bounded-log §5.4.2 deadlock: when the ring is FULL
+        of uncommitted OLD-term entries, nothing can commit (only
+        current-term entries commit directly) and nothing can be appended
+        (no room) — a wedge standard Raft avoids with a term-start no-op,
+        which this engine skips to keep committed logs byte-identical to
+        the oracle. The leader truncates one batch of its never-acked
+        tail cluster-wide (every row's verified match clamps with it, so
+        stale matches over the old tail can never count toward a commit
+        of the replacement entries) and re-queues the bytes it still
+        holds; they commit at fresh indices under the current term.
+        Safety: the dropped entries were uncommitted and no client ever
+        saw them durable."""
+        cap = self.state.capacity
+        lasts = self._pre_lasts()
+        last = int(lasts[r])
+        if last - self.commit_watermark < cap:
+            return                        # room exists: no deadlock
+        tail_term = int(
+            self._fetch(self.state.log_term)[r, (last - 1) % cap]
+        )
+        if tail_term >= term:
+            return                        # current-term tail commits normally
+        drop = min(self.cfg.batch_size, last - self.commit_watermark)
+        cut = last - drop
+        n = self._truncate_uncommitted_tail(cut, lasts)
+        self.nodelog(
+            r, f"old-term tail ({cut}, {last}] truncated to unwedge "
+            f"the full ring; {n} entries re-queued"
+        )
 
     def _repair_program(self) -> bool:
         """Which step program the next replicate runs: the repair-capable
@@ -1359,34 +1428,12 @@ class RaftEngine:
             return False
         cut = first_lost - 1
         old_last = int(lasts[leader])
-        # committed entries are never abandoned: the suffix range starts
-        # above the watermark by construction (caller's lo > hi_rec)
-        assert cut >= self.commit_watermark
-        requeue = []
-        for i in range(first_lost, old_last + 1):
-            ent = self._uncommitted.pop(i, None)
-            seq = self._seq_at_index.pop(i, None)
-            if ent is not None and seq is not None:
-                requeue.append((seq, ent[0]))
-        self._queue = requeue + self._queue
-        # this truncation happens outside a replicate step, so bump the
-        # ring-validity floors here (same rule as _note_truncations)
-        for q in range(self.cfg.rows):
-            if int(lasts[q]) > cut:
-                self._ring_floor[q] = max(
-                    self._ring_floor[q], int(lasts[q]) - cap + 1
-                )
-        cut_arr = jnp.asarray(cut, self.state.last_index.dtype)
-        self.state = self.state.replace(
-            last_index=jnp.minimum(self.state.last_index, cut_arr),
-            match_index=jnp.minimum(self.state.match_index, cut_arr),
-        )
-        self._lasts_snapshot = None
+        n = self._truncate_uncommitted_tail(cut, lasts)
         self.nodelog(
             leader,
             f"unrecoverable uncommitted suffix [{first_lost}, {old_last}] "
             f"abandoned (< {self.cfg.rs_k} shard holders); "
-            f"{len(requeue)} entries re-queued",
+            f"{n} entries re-queued",
         )
         return True
 

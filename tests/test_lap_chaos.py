@@ -111,3 +111,72 @@ def test_ring_bytes_match_archive_after_lap_chaos(seed):
                 )
                 checked += 1
     assert checked > 0
+
+
+def run_ec_lap_chaos(seed):
+    """RS(5,3) with capacity 32 and heavy traffic: EC heal + snapshot
+    installs + the full-ring §5.4.2 escape under the adversary."""
+    rng = random.Random(81000 + seed)
+    cfg = RaftConfig(
+        n_replicas=5, rs_k=3, rs_m=2, entry_bytes=12, batch_size=8,
+        log_capacity=CAP, transport="single", seed=seed,
+    )
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e.run_until_leader()
+    partitioned = False
+    for _ in range(8):
+        for _ in range(rng.randrange(10, 30)):
+            e.submit(bytes(rng.getrandbits(8) for _ in range(12)))
+        action = rng.choice(["kill", "recover", "slow", "unslow",
+                             "campaign", "partition", "heal", "none"])
+        victim = rng.randrange(5)
+        if action == "kill":
+            if e.alive[victim] and int((~e.alive).sum()) < 1:
+                e.fail(victim)
+        elif action == "recover":
+            if not e.alive[victim]:
+                e.recover(victim)
+        elif action == "slow":
+            if e.alive[victim] and not e.slow.any():
+                e.set_slow(victim, True)
+        elif action == "unslow":
+            e.set_slow(victim, False)
+        elif action == "campaign":
+            e.force_campaign(victim)
+        elif action == "partition" and not partitioned:
+            cut = [rng.randrange(5)]
+            e.partition([cut, [r for r in range(5) if r not in cut]])
+            partitioned = True
+        elif action == "heal" and partitioned:
+            e.heal_partition()
+            partitioned = False
+        e.run_for(40.0)
+    e.heal_partition()
+    for r in range(5):
+        if not e.alive[r]:
+            e.recover(r)
+        e.set_slow(r, False)
+    probe = e.submit(bytes(12))
+    e.run_until_committed(probe, limit=1800.0)
+    e.run_for(6 * cfg.heartbeat_period)
+    return e
+
+
+# 12/14/23/29 reproduced the bounded-log §5.4.2 deadlock: a ring FULL of
+# uncommitted old-term entries can neither commit (no current-term entry
+# on top) nor append one (no room) — until _make_room_for_current_term
+# truncates a never-acked tail batch and re-queues its bytes
+@pytest.mark.parametrize("seed", [12, 14, 23, 29])
+def test_ec_full_ring_old_term_deadlock_escapes(seed):
+    e = run_ec_lap_chaos(seed)
+    assert e.commit_watermark > CAP
+    wm = e.commit_watermark
+    lo = max(1, wm - CAP + 1, int(max(e._ring_floor[:5])))
+    try:
+        got = e.committed_entries(lo, wm)
+        for i in range(lo, wm + 1):
+            ent = e.store.get(i)
+            if ent is not None:
+                assert ent[0] == got[i - lo].tobytes(), f"idx {i}"
+    except ValueError:
+        pass   # no eligible read quorum at quiescence: refusal is legal
