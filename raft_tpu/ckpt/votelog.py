@@ -66,6 +66,23 @@ class VoteLog:
                     f"{path} exists but is not a vote log (bad header); "
                     "refusing to append unreadable durability records"
                 )
+        if size > 0:
+            # A crash mid-append can leave a torn trailing record. Replay
+            # ignores it — but appending AFTER it would start every new
+            # record at a misaligned offset, and replay's fixed 16-byte
+            # framing would then parse across the torn boundary, silently
+            # garbling every subsequent fsync'd record: the exact
+            # double-vote hazard this log exists to prevent. Trim to the
+            # last whole-record boundary before appending.
+            aligned = (
+                len(_MAGIC)
+                + ((size - len(_MAGIC)) // _REC.size) * _REC.size
+            )
+            if aligned != size:
+                with open(path, "r+b") as f:
+                    f.truncate(aligned)
+                    f.flush()
+                    os.fsync(f.fileno())
         self._f = open(path, "ab" if size > 0 else "wb")
         if size == 0:
             self._f.write(_MAGIC)

@@ -28,20 +28,44 @@ cannot overlap, and ``C % B == 0`` so the rotation offset
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+# CI hook (VERDICT r3 #2): route kernel-eligible shapes through the Pallas
+# path in INTERPRET mode on non-TPU backends, so the kernel's composition
+# with shard_map mesh programs is exercised before real multi-chip hardware
+# runs it. Enabled per-process by env (survives the dryrun re-exec) or
+# per-test by force_pallas_interpret().
+_force_interpret = bool(os.environ.get("RAFT_TPU_PALLAS_INTERPRET"))
+
+
+def force_pallas_interpret(on: bool) -> None:
+    """Route ``_pallas_ok`` shapes through the Pallas kernels in interpret
+    mode on non-TPU backends (CI composition testing)."""
+    global _force_interpret
+    _force_interpret = on
+
+
+def pallas_interpret() -> bool:
+    """Whether Pallas calls on the current backend must run in interpret
+    mode (any backend without a Mosaic compiler — i.e. everything but
+    TPU)."""
+    return jax.default_backend() != "tpu"
+
 
 def _pallas_ok(C: int, B: int) -> bool:
-    """Whether the Pallas TPU window-write kernel serves this shape: a
-    TPU backend and 128-row blocks dividing both the window and the ring
-    (the term buffer's column blocks put the block size in the LANE
-    dimension, which Mosaic requires to be a multiple of 128). Everything
-    else uses the XLA reference formulation below."""
-    if jax.default_backend() != "tpu":
+    """Whether the Pallas window-write kernel serves this shape: 128-row
+    blocks dividing both the window and the ring (the term buffer's
+    column blocks put the block size in the LANE dimension, which Mosaic
+    requires to be a multiple of 128), on a TPU backend — or anywhere in
+    interpret mode when forced (see above). Everything else uses the XLA
+    reference formulation below."""
+    if B % 128 or C % 128:
         return False
-    return B % 128 == 0 and C % 128 == 0
+    return jax.default_backend() == "tpu" or _force_interpret
 
 
 def _rot(win2: jax.Array, s: jax.Array, base: jax.Array, B: int,
@@ -85,7 +109,9 @@ def write_window_cols(buf: jax.Array, win: jax.Array, s: jax.Array,
         # (core.ring_pallas; pinned to this XLA path by tests).
         from raft_tpu.core.ring_pallas import write_window_cols_tpu
 
-        return write_window_cols_tpu(buf, win, s, count, lane_sel)
+        return write_window_cols_tpu(
+            buf, win, s, count, lane_sel, interpret=pallas_interpret()
+        )
     return write_window_cols_xla(buf, win, s, count, lane_sel)
 
 

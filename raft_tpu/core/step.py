@@ -259,11 +259,12 @@ def replicate_step(
             # (core.ring_pallas) — the XLA formulation below splits into
             # a window read, compare+reduce, cond + DUS ops and staging
             # copies (~8 us of the headline step; docs/PERF.md).
+            from raft_tpu.core.ring import pallas_interpret
             from raft_tpu.core.ring_pallas import write_window_both_tpu
 
             log_payload, log_term, mm = write_window_both_tpu(
                 log_payload, log_term, win_p, win_t, start_slot, count,
-                ws, accept, last_index,
+                ws, accept, last_index, interpret=pallas_interpret(),
             )
             any_mm = mm[0] != 0                            # bool[L]
         else:
@@ -362,13 +363,24 @@ def replicate_step(
     # match vector, restricted to current-term entries (§5.4.2).
     if member is None:
         quorum = commit_quorum
+        ack_mask = alive
     else:
         mcount = jnp.sum(member.astype(jnp.int32))
         quorum = mcount // 2 + 1
         if ec and commit_quorum is not None:
             # EC durability floor (k + margin shard-holders) is static
             quorum = jnp.maximum(quorum, commit_quorum)
-    match = jnp.where(alive, comm.all_gather(m_eff), 0)    # i32[R]
+        # Only MEMBERS of the configuration the quorum is counted over may
+        # contribute acks. The engine builds `alive` from the membership it
+        # held when the tick started, but the step that APPENDS a config
+        # entry runs under the NEW mask (append-time activation) while
+        # `alive` still reflects the OLD one — without this mask a
+        # just-removed server's ack (or a removed-but-still-leading
+        # server's own row, dissertation §4.2.2) counts toward the new
+        # configuration's majority, committing entries a new-config
+        # majority need not hold (a Leader Completeness violation).
+        ack_mask = alive & member
+    match = jnp.where(ack_mask, comm.all_gather(m_eff), 0)    # i32[R]
     commit_cand = commit_from_match(match, quorum)
     cand_slot = slot_of(jnp.maximum(commit_cand, 1), cap)
     cand_term = comm.select_row(log_term[:, cand_slot], leader)

@@ -458,13 +458,15 @@ class RaftEngine:
                         refused.append((seq, p))
                 pos += cnt
             pending = refused + pending[take:]
-            self._advance_commit(r, final_commit)
-            self._update_steady(r, infos.match[-1], eff)
-            # keep the host term mirror in step with on-device adoption
-            # (same sync as the tick path) so post-failover campaigns and
-            # nodelog lines start from the real term
+            # Durability fence FIRST (same ordering as the tick path): the
+            # chunk's term adoptions reach disk before any externally
+            # observable action — _advance_commit archives entries and
+            # advances the durability-visible watermark (ckpt.votelog:
+            # "persist between the step and any such action").
             self.terms[eff] = np.maximum(self.terms[eff], self.leader_term)
             self._persist_votes()
+            self._advance_commit(r, final_commit)
+            self._update_steady(r, infos.match[-1], eff)
             if max_term > self.leader_term:
                 # deposed mid-chunk: hand the rest back to the queue
                 self._step_down_leader(r, max_term)
@@ -567,6 +569,16 @@ class RaftEngine:
         #   (index, old mask, new mask, ingest term) — the term makes the
         #   keep-if-held check self-contained across later elections
         self._apply_membership(np.array(new, bool))
+
+    def _rollback_pending_config(self, r: int, reason: str) -> None:
+        """Roll an in-flight (uncommitted) configuration change back to
+        its old mask — the entry no longer survives in the relevant log
+        (election winner doesn't hold it / truncation removed it from
+        every row). Its seq never reads durable; the operator retries."""
+        _, old_mask, _, _ = self._pending_config
+        self._pending_config = None
+        self._apply_membership(np.array(old_mask, bool))
+        self.nodelog(r, reason)
 
     def _apply_membership(self, new: np.ndarray) -> None:
         added = new & ~self.member
@@ -847,9 +859,9 @@ class RaftEngine:
                             self.state.log_term)[r, cslot]) == cterm
                     )
                     if not holds:
-                        self._pending_config = None
-                        self._apply_membership(np.array(old_mask, bool))
-                        self.nodelog(r, "uncommitted configuration rolled back")
+                        self._rollback_pending_config(
+                            r, "uncommitted configuration rolled back"
+                        )
                 kept_cfg = (
                     self._pending_config[0]
                     if self._pending_config is not None else None
@@ -1045,11 +1057,29 @@ class RaftEngine:
         assert cut >= self.commit_watermark
         cap = self.state.capacity
         old_max = int(np.max(np.asarray(lasts)))
+        # An in-flight configuration entry inside the truncated range is
+        # leaving EVERY row's log (last_index clamps to cut below). Raft's
+        # rule — a server uses the latest configuration entry in its log —
+        # then demands the previous configuration: roll the membership
+        # back and drop the RCFG bytes (its seq reads as lost, like the
+        # campaign holds-check rollback; the operator retries). Re-queuing
+        # them as a plain data entry would leave ``_pending_config``
+        # pointing at an index a DIFFERENT entry later occupies, and
+        # ``_advance_commit`` would then "commit" the configuration off
+        # the wrong entry.
+        cfg_idx = None
+        if self._pending_config is not None and \
+                cut < self._pending_config[0] <= old_max:
+            cfg_idx = self._pending_config[0]
+            self._rollback_pending_config(
+                self.leader_id if self.leader_id is not None else 0,
+                "uncommitted configuration rolled back (entry truncated)",
+            )
         requeue = []
         for i in range(cut + 1, old_max + 1):
             ent = self._uncommitted.pop(i, None)
             seq = self._seq_at_index.pop(i, None)
-            if ent is not None and seq is not None:
+            if ent is not None and seq is not None and i != cfg_idx:
                 requeue.append((seq, ent[0]))
         self._queue = requeue + self._queue
         for q in range(self.cfg.rows):

@@ -343,3 +343,76 @@ class TestInFlightWindows:
             e.recover(f)
         e.run_until_committed(s_add, limit=900.0)
         assert int(e.member.sum()) == 4 and e.member[3]
+
+
+class TestAdviceR3:
+    def test_removed_member_ack_does_not_count(self):
+        """ADVICE r3 (high): the step that appends a config entry counts
+        commits under the NEW configuration's majority — so an ack from
+        the row being REMOVED must not count toward it. Otherwise
+        {leader, removed} could commit the entry while only the leader
+        of the new 3-member config holds it, and a later new-config
+        majority election could elect a leader without it (a Leader
+        Completeness violation)."""
+        cfg, e = mk(seed=14, n=4, rows=4)
+        lead = e.run_until_leader()
+        drain(e, payloads(3, 140))          # everyone's match caught up
+        others = [r for r in range(4) if r != lead]
+        victim = others[0]
+        for r in others[1:]:
+            e.set_slow(r, True)             # receive but never append
+        s_rm = e.remove_server(victim)
+        e.run_for(6 * cfg.heartbeat_period)
+        # available acks: leader + victim. The victim is not a member of
+        # the new 3-member config (quorum 2): the entry must NOT commit.
+        assert e._pending_config is not None
+        assert not e.is_durable(s_rm)
+        assert int(e.member.sum()) == 3     # activated at append time
+        for r in others[1:]:
+            e.set_slow(r, False)            # real members ack now
+        e.run_until_committed(s_rm)
+        assert e._pending_config is None
+
+    def test_truncated_config_entry_rolls_back(self):
+        """ADVICE r3 (medium): _make_room_for_current_term truncating an
+        in-flight configuration entry must roll the membership back (the
+        entry leaves every log), not re-queue its bytes as a plain data
+        entry while _pending_config points at an index a different entry
+        later occupies."""
+        cfg, e = mk(seed=15, rows=4, batch_size=4, log_capacity=8)
+        lead = e.run_until_leader()
+        others = [r for r in range(3) if r != lead]
+        for f in others:
+            e.fail(f)                       # commits stall: ring fills
+        for p in payloads(7, 150):
+            e.submit(p)
+        e.run_for(6 * cfg.heartbeat_period)
+        e.fail(3)                           # joiner down: no ack from it
+        s_add = e.add_server(3)
+        e.run_for(3 * cfg.heartbeat_period)  # entry at index 8: ring FULL
+        assert e._pending_config is not None
+        assert int(e.member.sum()) == 4
+        # a disruptive candidacy bumps the cluster term; the leader is
+        # deposed and re-elected in a higher term over a ring still full
+        # of old-term uncommitted entries -> _make_room_for_current_term
+        # truncates a batch off the tail, which includes the entry
+        for f in others:
+            e.recover(f)
+            e.set_slow(f, True)             # they vote but never ack
+        e.force_campaign(others[0])
+        e.run_for(2 * cfg.heartbeat_period)  # deposed on its next tick
+        e.run_until_leader()                 # re-elected in a higher term
+        e.run_for(6 * cfg.heartbeat_period)  # make-room truncation fires
+        assert e._pending_config is None
+        assert int(e.member.sum()) == 3, \
+            "truncated config entry left the new membership active"
+        assert not e.is_durable(s_add)
+        # the cluster keeps working and the change can be retried
+        for f in others:
+            e.set_slow(f, False)
+        probe = e.submit(payloads(1, 151)[0])
+        e.run_until_committed(probe, limit=900.0)
+        e.recover(3)
+        s2 = e.add_server(3)
+        e.run_until_committed(s2, limit=900.0)
+        assert int(e.member.sum()) == 4 and e.member[3]

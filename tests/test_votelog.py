@@ -186,3 +186,46 @@ class TestHeaderIntegrity:
         vl2.record_many([(0, 6, 2)])
         vl2.close()
         assert VoteLog.replay(p) == {2: (5, 0), 0: (6, 2)}
+
+
+class TestAdviceR3:
+    def test_torn_record_trimmed_on_reopen(self, tmp_path):
+        """ADVICE r3 (medium): reopening a log whose tail is a torn
+        record must trim to the last whole-record boundary — appending
+        after the torn bytes would misalign every later record and
+        replay's fixed framing would silently garble them (defeating the
+        double-vote protection the log exists for)."""
+        p = str(tmp_path / "v.log")
+        vl = VoteLog(p)
+        vl.record_many([(0, 5, 1)])
+        vl.close()
+        with open(p, "ab") as f:
+            f.write(b"\x01\x02\x03")          # crash mid-append
+        vl = VoteLog(p)                        # reopen after the crash
+        vl.record_many([(1, 7, 0)])
+        vl.close()
+        assert VoteLog.replay(p) == {0: (5, 1), 1: (7, 0)}
+
+    def test_submit_pipelined_persists_before_commit(self, tmp_path):
+        """ADVICE r3 (low): the chunk-sync block persists the chunk's
+        term adoptions BEFORE _advance_commit makes anything externally
+        observable — the same fence ordering as the tick path."""
+        cfg, e = mk(seed=3, vote_log=str(tmp_path / "v.log"))
+        e.run_until_leader()
+        order = []
+        real_persist, real_adv = e._persist_votes, e._advance_commit
+
+        def spy_persist(*a, **k):
+            order.append("persist")
+            return real_persist(*a, **k)
+
+        def spy_adv(*a, **k):
+            order.append("commit")
+            return real_adv(*a, **k)
+
+        e._persist_votes, e._advance_commit = spy_persist, spy_adv
+        seqs = e.submit_pipelined(payloads(8, 30))
+        e._persist_votes, e._advance_commit = real_persist, real_adv
+        assert "persist" in order and "commit" in order
+        assert order.index("persist") < order.index("commit")
+        e.run_until_committed(seqs[-1])
