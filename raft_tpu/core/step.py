@@ -129,6 +129,13 @@ def replicate_step(
     ec: bool = False,
     commit_quorum: int | None = None,
     repair: bool = True,
+    term_floor: jax.Array | int | None = None,  # i32[] first log index of
+    #   the leader's CURRENT term (engine-maintained: set at election,
+    #   clamped at truncation). When provided on the steady (repair=False)
+    #   non-EC resident layout at a kernel-eligible shape, the WHOLE step
+    #   runs as one fused Pallas program (core.step_pallas) using
+    #   ``commit_cand >= term_floor`` as the §5.4.2 gate — equivalent to
+    #   the ring-read formulation below. None = general path.
 ) -> tuple[ReplicaState, RepInfo]:
     """One leader tick: ingest + repair + replicate + quorum commit, on device.
 
@@ -162,6 +169,26 @@ def replicate_step(
     cap = state.capacity
     B = client_payload.shape[0]
     M = client_payload.shape[1]                    # L * W folded lanes
+    from raft_tpu.core.comm import SingleDeviceComm
+
+    if (
+        term_floor is not None and (not repair or ec)
+        and isinstance(comm, SingleDeviceComm) and _pallas_ok(cap, B)
+    ):
+        # The EC program has no repair window (shards are healed by
+        # reconstruction, not log windows), so its structure IS the steady
+        # program's — the pre-encoded shard batch rides the same fused
+        # kernel regardless of the repair dispatch flag.
+        from raft_tpu.core.ring import pallas_interpret
+        from raft_tpu.core.step_pallas import steady_replicate_step_tpu
+
+        return steady_replicate_step_tpu(
+            state, client_payload, jnp.int32(client_count),
+            jnp.int32(leader), jnp.int32(leader_term), alive, slow,
+            jnp.int32(floor_prev_term), jnp.int32(repair_floor), member,
+            jnp.int32(term_floor), commit_quorum=commit_quorum,
+            interpret=pallas_interpret(),
+        )
     ids = comm.replica_ids()                       # i32[L]
     L = ids.shape[0]
     W = M // L                                     # i32 lanes per replica
@@ -433,12 +460,30 @@ def replicate_step(
 def scan_replicate(
     comm, ec, commit_quorum, repair, state, payloads, counts, leader,
     leader_term, alive, slow, floor_prev_term=0, repair_floor=0,
-    member=None,
+    member=None, term_floor=None,
 ):
     """T replication steps as one compiled ``lax.scan`` — no host round-trip
     per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
     ``payloads``: i32[T, B, L*W] folded batches; ``counts``: i32[T];
     ``repair`` selects the repair-capable vs steady-state step program."""
+    from raft_tpu.core.comm import SingleDeviceComm
+
+    cap, B = state.capacity, payloads.shape[1]
+    if (
+        term_floor is not None and (not repair or ec)
+        and isinstance(comm, SingleDeviceComm) and _pallas_ok(cap, B)
+    ):
+        # fused whole-step program with the packed state-vector carry —
+        # pack/unpack and mask setup once per scan (core.step_pallas)
+        from raft_tpu.core.ring import pallas_interpret
+        from raft_tpu.core.step_pallas import steady_scan_replicate_tpu
+
+        return steady_scan_replicate_tpu(
+            state, payloads, counts, jnp.int32(leader),
+            jnp.int32(leader_term), alive, slow, jnp.int32(floor_prev_term),
+            jnp.int32(repair_floor), member, jnp.int32(term_floor),
+            commit_quorum=commit_quorum, interpret=pallas_interpret(),
+        )
 
     def body(st, xs):
         payload, count = xs
@@ -446,6 +491,12 @@ def scan_replicate(
             comm, st, payload, count, leader, leader_term, alive, slow,
             floor_prev_term, repair_floor, member, ec=ec,
             commit_quorum=commit_quorum, repair=repair,
+            # intentionally NOT forwarding term_floor: the fused per-step
+            # dispatch guard is identical to the scan-level one above, so
+            # it could only fire here if the two drifted apart — and a
+            # per-step fused kernel inside the scan would re-pack state
+            # every iteration, defeating the packed-carry design.
+            term_floor=None,
         )
         return st, info
 

@@ -170,6 +170,11 @@ class RaftEngine:
         #   their range to the last log_capacity entries, so the store
         #   compacts beyond 2x that instead of growing without bound.
         self._lasts_snapshot = None   # see _pre_lasts
+        self._term_floor = 1   # first log index of the current leader's
+        #   term (dissertation §5.4.2 gate for the fused steady program,
+        #   core.step_pallas): set to last_index+1 on every election win,
+        #   clamped down when a truncation drops the tail below it.
+        #   Meaningless while no leader is elected (nothing dispatches).
         self._ring_floor = np.ones(n, np.int64)
         #   Per-replica smallest log index whose ring slot is guaranteed to
         #   hold that entry's real bytes. Normally 1 (rings fill from
@@ -437,6 +442,7 @@ class RaftEngine:
                 member=self._member_arg(),
                 repair_floor=floor,
                 floor_prev_term=fpt,
+                term_floor=self._term_floor,
             )
             self._note_truncations(pre_lasts)
             # ---- one host sync for the whole chunk ----
@@ -902,6 +908,9 @@ class RaftEngine:
             self.leader_term = cand_term
             self.lead_terms[r] = cand_term
             self._steady = False   # matches reset per term; repair re-verifies
+            # §5.4.2 floor for the fused steady program: everything this
+            # leader appends from here on carries cand_term
+            self._term_floor = int(self._pre_lasts()[r]) + 1
             # demote any stale leader bookkeeping (device already denied
             # it) — but only leaders this election could REACH: across a
             # partition a deposed-in-name leader keeps ticking in its own
@@ -1007,6 +1016,7 @@ class RaftEngine:
                     else self._member_arg()),
             repair_floor=floor,
             floor_prev_term=fpt,
+            term_floor=self._term_floor,
         )
         self._note_truncations(pre_lasts)
         max_term = int(info.max_term)
@@ -1094,6 +1104,9 @@ class RaftEngine:
         )
         self._lasts_snapshot = None
         self._steady = False
+        # re-appends land at cut+1 under the current term: the §5.4.2
+        # floor must never sit above the first current-term index
+        self._term_floor = min(self._term_floor, cut + 1)
         return len(requeue)
 
     def _make_room_for_current_term(self, r: int, term: int) -> None:

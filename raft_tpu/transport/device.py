@@ -75,26 +75,28 @@ class SingleDeviceTransport:
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
         alive, slow, repair=True, member=None, repair_floor=0,
-        floor_prev_term=0,
+        floor_prev_term=0, term_floor=None,
     ) -> Tuple[ReplicaState, RepInfo]:
         fpt = jnp.int32(floor_prev_term)
         rf = jnp.int32(repair_floor)
+        tf = None if term_floor is None else jnp.int32(term_floor)
         if self._member_mode:
             if member is None:
                 member = jnp.ones(self.cfg.rows, bool)
             return self._replicate[bool(repair)](
                 state, client_payload, jnp.int32(client_count),
                 jnp.int32(leader), jnp.int32(leader_term), alive, slow,
-                fpt, rf, member,
+                fpt, rf, member, term_floor=tf,
             )
         return self._replicate[bool(repair)](
             state, client_payload, jnp.int32(client_count), jnp.int32(leader),
-            jnp.int32(leader_term), alive, slow, fpt, rf,
+            jnp.int32(leader_term), alive, slow, fpt, rf, term_floor=tf,
         )
 
     def replicate_many(
         self, state, payloads, counts, leader, leader_term, alive, slow,
         repair=True, member=None, repair_floor=0, floor_prev_term=0,
+        term_floor=None,
     ) -> Tuple[ReplicaState, RepInfo]:
         """T replication steps as one compiled ``lax.scan`` — no host
         round-trip per batch (SURVEY.md §7 hard part 1). ``payloads`` is
@@ -102,16 +104,18 @@ class SingleDeviceTransport:
         i32[T]."""
         fpt = jnp.int32(floor_prev_term)
         rf = jnp.int32(repair_floor)
+        tf = None if term_floor is None else jnp.int32(term_floor)
         if self._member_mode:
             if member is None:
                 member = jnp.ones(self.cfg.rows, bool)
             return self._replicate_many[bool(repair)](
                 state, payloads, counts, jnp.int32(leader),
                 jnp.int32(leader_term), alive, slow, fpt, rf, member,
+                term_floor=tf,
             )
         return self._replicate_many[bool(repair)](
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
-            alive, slow, fpt, rf,
+            alive, slow, fpt, rf, term_floor=tf,
         )
 
     def request_votes(

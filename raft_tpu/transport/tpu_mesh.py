@@ -181,8 +181,11 @@ class TpuMeshTransport:
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
         alive, slow, repair=True, member=None, repair_floor=0,
-        floor_prev_term=0,
+        floor_prev_term=0, term_floor=None,
     ) -> Tuple[ReplicaState, RepInfo]:
+        # term_floor is accepted for interface parity and unused: the mesh
+        # program's Comm ops are real collectives, which the fused resident
+        # step cannot express — the general §5.4.2 ring-read gate runs here.
         extra = ()
         if self._member_mode:
             extra = (jnp.ones(self.cfg.rows, bool) if member is None
@@ -196,6 +199,7 @@ class TpuMeshTransport:
     def replicate_many(
         self, state, payloads, counts, leader, leader_term, alive, slow,
         repair=True, member=None, repair_floor=0, floor_prev_term=0,
+        term_floor=None,
     ) -> Tuple[ReplicaState, RepInfo]:
         """i32[T, B, R*W] folded payloads → T steps in one compiled scan."""
         extra = ()
