@@ -35,6 +35,40 @@ host crash kills one replica row's device shards, not the cluster's only
 brain — any surviving process still holds the full control state.
 Placement rules are additionally covered by fake-fabric unit tests and
 the single-process virtual mesh.
+
+Surviving a real process death (what re-formation requires)
+-----------------------------------------------------------
+``tests/test_multiprocess.py::test_process_death_survivor_reforms`` kills
+one of two OS processes with SIGKILL mid-traffic and asserts the survivor
+keeps committing. The recovery contract, honestly stated:
+
+1. **Detection.** A fixed JAX mesh gives no failure notification: the
+   survivor's next collective simply stalls (or raises a fabric timeout).
+   Detection is therefore a *progress watchdog* — the mirrored loops
+   commit in lockstep, so "no committed round for T seconds" is the
+   peer-death signal. T must exceed the longest legitimate stall
+   (compiles, checkpoint writes).
+2. **Re-formation is a restart, not a live mesh shrink.** XLA backends
+   pin the process set at ``jax.distributed.initialize``; a survivor
+   cannot drop a dead peer from a live mesh. It re-execs itself (or is
+   restarted by its supervisor — the same thing k8s does), initializes a
+   fresh runtime over the processes that remain, and rebuilds the
+   transport over the surviving devices.
+3. **State comes from stable storage, not device memory.** The dead
+   host's replica-row shards are gone. Because checkpoints are
+   cluster-wide (mirrored control planes archive every commit) and every
+   process writes its own vote WAL, ANY surviving process can restore
+   the full cluster: rows whose devices died restart from their last
+   durable state — exactly Raft's crash-restart model — and the repair
+   window / snapshot install heals them forward. The WAL overlay
+   guarantees no restored row regresses below a term it acted in (no
+   double vote).
+4. **Durability fences acks.** An entry is safely acknowledgeable only
+   once a checkpoint covering it is on disk; the test's client records
+   acks only after ``save_checkpoint`` returns, and recovery asserts the
+   acked sequence is a byte-identical prefix of the restored committed
+   log. Entries committed after the last checkpoint survive only if some
+   surviving process archived them — acks must wait for the fence.
 """
 
 from __future__ import annotations
