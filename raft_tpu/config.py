@@ -113,9 +113,12 @@ class RaftConfig:
             if self.entry_bytes % self.rs_k != 0:
                 raise ValueError("entry_bytes must be divisible by rs_k")
             if not (0 <= self.ec_commit_margin <= self.rs_m):
-                # surviving `margin` failures needs n - margin >= k shard
-                # holders, i.e. margin <= m; a larger margin would silently
-                # clamp and void the documented durability guarantee
+                # The quorum (k + margin acks) must be satisfiable by the
+                # INITIAL membership: n_replicas members means margin <=
+                # n_replicas - k = rs_m, or the cluster starts wedged.
+                # Under membership headroom the code has rows - k parity
+                # shards and a grown cluster could hold more, but the
+                # quorum is static — the initial-liveness bound governs.
                 raise ValueError("ec_commit_margin must be in [0, rs_m]")
         if self.payload_shards < 1:
             raise ValueError("payload_shards must be >= 1")
@@ -124,13 +127,15 @@ class RaftConfig:
         if self.max_replicas is not None:
             if self.max_replicas < self.n_replicas:
                 raise ValueError("max_replicas must be >= n_replicas")
-            if self.ec_enabled:
-                # RS(n,k) ties the shard layout to the replica count;
-                # membership change under EC would re-shard the whole log
-                raise ValueError(
-                    "membership change (max_replicas) is not supported "
-                    "for erasure-coded clusters"
-                )
+            # EC + membership: the RS code is provisioned ONCE for the
+            # full headroom — RS(max_replicas, rs_k) — so every row has a
+            # permanently assigned shard lane and membership changes never
+            # re-shard history (row == shard index is a static invariant;
+            # spare rows simply start/stop receiving their already-defined
+            # shards). The cost of headroom is max_replicas-k parity
+            # shards per entry instead of n-k, paid at encode time and in
+            # ring lanes — the TPU-native trade: static shapes, zero
+            # re-encode on reconfiguration.
         if self.steady_dispatch not in ("auto", "off"):
             raise ValueError('steady_dispatch must be "auto" or "off"')
         if self.shard_bytes % 4:

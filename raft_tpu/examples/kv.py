@@ -68,8 +68,31 @@ class ReplicatedKV:
         return self.engine.submit(self._encode(_DELETE, key, b""))
 
     def get(self, key: bytes) -> Optional[bytes]:
-        """Read from APPLIED (committed) state — never shows a write that
-        could still be lost to a leadership change."""
+        """Read from LOCAL applied (committed) state.
+
+        Weaker contract than ``linearizable_get``: it never shows a
+        write that could still be lost to a leadership change, but it
+        can be arbitrarily STALE — on a partitioned/minority-side engine
+        mirror nothing proves a fresher write hasn't committed on the
+        majority side. Use ``linearizable_get`` when the read must
+        reflect every write acknowledged before it was issued."""
+        return self._data.get(key)
+
+    def linearizable_get(self, key: bytes) -> Optional[bytes]:
+        """Linearizable read (ReadIndex, dissertation §6.4): the engine
+        confirms leadership with a quorum round and returns a read index;
+        the value is served only from state applied to at least that
+        index. Raises ``raft_tpu.raft.engine.LinearizableReadRefused``
+        when leadership cannot be confirmed (no leader, deposed, or a
+        quorum is unreachable — e.g. from the minority side of a
+        partition), and ``RuntimeError`` if the apply stream is paused
+        behind an archive gap below the read index."""
+        idx = self.engine.read_linearizable()
+        if self.last_applied < idx:
+            raise RuntimeError(
+                f"apply stream at {self.last_applied} has not reached "
+                f"read index {idx} (archive gap)"
+            )
         return self._data.get(key)
 
     def __len__(self) -> int:

@@ -240,6 +240,7 @@ class GoldenCluster:
         # AppendEntries are not delivered (stale matchIndex).
         self.alive: Dict[str, bool] = {n: True for n in self.nodes}
         self.slow: Dict[str, bool] = {n: False for n in self.nodes}
+        self._group_of: Optional[Dict[str, int]] = None   # see partition()
         for name in self.nodes:
             self._arm_follower_timeout(name)
 
@@ -271,6 +272,38 @@ class GoldenCluster:
 
     def set_slow(self, name: str, is_slow: bool) -> None:
         self.slow[name] = is_slow
+
+    def partition(self, groups) -> None:
+        """Link-level partition (OUR extension, mirroring
+        ``RaftEngine.partition`` so one schedule drives both sides of a
+        differential run): nodes in different groups exchange nothing —
+        no AppendEntries, no votes, no replies. Groups are lists of node
+        names or replica indices; unlisted nodes are isolated. The client
+        is unaffected (the reference's client is in-process with every
+        node, main.go:87-95 — there is no client link to cut)."""
+        g: Dict[str, int] = {}
+        for gi, group in enumerate(groups):
+            for m in group:
+                name = m if isinstance(m, str) else f"Server{m}"
+                g[name] = gi
+        iso = len(groups)
+        for name in self.nodes:
+            if name not in g:
+                g[name] = iso
+                iso += 1
+        self._group_of = g
+        for name in self.nodes:
+            self.nodes[name].nodelog("partitioned")
+
+    def heal_partition(self) -> None:
+        self._group_of = None
+        for name in self.nodes:
+            self.nodes[name].nodelog("partition healed")
+
+    def _reachable(self, a: str, b: str) -> bool:
+        if a == b or self._group_of is None:
+            return True
+        return self._group_of[a] == self._group_of[b]
 
     # -- scheduling ---------------------------------------------------------
     def _push(self, t: float, kind: str, node: str) -> None:
@@ -346,6 +379,8 @@ class GoldenCluster:
                 continue
             if not self.alive[name]:
                 continue                             # dead peer: no response
+            if not self._reachable(cand.id, name):
+                continue                             # partitioned away
             prev_state = peer.state
             res = peer.handle_request_vote(
                 VoteRequest(cand.term, cand.id)      # fields as sent, main.go:264
@@ -386,6 +421,8 @@ class GoldenCluster:
                 continue
             if not self.alive[name]:
                 continue                  # dead peer: not delivered
+            if not self._reachable(leader.id, name):
+                continue                  # partitioned away: not delivered
             if self.slow[name]:
                 # Engine slow-mask semantics (engine.set_slow): the replica
                 # *receives* traffic — election timer resets, terms flow

@@ -60,6 +60,19 @@ CANDIDATE = "candidate"
 LEADER = "leader"
 
 
+class LinearizableReadRefused(Exception):
+    # deliberately NOT a RuntimeError: ReplicatedKV.linearizable_get's
+    # other failure mode (apply stream paused behind an archive gap)
+    # raises RuntimeError, and the two demand different recovery actions
+    # (retry against the real leader vs wait for the gap to heal) — the
+    # types must stay distinguishable by `except` clause.
+    """``read_linearizable`` could not confirm leadership: the caller is
+    not leader, was deposed during the confirmation round, or cannot
+    reach a quorum of the configuration (e.g. a minority-side leader
+    during a partition). The read must be retried against the real
+    leader — serving it here could return stale state."""
+
+
 class VirtualClock:
     """Deterministic time source; the engine advances it to each event."""
 
@@ -146,7 +159,9 @@ class RaftEngine:
         if cfg.ec_enabled:
             from raft_tpu.ec.rs import RSCode
 
-            self._code = RSCode(cfg.n_replicas, cfg.rs_k)
+            # Provisioned for the FULL row headroom (config.py): shard i
+            # lives on row i forever; membership changes never re-shard.
+            self._code = RSCode(cfg.rows, cfg.rs_k)
         else:
             self._code = None
         self._uncommitted: Dict[int, Tuple[bytes, int]] = {}
@@ -493,6 +508,84 @@ class RaftEngine:
             if seq not in self.commit_time
         )
 
+    def read_linearizable(self, r: Optional[int] = None) -> int:
+        """ReadIndex (dissertation §6.4): confirm leadership with a quorum
+        round, then return the commit index the read may be served at.
+
+        The leader notes its commit index (the *read index*), runs one
+        empty replication round, and only if (a) no reachable replica
+        reports a higher term and (b) the round reached a strict majority
+        of the current configuration does the read proceed — a
+        minority-side stale leader can never satisfy (b), so it cannot
+        serve a linearizable read while the majority commits elsewhere
+        (the split-brain hazard ``ReplicatedKV.get``'s local-applied
+        contract does not guard against). Raises
+        ``LinearizableReadRefused`` otherwise.
+
+        Returns the read index; a linearizable read serves from state
+        applied to AT LEAST that index (``committed_entries`` up to it,
+        or ``ReplicatedKV.linearizable_get``). §6.4's "leader must have
+        committed an entry in its term first" exists because a fresh
+        leader's commit index may lag reality; here ``commit_watermark``
+        is the control plane's global monotone watermark, so the note
+        taken before confirmation already covers every acknowledged
+        write. ``r`` defaults to the routed leader; pass an explicit row
+        to probe a specific (possibly stale split-brain) leader."""
+        if r is None:
+            r = self.leader_id
+        if r is None or self.roles[r] != LEADER or not self.alive[r]:
+            raise LinearizableReadRefused("not a live leader")
+        term = int(self.lead_terms[r])
+        if int(self.terms[r]) > term:
+            self._step_down_leader(r, int(self.terms[r]))
+            raise LinearizableReadRefused("deposed (higher term seen)")
+        read_index = self.commit_watermark
+        eff = self._reach(r)
+        # (b) first — it needs no device round and a minority-side leader
+        # must be refused even while its own side is quiet. _reach already
+        # intersects membership, so eff counts members only.
+        confirmed = int(eff.sum())
+        if confirmed <= int(self.member.sum()) // 2:
+            raise LinearizableReadRefused(
+                f"quorum unreachable ({confirmed} of "
+                f"{int(self.member.sum())} members)"
+            )
+        # (a): one empty round over the current reach — any reachable row
+        # at a higher term deposes this leader here, exactly as a
+        # heartbeat tick would (main.go:312-321)
+        info = self._empty_round(r, term, eff)
+        max_term = int(info.max_term)
+        if max_term > term:
+            self._step_down_leader(r, max_term)
+            raise LinearizableReadRefused("deposed during confirmation")
+        self.terms[eff] = np.maximum(self.terms[eff], term)
+        self._persist_votes()
+        self._advance_commit(r, int(info.commit_index))
+        self._reset_heard_timers(r)
+        return read_index
+
+    def _empty_round(self, r: int, term: int, eff) -> "RepInfo":
+        """One zero-entry replication round sourced at ``r`` — the device
+        half of a heartbeat, shared by the read-confirmation path (and
+        mirroring the tick's take==0 branch in ``_fire_leader_tick``; a
+        protocol-argument change there must land here too)."""
+        cfg = self.cfg
+        if self._hb_payload is None:
+            self._hb_payload = jnp.zeros(
+                (cfg.batch_size, cfg.rows * cfg.shard_words), jnp.int32
+            )
+        pre_lasts = self._pre_lasts()
+        floor, fpt = self._floor_attest(r)
+        self.state, info = self.t.replicate(
+            self.state, self._hb_payload, 0, r, term,
+            jnp.asarray(eff), jnp.asarray(self.slow),
+            repair=self._repair_program(), member=self._member_arg(),
+            repair_floor=floor, floor_prev_term=fpt,
+            term_floor=self._term_floor,
+        )
+        self._note_truncations(pre_lasts)
+        return info
+
     # ------------------------------------------------------------- membership
     def _member_arg(self):
         """The member mask for device steps — None on fixed-membership
@@ -561,6 +654,13 @@ class RaftEngine:
         new[r] = False
         if int(new.sum()) < 1:
             raise ValueError("cannot remove the last member")
+        if self.cfg.ec_enabled and int(new.sum()) < self.cfg.commit_quorum:
+            # the k+margin durability quorum must stay satisfiable: fewer
+            # members than commit_quorum could never commit again
+            raise ValueError(
+                f"removing replica {r} leaves {int(new.sum())} members, "
+                f"below the EC commit quorum ({self.cfg.commit_quorum})"
+            )
         return self._change_membership(new)
 
     def _note_config_ingest(self, idx: int, seq: int, term: int) -> None:
@@ -1356,12 +1456,16 @@ class RaftEngine:
         from raft_tpu.ec.reconstruct import heal_replica, install_entries
 
         match = np.asarray(info.match)
-        n, k = self.cfg.n_replicas, self.cfg.rs_k
+        n, k = self.cfg.rows, self.cfg.rs_k
         leader_last = int(self._fetch(self.state.last_index)[leader])
         hi_rec = self.commit_watermark
         for p in range(n):
             if (p == leader or not self.alive[p] or self.slow[p]
-                    or not self.connectivity[leader, p]):
+                    or not self.connectivity[leader, p]
+                    or not self.member[p]):
+                # spare (non-member) rows idle unhealed until added; a
+                # REMOVED row's committed shards still serve as donor
+                # material below (donor criteria are data-based)
                 continue
             if match[p] >= leader_last:
                 continue

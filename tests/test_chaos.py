@@ -283,3 +283,147 @@ def test_ec_chaos_unrecoverable_suffix_abandoned(seed):
     cfg, e, tr = mk_ec(seed)
     snaps = run_ec_chaos(e, rng, phases=7, phase_s=35.0)
     check_ec_invariants(cfg, e, tr, snaps)
+
+
+# ---------------------------------------------------------------- sessions
+def mk_sessions(seed):
+    cfg = RaftConfig(
+        n_replicas=3, max_replicas=5, entry_bytes=24, batch_size=4,
+        log_capacity=64, transport="single", seed=seed,
+    )
+    tr = TraceRecorder()
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr), tr
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_exactly_once_counter_under_full_chaos(seed):
+    """VERDICT r3 #6 — the end-to-end client story UNDER the adversary:
+    a non-idempotent sessioned counter driven by blind client retries
+    through the full chaos mix (crashes, slow windows, disruptive
+    candidacies, partitions, live membership changes, ring laps on a
+    64-slot log). At quiescence every operation is retried until
+    acknowledged (durable); the final count must equal the sum of the
+    DISTINCT acknowledged operations — each applied exactly once — and a
+    fresh replay of the log from a checkpoint must agree."""
+    import tempfile
+
+    from raft_tpu.examples import ReplicatedCounter
+
+    rng = random.Random(seed)
+    cfg, e, tr = mk_sessions(seed)
+    ctr = ReplicatedCounter(e)
+    e.run_until_leader()
+    pair_amount = {}            # (client, req) -> amount
+    pair_seqs = {}              # (client, req) -> [engine seqs]
+    partitioned = False
+    n = cfg.rows
+
+    outstanding = {}            # client -> (req, amount) awaiting ack
+
+    def submit_some():
+        # §6.3 session contract: requests are SERIAL per client — a new
+        # request is issued only once the previous one is acknowledged;
+        # until then the client retries the outstanding one blindly
+        for _ in range(rng.randrange(1, 5)):
+            if e.leader_id is None:
+                return
+            client = rng.randrange(1, 5)
+            try:
+                if client in outstanding:
+                    req, amount = outstanding[client]
+                    if any(e.is_durable(s) for s in pair_seqs[(client, req)]):
+                        del outstanding[client]     # acked: move on below
+                    else:
+                        s2, _ = ctr.add(client, amount, request_id=req)
+                        pair_seqs[(client, req)].append(s2)
+                        continue
+                amount = rng.randrange(1, 10)
+                seq, req = ctr.add(client, amount)
+                outstanding[client] = (req, amount)
+                pair_amount[(client, req)] = amount
+                pair_seqs.setdefault((client, req), []).append(seq)
+            except RuntimeError:
+                return               # no leader right now: client backs off
+
+    for _ in range(10):
+        submit_some()
+        action = rng.choice([
+            "kill", "recover", "slow", "unslow", "campaign",
+            "partition", "heal", "add", "remove", "none",
+        ])
+        victim = rng.randrange(n)
+        members = [r for r in range(n) if e.member[r]]
+        dead_members = sum(1 for r in members if not e.alive[r])
+        if action == "kill":
+            if (e.alive[victim] and e.member[victim]
+                    and dead_members + 1 <= (len(members) - 1) // 2):
+                e.fail(victim)
+        elif action == "recover":
+            if not e.alive[victim]:
+                e.recover(victim)
+        elif action == "slow":
+            if e.alive[victim] and e.member[victim]:
+                e.set_slow(victim, True)
+        elif action == "unslow":
+            e.set_slow(victim, False)
+        elif action == "campaign":
+            e.force_campaign(victim)
+        elif action == "partition" and not partitioned:
+            cut = rng.sample(members, 1)
+            rest = [r for r in range(n) if r not in cut]
+            e.partition([cut, rest])
+            partitioned = True
+        elif action == "heal" and partitioned:
+            e.heal_partition()
+            partitioned = False
+        elif action == "add":
+            spares = [r for r in range(n) if not e.member[r]]
+            if (spares and e._pending_config is None and not partitioned
+                    and e.leader_id is not None and dead_members == 0):
+                try:
+                    e.add_server(spares[0])
+                except RuntimeError:
+                    pass
+        elif action == "remove":
+            cands = [r for r in members
+                     if r != e.leader_id and e.alive[r]]
+            if (len(members) > 3 and cands and not partitioned
+                    and e._pending_config is None
+                    and e.leader_id is not None and dead_members == 0):
+                try:
+                    e.remove_server(rng.choice(cands))
+                except RuntimeError:
+                    pass
+        e.run_for(40.0)
+
+    # quiescence: heal everything, then the client retries every
+    # operation until it is ACKNOWLEDGED (durable)
+    e.heal_partition()
+    for r in range(n):
+        if not e.alive[r]:
+            e.recover(r)
+        e.set_slow(r, False)
+    e.run_until_leader(limit=1200.0)
+    for (client, req), amount in pair_amount.items():
+        tries = 0
+        while not any(e.is_durable(s) for s in pair_seqs[(client, req)]):
+            tries += 1
+            assert tries < 50, f"op ({client},{req}) never acknowledged"
+            s2, _ = ctr.add(client, amount, request_id=req)
+            pair_seqs[(client, req)].append(s2)
+            e.run_until_committed(s2, limit=1200.0)
+    e.run_for(6 * cfg.heartbeat_period)
+
+    # exactly-once: the count equals the sum of DISTINCT acknowledged
+    # operations — blind retries, re-queues after truncation, and
+    # committed-twice retries all collapse to one application each
+    assert ctr.value == sum(pair_amount.values())
+
+    # the log itself proves it: a fresh replay from a checkpoint agrees
+    with tempfile.TemporaryDirectory() as td:
+        path = f"{td}/chaos.ckpt"
+        e.save_checkpoint(path)
+        e2 = RaftEngine.restore(cfg, path, SingleDeviceTransport(cfg))
+        ctr2 = ReplicatedCounter(e2, replay=True)
+        assert ctr2.value == ctr.value, "replayed log disagrees"
+    check_invariants(cfg, e, tr, [])

@@ -121,3 +121,71 @@ def test_ec_read_survives_systematic_holder_death():
     e.fail(survivor)
     with pytest.raises(ValueError):
         e.committed_entries(1, 12)
+
+
+class TestLinearizableReads:
+    """ReadIndex (VERDICT r3 #5, dissertation §6.4)."""
+
+    def test_read_index_confirms_and_serves(self):
+        from raft_tpu.examples.kv import ReplicatedKV
+
+        e = mk(seed=21, entry_bytes=20)
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        s = kv.set(b"color", b"green")
+        e.run_until_committed(s)
+        idx = e.read_linearizable()
+        assert idx == e.commit_watermark >= 1
+        assert kv.linearizable_get(b"color") == b"green"
+
+    def test_refused_without_leader(self):
+        from raft_tpu.raft.engine import LinearizableReadRefused
+
+        e = mk(seed=22)
+        with pytest.raises(LinearizableReadRefused, match="not a live"):
+            e.read_linearizable()
+
+    def test_minority_leader_cannot_serve_while_majority_commits(self):
+        """The split-brain read hazard, proven end to end: the old leader
+        keeps 'leading' its minority side of a partition while the
+        majority elects a new leader and commits fresh writes. The stale
+        leader must REFUSE a linearizable read; the real leader serves it
+        at an index covering the new writes."""
+        from raft_tpu.examples.kv import ReplicatedKV
+        from raft_tpu.raft.engine import LEADER, LinearizableReadRefused
+
+        e = mk(seed=23, log_capacity=128, entry_bytes=20)
+        kv = ReplicatedKV(e)
+        old = e.run_until_leader()
+        s = kv.set(b"owner", b"old")
+        e.run_until_committed(s)
+        pre_wm = e.commit_watermark
+        others = [r for r in range(3) if r != old]
+        e.partition([[old], others])
+        # before the majority even re-elects: the minority leader already
+        # cannot confirm (quorum unreachable)
+        with pytest.raises(LinearizableReadRefused, match="quorum"):
+            e.read_linearizable(old)
+        # majority side elects in a higher term and commits a fresh write
+        # (leader_id still names the stale minority leader until then)
+        for _ in range(60):
+            if e.leader_id in others:
+                break
+            e.run_for(5.0)
+        new = e.leader_id
+        assert new in others and e.roles[old] == LEADER  # true split-brain
+        s2 = kv.set(b"owner", b"new")
+        e.run_until_committed(s2, limit=900.0)
+        # the stale minority leader still refuses; the real leader serves
+        # at an index covering the majority's write
+        with pytest.raises(LinearizableReadRefused):
+            e.read_linearizable(old)
+        idx = e.read_linearizable(new)
+        assert idx >= pre_wm + 1
+        assert kv.linearizable_get(b"owner") == b"new"
+        # heal: the old leader is deposed on first contact and the read
+        # index keeps moving forward
+        e.heal_partition()
+        e.run_for(6 * e.cfg.heartbeat_period)
+        assert e.roles[old] != LEADER
+        assert e.read_linearizable() >= idx
