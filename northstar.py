@@ -62,6 +62,9 @@ def run_device(
             comm, False, cfg.commit_quorum, False, st, ps, cs,
             jnp.int32(0), jnp.int32(1),
             jnp.ones(cfg.n_replicas, bool), jnp.zeros(cfg.n_replicas, bool),
+            # single-term pipeline: every index is current-term, so the
+            # fused whole-step steady program serves (core.step_pallas)
+            term_floor=1,
         ),
         donate_argnums=(0,),
     )

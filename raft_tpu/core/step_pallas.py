@@ -86,7 +86,25 @@ def _pick_br(B: int, C: int) -> int:
     return 128
 
 
-def _steady_kernel(BR: int, C: int, L: int, s_ref,
+def _mul_const_packed(x, c_bits):
+    """GF(2^8) multiply of every byte of packed-i32 ``x`` by the constant
+    whose bit-decomposition products are ``c_bits`` (u8[8], c_bits[i] =
+    mul(c, 1<<i)): XOR over set bits i of ((x >> i) & 0x01010101) *
+    c_bits[i]. Byte-parallel within each i32 word — the isolated bit mask
+    makes every byte slot 0 or 1, so the integer multiply never carries
+    across byte boundaries, and the arithmetic right shift's sign fill
+    sits above every masked bit (i <= 7, mask bits <= 24). This is the
+    ec.kernels bit-sliced formulation restated on the folded i32 layout,
+    so the parity encode can run INSIDE the window-merge kernel."""
+    acc = jnp.zeros_like(x)
+    for i in range(8):
+        c = int(c_bits[i])
+        if c:
+            acc ^= ((x >> i) & 0x01010101) * c
+    return acc
+
+
+def _steady_kernel(BR: int, C: int, L: int, pconsts, s_ref,
                    cnt_ref, prevt_ref, par_ref, vec_ref, msks_ref,
                    win_ref, bufp_ref, buft_ref,
                    outp_ref, outt_ref, vec_o, match_o, scal_o, nextp_o,
@@ -165,6 +183,22 @@ def _steady_kernel(BR: int, C: int, L: int, s_ref,
     sel = (jj >= 0) & (jj < count) & lanes
     val2 = jnp.concatenate([prevp_ref[:], win_ref[:]], axis=0)
     src = pltpu.roll(val2, off - BR, 0)[:BR]
+    if pconsts is not None:
+        # RS parity encode fused into the merge: the window carries only
+        # the k data-lane blocks; parity block p is computed right here,
+        # one VMEM traversal for encode + ring write (pconsts is the
+        # (rows-k, k, 8) bit-decomposition table of the code's parity
+        # matrix, baked at trace time).
+        m_par, k_data = pconsts.shape[0], pconsts.shape[1]
+        parts = [src]
+        for p in range(m_par):
+            acc_p = jnp.zeros((BR, W), jnp.int32)
+            for j in range(k_data):
+                acc_p ^= _mul_const_packed(
+                    src[:, j * W:(j + 1) * W], pconsts[p, j]
+                )
+            parts.append(acc_p)
+        src = jnp.concatenate(parts, axis=1)           # (BR, M)
     outp_ref[:] = jnp.where(sel, src, bufp_ref[:])
     prevp_ref[:] = win_ref[:]
 
@@ -293,10 +327,16 @@ def _start_slot_and_prev(vecs, log_term, leader, cap, L):
 
 
 def _invoke(s, cnt, prev_col, params, vecs, masks, win, log_payload,
-            log_term, interpret):
+            log_term, interpret, pconsts=None):
     cap, M = log_payload.shape
     L = log_term.shape[0]
-    B = win.shape[0]
+    B, Mk = win.shape            # Mk = k*W data lanes when pconsts is set
+    if (Mk != M) != (pconsts is not None):
+        raise ValueError(
+            f"window lanes {Mk} vs payload lanes {M}: data-lane-only "
+            "windows require ec_consts (in-kernel parity), full-lane "
+            "windows must not pass it"
+        )
     BR = _pick_br(B, cap)
     G = B // BR + 1
     CB = cap // BR
@@ -315,7 +355,7 @@ def _invoke(s, cnt, prev_col, params, vecs, masks, win, log_payload,
             smem((1, 6)),
             smem((6, L)),
             smem((3, L)),
-            pl.BlockSpec((BR, M), lambda i, m: (jnp.clip(i, 0, WB - 1), 0)),
+            pl.BlockSpec((BR, Mk), lambda i, m: (jnp.clip(i, 0, WB - 1), 0)),
             pl.BlockSpec((BR, M), lambda i, m: (((m[0] // BR) + i) % CB, 0)),
             pl.BlockSpec((L, BR), lambda i, m: (0, ((m[0] // BR) + i) % CB)),
         ],
@@ -328,12 +368,12 @@ def _invoke(s, cnt, prev_col, params, vecs, masks, win, log_payload,
             smem((L, 1)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((BR, M), jnp.int32),
+            pltpu.VMEM((BR, Mk), jnp.int32),
             pltpu.SMEM((5, max(L, 3)), jnp.int32),
         ],
     )
     return pl.pallas_call(
-        functools.partial(_steady_kernel, BR, cap, L),
+        functools.partial(_steady_kernel, BR, cap, L, pconsts),
         out_shape=[
             jax.ShapeDtypeStruct((cap, M), log_payload.dtype),
             jax.ShapeDtypeStruct((L, cap), log_term.dtype),
@@ -462,6 +502,14 @@ def steady_scan_replicate_tpu(
     #                                 stacking — the stacking DUS costs
     #                                 ~0.6 us/step; bench asserts only the
     #                                 final commit)
+    ec_consts=None,                 # u8[rows-k, k, 8] parity-matrix
+    #                                 bit-decomposition table: the windows
+    #                                 carry only the k DATA lane blocks
+    #                                 (i32[B, k*W]) and the kernel encodes
+    #                                 the parity lanes in the merge pass —
+    #                                 encode + ring write in one VMEM
+    #                                 traversal (ec.kernels._bit_consts of
+    #                                 RSCode(rows, k).parity_matrix)
 ):
     """T fused steady steps with the packed (6, L) state-vector carry —
     pack/unpack and param/mask setup happen once per scan, not per step."""
@@ -480,7 +528,7 @@ def steady_scan_replicate_tpu(
             win = mk_payload(win)
         log_payload, log_term, vecs, match_o, scal_o, next_prev = _invoke(
             s, jnp.int32(cnt).reshape(1, 1), prev_col, params, vecs, masks,
-            win, log_payload, log_term, interpret,
+            win, log_payload, log_term, interpret, pconsts=ec_consts,
         )
         info = _mk_info(match_o, scal_o)
         # the kernel hands the next iteration its window start slot and

@@ -186,6 +186,29 @@ def _encode_fold_pallas(k: int, m: int, consts_key, data: jax.Array) -> jax.Arra
     return jnp.concatenate([to_words(data), to_words(parity)], axis=1)
 
 
+def parity_consts(n: int, k: int) -> np.ndarray:
+    """Public view of the parity-matrix bit-decomposition table:
+    u8[n-k, k, 8] with ``[p, j, i] = mul(P[p, j], 1 << i)``. Consumed by
+    the fused steady kernel's in-kernel encode
+    (core.step_pallas ``ec_consts``) and anything else that restates the
+    bit-sliced multiply."""
+    return np.frombuffer(_parity_consts_key(n, k), np.uint8).reshape(
+        n - k, k, 8
+    )
+
+
+def fold_data_lanes(data: jax.Array) -> jax.Array:
+    """u8[B, S] raw entry bytes -> i32[B, S/4] — exactly the systematic
+    data-lane blocks of the folded layout (``to_words`` of
+    ``_encode_fold_pallas``; XLA's bitcast packs element 0
+    least-significant, matching the little-endian host fold). The window
+    format of the fused kernel's in-kernel-parity mode."""
+    b, s = data.shape
+    return jax.lax.bitcast_convert_type(
+        data.reshape(b, s // 4, 4), jnp.int32
+    )
+
+
 def encode_fold_device(code: RSCode, data: jax.Array) -> jax.Array:
     """Fused encode + fold: u8[B, S] -> i32[B, n*Wk] (the device log
     payload layout). Equals ``fold_shards_device(encode_device(...))``

@@ -323,3 +323,66 @@ def test_ec_schedule_fused_vs_general():
             getattr(sg, f), getattr(sf, f), err_msg=f"state.{f}"
         )
     assert int(iff[-1].commit_index) == 2 * B + 100
+
+
+def test_ec_inline_parity_encode_matches_general():
+    """The in-kernel parity encode (windows carry only data lanes; the
+    merge pass computes parity lanes with the packed-i32 GF(2^8)
+    bit-decomposition) must produce byte-identical state and infos to
+    the general path fed pre-encoded full-lane payloads."""
+    from raft_tpu.core.step_pallas import steady_scan_replicate_tpu
+    from raft_tpu.ec.kernels import (
+        encode_fold_device, fold_data_lanes, parity_consts,
+    )
+    from raft_tpu.ec.rs import RSCode
+
+    n, k = 5, 3
+    cfg = RaftConfig(n_replicas=n, entry_bytes=24, batch_size=B,
+                     log_capacity=C, rs_k=k, rs_m=n - k)
+    code = RSCode(n, k)
+    comm = SingleDeviceComm(n)
+    rng = np.random.default_rng(5)
+    T = 5
+    raw = rng.integers(0, 256, (T, B, cfg.entry_bytes), dtype=np.uint8)
+    counts = jnp.asarray([B, 100, 0, B, B], jnp.int32)
+    alive = jnp.ones(n, bool)
+    slow = jnp.zeros(n, bool)
+
+    # general: per-step encode_fold + replicate_step
+    st_g = init_state(cfg)
+    infos_g = []
+    for t in range(T):
+        st_g, info = replicate_step(
+            comm, st_g, encode_fold_device(code, jnp.asarray(raw[t])),
+            counts[t], jnp.int32(0), jnp.int32(1), alive, slow,
+            ec=True, commit_quorum=cfg.commit_quorum, repair=True,
+        )
+        infos_g.append(jax.tree.map(np.asarray, info))
+
+    # fused: data lanes only + in-kernel parity
+    consts = parity_consts(n, k)
+    data_lanes = fold_data_lanes
+
+    st_f, infos_f = steady_scan_replicate_tpu(
+        init_state(cfg), jnp.asarray(raw), counts, jnp.int32(0),
+        jnp.int32(1), alive, slow, jnp.int32(0), jnp.int32(0), None,
+        jnp.int32(1), commit_quorum=cfg.commit_quorum,
+        mk_payload=data_lanes, ec_consts=consts,
+        interpret=ring.pallas_interpret(),
+    )
+    st_f = jax.tree.map(np.asarray, st_f)
+    for t in range(T):
+        for f in infos_g[t]._fields:
+            np.testing.assert_array_equal(
+                getattr(infos_g[t], f),
+                np.asarray(jax.tree.map(lambda a: a[t], infos_f)[
+                    infos_f._fields.index(f)]),
+                err_msg=f"step {t} info.{f}",
+            )
+    for f in ("term", "voted_for", "last_index", "commit_index",
+              "match_index", "match_term", "log_term", "log_payload"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(jax.tree.map(np.asarray, st_g), f)),
+            getattr(st_f, f), err_msg=f"state.{f}",
+        )
+    assert int(infos_g[-1].commit_index) == 3 * B + 100
