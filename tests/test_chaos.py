@@ -427,3 +427,144 @@ def test_exactly_once_counter_under_full_chaos(seed):
         ctr2 = ReplicatedCounter(e2, replay=True)
         assert ctr2.value == ctr.value, "replayed log disagrees"
     check_invariants(cfg, e, tr, [])
+
+
+# ------------------------------------------------- EC + membership chaos
+def mk_ec_member(seed):
+    cfg = RaftConfig(
+        n_replicas=5, max_replicas=7, rs_k=3, rs_m=2, entry_bytes=12,
+        batch_size=4, log_capacity=256, transport="single", seed=seed,
+    )
+    tr = TraceRecorder()
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr), tr
+
+
+def run_ec_member_chaos(e, rng, phases=10, phase_s=40.0):
+    """The round-4 interaction space: erasure coding x live membership x
+    every fault type. The RS(rows, k) code is provisioned for the 7-row
+    headroom, so adds/removes move only the quorum and the set of rows
+    receiving their permanent shard lanes — this generator hunts for
+    wedges/corruption where those interact with crashes, storms,
+    partitions, and reconstruction heals."""
+    n = e.cfg.rows
+    eb = e.cfg.entry_bytes
+    quorum = e.cfg.commit_quorum          # k + margin = 4
+    partitioned = False
+    e.run_until_leader()
+    snapshots = []
+    for _ in range(phases):
+        for _ in range(rng.randrange(0, 5)):
+            e.submit(bytes(rng.getrandbits(8) for _ in range(eb)))
+        action = rng.choice([
+            "kill", "recover", "slow", "unslow", "campaign",
+            "partition", "heal", "add", "remove", "none",
+        ])
+        victim = rng.randrange(n)
+        members = [r for r in range(n) if e.member[r]]
+        dead_members = sum(1 for r in members if not e.alive[r])
+        if action == "kill":
+            # live members must stay >= the k+margin quorum
+            if (e.alive[victim] and e.member[victim]
+                    and len(members) - dead_members - 1 >= quorum):
+                e.fail(victim)
+        elif action == "recover":
+            if not e.alive[victim]:
+                e.recover(victim)
+        elif action == "slow":
+            if (e.alive[victim] and e.member[victim] and not e.slow.any()
+                    and len(members) - dead_members - 1 >= quorum):
+                e.set_slow(victim, True)
+        elif action == "unslow":
+            e.set_slow(victim, False)
+        elif action == "campaign":
+            e.force_campaign(victim)
+        elif action == "partition" and not partitioned:
+            cut = rng.sample(members, 1)
+            rest = [r for r in range(n) if r not in cut]
+            e.partition([cut, rest])
+            partitioned = True
+        elif action == "heal" and partitioned:
+            e.heal_partition()
+            partitioned = False
+        elif action == "add":
+            spares = [r for r in range(n) if not e.member[r]]
+            if (spares and e._pending_config is None and not partitioned
+                    and e.leader_id is not None and dead_members == 0):
+                try:
+                    e.add_server(spares[0])
+                except RuntimeError:
+                    pass
+        elif action == "remove":
+            cands = [r for r in members
+                     if r != e.leader_id and e.alive[r]]
+            if (cands and not partitioned
+                    and e._pending_config is None
+                    and e.leader_id is not None and dead_members == 0):
+                try:
+                    e.remove_server(rng.choice(cands))
+                except (RuntimeError, ValueError):
+                    pass              # in flight / below quorum floor
+        e.run_for(phase_s)
+        lead = e.leader_id
+        if (lead is not None
+                and (e.connectivity[lead] & e.member).sum() >= quorum):
+            snapshots.append(int(np.asarray(e.state.commit_index)[lead]))
+    e.heal_partition()
+    for r in range(n):
+        if not e.alive[r]:
+            e.recover(r)
+        e.set_slow(r, False)
+    probe = e.submit(bytes(eb))
+    e.run_until_committed(probe, limit=1500.0)
+    e.run_for(6 * e.cfg.heartbeat_period)
+    return snapshots
+
+
+def check_ec_member_invariants(cfg, e, tr, snaps):
+    """Election safety, device-commit non-regression, membership
+    coherence, and read-quorum consistency over the headroom code: every
+    k-subset of ring-valid sufficiently-committed rows — members,
+    spares that were once members, and removed rows alike — must decode
+    the same committed window."""
+    from itertools import combinations
+
+    from raft_tpu.ec.reconstruct import reconstruct
+    from raft_tpu.ec.rs import RSCode
+
+    for term, leaders in tr.leaders_by_term().items():
+        assert len(leaders) <= 1, f"two leaders in term {term}"
+    assert e._pending_config is None
+    members = int(e.member.sum())
+    assert cfg.commit_quorum <= members <= cfg.rows
+    hi = e.commit_watermark
+    assert hi >= 1
+    if snaps:
+        assert int(np.asarray(e.state.commit_index).max()) >= max(snaps)
+    lo = max(1, hi - e.state.capacity + 1)
+    code = RSCode(cfg.rows, cfg.rs_k)
+    commits = np.asarray(e.state.commit_index)
+    lasts = np.asarray(e.state.last_index)
+    cap = e.state.capacity
+    eligible = [
+        r for r in range(cfg.rows)
+        if int(commits[r]) >= hi
+        and int(lasts[r]) - cap + 1 <= lo
+        and int(e._ring_floor[r]) <= lo
+    ]
+    assert len(eligible) >= cfg.rs_k, f"only {len(eligible)} full holders"
+    decoded = None
+    for rows in combinations(eligible, cfg.rs_k):
+        got = [bytes(x)
+               for x in reconstruct(e.state, code, list(rows), lo, hi)]
+        if decoded is None:
+            decoded = got
+        else:
+            assert got == decoded, f"read quorum {rows} diverges"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_ec_membership_chaos(seed):
+    rng = random.Random(73000 + seed)
+    cfg, e, tr = mk_ec_member(seed)
+    snaps = run_ec_member_chaos(e, rng)
+    check_ec_member_invariants(cfg, e, tr, snaps)
