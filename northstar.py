@@ -42,7 +42,13 @@ from raft_tpu.core.state import fold_batch, init_state, log_entries
 from raft_tpu.core.step import scan_replicate
 from raft_tpu.obs.profiling import device_seconds
 
-CHUNK_STEPS = 16      # scan length per device dispatch
+CHUNK_STEPS = 32     # steps per device dispatch. Each chunk is ONE
+#   kernel launch (core.step_pallas.steady_pipeline_tpu); the launch has
+#   a ~160 us fixed cost, so bigger chunks amortize better — but the
+#   per-chunk fidelity read-back can only serve entries still in the
+#   ring (log_capacity = CHUNK_STEPS * batch below), and rings past
+#   ~32k slots start paying HBM locality (~+2 us/step measured). 32 is
+#   the measured sweet spot that also matches the bench headline ring.
 
 
 def entry_block(rng: np.random.Generator, n: int, entry: int) -> np.ndarray:
@@ -56,18 +62,40 @@ def run_device(
     p99_us, wall_s, method) with the hash over follower-read-back
     committed bytes. ``measure_latency=False`` skips the timing probes
     (byte-identity-only callers, e.g. the CI test)."""
+    from raft_tpu.core.ring import _pallas_ok
+
     comm = SingleDeviceComm(cfg.n_replicas)
-    fn = jax.jit(
-        lambda st, ps, cs: scan_replicate(
-            comm, False, cfg.commit_quorum, False, st, ps, cs,
-            jnp.int32(0), jnp.int32(1),
-            jnp.ones(cfg.n_replicas, bool), jnp.zeros(cfg.n_replicas, bool),
-            # single-term pipeline: every index is current-term, so the
-            # fused whole-step steady program serves (core.step_pallas)
-            term_floor=1,
-        ),
-        donate_argnums=(0,),
-    )
+    if _pallas_ok(cfg.log_capacity, cfg.batch_size):
+        # the saturated chunk as ONE kernel launch (the launch-feasibility
+        # cond inside falls back to the per-step fused scan for the
+        # stream's partial final chunk)
+        from raft_tpu.core.ring import pallas_interpret
+        from raft_tpu.core.step_pallas import steady_pipeline_tpu
+
+        def _chunk(st, ps, cs):
+            st, info = steady_pipeline_tpu(
+                st, ps, cs, jnp.int32(0), jnp.int32(1),
+                jnp.ones(cfg.n_replicas, bool),
+                jnp.zeros(cfg.n_replicas, bool),
+                jnp.int32(0), jnp.int32(0), None, jnp.int32(1),
+                commit_quorum=cfg.commit_quorum,
+                interpret=pallas_interpret(),
+            )
+            return st, info
+    else:
+        def _chunk(st, ps, cs):
+            st, infos = scan_replicate(
+                comm, False, cfg.commit_quorum, False, st, ps, cs,
+                jnp.int32(0), jnp.int32(1),
+                jnp.ones(cfg.n_replicas, bool),
+                jnp.zeros(cfg.n_replicas, bool),
+                # single-term pipeline: every index is current-term, so
+                # the fused whole-step steady program serves
+                term_floor=1,
+            )
+            return st, jax.tree.map(lambda a: a[-1], infos)
+
+    fn = jax.jit(_chunk, donate_argnums=(0,))
     B, E = cfg.batch_size, cfg.entry_bytes
     rng = np.random.default_rng(seed)
     state = init_state(cfg)
@@ -86,7 +114,7 @@ def run_device(
             fold_batch(data, cfg.n_replicas).reshape(T, B, -1)
         )
         state, infos = fn(state, payload, jnp.asarray(counts))
-        new_commit = int(np.asarray(infos.commit_index)[-1])
+        new_commit = int(np.asarray(infos.commit_index).ravel()[-1])
         assert new_commit == committed + take, (
             f"commit stalled: {new_commit} != {committed + take}"
         )
@@ -169,7 +197,11 @@ def main():
     ap.add_argument("--entries", type=int, default=1 << 20)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
-    cfg = RaftConfig()  # 3 replicas, 256 B, batch 1024 — the north star
+    # 3 replicas, 256 B entries, batch 1024 — the north star. The ring
+    # must hold one full pipeline chunk: the per-chunk fidelity read-back
+    # (SHA over follower bytes) can only serve entries still in the ring,
+    # so log_capacity >= CHUNK_STEPS * batch (a ~100 MB device ring).
+    cfg = RaftConfig(log_capacity=CHUNK_STEPS * 1024)
     dev_hash, p50, p99, wall, method = run_device(cfg, args.entries, args.seed)
     gold_hash = run_golden(
         args.entries, cfg.entry_bytes, args.seed, n_replicas=cfg.n_replicas
