@@ -60,6 +60,18 @@ CANDIDATE = "candidate"
 LEADER = "leader"
 
 
+def _pipeline_backend_ok() -> bool:
+    """The single-launch pipeline chunk runs on REAL hardware only —
+    deliberately stricter than ``ring._pallas_ok``: an engine chunk spans
+    the whole ring, so the flight always revisits destination blocks,
+    which interpret mode cannot model under in-place aliasing (bench.py's
+    lap gate asserts the regime on hardware; CI covers the engine gate
+    and bookkeeping through a transport shim that patches this hook)."""
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 class LinearizableReadRefused(Exception):
     # deliberately NOT a RuntimeError: ReplicatedKV.linearizable_get's
     # other failure mode (apply stream paused behind an archive gap)
@@ -449,6 +461,48 @@ class RaftEngine:
             eff = self._reach(r)
             pre_lasts = self._pre_lasts()
             floor, fpt = self._floor_attest(r)
+            if self._pipeline_eligible(r, take, T, leader_last, eff):
+                # The saturated fast path: the whole full-ring chunk as
+                # ONE kernel launch (core.step_pallas.steady_pipeline_tpu
+                # via the transport). The host gate below implies the
+                # kernel's launch-feasibility predicate, so every step
+                # ingests and commits a full batch — bookkeeping is the
+                # contiguous mapping, verified by the commit assert.
+                self.state, info = self.t.replicate_pipeline(
+                    self.state, payload_stack, jnp.asarray(counts), r,
+                    self.leader_term, jnp.asarray(eff),
+                    jnp.asarray(self.slow), member=self._member_arg(),
+                    repair_floor=floor, floor_prev_term=fpt,
+                    term_floor=self._term_floor,
+                )
+                self._note_truncations(pre_lasts)
+                final_commit = int(info.commit_index)
+                if final_commit != leader_last + take:
+                    # the host gate and the kernel's feasibility predicate
+                    # are meant to be equivalent; a desync means mappings
+                    # for the chunk cannot be trusted — fail loudly
+                    # rather than mis-account durable entries (restoring
+                    # the queue first so the exception is survivable)
+                    self._queue = pending + deferred + self._queue
+                    raise RuntimeError(
+                        f"pipeline chunk shortfall: committed "
+                        f"{final_commit}, expected {leader_last + take} "
+                        "(host feasibility gate out of sync with the "
+                        "kernel's launch predicate)"
+                    )
+                for i, (seq, p) in enumerate(chunk):
+                    idx = leader_last + 1 + i
+                    self._seq_at_index[idx] = seq
+                    self._uncommitted[idx] = (p, self.leader_term)
+                pending = pending[take:]
+                self.terms[eff] = np.maximum(self.terms[eff], self.leader_term)
+                self._persist_votes()
+                self._advance_commit(r, final_commit)
+                self._update_steady(r, info.match, eff)
+                if int(info.max_term) > self.leader_term:
+                    self._step_down_leader(r, int(info.max_term))
+                    break
+                continue
             self.state, infos = self.t.replicate_many(
                 self.state, payload_stack, jnp.asarray(counts), r,
                 self.leader_term, jnp.asarray(eff),
@@ -498,6 +552,54 @@ class RaftEngine:
         if self.leader_id == r:
             self._reset_heard_timers(r)
         return seqs
+
+    def _pipeline_eligible(self, r: int, take: int, T: int,
+                           leader_last: int, eff) -> bool:
+        """Host gate for the single-launch pipeline chunk: must IMPLY the
+        kernel's launch-feasibility predicate (core.step_pallas), so the
+        flight provably ingests and commits a full batch every step —
+        the contract the simplified contiguous bookkeeping rests on.
+
+        - the transport exposes the program and the shapes are
+          kernel-eligible (ring._pallas_ok);
+        - the chunk is exactly one full ring of full batches (counts all
+          B — padding heartbeat steps would break the affine geometry);
+        - the cluster is VERIFIED steady (every reachable non-slow
+          member's match at the leader's tail — the kernel's launch-time
+          accept set) and fully committed, with the start slot aligned;
+        - the accept set meets the commit quorum, and no reachable row
+          holds a higher term (those deny/depose instead of acking).
+        """
+        from raft_tpu.core.ring import _pallas_ok
+
+        cfg = self.cfg
+        B = cfg.batch_size
+        if not (
+            getattr(self.t, "replicate_pipeline", None) is not None
+            and _pipeline_backend_ok()
+            and take == T * B
+            and _pallas_ok(cfg.log_capacity, B)
+            and self._steady
+            and self.commit_watermark == leader_last
+        ):
+            return False
+        from raft_tpu.core.step_pallas import _pick_br
+
+        if leader_last % _pick_br(B, cfg.log_capacity) != 0:
+            return False
+        if np.any(self.terms[eff] > self.leader_term):
+            return False
+        accept = eff & ~self.slow
+        if cfg.max_replicas is not None:
+            # mirror core.step_pallas._params_and_masks EXACTLY: the
+            # kernel maxes the member majority with the static
+            # commit_quorum unconditionally (for non-EC that is the
+            # INITIAL configuration's majority)
+            quorum = max(int(self.member.sum()) // 2 + 1,
+                         cfg.commit_quorum)
+        else:
+            quorum = cfg.commit_quorum
+        return int(accept.sum()) >= quorum
 
     @property
     def in_flight_count(self) -> int:

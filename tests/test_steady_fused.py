@@ -483,3 +483,68 @@ class TestPipelineKernel:
             ec_consts=parity_consts(n, k),
         )
         assert int(info.commit_index) == T * B
+
+
+def test_engine_pipeline_chunk_gate_and_bookkeeping(monkeypatch):
+    """The engine's submit_pipelined fast path: full-ring chunks on a
+    verified-steady cluster go through transport.replicate_pipeline as
+    one launch, with contiguous seq bookkeeping — byte-identical to an
+    engine that never takes the fast path. CI exercises the gate and the
+    bookkeeping through a transport shim (the real kernel's lap regime
+    is hardware-gated in bench.py)."""
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    rng = np.random.default_rng(21)
+    ps = [rng.integers(0, 256, 8, dtype=np.uint8).tobytes()
+          for _ in range(640 + 120)]
+
+    def build(shimmed):
+        cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                         log_capacity=C, seed=6)
+        t = SingleDeviceTransport(cfg)
+        calls = []
+        if shimmed:
+            def shim(state, payloads, counts, r, term, alive, slow,
+                     member=None, repair_floor=0, floor_prev_term=0,
+                     term_floor=1):
+                calls.append(int(counts.shape[0]))
+                st, infos = t.replicate_many(
+                    state, payloads, counts, r, term, alive, slow,
+                    repair=False, member=member, repair_floor=repair_floor,
+                    floor_prev_term=floor_prev_term, term_floor=term_floor,
+                )
+                return st, jax.tree.map(lambda a: a[-1], infos)
+
+            t.replicate_pipeline = shim
+            import raft_tpu.raft.engine as engine_mod
+            monkeypatch.setattr(
+                engine_mod, "_pipeline_backend_ok", lambda: True
+            )
+        else:
+            # the fast path must not trigger: no transport support
+            t.replicate_pipeline = None
+            monkeypatch.setattr(
+                RaftEngine, "_pipeline_eligible",
+                lambda self, *a, **k: False,
+            )
+        e = RaftEngine(cfg, t)
+        e.run_until_leader()
+        # warm to verified-steady at a BLOCK-ALIGNED tail (the fast path
+        # requires last % BR == 0: misaligned starts would make the
+        # flight's spill blocks content-bearing distance-1 revisits)
+        warm = [e.submit(p) for p in ps[:128]]
+        e.run_until_committed(warm[-1])
+        e.run_for(4 * cfg.heartbeat_period)
+        seqs = e.submit_pipelined(ps[128:])       # 632 = 2 full chunks + 120
+        e.run_until_committed(seqs[-1], limit=900.0)
+        got = [bytes(x) for x in
+               np.asarray(e.committed_entries(
+                   max(1, e.commit_watermark - C + 1), e.commit_watermark))]
+        return e, calls, got
+
+    e1, calls, got1 = build(shimmed=True)
+    assert calls, "full-ring chunks never took the pipeline fast path"
+    e2, _, got2 = build(shimmed=False)
+    assert got1 == got2, "fast-path committed bytes diverged"
+    assert e1.commit_watermark == e2.commit_watermark
