@@ -548,3 +548,44 @@ def test_engine_pipeline_chunk_gate_and_bookkeeping(monkeypatch):
     e2, _, got2 = build(shimmed=False)
     assert got1 == got2, "fast-path committed bytes diverged"
     assert e1.commit_watermark == e2.commit_watermark
+
+
+def test_engine_pipeline_gate_negative_cases(monkeypatch):
+    """Each leg of the host gate refuses on its own: partial chunks,
+    misaligned tails, unsteady clusters, uncommitted backlogs, quorum
+    shortfalls, and non-TPU backends."""
+    import raft_tpu.raft.engine as engine_mod
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                     log_capacity=C, seed=7)
+    t = SingleDeviceTransport(cfg)
+    e = RaftEngine(cfg, t)
+    e.run_until_leader()
+    r = e.leader_id
+    T = C // B
+    eff = e._reach(r)
+    e._steady = True
+    # backend gate: everything else fine, but not on TPU -> refuse
+    assert not e._pipeline_eligible(r, T * B, T, 0, eff)
+    monkeypatch.setattr(engine_mod, "_pipeline_backend_ok", lambda: True)
+    assert e._pipeline_eligible(r, T * B, T, 0, eff)
+    # partial chunk
+    assert not e._pipeline_eligible(r, T * B - 4, T, 0, eff)
+    # misaligned tail
+    assert not e._pipeline_eligible(r, T * B, T, 8, eff)
+    # unsteady cluster
+    e._steady = False
+    assert not e._pipeline_eligible(r, T * B, T, 0, eff)
+    e._steady = True
+    # uncommitted backlog (watermark behind the tail)
+    e.commit_watermark = 0
+    assert not e._pipeline_eligible(r, T * B, T, B, eff)
+    # quorum shortfall: one live non-slow member is not a majority of 3
+    only_leader = np.zeros(cfg.rows, bool)
+    only_leader[r] = True
+    assert not e._pipeline_eligible(r, T * B, T, 0, only_leader)
+    # higher term visible on a reachable row
+    e.terms[(r + 1) % N] = e.leader_term + 1
+    assert not e._pipeline_eligible(r, T * B, T, 0, eff)
