@@ -125,12 +125,17 @@ def make_scan(cfg: RaftConfig, slow_mask, ec: bool,
         # chunked submit_pipelined pipeline expresses.
         from raft_tpu.core.ring import pallas_interpret
 
+        # turnover branch only when the static mask admits all-accept
+        # (an induced-slow row can never accept: compiling the branch
+        # would tax the aliased path through cond unification)
+        allow_turnover = not bool(np.asarray(slow_mask).any())
+
         def scan_fused(state):
             st, info = steady_pipeline_tpu(
                 state, wins, counts, leader, lterm, alive, slow,
                 jnp.int32(0), jnp.int32(0), None, jnp.int32(1),
                 commit_quorum=cfg.commit_quorum, ec_consts=ec_consts,
-                interpret=pallas_interpret(),
+                interpret=pallas_interpret(), allow_turnover=allow_turnover,
             )
             return st, info.commit_index
 
@@ -511,7 +516,15 @@ def _pipeline_lap_gate(rng) -> None:
     ])
     counts = jnp.full((T,), cfg.batch_size, jnp.int32)
     xs = jnp.stack([wins4[t % 4] for t in range(T)])
-    for slow in (np.zeros(3, bool), np.array([False, False, True])):
+    # three regimes: turnover (all-accept default), the ALIASED pipeline
+    # forced onto the same all-accept flight (allow_turnover=False), and
+    # the aliased pipeline with a never-accepting slow row
+    cases = [
+        (np.zeros(3, bool), True),
+        (np.zeros(3, bool), False),
+        (np.array([False, False, True]), False),
+    ]
+    for slow, allow in cases:
         args = (jnp.int32(0), jnp.int32(1), jnp.ones(3, bool),
                 jnp.asarray(slow), jnp.int32(0), jnp.int32(0), None,
                 jnp.int32(1))
@@ -521,12 +534,14 @@ def _pipeline_lap_gate(rng) -> None:
         )
         st_p, _ = steady_pipeline_tpu(
             init_state(cfg), wins4, counts, *args, commit_quorum=None,
+            allow_turnover=allow,
         )
         for f in ("term", "voted_for", "last_index", "commit_index",
                   "match_index", "match_term", "log_term", "log_payload"):
             np.testing.assert_array_equal(
                 np.asarray(getattr(st_s, f)), np.asarray(getattr(st_p, f)),
-                err_msg=f"pipeline lap regime diverges: {f} (slow={slow})",
+                err_msg=f"pipeline lap regime diverges: {f} "
+                        f"(slow={slow}, turnover={allow})",
             )
 
     # same gate for the EC lane geometry (Mk < M windows + in-kernel

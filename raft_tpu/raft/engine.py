@@ -474,6 +474,11 @@ class RaftEngine:
                     jnp.asarray(self.slow), member=self._member_arg(),
                     repair_floor=floor, floor_prev_term=fpt,
                     term_floor=self._term_floor,
+                    # write-only turnover only when the host knows EVERY
+                    # row accepts (all rows reachable members, none slow —
+                    # one np.all covers both); with False the program is
+                    # the plain pipeline-vs-scan two-way cond
+                    allow_turnover=bool(np.all(eff & ~self.slow)),
                 )
                 self._note_truncations(pre_lasts)
                 final_commit = int(info.commit_index)

@@ -126,6 +126,7 @@ class SingleDeviceTransport:
     def replicate_pipeline(
         self, state, payloads, counts, leader, leader_term, alive, slow,
         member=None, repair_floor=0, floor_prev_term=0, term_floor=1,
+        allow_turnover=True,
     ) -> Tuple[ReplicaState, RepInfo]:
         """T saturated steps as ONE kernel launch
         (core.step_pallas.steady_pipeline_tpu) — the engine dispatches
@@ -146,6 +147,7 @@ class SingleDeviceTransport:
                     interpret=pallas_interpret(),
                 ),
                 donate_argnums=(0,),
+                static_argnames=("allow_turnover",),
             )
         if self._member_mode and member is None:
             member = jnp.ones(self.cfg.rows, bool)
@@ -154,4 +156,5 @@ class SingleDeviceTransport:
             jnp.int32(leader_term), alive, slow,
             jnp.int32(floor_prev_term), jnp.int32(repair_floor),
             member if self._member_mode else None, jnp.int32(term_floor),
+            allow_turnover=bool(allow_turnover),
         )

@@ -507,7 +507,7 @@ def test_engine_pipeline_chunk_gate_and_bookkeeping(monkeypatch):
         if shimmed:
             def shim(state, payloads, counts, r, term, alive, slow,
                      member=None, repair_floor=0, floor_prev_term=0,
-                     term_floor=1):
+                     term_floor=1, allow_turnover=True):
                 calls.append(int(counts.shape[0]))
                 st, infos = t.replicate_many(
                     state, payloads, counts, r, term, alive, slow,
@@ -589,3 +589,137 @@ def test_engine_pipeline_gate_negative_cases(monkeypatch):
     # higher term visible on a reachable row
     e.terms[(r + 1) % N] = e.leader_term + 1
     assert not e._pipeline_eligible(r, T * B, T, 0, eff)
+
+
+class TestTurnoverKernel:
+    """The write-only full-turnover pipeline: no ring inputs, no
+    aliasing — interpret mode is faithful here even across ring laps,
+    so CI pins the lap regime directly."""
+
+    def test_full_turnover_matches_scan_across_laps(self):
+        from raft_tpu.core.step_pallas import (
+            steady_pipeline_tpu, steady_scan_replicate_tpu,
+        )
+
+        cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                         log_capacity=C)
+        T = 7                                   # 896 over 256: 3.5 laps
+        wins = jnp.stack([batch(700 + t, B) for t in range(T)])
+        counts = jnp.full((T,), B, jnp.int32)
+        args = (jnp.int32(0), jnp.int32(1), jnp.ones(N, bool),
+                jnp.zeros(N, bool), jnp.int32(0), jnp.int32(0), None,
+                jnp.int32(1))
+        st_s, _ = steady_scan_replicate_tpu(
+            init_state(cfg), wins, counts, *args, commit_quorum=None,
+            stack_infos=False, interpret=True,
+        )
+        st_p, info = steady_pipeline_tpu(
+            init_state(cfg), wins, counts, *args, commit_quorum=None,
+            interpret=True,
+        )
+        assert int(info.commit_index) == T * B
+        for f in ("term", "voted_for", "last_index", "commit_index",
+                  "match_index", "match_term", "log_term", "log_payload"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st_s, f)), np.asarray(getattr(st_p, f)),
+                err_msg=f"state.{f}",
+            )
+
+    def test_slow_row_keeps_general_path(self):
+        """A non-accepting row must keep the flight off the write-only
+        kernel (its lanes would be garbage). Below turnover scale
+        (T*B < C) the two-way dispatch serves; the turnover-scale
+        routing itself is asserted in test_slow_row_turnover_scale and
+        on hardware by bench.py's lap gate."""
+        from raft_tpu.core.step_pallas import (
+            steady_pipeline_tpu, steady_scan_replicate_tpu,
+        )
+
+        cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                         log_capacity=1024)   # no revisit: interpret-safe
+        T = 7
+        wins = jnp.stack([batch(800 + t, B) for t in range(T)])
+        counts = jnp.full((T,), B, jnp.int32)
+        slow1 = jnp.zeros(N, bool).at[2].set(True)
+        args = (jnp.int32(0), jnp.int32(1), jnp.ones(N, bool), slow1,
+                jnp.int32(0), jnp.int32(0), None, jnp.int32(1))
+        st_s, _ = steady_scan_replicate_tpu(
+            init_state(cfg), wins, counts, *args, commit_quorum=None,
+            stack_infos=False, interpret=True,
+        )
+        st_p, info = steady_pipeline_tpu(
+            init_state(cfg), wins, counts, *args, commit_quorum=None,
+            interpret=True,
+        )
+        assert int(info.commit_index) == T * B
+        for f in ("last_index", "commit_index", "log_term", "log_payload"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st_s, f)), np.asarray(getattr(st_p, f)),
+                err_msg=f"state.{f}",
+            )
+        # row 2's ring must be PRESERVED zeros (slow: nothing appended)
+        assert int(np.asarray(st_p.last_index)[2]) == 0
+
+    def test_ec_turnover_matches_scan(self):
+        from raft_tpu.core.step_pallas import (
+            steady_pipeline_tpu, steady_scan_replicate_tpu,
+        )
+        from raft_tpu.ec.kernels import fold_data_lanes, parity_consts
+
+        n, k = 5, 3
+        cfg = RaftConfig(n_replicas=n, entry_bytes=24, batch_size=B,
+                         log_capacity=C, rs_k=k, rs_m=n - k)
+        T = 5
+        rng = np.random.default_rng(13)
+        raw = rng.integers(0, 256, (T, B, 24), dtype=np.uint8)
+        wins = jnp.stack([fold_data_lanes(jnp.asarray(raw[t]))
+                          for t in range(T)])
+        counts = jnp.full((T,), B, jnp.int32)
+        args = (jnp.int32(0), jnp.int32(1), jnp.ones(n, bool),
+                jnp.zeros(n, bool), jnp.int32(0), jnp.int32(0), None,
+                jnp.int32(1))
+        consts = parity_consts(n, k)
+        st_s, _ = steady_scan_replicate_tpu(
+            init_state(cfg), wins, counts, *args,
+            commit_quorum=cfg.commit_quorum, stack_infos=False,
+            interpret=True, ec_consts=consts,
+        )
+        st_p, info = steady_pipeline_tpu(
+            init_state(cfg), wins, counts, *args,
+            commit_quorum=cfg.commit_quorum, interpret=True,
+            ec_consts=consts,
+        )
+        assert int(info.commit_index) == T * B
+        for f in ("last_index", "commit_index", "log_term", "log_payload"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st_s, f)), np.asarray(getattr(st_p, f)),
+                err_msg=f"state.{f}",
+            )
+
+
+    def test_slow_row_turnover_scale_preserves_quiet_rows(self):
+        """At turnover scale with a non-accepting row, all_accept must
+        route to the general (aliased) pipeline: the quiet row's ring
+        stays byte-identical to its pre-flight content. (Interpret mode
+        cannot model the accepting rows' revisited lanes here — those
+        are hardware-gated in bench.py — but the PRESERVED lanes read
+        the pre-call buffer either way, so this assertion is sound.)"""
+        from raft_tpu.core.step_pallas import steady_pipeline_tpu
+
+        cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                         log_capacity=C)
+        T = 4                                    # T*B = 2*C: turnover scale
+        wins = jnp.stack([batch(850 + t, B) for t in range(T)])
+        counts = jnp.full((T,), B, jnp.int32)
+        slow1 = jnp.zeros(N, bool).at[2].set(True)
+        st, info = steady_pipeline_tpu(
+            init_state(cfg), wins, counts, jnp.int32(0), jnp.int32(1),
+            jnp.ones(N, bool), slow1, jnp.int32(0), jnp.int32(0), None,
+            jnp.int32(1), commit_quorum=None, interpret=True,
+        )
+        assert int(info.commit_index) == T * B
+        assert int(np.asarray(st.last_index)[2]) == 0
+        # the quiet row's payload lanes: untouched init zeros
+        W = cfg.shard_words
+        lanes = np.asarray(st.log_payload)[:, 2 * W:3 * W]
+        assert (lanes == 0).all(), "slow row's ring lanes were clobbered"
