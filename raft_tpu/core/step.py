@@ -186,7 +186,7 @@ def replicate_step(
             state, client_payload, jnp.int32(client_count),
             jnp.int32(leader), jnp.int32(leader_term), alive, slow,
             jnp.int32(floor_prev_term), jnp.int32(repair_floor), member,
-            jnp.int32(term_floor), commit_quorum=commit_quorum,
+            jnp.int32(term_floor), commit_quorum=commit_quorum, ec=ec,
             interpret=pallas_interpret(),
         )
     ids = comm.replica_ids()                       # i32[L]
@@ -482,7 +482,7 @@ def scan_replicate(
             state, payloads, counts, jnp.int32(leader),
             jnp.int32(leader_term), alive, slow, jnp.int32(floor_prev_term),
             jnp.int32(repair_floor), member, jnp.int32(term_floor),
-            commit_quorum=commit_quorum, interpret=pallas_interpret(),
+            commit_quorum=commit_quorum, ec=ec, interpret=pallas_interpret(),
         )
 
     def body(st, xs):

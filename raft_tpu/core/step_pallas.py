@@ -419,7 +419,7 @@ def _unpack(vecs, log_term, log_payload) -> ReplicaState:
 
 def _params_and_masks(leader, leader_term, term_floor, repair_floor,
                       floor_prev_term, alive, slow, member, commit_quorum,
-                      L):
+                      L, ec=False):
     if member is None:
         quorum = jnp.int32(
             commit_quorum if commit_quorum is not None else L // 2 + 1
@@ -427,7 +427,14 @@ def _params_and_masks(leader, leader_term, term_floor, repair_floor,
         ackm = alive
     else:
         quorum = jnp.sum(member.astype(jnp.int32)) // 2 + 1
-        if commit_quorum is not None:
+        if ec and commit_quorum is not None:
+            # EC durability floor only (mirrors core.step.replicate_step's
+            # member branch): the static k+margin quorum must hold no
+            # matter how far membership shrinks. For non-EC the member
+            # majority alone governs — clamping to the INITIAL majority
+            # here would wedge a legitimately shrunk cluster (e.g. 5->2
+            # members needing 3 acks from 2 rows) and diverge from the
+            # general XLA path.
             quorum = jnp.maximum(quorum, jnp.int32(commit_quorum))
         ackm = alive & member
     params = jnp.stack([
@@ -449,7 +456,7 @@ def _mk_info(match_o, scal_o):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("commit_quorum", "interpret"),
+    static_argnames=("commit_quorum", "ec", "interpret"),
     donate_argnums=(0,),
 )
 def steady_replicate_step_tpu(
@@ -465,20 +472,24 @@ def steady_replicate_step_tpu(
     member: jax.Array | None,       # bool[L] | None
     term_floor: jax.Array,          # i32[] first index of leader's term
     commit_quorum: int | None = None,
+    ec: bool = False,               # STATIC: EC cluster — the commit
+    #                                 quorum is the k+margin durability
+    #                                 floor and must clamp the member
+    #                                 majority (see _params_and_masks)
     interpret: bool = False,
 ):
     """One steady-state replication step, resident layout, one pallas_call.
 
-    Semantics identical to ``core.step.replicate_step(repair=False,
-    ec=False)`` given a correct ``term_floor`` (see module doc); returns
-    the same ``(ReplicaState, RepInfo)``.
+    Semantics identical to ``core.step.replicate_step(repair=False)``
+    given a correct ``term_floor`` (see module doc); returns the same
+    ``(ReplicaState, RepInfo)``.
     """
     cap = state.capacity
     L = state.term.shape[0]
     vecs = _pack(state)
     params, masks = _params_and_masks(
         leader, leader_term, term_floor, repair_floor, floor_prev_term,
-        alive, slow, member, commit_quorum, L,
+        alive, slow, member, commit_quorum, L, ec=ec,
     )
     s, prev_col = _start_slot_and_prev(vecs, state.log_term, leader, cap, L)
     cnt = jnp.int32(client_count).reshape(1, 1)
@@ -503,6 +514,7 @@ def steady_scan_replicate_tpu(
     member: jax.Array | None,
     term_floor: jax.Array,
     commit_quorum: int | None = None,
+    ec: bool = False,               # STATIC: see steady_replicate_step_tpu
     interpret: bool = False,
     mk_payload=None,                # optional per-step window factory:
     #                                 win = mk_payload(xs_elem) inside the
@@ -531,6 +543,10 @@ def steady_scan_replicate_tpu(
     params, masks = _params_and_masks(
         leader, leader_term, term_floor, repair_floor, floor_prev_term,
         alive, slow, member, commit_quorum, L,
+        # in-kernel parity encoding (ec_consts) is only ever an EC
+        # configuration; engine EC chunks instead arrive pre-encoded
+        # (full-lane windows, ec_consts=None) and signal via ec=True
+        ec=ec or ec_consts is not None,
     )
 
     def body(carry, xs):
@@ -789,6 +805,7 @@ def steady_pipeline_tpu(
     leader, leader_term, alive, slow, floor_prev_term, repair_floor,
     member, term_floor,
     commit_quorum: int | None = None,
+    ec: bool = False,               # STATIC: see steady_replicate_step_tpu
     interpret: bool = False,
     ec_consts=None,
     allow_turnover: bool = True,    # STATIC: compile the write-only
@@ -836,6 +853,7 @@ def steady_pipeline_tpu(
     params, masks = _params_and_masks(
         leader, leader_term, term_floor, repair_floor, floor_prev_term,
         alive, slow, member, commit_quorum, L,
+        ec=ec or ec_consts is not None,
     )
     s0, prev0 = _start_slot_and_prev(vecs, state.log_term, leader, cap, L)
     cnts = counts.astype(jnp.int32).reshape(1, T)
@@ -876,7 +894,7 @@ def steady_pipeline_tpu(
         return steady_scan_replicate_tpu(
             state, jnp.arange(T), counts, leader, leader_term, alive,
             slow, floor_prev_term, repair_floor, member, term_floor,
-            commit_quorum=commit_quorum, interpret=interpret,
+            commit_quorum=commit_quorum, ec=ec, interpret=interpret,
             mk_payload=lambda t: jax.lax.dynamic_index_in_dim(
                 wins, t % P, 0, keepdims=False
             ),

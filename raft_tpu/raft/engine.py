@@ -483,17 +483,43 @@ class RaftEngine:
                 self._note_truncations(pre_lasts)
                 final_commit = int(info.commit_index)
                 if final_commit != leader_last + take:
-                    # the host gate and the kernel's feasibility predicate
+                    # The host gate and the kernel's feasibility predicate
                     # are meant to be equivalent; a desync means mappings
                     # for the chunk cannot be trusted — fail loudly
-                    # rather than mis-account durable entries (restoring
-                    # the queue first so the exception is survivable)
-                    self._queue = pending + deferred + self._queue
+                    # rather than mis-account durable entries. BUT first
+                    # reconcile, so the exception is survivable: account
+                    # the committed prefix (it is durable — its bytes must
+                    # never be re-queued), then truncate the orphaned
+                    # uncommitted suffix off the device log. Without the
+                    # truncation the re-queued payloads would coexist with
+                    # an unaccounted device copy, and a later repair tick
+                    # could replicate and commit both.
+                    done = min(max(final_commit - leader_last, 0), take)
+                    for i, (seq, p) in enumerate(chunk[:done]):
+                        idx = leader_last + 1 + i
+                        self._seq_at_index[idx] = seq
+                        self._uncommitted[idx] = (p, self.leader_term)
+                    self.terms[eff] = np.maximum(
+                        self.terms[eff], self.leader_term
+                    )
+                    self._persist_votes()
+                    self._advance_commit(r, leader_last + done)
+                    self._truncate_uncommitted_tail(
+                        leader_last + done,
+                        self._fetch(self.state.last_index),
+                    )
+                    # chunk[:done] is committed and stays accounted; the
+                    # rest of the chunk re-queues for a later tick
+                    self._queue = (
+                        list(chunk[done:]) + pending[take:] + deferred
+                        + self._queue
+                    )
                     raise RuntimeError(
                         f"pipeline chunk shortfall: committed "
                         f"{final_commit}, expected {leader_last + take} "
                         "(host feasibility gate out of sync with the "
-                        "kernel's launch predicate)"
+                        "kernel's launch predicate); device log "
+                        "reconciled, uncommitted remainder re-queued"
                     )
                 for i, (seq, p) in enumerate(chunk):
                     idx = leader_last + 1 + i
@@ -574,6 +600,17 @@ class RaftEngine:
           accept set) and fully committed, with the start slot aligned;
         - the accept set meets the commit quorum, and no reachable row
           holds a higher term (those deny/depose instead of acking).
+
+        The accept set is verified against the CURRENT device state (one
+        fetch of the term/last/match vectors), not the ``_steady`` flag
+        alone: ``_update_steady`` is vacuously True when the previous
+        step's verified set was empty, and a flag can never prove the
+        rows counted toward quorum are at the leader's tail *now*. A row
+        counts only if its device log provably matches the leader's
+        through ``leader_last`` (same tail index, match verified in the
+        current term, no higher term) — a sufficient condition for the
+        kernel's per-row accept predicate, so host-feasible implies
+        kernel-feasible.
         """
         from raft_tpu.core.ring import _pallas_ok
 
@@ -594,14 +631,32 @@ class RaftEngine:
             return False
         if np.any(self.terms[eff] > self.leader_term):
             return False
-        accept = eff & ~self.slow
+        lasts, matches, mterms, dterms = np.asarray(self._fetch(jnp.stack([
+            self.state.last_index, self.state.match_index,
+            self.state.match_term, self.state.term,
+        ])))
+        verified = (
+            (lasts == leader_last) & (dterms <= self.leader_term)
+            & (
+                (leader_last == 0)   # empty prefix: no prev point to
+                #                      verify (the kernel's ws0==1 clause)
+                | ((mterms == self.leader_term) & (matches >= leader_last))
+            )
+        )
+        # the leader's own row accepts its own frontier; it needs no
+        # verified match, only a current term and the expected tail
+        verified[r] = (
+            lasts[r] == leader_last and dterms[r] <= self.leader_term
+        )
+        accept = eff & ~self.slow & verified
         if cfg.max_replicas is not None:
-            # mirror core.step_pallas._params_and_masks EXACTLY: the
-            # kernel maxes the member majority with the static
-            # commit_quorum unconditionally (for non-EC that is the
-            # INITIAL configuration's majority)
-            quorum = max(int(self.member.sum()) // 2 + 1,
-                         cfg.commit_quorum)
+            # mirror core.step_pallas._params_and_masks EXACTLY: member
+            # majority, clamped to the static commit_quorum only under EC
+            # (the k+margin durability floor); for non-EC the member
+            # majority alone governs, matching the general XLA path
+            quorum = int(self.member.sum()) // 2 + 1
+            if cfg.ec_enabled:
+                quorum = max(quorum, cfg.commit_quorum)
         else:
             quorum = cfg.commit_quorum
         return int(accept.sum()) >= quorum

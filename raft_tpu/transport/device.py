@@ -144,6 +144,7 @@ class SingleDeviceTransport:
                 _partial(
                     steady_pipeline_tpu,
                     commit_quorum=self.cfg.commit_quorum,
+                    ec=self.cfg.ec_enabled,
                     interpret=pallas_interpret(),
                 ),
                 donate_argnums=(0,),

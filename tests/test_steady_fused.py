@@ -175,6 +175,23 @@ class TestScripted:
         )
         assert int(infos[-1].commit_index) == B
 
+    def test_member_shrunk_below_initial_majority_non_ec(self):
+        """ADVICE r4 (medium): a non-EC cluster shrunk below its initial
+        size commits under the CURRENT member majority on both programs.
+        The fused path used to clamp the member majority to the static
+        commit_quorum (the INITIAL configuration's majority)
+        unconditionally — a permanent commit wedge (e.g. one remaining
+        member needing 2 acks). The clamp is EC-only (durability floor);
+        run_schedule's equivalence asserts the paths agree byte-for-byte
+        with the real cfg.commit_quorum passed."""
+        member = [True, False, False]          # 3 -> 1 member; majority 1
+        st, infos = run_schedule(
+            [(1, B, 0, 1, ALL, NONE_SLOW, 1),
+             (2, B, 0, 1, ALL, NONE_SLOW, 1)],
+            member=member, commit_quorum=2,    # initial majority of 3
+        )
+        assert int(infos[-1].commit_index) == 2 * B
+
     def test_dead_rows(self):
         dead1 = [True, True, False]
         sched = [
@@ -466,6 +483,40 @@ class TestPipelineKernel:
         assert int(np.asarray(st.last_index)[0]) == C   # 2 steps appended
         assert int(info.commit_index) == 0
 
+    def test_member_shrunk_pipeline_commits(self):
+        """ADVICE r4 (medium), pipeline flavor: with membership shrunk
+        below the initial majority (non-EC), the launch-feasibility
+        quorum is the member majority — the flight stays feasible and
+        commits, identically on pipeline and scan."""
+        from raft_tpu.core.step_pallas import (
+            steady_pipeline_tpu, steady_scan_replicate_tpu,
+        )
+
+        cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                         log_capacity=1024)
+        T = 5
+        wins = jnp.stack([batch(950 + t, B) for t in range(T)])
+        counts = jnp.full((T,), B, jnp.int32)
+        member = jnp.asarray([True, False, False])
+        args = (jnp.int32(0), jnp.int32(1), jnp.ones(N, bool),
+                jnp.zeros(N, bool), jnp.int32(0), jnp.int32(0), member,
+                jnp.int32(1))
+        st_s, _ = steady_scan_replicate_tpu(
+            init_state(cfg), wins, counts, *args,
+            commit_quorum=cfg.commit_quorum, stack_infos=False,
+            interpret=True,
+        )
+        st_p, info = steady_pipeline_tpu(
+            init_state(cfg), wins, counts, *args,
+            commit_quorum=cfg.commit_quorum, interpret=True,
+        )
+        assert int(info.commit_index) == T * B
+        for f in ("last_index", "commit_index", "log_term", "log_payload"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st_s, f)), np.asarray(getattr(st_p, f)),
+                err_msg=f"state.{f}",
+            )
+
     def test_ec_pipeline_matches_scan(self):
         from raft_tpu.ec.kernels import fold_data_lanes, parity_consts
 
@@ -589,6 +640,97 @@ def test_engine_pipeline_gate_negative_cases(monkeypatch):
     # higher term visible on a reachable row
     e.terms[(r + 1) % N] = e.leader_term + 1
     assert not e._pipeline_eligible(r, T * B, T, 0, eff)
+
+
+def test_pipeline_gate_verifies_current_accept_set(monkeypatch):
+    """ADVICE r4 (low): the gate must not trust the (possibly vacuously
+    true) ``_steady`` flag — rows counted toward the launch quorum are
+    verified against the CURRENT device last/match/term vectors, so a
+    row that lags NOW is never counted no matter what the flag says."""
+    import raft_tpu.raft.engine as engine_mod
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                     log_capacity=C, seed=8)
+    t = SingleDeviceTransport(cfg)
+    e = RaftEngine(cfg, t)
+    e.run_until_leader()
+    r = e.leader_id
+    T = C // B
+    monkeypatch.setattr(engine_mod, "_pipeline_backend_ok", lambda: True)
+    ps = [bytes([i % 256]) * 8 for i in range(B)]
+    seqs = [e.submit(p) for p in ps]
+    e.run_until_committed(seqs[-1])
+    e.run_for(4 * cfg.heartbeat_period)
+    eff = e._reach(r)
+    e._steady = True
+    leader_last = int(np.asarray(e.state.last_index)[r])
+    assert e.commit_watermark == leader_last
+    assert e._pipeline_eligible(r, T * B, T, leader_last, eff)
+    # degrade both followers' device match on the quiet; the flag alone
+    # would still admit the flight — the state verification must refuse
+    e.state = e.state.replace(
+        match_index=jnp.zeros_like(e.state.match_index)
+    )
+    e._steady = True
+    assert not e._pipeline_eligible(r, T * B, T, leader_last, eff)
+
+
+def test_pipeline_shortfall_reconciles_device_log(monkeypatch):
+    """ADVICE r4 (low), second half: if the kernel still falls short of
+    the host gate's expectation, the engine must reconcile — truncate
+    the orphaned uncommitted suffix off the device log BEFORE re-queuing
+    the bytes — so a later tick can never commit two copies. The
+    exception stays (gate/kernel desync is a bug signal) but is
+    survivable: the same engine then commits every payload exactly
+    once through the regular tick path."""
+    import raft_tpu.raft.engine as engine_mod
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                     log_capacity=C, seed=9)
+    t = SingleDeviceTransport(cfg)
+
+    def sabotaged(state, payloads, counts, rr, term, alive, slow,
+                  member=None, repair_floor=0, floor_prev_term=0,
+                  term_floor=1, allow_turnover=True):
+        # every follower silently drops the chunk: the leader ingests it
+        # all, nothing commits — the worst-case gate/kernel desync
+        allslow = jnp.ones_like(jnp.asarray(slow), bool)
+        st, infos = t.replicate_many(
+            state, payloads, counts, rr, term, alive, allslow,
+            repair=False, member=member, repair_floor=repair_floor,
+            floor_prev_term=floor_prev_term, term_floor=term_floor,
+        )
+        return st, jax.tree.map(lambda a: a[-1], infos)
+
+    t.replicate_pipeline = sabotaged
+    monkeypatch.setattr(engine_mod, "_pipeline_backend_ok", lambda: True)
+    e = RaftEngine(cfg, t)
+    e.run_until_leader()
+    r = e.leader_id
+    rng = np.random.default_rng(33)
+    ps = [rng.integers(0, 256, 8, dtype=np.uint8).tobytes()
+          for _ in range(C)]
+    e._steady = True   # flag says steady; the device state agrees (all
+    #                    at tail 0) — only the sabotaged kernel desyncs
+    with pytest.raises(RuntimeError, match="pipeline chunk shortfall"):
+        e.submit_pipelined(ps)
+    # device log reconciled: the orphaned suffix is gone everywhere
+    assert int(np.asarray(e.state.last_index).max()) == 0
+    assert len(e._queue) == len(ps)
+    # the re-queued bytes commit exactly once through the regular path
+    t.replicate_pipeline = None
+    for _ in range(200):
+        if e.commit_watermark >= len(ps):
+            break
+        e.run_for(cfg.heartbeat_period)
+    assert e.commit_watermark == len(ps)
+    got = [bytes(x) for x in
+           np.asarray(e.committed_entries(1, len(ps)))]
+    assert got == ps
 
 
 class TestTurnoverKernel:
