@@ -325,6 +325,50 @@ def bench_rs53() -> dict:
     return out
 
 
+# ------------------------------------------------- mesh per-device kernel
+def bench_mesh1(rng) -> dict:
+    """Per-device fused-kernel overhead (VERDICT r4 #1 'Done' row): the
+    MESH program — per-device whole-step kernel with its launch
+    collectives, inside shard_map (core.step_mesh) — on a mesh of ONE
+    device, against the co-located resident kernel at the same shape.
+    One real chip cannot host a multi-row mesh, so the row isolates
+    exactly the delta the mesh formulation adds per device (gathers,
+    shard_map plumbing, localized data plane); the cross-device ICI hop
+    cost is bounded below by this number plus link latency."""
+    from raft_tpu.transport import SingleDeviceTransport, TpuMeshTransport
+
+    cfg = RaftConfig(n_replicas=1)
+    words = rng.integers(
+        np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+        (cfg.batch_size, cfg.shard_words), dtype=np.int32,
+    )
+    wins = jnp.asarray(words)[None]          # n=1: lanes == shard_words
+    counts = jnp.full((T_STEPS,), cfg.batch_size, jnp.int32)
+    alive = jnp.ones(1, bool)
+    slow = jnp.zeros(1, bool)
+    rows = {}
+    for name, t in (
+        ("mesh_of_1", TpuMeshTransport(cfg, jax.devices()[:1])),
+        ("co_located", SingleDeviceTransport(cfg)),
+    ):
+        def fn(state, t=t):
+            st, info = t.replicate_pipeline(
+                state, wins, counts, 0, 1, alive, slow, term_floor=1,
+                allow_turnover=True,
+            )
+            return st, info.commit_index
+
+        rows[name] = bench_scan(cfg, jax.jit(fn, donate_argnums=(0,)),
+                                reps=3)
+    return {
+        "mesh_of_1": rows["mesh_of_1"],
+        "co_located": rows["co_located"],
+        "per_device_overhead_us": round(
+            rows["mesh_of_1"]["p50_us"] - rows["co_located"]["p50_us"], 3
+        ),
+    }
+
+
 # --------------------------------------------------------------- config 5
 def bench_storm() -> dict:
     """Election churn: commit progress through a disruptive-candidacy
@@ -664,6 +708,7 @@ def main() -> None:
             "c3_rs53": bench_rs53(),
             "c4_slow": c4,
             "c5_storm": bench_storm(),
+            "mesh1_per_device": bench_mesh1(rng),
         },
     }
     print(json.dumps(out))

@@ -169,8 +169,27 @@ def replicate_step(
     cap = state.capacity
     B = client_payload.shape[0]
     M = client_payload.shape[1]                    # L * W folded lanes
-    from raft_tpu.core.comm import SingleDeviceComm
+    from raft_tpu.core.comm import MeshComm, SingleDeviceComm
 
+    if (
+        term_floor is not None and (not repair or ec)
+        and isinstance(comm, MeshComm) and _pallas_ok(cap, B)
+        and M == state.log_payload.shape[1]
+    ):
+        # mesh layout: the per-device fused kernel (replicated scalar
+        # plane + local data plane, two launch collectives —
+        # core.step_mesh). EC windows arrive pre-encoded, so the lane
+        # check above (full local lanes) holds for every engine call.
+        from raft_tpu.core.ring import pallas_interpret
+        from raft_tpu.core.step_mesh import mesh_replicate_step
+
+        return mesh_replicate_step(
+            comm.axis, state, client_payload, jnp.int32(client_count),
+            jnp.int32(leader), jnp.int32(leader_term), alive, slow,
+            jnp.int32(floor_prev_term), jnp.int32(repair_floor), member,
+            jnp.int32(term_floor), commit_quorum=commit_quorum, ec=ec,
+            interpret=pallas_interpret(),
+        )
     if (
         term_floor is not None and (not repair or ec)
         and isinstance(comm, SingleDeviceComm) and _pallas_ok(cap, B)
@@ -466,9 +485,26 @@ def scan_replicate(
     per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
     ``payloads``: i32[T, B, L*W] folded batches; ``counts``: i32[T];
     ``repair`` selects the repair-capable vs steady-state step program."""
-    from raft_tpu.core.comm import SingleDeviceComm
+    from raft_tpu.core.comm import MeshComm, SingleDeviceComm
 
     cap, B = state.capacity, payloads.shape[1]
+    if (
+        term_floor is not None and (not repair or ec)
+        and isinstance(comm, MeshComm) and _pallas_ok(cap, B)
+        and payloads.shape[2] == state.log_payload.shape[1]
+    ):
+        # per-device fused scan: ONE launch gather, zero collectives in
+        # the loop (core.step_mesh module doc)
+        from raft_tpu.core.ring import pallas_interpret
+        from raft_tpu.core.step_mesh import mesh_scan_replicate
+
+        return mesh_scan_replicate(
+            comm.axis, state, payloads, counts, jnp.int32(leader),
+            jnp.int32(leader_term), alive, slow, jnp.int32(floor_prev_term),
+            jnp.int32(repair_floor), member, jnp.int32(term_floor),
+            commit_quorum=commit_quorum, ec=ec,
+            interpret=pallas_interpret(),
+        )
     if (
         term_floor is not None and (not repair or ec)
         and isinstance(comm, SingleDeviceComm) and _pallas_ok(cap, B)

@@ -59,8 +59,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core.state import NO_VOTE, ReplicaState, slot_of
 
-# per-scan params operand layout (1-D SMEM, hoisted out of the loop)
-_LEADER, _LTERM, _TFLOOR, _RFLOOR, _FPT, _QUORUM = range(6)
+# per-scan params operand layout (1-D SMEM, hoisted out of the loop).
+# _MYROW is the local replica row's GLOBAL id in the mesh variant (the
+# per-device data plane, core.step_mesh); -1 and unread on the resident
+# layout.
+_LEADER, _LTERM, _TFLOOR, _RFLOOR, _FPT, _QUORUM, _MYROW = range(7)
+_NPARAMS = 7
 
 # packed state-vector rows (the (6, L) SMEM operand/result)
 _VT, _VV, _VL, _VC, _VMI, _VMT = range(6)
@@ -125,7 +129,20 @@ def _mul_const_packed(x, c_bits):
 # per-step geometry guard. A change to the merge, conflict check, parity
 # encode, or quorum logic must land in BOTH; tests/test_steady_fused.py
 # pins each against the general XLA formulation and against each other.
-def _steady_kernel(BR: int, C: int, L: int, pconsts, s_ref,
+#
+# ``local`` (static) selects the MESH data plane (core.step_mesh): the
+# scalar core still simulates ALL L(=R) rows from the gathered state
+# vectors — replicated SPMD work, identical on every device — but the
+# VMEM buffers hold only the local replica row's lanes (payload (C, W),
+# terms (1, C)), selected by the _MYROW param. The §5.3 conflict bit and
+# the next-prev stash, which read OTHER rows' ring content the device
+# does not hold, are replaced by their closed forms under the engine's
+# steady-program invariants (see core.step_mesh module doc): an
+# accepting row's tail lands exactly at the window end (a stale suffix
+# always conflicts — no follower holds current-term entries beyond the
+# leader's tail), and the next window's prev term is ``lterm`` for
+# accepting rows and provably != lterm for the rest (sentinel -1).
+def _steady_kernel(BR: int, C: int, L: int, pconsts, local, s_ref,
                    cnt_ref, prevt_ref, par_ref, vec_ref, msks_ref,
                    win_ref, bufp_ref, buft_ref,
                    outp_ref, outt_ref, vec_o, match_o, scal_o, nextp_o,
@@ -136,7 +153,7 @@ def _steady_kernel(BR: int, C: int, L: int, pconsts, s_ref,
     i = pl.program_id(0)
     off = s % BR
     M = outp_ref.shape[1]
-    W = M // L
+    W = M if local else M // L
     legit = lterm >= 1
 
     # ---- prologue: frontier accounting + per-row masks (grid step 0) -----
@@ -197,11 +214,18 @@ def _steady_kernel(BR: int, C: int, L: int, pconsts, s_ref,
     # ---- window merge: payload + uniform-term write + §5.3 check ---------
     r = jax.lax.broadcasted_iota(jnp.int32, (BR, M), 0)
     jj = BR * i - off + r
-    lane_rep = jax.lax.broadcasted_iota(jnp.int32, (BR, M), 1) // W
-    lanes = (lane_rep == 0) & (msk_ref[_ACC, 0] != 0)
-    for l in range(1, L):
-        lanes |= (lane_rep == l) & (msk_ref[_ACC, l] != 0)
-    sel = (jj >= 0) & (jj < count) & lanes
+    if local:
+        myr = par_ref[0, _MYROW]
+        acc_my = msk_ref[_ACC, 0]
+        for l in range(1, L):
+            acc_my = jnp.where(myr == l, msk_ref[_ACC, l], acc_my)
+        sel = (jj >= 0) & (jj < count) & (acc_my != 0)
+    else:
+        lane_rep = jax.lax.broadcasted_iota(jnp.int32, (BR, M), 1) // W
+        lanes = (lane_rep == 0) & (msk_ref[_ACC, 0] != 0)
+        for l in range(1, L):
+            lanes |= (lane_rep == l) & (msk_ref[_ACC, l] != 0)
+        sel = (jj >= 0) & (jj < count) & lanes
     val2 = jnp.concatenate([prevp_ref[:], win_ref[:]], axis=0)
     src = pltpu.roll(val2, off - BR, 0)[:BR]
     if pconsts is not None:
@@ -217,31 +241,39 @@ def _steady_kernel(BR: int, C: int, L: int, pconsts, s_ref,
     c1 = jax.lax.broadcasted_iota(jnp.int32, (1, BR), 1)
     jt1 = BR * i - off + c1
     valid1 = (jt1 >= 0) & (jt1 < count)                 # (1, BR)
-    curt = buft_ref[:]                                  # OLD terms (L, BR)
-    rows_t = []
-    for l in range(L):
-        cur_l = curt[l:l + 1, :]
-        rows_t.append(jnp.where(
-            valid1 & (msk_ref[_ACC, l] != 0), lterm, cur_l
-        ))
-        mm_row = valid1 & (ws + jt1 <= vec_ref[_VL, l]) & (cur_l != lterm)
-        msk_ref[_MM, l] |= jnp.max(jnp.where(mm_row, 1, 0))
-    outt_ref[:] = jnp.concatenate(rows_t, axis=0)
+    curt = buft_ref[:]                          # OLD terms (L or 1, BR)
+    if local:
+        # only the local row's term ring exists here; the conflict bit is
+        # closed-form in the epilogue (module NOTE above)
+        outt_ref[:] = jnp.where(valid1 & (acc_my != 0), lterm, curt)
+    else:
+        rows_t = []
+        for l in range(L):
+            cur_l = curt[l:l + 1, :]
+            rows_t.append(jnp.where(
+                valid1 & (msk_ref[_ACC, l] != 0), lterm, cur_l
+            ))
+            mm_row = valid1 & (ws + jt1 <= vec_ref[_VL, l]) & \
+                (cur_l != lterm)
+            msk_ref[_MM, l] |= jnp.max(jnp.where(mm_row, 1, 0))
+        outt_ref[:] = jnp.concatenate(rows_t, axis=0)
 
     # ---- stash the NEXT step's prev-term column while it is in VMEM ------
     # The next frontier window's prev entry is this window's last valid
     # entry (slot q); handing its term column to the next scan iteration
     # through the carry removes the host-graph slice of the term ring
     # whose data dependency serialized each iteration against the previous
-    # kernel's output.
-    q = (s + count - 1) % C
-    d = ((s // BR) + i) % (C // BR)
+    # kernel's output. (Local mode computes the closed form in the
+    # epilogue instead — other rows' ring content is not held here.)
+    if not local:
+        q = (s + count - 1) % C
+        d = ((s // BR) + i) % (C // BR)
 
-    @pl.when((count > 0) & (d == q // BR))
-    def _stash_next_prev():
-        sel_q = c1 == q % BR
-        for l in range(L):
-            nextp_o[l, 0] = jnp.sum(jnp.where(sel_q, rows_t[l], 0))
+        @pl.when((count > 0) & (d == q // BR))
+        def _stash_next_prev():
+            sel_q = c1 == q % BR
+            for l in range(L):
+                nextp_o[l, 0] = jnp.sum(jnp.where(sel_q, rows_t[l], 0))
 
     # ---- epilogue: state advance + quorum commit (last grid step) --------
     @pl.when(i == pl.num_programs(0) - 1)
@@ -257,14 +289,22 @@ def _steady_kernel(BR: int, C: int, L: int, pconsts, s_ref,
             heard = msk_ref[_HEARD, l] != 0
             m0 = msk_ref[_MEFF, l]
             last0 = vec_ref[_VL, l]
-            # no conflict: keep any consistent suffix beyond the window;
-            # conflict: truncate to the window end (Raft §5.3)
-            vec_o[_VL, l] = jnp.where(
-                acc,
-                jnp.where(mm, jnp.maximum(we, ws - 1),
-                          jnp.maximum(last0, we)),
-                last0,
-            )
+            if local:
+                # closed form (module NOTE): an accepting row's tail is
+                # exactly the window end — a consistent suffix beyond it
+                # cannot exist (it would be current-term entries past the
+                # leader's tail), so a longer tail always conflicts and
+                # truncates to ``we``
+                vec_o[_VL, l] = jnp.where(acc & (count > 0), we, last0)
+            else:
+                # no conflict: keep any consistent suffix beyond the
+                # window; conflict: truncate to the window end (§5.3)
+                vec_o[_VL, l] = jnp.where(
+                    acc,
+                    jnp.where(mm, jnp.maximum(we, ws - 1),
+                              jnp.maximum(last0, we)),
+                    last0,
+                )
             m1 = jnp.where(acc, jnp.maximum(m0, we), m0)
             meffs.append(m1)
             heards.append(heard)
@@ -318,30 +358,56 @@ def _steady_kernel(BR: int, C: int, L: int, pconsts, s_ref,
         # next step's window start slot: slot_of(leader_last_new + 1)
         scal_o[0, 3] = (ws - 1 + count) % C
 
-        @pl.when(count == 0)
-        def _next_prev_passthrough():
+        if local:
+            # closed-form next-prev column (module NOTE): accepting rows
+            # just wrote ``lterm`` at the window tail; for every other
+            # row the next window's prev slot provably does not hold
+            # lterm, so any value != lterm preserves the accept
+            # booleans — the -1 sentinel makes the mismatch explicit
             for l in range(L):
-                nextp_o[l, 0] = prevt_ref[l, 0]
+                nextp_o[l, 0] = jnp.where(
+                    count > 0,
+                    jnp.where(msk_ref[_ACC, l] != 0, lterm,
+                              jnp.int32(-1)),
+                    prevt_ref[l, 0],
+                )
+        else:
+            @pl.when(count == 0)
+            def _next_prev_passthrough():
+                for l in range(L):
+                    nextp_o[l, 0] = prevt_ref[l, 0]
 
 
 def _start_slot_and_prev(vecs, log_term, leader, cap, L):
     """The one piece the grid cannot compute for itself: the window start
     slot (its index maps consume it) and the prev-term column — one tiny
     fused XLA region per step."""
-    last0_l = vecs[_VL, leader]
-    ws = last0_l + 1
-    s = slot_of(ws, cap)
-    prev_slot = slot_of(jnp.maximum(ws - 1, 1), cap)
+    s, prev_slot = _frontier_slots(vecs[_VL, leader], cap)
     prev_col = jax.lax.dynamic_slice(
         log_term, (jnp.int32(0), prev_slot), (L, 1)
     ).astype(jnp.int32)
-    return jnp.int32(s)[None], prev_col
+    return s, prev_col
+
+
+def _frontier_slots(last0_l, cap):
+    """Window start slot and prev-term slot for a leader whose tail is
+    ``last0_l`` — shared by the resident ``_start_slot_and_prev`` and the
+    mesh ``core.step_mesh._gather_plane`` so the frontier geometry
+    (including the max(ws-1, 1) head clamp) can never drift between the
+    two layouts."""
+    ws = last0_l + 1
+    s = slot_of(ws, cap)
+    prev_slot = slot_of(jnp.maximum(ws - 1, 1), cap)
+    return jnp.int32(s)[None], prev_slot
 
 
 def _invoke(s, cnt, prev_col, params, vecs, masks, win, log_payload,
-            log_term, interpret, pconsts=None):
+            log_term, interpret, pconsts=None, local=False):
     cap, M = log_payload.shape
-    L = log_term.shape[0]
+    # local (mesh) mode: the scalar plane is R-wide (the gathered vecs)
+    # while the ring buffers hold one row's lanes — see _steady_kernel.
+    L = vecs.shape[1]
+    TL = log_term.shape[0]       # term-ring rows held here (1 when local)
     B, Mk = win.shape            # Mk = k*W data lanes when pconsts is set
     if (Mk != M) != (pconsts is not None):
         raise ValueError(
@@ -364,16 +430,16 @@ def _invoke(s, cnt, prev_col, params, vecs, masks, win, log_payload,
         in_specs=[
             smem((1, 1)),
             smem((L, 1)),
-            smem((1, 6)),
+            smem((1, _NPARAMS)),
             smem((6, L)),
             smem((3, L)),
             pl.BlockSpec((BR, Mk), lambda i, m: (jnp.clip(i, 0, WB - 1), 0)),
             pl.BlockSpec((BR, M), lambda i, m: (((m[0] // BR) + i) % CB, 0)),
-            pl.BlockSpec((L, BR), lambda i, m: (0, ((m[0] // BR) + i) % CB)),
+            pl.BlockSpec((TL, BR), lambda i, m: (0, ((m[0] // BR) + i) % CB)),
         ],
         out_specs=[
             pl.BlockSpec((BR, M), lambda i, m: (((m[0] // BR) + i) % CB, 0)),
-            pl.BlockSpec((L, BR), lambda i, m: (0, ((m[0] // BR) + i) % CB)),
+            pl.BlockSpec((TL, BR), lambda i, m: (0, ((m[0] // BR) + i) % CB)),
             smem((6, L)),
             smem((1, L)),
             smem((1, 4)),
@@ -385,10 +451,10 @@ def _invoke(s, cnt, prev_col, params, vecs, masks, win, log_payload,
         ],
     )
     return pl.pallas_call(
-        functools.partial(_steady_kernel, BR, cap, L, pconsts),
+        functools.partial(_steady_kernel, BR, cap, L, pconsts, local),
         out_shape=[
             jax.ShapeDtypeStruct((cap, M), log_payload.dtype),
-            jax.ShapeDtypeStruct((L, cap), log_term.dtype),
+            jax.ShapeDtypeStruct((TL, cap), log_term.dtype),
             jax.ShapeDtypeStruct((6, L), jnp.int32),
             jax.ShapeDtypeStruct((1, L), jnp.int32),
             jax.ShapeDtypeStruct((1, 4), jnp.int32),
@@ -419,7 +485,7 @@ def _unpack(vecs, log_term, log_payload) -> ReplicaState:
 
 def _params_and_masks(leader, leader_term, term_floor, repair_floor,
                       floor_prev_term, alive, slow, member, commit_quorum,
-                      L, ec=False):
+                      L, ec=False, my=None):
     if member is None:
         quorum = jnp.int32(
             commit_quorum if commit_quorum is not None else L // 2 + 1
@@ -440,6 +506,7 @@ def _params_and_masks(leader, leader_term, term_floor, repair_floor,
     params = jnp.stack([
         jnp.int32(leader), jnp.int32(leader_term), jnp.int32(term_floor),
         jnp.int32(repair_floor), jnp.int32(floor_prev_term), quorum,
+        jnp.int32(-1 if my is None else my),
     ])[None, :]
     masks = jnp.stack([alive, slow, ackm]).astype(jnp.int32)
     return params, masks
@@ -595,7 +662,7 @@ def steady_scan_replicate_tpu(
 # the scan formulation.
 
 def _steady_pipeline_kernel(BR: int, C: int, L: int, G: int, P: int,
-                            pconsts, s0_ref,
+                            pconsts, local, s0_ref,
                             counts_ref, prev0_ref, par_ref, vecs0_ref,
                             msks_ref, wins_ref, bufp_ref, buft_ref,
                             outp_ref, outt_ref, vec_o, match_o, scal_o,
@@ -608,7 +675,7 @@ def _steady_pipeline_kernel(BR: int, C: int, L: int, G: int, P: int,
     leader = par_ref[0, _LEADER]
     lterm = par_ref[0, _LTERM]
     M = outp_ref.shape[1]
-    W = M // L
+    W = M if local else M // L
     B = BR * (G - 1)
     off = s0 % BR                       # constant: B % BR == 0
     s_t = (s0 + t * B) % C              # the map's assumed start slot
@@ -681,11 +748,18 @@ def _steady_pipeline_kernel(BR: int, C: int, L: int, G: int, P: int,
     # ---- window merge (identical geometry to the per-step kernel) --------
     r = jax.lax.broadcasted_iota(jnp.int32, (BR, M), 0)
     jj = BR * i - off + r
-    lane_rep = jax.lax.broadcasted_iota(jnp.int32, (BR, M), 1) // W
-    lanes = (lane_rep == 0) & (msk_ref[_ACC, 0] != 0)
-    for l in range(1, L):
-        lanes |= (lane_rep == l) & (msk_ref[_ACC, l] != 0)
-    sel = (jj >= 0) & (jj < count) & lanes
+    if local:
+        myr = par_ref[0, _MYROW]
+        acc_my = msk_ref[_ACC, 0]
+        for l in range(1, L):
+            acc_my = jnp.where(myr == l, msk_ref[_ACC, l], acc_my)
+        sel = (jj >= 0) & (jj < count) & (acc_my != 0)
+    else:
+        lane_rep = jax.lax.broadcasted_iota(jnp.int32, (BR, M), 1) // W
+        lanes = (lane_rep == 0) & (msk_ref[_ACC, 0] != 0)
+        for l in range(1, L):
+            lanes |= (lane_rep == l) & (msk_ref[_ACC, l] != 0)
+        sel = (jj >= 0) & (jj < count) & lanes
     win = wins_ref[0]
     val2 = jnp.concatenate([prevp_ref[:], win], axis=0)
     src = pltpu.roll(val2, off - BR, 0)[:BR]
@@ -698,25 +772,32 @@ def _steady_pipeline_kernel(BR: int, C: int, L: int, G: int, P: int,
     jt1 = BR * i - off + c1
     valid1 = (jt1 >= 0) & (jt1 < count)
     curt = buft_ref[:]
-    rows_t = []
-    for l in range(L):
-        cur_l = curt[l:l + 1, :]
-        rows_t.append(jnp.where(
-            valid1 & (msk_ref[_ACC, l] != 0), lterm, cur_l
-        ))
-        mm_row = valid1 & (ws + jt1 <= vec_scr[_VL, l]) & (cur_l != lterm)
-        msk_ref[_MM, l] |= jnp.max(jnp.where(mm_row, 1, 0))
-    outt_ref[:] = jnp.concatenate(rows_t, axis=0)
-
-    # stash the next step's prev-term column while its block is in VMEM
-    q = (s_t + count - 1) % C
-    d = ((s_t // BR) + i) % (C // BR)
-
-    @pl.when((count > 0) & (d == q // BR))
-    def _stash_next_prev():
-        sel_q = c1 == q % BR
+    if local:
+        # local row's term ring only; conflict bit + next-prev are
+        # closed-form (see _steady_kernel NOTE)
+        outt_ref[:] = jnp.where(valid1 & (acc_my != 0), lterm, curt)
+    else:
+        rows_t = []
         for l in range(L):
-            prevc_scr[l, 0] = jnp.sum(jnp.where(sel_q, rows_t[l], 0))
+            cur_l = curt[l:l + 1, :]
+            rows_t.append(jnp.where(
+                valid1 & (msk_ref[_ACC, l] != 0), lterm, cur_l
+            ))
+            mm_row = valid1 & (ws + jt1 <= vec_scr[_VL, l]) & \
+                (cur_l != lterm)
+            msk_ref[_MM, l] |= jnp.max(jnp.where(mm_row, 1, 0))
+        outt_ref[:] = jnp.concatenate(rows_t, axis=0)
+
+        # stash the next step's prev-term column while its block is in
+        # VMEM
+        q = (s_t + count - 1) % C
+        d = ((s_t // BR) + i) % (C // BR)
+
+        @pl.when((count > 0) & (d == q // BR))
+        def _stash_next_prev():
+            sel_q = c1 == q % BR
+            for l in range(L):
+                prevc_scr[l, 0] = jnp.sum(jnp.where(sel_q, rows_t[l], 0))
 
     # ---- per-step epilogue (i == G-1) ------------------------------------
     @pl.when(i == G - 1)
@@ -732,12 +813,22 @@ def _steady_pipeline_kernel(BR: int, C: int, L: int, G: int, P: int,
             heard = msk_ref[_HEARD, l] != 0
             m0 = msk_ref[_MEFF, l]
             last0 = vec_scr[_VL, l]
-            vec_scr[_VL, l] = jnp.where(
-                acc,
-                jnp.where(mm, jnp.maximum(we, ws - 1),
-                          jnp.maximum(last0, we)),
-                last0,
-            )
+            if local:
+                # closed form (_steady_kernel NOTE); acc already implies
+                # count > 0 in the pipeline prologue
+                vec_scr[_VL, l] = jnp.where(acc, we, last0)
+                prevc_scr[l, 0] = jnp.where(
+                    count > 0,
+                    jnp.where(acc, lterm, jnp.int32(-1)),
+                    prevc_scr[l, 0],
+                )
+            else:
+                vec_scr[_VL, l] = jnp.where(
+                    acc,
+                    jnp.where(mm, jnp.maximum(we, ws - 1),
+                              jnp.maximum(last0, we)),
+                    last0,
+                )
             m1 = jnp.where(acc, jnp.maximum(m0, we), m0)
             meffs.append(m1)
             heards.append(heard)
@@ -793,6 +884,46 @@ def _steady_pipeline_kernel(BR: int, C: int, L: int, G: int, P: int,
             scal_o[0, 1] = max_term
             scal_o[0, 2] = count
             scal_o[0, 3] = (ws - 1 + count) % C
+
+
+def _launch_feasibility(vecs, masks, params, prev0, counts, s0, BR, B, L,
+                        leader, leader_term, repair_floor,
+                        floor_prev_term):
+    """The single-launch pipeline's launch-feasibility predicate and the
+    launch-time accept set (shared by the resident ``steady_pipeline_tpu``
+    and the mesh ``core.step_mesh`` pipeline so the two can never drift).
+    All inputs are replicated values; under ``shard_map`` every device
+    computes the identical decision."""
+    last0_l = vecs[_VL, leader]
+    commit0_l = vecs[_VC, leader]
+    term0_l = vecs[_VT, leader]
+    lterm = jnp.int32(leader_term)
+    leader_current = (lterm >= 1) & (term0_l <= lterm)
+    ws0 = last0_l + 1
+    prev_term = jnp.where(
+        ws0 - 1 < jnp.int32(repair_floor), jnp.int32(floor_prev_term),
+        prev0[leader, 0],
+    )
+    prev_term = jnp.where(ws0 == 1, 0, prev_term)
+    rows = jnp.arange(L)
+    accept0 = (
+        (masks[_MAL] != 0) & (masks[_MSL] == 0) & (masks[_MAK] != 0)
+        & (lterm >= vecs[_VT]) & (vecs[_VL] == last0_l)
+        & ((ws0 == 1) | (prev0[:, 0] == prev_term))
+    ) | ((rows == jnp.int32(leader)) & (masks[_MAK] != 0))
+    #     ^ the leader's own match counts toward the quorum only when it
+    #       is inside the ack mask (a departing non-member leader's row
+    #       is zeroed by the kernel's _MAK gate — counting it here would
+    #       declare a flight feasible that can never commit)
+    quorum = params[0, _QUORUM]
+    feasible = (
+        leader_current
+        & (commit0_l == last0_l)
+        & (s0[0] % BR == 0)
+        & jnp.all(counts == B)
+        & (jnp.sum(accept0.astype(jnp.int32)) >= quorum)
+    )
+    return feasible, accept0
 
 
 def steady_pipeline_tpu(
@@ -859,34 +990,9 @@ def steady_pipeline_tpu(
     cnts = counts.astype(jnp.int32).reshape(1, T)
 
     # ---- launch feasibility (see docstring) ------------------------------
-    last0_l = vecs[_VL, leader]
-    commit0_l = vecs[_VC, leader]
-    term0_l = vecs[_VT, leader]
-    lterm = jnp.int32(leader_term)
-    leader_current = (lterm >= 1) & (term0_l <= lterm)
-    ws0 = last0_l + 1
-    prev_term = jnp.where(
-        ws0 - 1 < jnp.int32(repair_floor), jnp.int32(floor_prev_term),
-        prev0[leader, 0],
-    )
-    prev_term = jnp.where(ws0 == 1, 0, prev_term)
-    rows = jnp.arange(L)
-    accept0 = (
-        (masks[_MAL] != 0) & (masks[_MSL] == 0) & (masks[_MAK] != 0)
-        & (lterm >= vecs[_VT]) & (vecs[_VL] == last0_l)
-        & ((ws0 == 1) | (prev0[:, 0] == prev_term))
-    ) | ((rows == jnp.int32(leader)) & (masks[_MAK] != 0))
-    #     ^ the leader's own match counts toward the quorum only when it
-    #       is inside the ack mask (a departing non-member leader's row
-    #       is zeroed by the kernel's _MAK gate — counting it here would
-    #       declare a flight feasible that can never commit)
-    quorum = params[0, _QUORUM]
-    feasible = (
-        leader_current
-        & (commit0_l == last0_l)
-        & (s0[0] % BR == 0)
-        & jnp.all(counts == B)
-        & (jnp.sum(accept0.astype(jnp.int32)) >= quorum)
+    feasible, accept0 = _launch_feasibility(
+        vecs, masks, params, prev0, counts, s0, BR, B, L, leader,
+        leader_term, repair_floor, floor_prev_term,
     )
 
     def run_scan(state):
@@ -938,7 +1044,8 @@ def steady_pipeline_tpu(
 
 def _run_pipeline(state, wins, cnts, s0, prev0, params, vecs, masks,
                   BR, G, CB, WB, P, T, cap, M, Mk, L, ec_consts,
-                  interpret):
+                  interpret, local=False):
+    TL = state.log_term.shape[0]         # 1 in local (mesh) mode
 
     def smem(shape):
         return pl.BlockSpec(shape, lambda t, i, m: (0,) * len(shape),
@@ -950,7 +1057,7 @@ def _run_pipeline(state, wins, cnts, s0, prev0, params, vecs, masks,
         in_specs=[
             smem((1, T)),
             smem((L, 1)),
-            smem((1, 6)),
+            smem((1, _NPARAMS)),
             smem((6, L)),
             smem((3, L)),
             pl.BlockSpec((1, BR, Mk),
@@ -960,7 +1067,7 @@ def _run_pipeline(state, wins, cnts, s0, prev0, params, vecs, masks,
                 lambda t, i, m: (((m[0] // BR) + t * WB + i) % CB, 0),
             ),
             pl.BlockSpec(
-                (L, BR),
+                (TL, BR),
                 lambda t, i, m: (0, ((m[0] // BR) + t * WB + i) % CB),
             ),
         ],
@@ -970,7 +1077,7 @@ def _run_pipeline(state, wins, cnts, s0, prev0, params, vecs, masks,
                 lambda t, i, m: (((m[0] // BR) + t * WB + i) % CB, 0),
             ),
             pl.BlockSpec(
-                (L, BR),
+                (TL, BR),
                 lambda t, i, m: (0, ((m[0] // BR) + t * WB + i) % CB),
             ),
             smem((6, L)),
@@ -987,10 +1094,10 @@ def _run_pipeline(state, wins, cnts, s0, prev0, params, vecs, masks,
     )
     outs = pl.pallas_call(
         functools.partial(_steady_pipeline_kernel, BR, cap, L, G, P,
-                          ec_consts),
+                          ec_consts, local),
         out_shape=[
             jax.ShapeDtypeStruct((cap, M), state.log_payload.dtype),
-            jax.ShapeDtypeStruct((L, cap), state.log_term.dtype),
+            jax.ShapeDtypeStruct((TL, cap), state.log_term.dtype),
             jax.ShapeDtypeStruct((6, L), jnp.int32),
             jax.ShapeDtypeStruct((1, L), jnp.int32),
             jax.ShapeDtypeStruct((1, 4), jnp.int32),
@@ -1003,6 +1110,8 @@ def _run_pipeline(state, wins, cnts, s0, prev0, params, vecs, masks,
     )(s0, cnts, prev0, params, vecs, masks, wins,
       state.log_payload, state.log_term)
     log_payload, log_term, vec_o, match_o, scal_o = outs
+    if local:
+        return (log_payload, log_term, vec_o), _mk_info(match_o, scal_o)
     return _unpack(vec_o, log_term, log_payload), _mk_info(match_o, scal_o)
 
 
@@ -1019,7 +1128,7 @@ def _run_pipeline(state, wins, cnts, s0, prev0, params, vecs, masks,
 
 
 def _turnover_kernel(BR: int, C: int, L: int, G: int, P: int, pconsts,
-                     s0_ref, par_ref, vecs0_ref,
+                     local, s0_ref, par_ref, vecs0_ref,
                      wins_ref, outp_ref, outt_ref, vec_o, scal_o,
                      vec_scr):
     t = pl.program_id(0)
@@ -1027,7 +1136,7 @@ def _turnover_kernel(BR: int, C: int, L: int, G: int, P: int, pconsts,
     T = pl.num_programs(0)
     lterm = par_ref[0, _LTERM]
     M = outp_ref.shape[1]
-    W = M // L
+    W = M if local else M // L
     B = BR * G
 
     @pl.when((t == 0) & (i == 0))
@@ -1036,12 +1145,14 @@ def _turnover_kernel(BR: int, C: int, L: int, G: int, P: int, pconsts,
             for l in range(L):
                 vec_scr[v, l] = vecs0_ref[v, l]
 
-    # window write: every lane of every row, unconditionally
+    # window write: every lane of every row, unconditionally (in local
+    # mode the buffers hold one row's lanes; the all-accept predicate
+    # that admitted this kernel covers the local row too)
     src = wins_ref[0]
     if pconsts is not None:
         src = _encode_parity_lanes(src, pconsts, BR, W)
     outp_ref[:] = src
-    outt_ref[:] = jnp.full((L, BR), lterm, jnp.int32)
+    outt_ref[:] = jnp.full((1 if local else L, BR), lterm, jnp.int32)
 
     # per-step epilogue: with all rows accepting a full window, the
     # bookkeeping is closed-form — same formulas as the general program
@@ -1076,8 +1187,9 @@ def _turnover_kernel(BR: int, C: int, L: int, G: int, P: int, pconsts,
 
 
 def _run_turnover(state, wins, s0, params, vecs, BR, CB, WB, P, T, cap,
-                  M, Mk, L, ec_consts, interpret):
+                  M, Mk, L, ec_consts, interpret, local=False):
     G = WB                               # off == 0: no overlap block
+    TL = state.log_term.shape[0]         # 1 in local (mesh) mode
 
     def smem(shape):
         return pl.BlockSpec(shape, lambda t, i, m: (0,) * len(shape),
@@ -1087,7 +1199,7 @@ def _run_turnover(state, wins, s0, params, vecs, BR, CB, WB, P, T, cap,
         num_scalar_prefetch=1,
         grid=(T, G),
         in_specs=[
-            smem((1, 6)),
+            smem((1, _NPARAMS)),
             smem((6, L)),
             pl.BlockSpec((1, BR, Mk),
                          lambda t, i, m: (t % P, i, 0)),
@@ -1098,7 +1210,7 @@ def _run_turnover(state, wins, s0, params, vecs, BR, CB, WB, P, T, cap,
                 lambda t, i, m: (((m[0] // BR) + t * WB + i) % CB, 0),
             ),
             pl.BlockSpec(
-                (L, BR),
+                (TL, BR),
                 lambda t, i, m: (0, ((m[0] // BR) + t * WB + i) % CB),
             ),
             smem((6, L)),
@@ -1107,10 +1219,11 @@ def _run_turnover(state, wins, s0, params, vecs, BR, CB, WB, P, T, cap,
         scratch_shapes=[pltpu.SMEM((6, L), jnp.int32)],
     )
     outs = pl.pallas_call(
-        functools.partial(_turnover_kernel, BR, cap, L, G, P, ec_consts),
+        functools.partial(_turnover_kernel, BR, cap, L, G, P, ec_consts,
+                          local),
         out_shape=[
             jax.ShapeDtypeStruct((cap, M), state.log_payload.dtype),
-            jax.ShapeDtypeStruct((L, cap), state.log_term.dtype),
+            jax.ShapeDtypeStruct((TL, cap), state.log_term.dtype),
             jax.ShapeDtypeStruct((6, L), jnp.int32),
             jax.ShapeDtypeStruct((1, 4), jnp.int32),
         ],
@@ -1119,4 +1232,6 @@ def _run_turnover(state, wins, s0, params, vecs, BR, CB, WB, P, T, cap,
     )(s0, params, vecs, wins)
     log_payload, log_term, vec_o, scal_o = outs
     match_o = vec_o[_VMI][None, :]       # all-accept: match == new tail
+    if local:
+        return (log_payload, log_term, vec_o), _mk_info(match_o, scal_o)
     return _unpack(vec_o, log_term, log_payload), _mk_info(match_o, scal_o)

@@ -495,15 +495,9 @@ class RaftEngine:
                     # an unaccounted device copy, and a later repair tick
                     # could replicate and commit both.
                     done = min(max(final_commit - leader_last, 0), take)
-                    for i, (seq, p) in enumerate(chunk[:done]):
-                        idx = leader_last + 1 + i
-                        self._seq_at_index[idx] = seq
-                        self._uncommitted[idx] = (p, self.leader_term)
-                    self.terms[eff] = np.maximum(
-                        self.terms[eff], self.leader_term
+                    self._account_chunk_prefix(
+                        r, chunk, done, leader_last, eff
                     )
-                    self._persist_votes()
-                    self._advance_commit(r, leader_last + done)
                     self._truncate_uncommitted_tail(
                         leader_last + done,
                         self._fetch(self.state.last_index),
@@ -521,14 +515,8 @@ class RaftEngine:
                         "kernel's launch predicate); device log "
                         "reconciled, uncommitted remainder re-queued"
                     )
-                for i, (seq, p) in enumerate(chunk):
-                    idx = leader_last + 1 + i
-                    self._seq_at_index[idx] = seq
-                    self._uncommitted[idx] = (p, self.leader_term)
+                self._account_chunk_prefix(r, chunk, take, leader_last, eff)
                 pending = pending[take:]
-                self.terms[eff] = np.maximum(self.terms[eff], self.leader_term)
-                self._persist_votes()
-                self._advance_commit(r, final_commit)
                 self._update_steady(r, info.match, eff)
                 if int(info.max_term) > self.leader_term:
                     self._step_down_leader(r, int(info.max_term))
@@ -583,6 +571,22 @@ class RaftEngine:
         if self.leader_id == r:
             self._reset_heard_timers(r)
         return seqs
+
+    def _account_chunk_prefix(self, r: int, chunk, n: int,
+                              leader_last: int, eff) -> None:
+        """Durable accounting for the first ``n`` entries of a pipeline
+        chunk at contiguous indices after ``leader_last``: stamp seq and
+        payload bookkeeping, fence term durability to disk, then advance
+        the commit watermark (archive + ack). Shared by the fast path's
+        success and shortfall-reconcile branches so the two can never
+        drift on what "durably accounted" means."""
+        for i, (seq, p) in enumerate(chunk[:n]):
+            idx = leader_last + 1 + i
+            self._seq_at_index[idx] = seq
+            self._uncommitted[idx] = (p, self.leader_term)
+        self.terms[eff] = np.maximum(self.terms[eff], self.leader_term)
+        self._persist_votes()
+        self._advance_commit(r, leader_last + n)
 
     def _pipeline_eligible(self, r: int, take: int, T: int,
                            leader_last: int, eff) -> bool:

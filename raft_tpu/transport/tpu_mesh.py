@@ -146,6 +146,17 @@ class TpuMeshTransport:
         if cfg.ec_enabled:
             self._replicate[False] = self._replicate[True]
             self._replicate_many[False] = self._replicate_many[True]
+        # fused-dispatch program family (built lazily): same protocol
+        # functions with the engine's term_floor threaded through, which
+        # lets core.step route to the per-device fused kernels
+        # (core.step_mesh) when the shape allows — VERDICT r4 #1: the
+        # deployment shape and the fast shape are the same program now.
+        self._comm = comm
+        self._state_specs = state_specs
+        self._info_specs = info_specs
+        self._lanes = lanes
+        self._mem_spec = mem_spec
+        self._fused = {}
 
     def init(self) -> ReplicaState:
         state = init_state(self.cfg)
@@ -178,18 +189,90 @@ class TpuMeshTransport:
         RS shards)."""
         return jax.device_put(payload, self._payload2)
 
+    def _member_or_ones(self, member):
+        return jnp.ones(self.cfg.rows, bool) if member is None else member
+
+    def _fused_program(self, kind: str, rep: bool, allow_turnover=True):
+        """shard_map programs that thread ``term_floor`` through, so the
+        per-step dispatch inside core.step (one source of truth) can
+        route to the per-device fused kernels. Built lazily per
+        (kind, repair[, turnover]) and cached."""
+        key = (kind, rep, allow_turnover)
+        if key in self._fused:
+            return self._fused[key]
+        cfg = self.cfg
+        comm = self._comm
+        lanes = self._lanes
+        mm = self._member_mode
+
+        if kind == "replicate":
+            def fn(state, payload, cnt, leader, lterm, alive, slow, fpt,
+                   rf, *rest):
+                member = rest[0] if mm else None
+                tf = rest[-1]
+                return replicate_step(
+                    comm, state, payload, cnt, leader, lterm, alive,
+                    slow, fpt, rf, member, ec=cfg.ec_enabled,
+                    commit_quorum=cfg.commit_quorum, repair=rep,
+                    term_floor=tf,
+                )
+            win_spec = P(None, lanes)
+        elif kind == "replicate_many":
+            def fn(state, payloads, counts, leader, lterm, alive, slow,
+                   fpt, rf, *rest):
+                member = rest[0] if mm else None
+                tf = rest[-1]
+                return scan_replicate(
+                    comm, cfg.ec_enabled, cfg.commit_quorum, rep, state,
+                    payloads, counts, leader, lterm, alive, slow, fpt,
+                    rf, member, term_floor=tf,
+                )
+            win_spec = P(None, None, lanes)
+        else:                                    # "pipeline"
+            from raft_tpu.core.ring import pallas_interpret
+            from raft_tpu.core.step_mesh import mesh_pipeline
+
+            def fn(state, wins, counts, leader, lterm, alive, slow, fpt,
+                   rf, *rest):
+                member = rest[0] if mm else None
+                tf = rest[-1]
+                return mesh_pipeline(
+                    AXIS, state, wins, counts, leader, lterm, alive,
+                    slow, fpt, rf, member, tf,
+                    commit_quorum=cfg.commit_quorum, ec=cfg.ec_enabled,
+                    interpret=pallas_interpret(),
+                    allow_turnover=allow_turnover,
+                )
+            win_spec = P(None, None, lanes)
+
+        prog = jax.jit(
+            jax.shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(
+                    self._state_specs, win_spec,
+                    P(), P(), P(), P(), P(), P(), P(),
+                ) + self._mem_spec + (P(),),
+                out_specs=(self._state_specs, self._info_specs),
+                check_vma=False,
+            )
+        )
+        self._fused[key] = prog
+        return prog
+
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
         alive, slow, repair=True, member=None, repair_floor=0,
         floor_prev_term=0, term_floor=None,
     ) -> Tuple[ReplicaState, RepInfo]:
-        # term_floor is accepted for interface parity and unused: the mesh
-        # program's Comm ops are real collectives, which the fused resident
-        # step cannot express — the general §5.4.2 ring-read gate runs here.
-        extra = ()
-        if self._member_mode:
-            extra = (jnp.ones(self.cfg.rows, bool) if member is None
-                     else member,)
+        extra = (self._member_or_ones(member),) if self._member_mode else ()
+        if term_floor is not None:
+            return self._fused_program("replicate", bool(repair))(
+                state, client_payload, jnp.int32(client_count),
+                jnp.int32(leader), jnp.int32(leader_term), alive, slow,
+                jnp.int32(floor_prev_term), jnp.int32(repair_floor),
+                *extra, jnp.int32(term_floor),
+            )
         return self._replicate[bool(repair)](
             state, client_payload, jnp.int32(client_count), jnp.int32(leader),
             jnp.int32(leader_term), alive, slow,
@@ -202,14 +285,39 @@ class TpuMeshTransport:
         term_floor=None,
     ) -> Tuple[ReplicaState, RepInfo]:
         """i32[T, B, R*W] folded payloads → T steps in one compiled scan."""
-        extra = ()
-        if self._member_mode:
-            extra = (jnp.ones(self.cfg.rows, bool) if member is None
-                     else member,)
+        extra = (self._member_or_ones(member),) if self._member_mode else ()
+        if term_floor is not None:
+            return self._fused_program("replicate_many", bool(repair))(
+                state, payloads, counts, jnp.int32(leader),
+                jnp.int32(leader_term), alive, slow,
+                jnp.int32(floor_prev_term), jnp.int32(repair_floor),
+                *extra, jnp.int32(term_floor),
+            )
         return self._replicate_many[bool(repair)](
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
             alive, slow, jnp.int32(floor_prev_term), jnp.int32(repair_floor),
             *extra,
+        )
+
+    def replicate_pipeline(
+        self, state, payloads, counts, leader, leader_term, alive, slow,
+        member=None, repair_floor=0, floor_prev_term=0, term_floor=1,
+        allow_turnover=True,
+    ) -> Tuple[ReplicaState, RepInfo]:
+        """T saturated steps as ONE per-device kernel launch over the
+        mesh (core.step_mesh.mesh_pipeline): two launch collectives,
+        then a communication-free flight on every chip. Same contract
+        as the single-device ``replicate_pipeline`` — the engine's host
+        gate implies the (shared) launch-feasibility predicate and
+        verifies commit progress covers the chunk."""
+        extra = (self._member_or_ones(member),) if self._member_mode else ()
+        return self._fused_program(
+            "pipeline", True, bool(allow_turnover)
+        )(
+            state, payloads, counts, jnp.int32(leader),
+            jnp.int32(leader_term), alive, slow,
+            jnp.int32(floor_prev_term), jnp.int32(repair_floor),
+            *extra, jnp.int32(term_floor),
         )
 
     def request_votes(
