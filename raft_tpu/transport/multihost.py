@@ -40,14 +40,26 @@ Surviving a real process death (what re-formation requires)
 -----------------------------------------------------------
 ``tests/test_multiprocess.py::test_process_death_survivor_reforms`` kills
 one of two OS processes with SIGKILL mid-traffic and asserts the survivor
-keeps committing. The recovery contract, honestly stated:
+keeps committing; ``test_three_process_reformation_and_rejoin`` runs the
+FULL elastic loop at N=3 — the surviving majority agrees on who is left,
+derives a new coordinator, re-forms, keeps committing, and the killed
+process later rejoins and snapshot-heals back to full strength. The
+survivor-agreement/epoch machinery lives in ``transport.reform``
+(heartbeats, deterministic coordinator derivation, max-watermark
+checkpoint election, write-once epoch publication, join requests). The
+recovery contract, honestly stated:
 
-1. **Detection.** A fixed JAX mesh gives no failure notification: the
-   survivor's next collective simply stalls (or raises a fabric timeout).
+1. **Detection.** A fixed JAX mesh gives no failure notification for a
+   non-leader peer: the survivor's next collective simply stalls.
    Detection is therefore a *progress watchdog* — the mirrored loops
    commit in lockstep, so "no committed round for T seconds" is the
    peer-death signal. T must exceed the longest legitimate stall
-   (compiles, checkpoint writes).
+   (compiles, checkpoint writes). Death of the runtime COORDINATOR is
+   detected faster and harder: the coordination service fast-fails every
+   surviving worker (an uncatchable LOG(FATAL)), so each host runs a
+   tiny supervisor (the k8s/systemd pattern) that treats that exit as
+   the detection signal and restarts the worker into the re-formation
+   path.
 2. **Re-formation is a restart, not a live mesh shrink.** XLA backends
    pin the process set at ``jax.distributed.initialize``; a survivor
    cannot drop a dead peer from a live mesh. It re-execs itself (or is

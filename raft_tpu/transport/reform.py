@@ -1,0 +1,308 @@
+"""Process-group re-formation: the elastic-recovery loop for multihost.
+
+``transport/multihost.py`` states the recovery contract for a mirrored
+multi-process cluster: detection is a progress watchdog (a fixed JAX mesh
+gives no failure notification), re-formation is a restart into a fresh
+runtime over the processes that remain, and state comes from stable
+storage. Round 4 proved the 2-process->1 half of that contract
+(tests/test_multiprocess.py). This module supplies the piece an N>=3
+cluster additionally needs: **agreement on who survived, who coordinates
+the next runtime, and which checkpoint the new epoch restores from** —
+plus the rejoin path for a process that comes back from the dead.
+
+The agreement medium is a shared **rendezvous directory** on common
+storage — the stand-in for the deployment's supervisor or config service
+(k8s, etcd, a cluster manager); the reference has no analogue (its whole
+"cluster" is goroutines in one process, main.go:12). The protocol:
+
+- Every process writes a *heartbeat* file each committed round:
+  ``hb-{pid}.json`` = {time, epoch, round, wm, ckpt}. Heartbeats double
+  as the failure detector's evidence and the checkpoint directory.
+- Epochs are numbered runtime generations. ``epoch-{n}.json`` (written
+  atomically, write-once) fixes the new generation: its member set, the
+  JAX coordinator address, the checkpoint to restore from, and the
+  replica rows considered dead. Processes poll for epochs that include
+  them and re-exec into the new runtime.
+- **Coordinator derivation**: the survivor with the lowest pid among
+  fresh heartbeats proposes the next epoch — a deterministic rule every
+  survivor evaluates identically, so losing the ORIGINAL coordinator
+  (process 0, the jax.distributed rendezvous host) just promotes the
+  next-lowest survivor. Write-once epoch files make a racing duplicate
+  proposal harmless (first rename wins; the loser re-reads).
+- **Checkpoint election**: the proposer restores the epoch from the
+  fresh checkpoint with the HIGHEST watermark. Every process only acks
+  entries after its own checkpoint covers them, and mirrored processes
+  commit identical prefixes, so the max-watermark checkpoint covers
+  every acked entry of every survivor — the durability fence holds
+  across re-formation.
+- **Rejoin**: a restarted process writes ``join-{pid}`` and waits.
+  Members see the pending join on their next round; the current
+  coordinator proposes an epoch with the joiner added back and its
+  replica row no longer marked dead. The joiner's engine state comes
+  entirely from the elected checkpoint (the snapshot-install of the
+  mirrored model); its device row then heals forward through the
+  engine's repair window / snapshot heal like any lapped replica.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def _atomic_write(path: str, payload: dict) -> bool:
+    """Write-once atomic JSON publish: False if ``path`` already exists
+    (or appears concurrently — os.link semantics make the publish
+    exclusive even when two proposers race)."""
+    if os.path.exists(path):
+        return False
+    # unique tmp per attempt: pid alone collides for two writers in one
+    # process (threads) or across pid reuse after a kill
+    import uuid
+
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, path)          # fails if a racer published first
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        os.unlink(tmp)
+
+
+@dataclass
+class Epoch:
+    n: int
+    members: List[int]              # original process ids, sorted
+    coord: str                      # jax.distributed coordinator address
+    ckpt: Optional[str]             # checkpoint to restore (None: fresh)
+    dead_rows: List[int] = field(default_factory=list)
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.members)
+
+    def process_id(self, pid: int) -> int:
+        return self.members.index(pid)
+
+
+class Rendezvous:
+    """One process's handle on the shared re-formation directory."""
+
+    def __init__(self, root: str, pid: int):
+        self.root = root
+        self.pid = pid
+        os.makedirs(root, exist_ok=True)
+
+    # ---- heartbeats ----------------------------------------------------
+    def heartbeat(self, epoch: int, round_no: int, wm: int,
+                  ckpt: Optional[str]) -> None:
+        path = os.path.join(self.root, f"hb-{self.pid}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"time": time.time(), "epoch": epoch,
+                       "round": round_no, "wm": wm, "ckpt": ckpt}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def my_heartbeat(self) -> Optional[dict]:
+        """This process's last published heartbeat (stale or not) — the
+        restart path reads it to learn which epoch it last participated
+        in and which checkpoint it last fenced acks behind."""
+        path = os.path.join(self.root, f"hb-{self.pid}.json")
+        try:
+            return json.load(open(path))
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def fresh_peers(self, stale_s: float) -> Dict[int, dict]:
+        """pids (self included) whose heartbeat is younger than
+        ``stale_s`` — the failure detector's survivor estimate."""
+        now = time.time()
+        out: Dict[int, dict] = {}
+        for f in os.listdir(self.root):
+            # exact-shape match: a concurrent writer's hb-N.json.tmp must
+            # not be parsed (os.replace makes the .json itself atomic)
+            if not (f.startswith("hb-") and f.endswith(".json")):
+                continue
+            try:
+                hb = json.load(open(os.path.join(self.root, f)))
+            except (json.JSONDecodeError, OSError):
+                continue                      # torn concurrent write
+            if now - hb["time"] <= stale_s:
+                out[int(f[3:-5])] = hb
+        return out
+
+    # ---- epochs --------------------------------------------------------
+    def latest_epoch(self) -> Optional[Epoch]:
+        best = None
+        for f in os.listdir(self.root):
+            if f.startswith("epoch-") and f.endswith(".json"):
+                n = int(f[6:-5])
+                if best is None or n > best:
+                    best = n
+        if best is None:
+            return None
+        d = json.load(open(os.path.join(self.root, f"epoch-{best}.json")))
+        return Epoch(n=best, members=sorted(d["members"]),
+                     coord=d["coord"], ckpt=d.get("ckpt"),
+                     dead_rows=d.get("dead_rows", []))
+
+    def publish_epoch(self, n: int, members: List[int],
+                      ckpt: Optional[str],
+                      dead_rows: List[int]) -> Optional[Epoch]:
+        """Publish epoch ``n`` (write-once). The coordinator address is a
+        freshly bound localhost port; jax.distributed requires the
+        process with process_id 0 — i.e. ``sorted(members)[0]`` — to
+        host the service there, so on a real fabric the address host
+        must be that member's hostname (the localhost CI cluster makes
+        every choice valid). The probe-then-close port pick is TOCTOU:
+        another process can take the port before the coordinator binds
+        it. That failure is SELF-HEALING, not permanent — the epoch's
+        members fail ``initialize`` (bounded timeout), their supervisors
+        restart them into the reform path (each entry attempt first
+        heartbeats its target epoch, so a re-entry loop cannot form),
+        and the next proposal mints a fresh port in epoch ``n+1``.
+        Returns None if a racer published first (caller re-reads)."""
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        coord = f"127.0.0.1:{port}"
+        ep = {"members": sorted(members), "coord": coord, "ckpt": ckpt,
+              "dead_rows": sorted(dead_rows)}
+        if _atomic_write(os.path.join(self.root, f"epoch-{n}.json"), ep):
+            return Epoch(n=n, members=sorted(members), coord=coord,
+                         ckpt=ckpt, dead_rows=sorted(dead_rows))
+        return None
+
+    def propose_next_epoch(self, prev: Epoch, survivors: Dict[int, dict],
+                           joiners: List[int]) -> Optional[Epoch]:
+        """Coordinator-side epoch bump: members = fresh survivors of the
+        previous epoch plus any joiners; dead rows = rows of members that
+        did NOT survive (row == original pid, the initial placement
+        convention) minus rows coming back; checkpoint = the survivor
+        checkpoint with the highest watermark (see module doc)."""
+        alive = sorted(set(survivors) & set(prev.members))
+        members = sorted(set(alive) | set(joiners))
+        dead = sorted(
+            (set(prev.members) | set(prev.dead_rows)) - set(members)
+        )
+        best_ckpt, best_wm = None, -1
+        for p in alive:
+            hb = survivors[p]
+            if hb.get("ckpt") and hb.get("wm", -1) > best_wm:
+                best_ckpt, best_wm = hb["ckpt"], hb["wm"]
+        return self.publish_epoch(prev.n + 1, members, best_ckpt, dead)
+
+    def is_coordinator(self, survivors: Dict[int, dict],
+                       members: Optional[List[int]] = None) -> bool:
+        """Deterministic coordinator derivation: lowest fresh pid —
+        restricted to the current epoch's ``members`` when given, so a
+        waiting joiner (fresh but not a member) can never self-elect."""
+        pool = set(survivors)
+        if members is not None:
+            pool &= set(members)
+        return bool(pool) and min(pool) == self.pid
+
+    # ---- joins ---------------------------------------------------------
+    def request_join(self) -> None:
+        _atomic_write(
+            os.path.join(self.root, f"join-{self.pid}.json"),
+            {"time": time.time()},
+        )
+
+    def pending_joins(self, members: List[int],
+                      stale_s: Optional[float] = None) -> List[int]:
+        """Join requests from non-members. With ``stale_s``, only joiners
+        with a FRESH heartbeat count (a waiting joiner heartbeats in
+        ``await_epoch_including_me``) — a leftover join file from a
+        process that died again must not be folded into an epoch it can
+        never connect to."""
+        fresh = None if stale_s is None else self.fresh_peers(stale_s)
+        out = []
+        for f in os.listdir(self.root):
+            if f.startswith("join-") and f.endswith(".json"):
+                p = int(f[5:-5])
+                if p in members:
+                    self.clear_join(p)      # folded in: retire the file
+                elif fresh is None or p in fresh:
+                    out.append(p)
+        return sorted(out)
+
+    def clear_join(self, pid: int) -> None:
+        try:
+            os.unlink(os.path.join(self.root, f"join-{pid}.json"))
+        except FileNotFoundError:
+            pass
+
+    def await_epoch_including_me(self, after: int = 0,
+                                 timeout_s: float = 600.0,
+                                 poll_s: float = 0.3,
+                                 hb: Optional[dict] = None) -> Epoch:
+        """Block until an epoch newer than ``after`` lists this pid as a
+        member, heartbeating meanwhile so the failure detector keeps
+        counting this process as alive. ``hb`` carries the last known
+        {round, wm, ckpt} so the re-published heartbeat stays a valid
+        candidate in the checkpoint election (clobbering it with
+        placeholders could silently drop the max-watermark checkpoint
+        from the next epoch's restore choice)."""
+        hb = hb or {}
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            ep = self.latest_epoch()
+            if ep is not None and ep.n > after and self.pid in ep.members:
+                self.clear_join(self.pid)
+                return ep
+            self.heartbeat(after, hb.get("round", -1), hb.get("wm", -1),
+                           hb.get("ckpt"))
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"pid {self.pid}: no epoch after {after} included me"
+        )
+
+    def reform(self, cur: Epoch, stall_s: float, joiners: List[int] = (),
+               timeout_s: float = 600.0, hb: Optional[dict] = None) -> Epoch:
+        """Drive one re-formation to completion: wait out heartbeat
+        staleness, derive the coordinator from the fresh set, propose the
+        next epoch if that is this process, and return the first epoch
+        newer than ``cur`` that includes this pid. Safe for every
+        survivor to call concurrently — non-coordinators just wait, a
+        lost proposal race falls through to the published epoch, and the
+        coordinator re-derivation loop covers the case where the
+        would-be coordinator is itself dead (its heartbeat goes stale
+        and the next-lowest survivor takes over)."""
+        hb = hb or {}
+        deadline = time.time() + timeout_s
+        seen, seen_at = None, time.time()
+        settle_s = 6.0
+        while time.time() < deadline:
+            ep = self.latest_epoch()
+            if ep is not None and ep.n > cur.n and self.pid in ep.members:
+                return ep
+            self.heartbeat(cur.n, hb.get("round", -1), hb.get("wm", -1),
+                           hb.get("ckpt"))
+            fresh = self.fresh_peers(stall_s)
+            # settle window: the fresh set must hold still before the
+            # derived coordinator proposes, so two survivors re-exec'ing
+            # a second apart converge on the SAME survivor set instead of
+            # the faster one forming a smaller epoch without the other
+            key = tuple(sorted(fresh))
+            if key != seen:
+                seen, seen_at = key, time.time()
+            if (
+                self.is_coordinator(fresh, cur.members)
+                and time.time() - seen_at >= settle_s
+            ):
+                self.propose_next_epoch(cur, fresh, list(joiners))
+            time.sleep(0.5)
+        raise TimeoutError(f"pid {self.pid}: re-formation stalled")
