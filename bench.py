@@ -377,35 +377,75 @@ def bench_storm() -> dict:
     follower timeout draw, uniform 10-29 s, main.go:114) and
     re-election convergence after a leader crash (timeout draw + the
     10-13 s candidate retry cadence, main.go:194), measured over >= 1k
-    virtual seconds with periodic leader kills layered on the storm."""
+    virtual seconds with periodic leader kills layered on the storm.
+
+    Run twice: with the reference's election dynamics (no §9.6
+    machinery — the comparable number), and with ``prevote`` +
+    ``check_quorum`` on, where the storm's injected candidacies are
+    suppressed by leader stickiness and convergence reduces to honest
+    post-crash elections."""
+    from raft_tpu.transport import SingleDeviceTransport
+
+    cfg0 = RaftConfig(
+        n_replicas=3, entry_bytes=256, batch_size=64, log_capacity=1 << 12,
+        transport="single",
+    )
+    t = SingleDeviceTransport(cfg0)  # compiled programs shared by BOTH
+    #                                  variants (the flags are host-side)
+    base = bench_storm_once(prevote=False, transport=t)
+    # shorter hardened window (fewer kill samples) keeps the whole bench
+    # inside the driver budget; the signal — suppressed campaigns, terms
+    # not spent, leaderless time collapsing to the honest crash
+    # recoveries — survives intact. Note the per-gap convergence TIME is
+    # bounded below by the reference's 10-29 s timeout draw either way;
+    # §9.6's win is that the storm stops CREATING gaps (and stops
+    # spending terms), not that honest elections get faster.
+    hardened = bench_storm_once(prevote=True, transport=t, window=400.0,
+                                measure_first_leader=False)
+    base["with_prevote_checkquorum"] = {
+        k: hardened[k]
+        for k in ("injections_attempted", "campaigns_real",
+                  "virtual_window_s", "submitted", "committed",
+                  "commit_ratio", "virtual_commit_p50_s",
+                  "reelection_convergence_s", "leaderless_total_s",
+                  "terms_spent")
+    }
+    return base
+
+
+def bench_storm_once(prevote: bool, transport=None, window: float = 1000.0,
+                     measure_first_leader: bool = True) -> dict:
     from raft_tpu.faults import FaultPlan
     from raft_tpu.raft import RaftEngine
     from raft_tpu.transport import SingleDeviceTransport
 
     cfg = RaftConfig(
         n_replicas=3, entry_bytes=256, batch_size=64, log_capacity=1 << 12,
-        transport="single", seed=2,
+        transport="single", seed=2, prevote=prevote, check_quorum=prevote,
     )
-    t = SingleDeviceTransport(cfg)   # one compiled program set, reused
+    t = transport if transport is not None else SingleDeviceTransport(cfg)
 
     # -- time to first leader over many seeds (the 10-29 s draw) ---------
-    first_leader = []
-    for seed in range(16):
-        e = RaftEngine(
-            RaftConfig(
-                n_replicas=3, entry_bytes=256, batch_size=64,
-                log_capacity=1 << 12, transport="single", seed=seed,
-            ),
-            t,
-        )
-        e.run_until_leader()
-        first_leader.append(e.clock.now)
+    first_leader = [float("nan")]
+    if measure_first_leader:
+        first_leader = []
+        for seed in range(16):
+            e = RaftEngine(
+                RaftConfig(
+                    n_replicas=3, entry_bytes=256, batch_size=64,
+                    log_capacity=1 << 12, transport="single", seed=seed,
+                    prevote=prevote, check_quorum=prevote,
+                ),
+                t,
+            )
+            e.run_until_leader()
+            first_leader.append(e.clock.now)
 
-    # -- storm + crash/recover over >= 1000 virtual seconds --------------
-    e = RaftEngine(cfg, t)
+    # -- storm + crash/recover over the virtual window -------------------
+    trace_lines: list = []
+    e = RaftEngine(cfg, t, trace=trace_lines.append)
     e.run_until_leader()
     t_start = e.clock.now
-    window = 1000.0
     plan = FaultPlan.election_storm(3, t_start, t_start + window, 5.0, seed=3)
     e.schedule_faults(plan)
     # a leader kill every ~100 s (recover 30 s later): each creates a
@@ -413,7 +453,7 @@ def bench_storm() -> dict:
     # reference's re-election scenario. The victim is whoever leads at
     # kill time, so the kills are driven inline rather than scheduled.
     kills = [(t_start + 50.0 + 100.0 * k, t_start + 80.0 + 100.0 * k)
-             for k in range(9)]
+             for k in range(max(int(window) // 100 - 1, 1))]
     seqs = []
     next_submit = t_start
     lost_at = None
@@ -444,7 +484,13 @@ def bench_storm() -> dict:
             lost_at = None
     lat = e.commit_latencies()
     out = {
-        "storm_campaigns": len(plan.events),
+        # injections the storm SCHEDULED vs candidacies that actually
+        # happened (term bumps): with PreVote on, the gap between the
+        # two IS the §9.6 suppression at work
+        "injections_attempted": len(plan.events),
+        "campaigns_real": sum(
+            1 for ln in trace_lines if "state changed to candidate" in ln
+        ),
         "leader_kills": ki,
         "virtual_window_s": window,
         "submitted": len(seqs),
@@ -468,6 +514,13 @@ def bench_storm() -> dict:
             "max": round(float(np.max(gaps)), 2) if gaps else None,
             "samples": len(gaps),
         },
+        # availability: total leaderless virtual time in the window —
+        # the §9.6 comparison metric (PreVote stops the storm from
+        # CREATING gaps; the per-gap close time stays timeout-bound)
+        "leaderless_total_s": round(float(np.sum(gaps)), 2) if gaps else 0.0,
+        # how many terms the window burned: the §9.6 machinery's whole
+        # point is that disruption no longer costs terms
+        "terms_spent": int(e.terms.max()),
     }
     return out
 

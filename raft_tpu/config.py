@@ -64,6 +64,23 @@ class RaftConfig:
     # backpressure point is the ring (core.step's room clamp).
     channel_depth: int = 10
 
+    # --- liveness hardening (dissertation §9.6) ---
+    # prevote: a follower whose election timer fires first solicits
+    #   NON-BINDING votes at term+1 (no term bump, nothing persisted) and
+    #   only campaigns for real if it would win — a grantor refuses while
+    #   it has heard a live leader within the minimum election timeout
+    #   (leader stickiness) or holds a more up-to-date log (§5.4.1). A
+    #   partitioned replica therefore stops inflating its term and cannot
+    #   depose a healthy leader on heal.
+    # check_quorum: a leader that cannot contact a member majority for a
+    #   full minimum election timeout steps down on its own — the
+    #   minority side of a partition goes quiet instead of heartbeating
+    #   a stale leadership forever.
+    # Both default OFF: the reference has neither, and the differential
+    # suites pin the reference's election dynamics.
+    prevote: bool = False
+    check_quorum: bool = False
+
     # --- steady-state program dispatch ---
     # "auto": run the repair-free step program whenever the last step showed
     #   every live non-slow follower caught up (~11% faster on the 3-replica

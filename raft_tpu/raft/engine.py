@@ -48,6 +48,7 @@ import heapq
 import random
 from typing import Callable, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -159,6 +160,12 @@ class RaftEngine:
         #   max_term by the same mask).
         self.leader_id: Optional[int] = None
         self.leader_term = 0
+        self._last_heard = np.full(n, -1e18)
+        #   When each replica last heard a leader's traffic (virtual
+        #   clock) — the §9.6 leader-stickiness evidence for PreVote.
+        self._quorum_contact_at: Dict[int, float] = {}
+        #   Per-leader: when it last contacted a member majority
+        #   (CheckQuorum's lease clock).
         self.commit_watermark = 0                  # committed LOG INDEX
         self.submit_time: Dict[int, float] = {}    # seq -> submit time
         self.commit_time: Dict[int, float] = {}    # seq -> commit time
@@ -903,6 +910,12 @@ class RaftEngine:
             return
         if self.roles[r] == LEADER and self.leader_id == r:
             return  # a leader bumping itself is a no-op disruption
+        if self.cfg.prevote and not self._prevote_wins(r):
+            # §9.6 is exactly the defense against this injection: the
+            # stickiness clause refuses the disruption while a live
+            # leader is heartbeating, so the storm costs no terms
+            self.nodelog(r, "injected candidacy suppressed by pre-vote")
+            return
         self.roles[r] = CANDIDATE
         self.terms[r] += 1
         self.nodelog(r, "state changed to candidate (injected)")
@@ -1072,6 +1085,13 @@ class RaftEngine:
         # heartbeats (main.go:124-127); replicate steps re-arm heard
         # followers, so a firing timer here means no current leader reached
         # this replica — campaign.
+        if self.cfg.prevote and not self._prevote_wins(r):
+            # §9.6: a would-be loser neither bumps its term nor disturbs
+            # anyone — it stays a follower and tries again later. A
+            # partitioned replica's term therefore stops inflating.
+            self.nodelog(r, "pre-vote failed; staying follower")
+            self._arm_follower(r)
+            return
         self.roles[r] = CANDIDATE
         self.terms[r] += 1
         self.nodelog(r, "state changed to candidate")
@@ -1081,8 +1101,56 @@ class RaftEngine:
         """Candidate re-election timeout (main.go:248-251): term+1, retry."""
         if not self.alive[r] or self.roles[r] != CANDIDATE or not self.member[r]:
             return
+        if self.cfg.prevote and not self._prevote_wins(r):
+            # the retry would lose too (a leader re-emerged, or the
+            # partition holds): demote without spending another term
+            self.roles[r] = FOLLOWER
+            self.nodelog(r, "pre-vote failed; state changed to follower")
+            self._arm_follower(r)
+            return
         self.terms[r] += 1
         self._campaign(r)
+
+    def _prevote_wins(self, r: int) -> bool:
+        """§9.6 PreVote round, host-side and NON-BINDING: would a member
+        majority grant ``r`` a vote at term+1? A grantor refuses when it
+        already sits at/above that term, when its log is more up to date
+        (the device vote round's §5.4.1 check, mirrored here), or when
+        it heard a live leader within the minimum election timeout
+        (leader stickiness — the clause that makes a rejoining
+        partitioned node harmless). Nothing is persisted and no device
+        state changes: a losing pre-vote leaves the cluster exactly as
+        it was, which is the entire point."""
+        eff = self._reach(r)
+        if not hasattr(self, "_last_keys_jit"):
+            cap = self.state.capacity
+
+            def _keys(state):
+                lasts = state.last_index
+                slots = (jnp.maximum(lasts, 1) - 1) % cap
+                lt = jnp.take_along_axis(
+                    state.log_term, slots[:, None], 1
+                )[:, 0]
+                return jnp.stack([lasts, jnp.where(lasts > 0, lt, 0)])
+
+            self._last_keys_jit = jax.jit(_keys)
+        lasts, last_terms = np.asarray(
+            self._fetch(self._last_keys_jit(self.state))
+        )
+        cand_key = (int(last_terms[r]), int(lasts[r]))
+        cand_term = int(self.terms[r]) + 1
+        stick = self.cfg.follower_timeout[0]
+        grants = 0
+        for p in np.flatnonzero(eff):
+            p = int(p)
+            if int(self.terms[p]) >= cand_term:
+                continue
+            if (int(last_terms[p]), int(lasts[p])) > cand_key:
+                continue
+            if p != r and self.clock.now - self._last_heard[p] < stick:
+                continue
+            grants += 1
+        return grants > int(self.member.sum()) // 2
 
     def _campaign(self, r: int) -> None:
         """One collective vote round (replaces the serial poll,
@@ -1173,6 +1241,7 @@ class RaftEngine:
             self.leader_id = r
             self.leader_term = cand_term
             self.lead_terms[r] = cand_term
+            self._quorum_contact_at[r] = self.clock.now  # CheckQuorum lease
             self._steady = False   # matches reset per term; repair re-verifies
             # §5.4.2 floor for the fused steady program: everything this
             # leader appends from here on carries cand_term
@@ -1211,6 +1280,25 @@ class RaftEngine:
             self._step_down_leader(r, int(self.terms[r]))
             return
         cfg = self.cfg
+        if cfg.check_quorum:
+            # §9.6 CheckQuorum: renew the lease while a member majority
+            # is reachable; a leader cut off for a full minimum election
+            # timeout demotes ITSELF (same term — nothing was heard),
+            # silencing the minority side of a partition instead of
+            # heartbeating a stale leadership forever.
+            if int(self._reach(r).sum()) > int(self.member.sum()) // 2:
+                self._quorum_contact_at[r] = self.clock.now
+            elif (self.clock.now
+                    - self._quorum_contact_at.setdefault(r, self.clock.now)
+                    >= cfg.follower_timeout[0]):
+                self.roles[r] = FOLLOWER
+                if self.leader_id == r:
+                    self.leader_id = None
+                self.nodelog(
+                    r, "step down to follower (lost quorum contact)"
+                )
+                self._arm_follower(r)
+                return
         B = cfg.batch_size
         routed = self.leader_id == r
         eff = self._reach(r)
@@ -1463,10 +1551,14 @@ class RaftEngine:
         """Replication traffic is the heartbeat: every heard follower's
         election timer resets (main.go:124-127) and a candidate hearing a
         current leader steps down (main.go:204-217)."""
+        self._last_heard[r] = self.clock.now
+        #   the source hears itself: a live leader must refuse pre-votes
+        #   against its own leadership (§9.6 stickiness)
         for p in range(self.cfg.rows):
             if p == r or not self.alive[p] or not self.connectivity[r, p]\
                     or not self.member[p]:
                 continue   # unreachable replicas hear nothing
+            self._last_heard[p] = self.clock.now   # §9.6 stickiness clock
             if self.roles[p] == FOLLOWER:
                 self._arm_follower(p)
             elif self.roles[p] == CANDIDATE:

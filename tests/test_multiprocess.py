@@ -393,8 +393,10 @@ if ep is None:
     raise SystemExit("no bootstrap epoch")
 if PID not in ep.members:
     # REJOIN: announce, heartbeat, wait for the coordinator to add us
+    # (hb= keeps our pre-death wm/ckpt in the republished heartbeat —
+    # the checkpoint election must never see placeholder values)
     rv.request_join()
-    ep = rv.await_epoch_including_me(after=ep.n)
+    ep = rv.await_epoch_including_me(after=ep.n, hb=rv.my_heartbeat())
 elif os.environ.pop("RAFT_REFORM", None):
     # restarted after a worker death: if a newer epoch we have NOT yet
     # tried already includes us (the runtime died because a peer moved
