@@ -81,6 +81,15 @@ class RaftConfig:
     prevote: bool = False
     check_quorum: bool = False
 
+    # --- multihost mirror desync guard ---
+    # Every N-th control-plane decision (event-heap pop), fold the
+    # decision and its observable outcome into a rolling digest and
+    # exchange digests across processes; mismatch raises
+    # ``MirrorDesyncError`` (fail-stop) instead of letting a divergence
+    # surface as a silently wrong collective or a hang. 0 = off (the
+    # single-process default; the digest fold itself is skipped too).
+    mirror_check_every: int = 0
+
     # --- steady-state program dispatch ---
     # "auto": run the repair-free step program whenever the last step showed
     #   every live non-slow follower caught up (~11% faster on the 3-replica

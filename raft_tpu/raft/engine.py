@@ -86,6 +86,15 @@ class LinearizableReadRefused(Exception):
     leader — serving it here could return stale state."""
 
 
+class MirrorDesyncError(Exception):
+    """The mirrored multihost control planes' decision streams diverged
+    (``RaftConfig.mirror_check_every``): a fail-stop with both digests
+    in the message, instead of the silent wrong collective or hang a
+    divergence would otherwise become. Recovery is a process-group
+    restart from stable storage (transport.reform) — the in-memory
+    control state of at least one process is untrustworthy."""
+
+
 class VirtualClock:
     """Deterministic time source; the engine advances it to each event."""
 
@@ -163,6 +172,10 @@ class RaftEngine:
         self._last_heard = np.full(n, -1e18)
         #   When each replica last heard a leader's traffic (virtual
         #   clock) — the §9.6 leader-stickiness evidence for PreVote.
+        self._mirror_digest = 0
+        self._mirror_decisions = 0
+        #   Rolling CRC of the decision stream + check cadence counter
+        #   (multihost mirror desync guard — _mirror_digest_step).
         self._quorum_contact_at: Dict[int, float] = {}
         #   Per-leader: when it last contacted a member majority
         #   (CheckQuorum's lease clock).
@@ -1025,26 +1038,88 @@ class RaftEngine:
         t, _, kind, r = heapq.heappop(self._q)
         self.clock.now = max(self.clock.now, t)
         tag, _, gen = kind.partition(":")
-        if tag in ("e", "c") and int(gen) != self._timer_gen[r]:
-            return True  # stale timer generation (reset since armed)
-        if tag == "e":
-            self._fire_follower(r)
-        elif tag == "c":
-            self._fire_candidate(r)
-        elif tag == "l":
-            self._fire_leader_tick(r)
-        elif tag == "f":
-            ev = self._fault_events[int(gen)]
-            {
-                "kill": self.fail,
-                "recover": self.recover,
-                "slow": lambda p: self.set_slow(p, True),
-                "unslow": lambda p: self.set_slow(p, False),
-                "campaign": self.force_campaign,
-                "partition": lambda p: self.partition(ev.groups),
-                "heal_partition": lambda p: self.heal_partition(),
-            }[ev.action](ev.replica)
+        stale = tag in ("e", "c") and int(gen) != self._timer_gen[r]
+        #   stale timer generation (reset since armed): no action — but
+        #   the pop still counts toward the mirror digest below, or a
+        #   generation divergence would desynchronize the decision COUNT
+        #   and cross-pair the digest exchange itself
+        if not stale:
+            if tag == "e":
+                self._fire_follower(r)
+            elif tag == "c":
+                self._fire_candidate(r)
+            elif tag == "l":
+                self._fire_leader_tick(r)
+            elif tag == "f":
+                ev = self._fault_events[int(gen)]
+                {
+                    "kill": self.fail,
+                    "recover": self.recover,
+                    "slow": lambda p: self.set_slow(p, True),
+                    "unslow": lambda p: self.set_slow(p, False),
+                    "campaign": self.force_campaign,
+                    "partition": lambda p: self.partition(ev.groups),
+                    "heal_partition": lambda p: self.heal_partition(),
+                }[ev.action](ev.replica)
+        if self.cfg.mirror_check_every:
+            self._mirror_digest_step(
+                t, kind + ("|stale" if stale else ""), r
+            )
         return True
+
+    # ------------------------------------------------ mirror desync guard
+    def _mirror_digest_step(self, t: float, kind: str, r: int) -> None:
+        """Fold one decision — the popped heap event plus the action's
+        observable outcome (role, leader, watermark) — into the rolling
+        digest; every ``cfg.mirror_check_every``-th decision, exchange
+        digests across processes and FAIL-STOP on mismatch. The mirrored
+        multihost control plane's only correctness argument is 'same
+        inputs, same decisions, identical collective launches'
+        (transport/multihost.py); any divergence that slips past it — a
+        float compare, an OS-timing-dependent branch — would otherwise
+        surface as a silently wrong collective or a hang. This converts
+        it to a clean, attributable raise."""
+        import zlib
+
+        rec = (
+            f"{t:.9f}|{kind}|{r}|{self.commit_watermark}|"
+            f"{self.leader_id}|{','.join(self.roles)}|"
+            f"{self._timer_gen}|"
+            f"{sorted(self._quorum_contact_at.items())}"
+        ).encode() + self.terms.tobytes() + self._last_heard.tobytes()
+        #   the WHOLE host mirror — terms/roles AND the timer state that
+        #   drives future fire decisions (_timer_gen, _last_heard,
+        #   _quorum_contact_at) — not just the popped row's fields: a
+        #   divergence must enter the digest at the very next decision,
+        #   while the processes' collective launches still align — once
+        #   launches themselves diverge, cross-paired collectives are
+        #   undefined behavior no digest exchange can reliably report
+        self._mirror_digest = zlib.crc32(rec, self._mirror_digest)
+        self._mirror_decisions += 1
+        if self._mirror_decisions % self.cfg.mirror_check_every == 0:
+            self._verify_mirror_digest()
+
+    def _verify_mirror_digest(self) -> None:
+        """One tiny cross-process allgather of the digest scalar (rides
+        the same fabric as every other collective — and, like them, is
+        itself issued in lockstep because the decision COUNT is part of
+        the mirrored stream). Single-process: no-op."""
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        digests = np.asarray(multihost_utils.process_allgather(
+            np.int64(self._mirror_digest)
+        )).ravel()
+        if not (digests == digests[0]).all():
+            raise MirrorDesyncError(
+                f"mirrored control planes diverged at decision "
+                f"{self._mirror_decisions}: per-process digests "
+                f"{[int(d) for d in digests]} (this process: "
+                f"{int(self._mirror_digest)}). A decision stream "
+                "divergence means collective launches can no longer be "
+                "trusted to match — failing stop instead of hanging."
+            )
 
     def next_event_time(self) -> Optional[float]:
         """Virtual-clock time of the next pending event, or None when the

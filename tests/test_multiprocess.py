@@ -332,6 +332,95 @@ else:   # recover: fresh single-process runtime on this host's devices
 '''
 
 
+DESYNC_CHILD = r'''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=3"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=2, process_id=int(sys.argv[2]))
+import numpy as np
+sys.path.insert(0, os.getcwd())
+from raft_tpu.config import RaftConfig
+from raft_tpu.raft import RaftEngine
+from raft_tpu.raft.engine import MirrorDesyncError
+from raft_tpu.transport.multihost import multihost_transport
+
+cfg = RaftConfig(n_replicas=3, entry_bytes=16, batch_size=4,
+                 log_capacity=64, transport="multihost", seed=7,
+                 mirror_check_every=8)
+e = RaftEngine(cfg, multihost_transport(cfg))
+lead = e.run_until_leader()
+rng = np.random.default_rng(1)
+ps = [rng.integers(0, 256, 16, np.uint8).tobytes() for _ in range(8)]
+seqs = [e.submit(p) for p in ps]
+e.run_until_committed(seqs[-1])
+print(f"SYNCED proc={jax.process_index()} wm={e.commit_watermark}",
+      flush=True)
+
+# FORCED DIVERGENCE on process 1 only: a host-mirror value drifts (the
+# float-compare / OS-timing-dependent-branch bug class the guard exists
+# for — content wrong, collective launch pattern still aligned). The
+# digest must split at the next check window, BEFORE the drifted term
+# can change an election decision and misalign the launches themselves.
+if jax.process_index() == 1:
+    victim = next(q for q in range(3) if q != lead)
+    e.terms[victim] += 1
+try:
+    for p in ps:
+        e.submit(p)
+    for _ in range(400):
+        if not e.step_event():
+            break
+    print(f"NODESYNC proc={jax.process_index()} wm={e.commit_watermark}",
+          flush=True)
+except MirrorDesyncError as ex:
+    print(f"DESYNC-CAUGHT proc={jax.process_index()}: {ex}", flush=True)
+'''
+
+
+def test_two_process_desync_fail_stop(tmp_path):
+    """VERDICT r4 #5: a forced control-plane divergence between the
+    mirrored engines must become a CLEAN MirrorDesyncError on every
+    process — with both digests in the message — not a silent wrong
+    collective or a hang."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    coord = f"127.0.0.1:{port}"
+
+    script = tmp_path / "desync_child.py"
+    script.write_text(DESYNC_CHILD)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ps = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord, str(i)],
+            env=env, cwd=here, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in ps:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in ps:
+                q.kill()
+            pytest.fail("desync child hung — fail-stop did not happen")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(ps, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"SYNCED proc={i} " in out, out[-500:]
+        assert f"DESYNC-CAUGHT proc={i}" in out, (
+            f"proc {i} never detected the divergence:\n" + out[-1500:]
+        )
+        assert "per-process digests" in out
+
+
 REFORM_CHILD = r'''
 import os, sys
 
