@@ -176,6 +176,10 @@ class RaftEngine:
         self._mirror_decisions = 0
         #   Rolling CRC of the decision stream + check cadence counter
         #   (multihost mirror desync guard — _mirror_digest_step).
+        self._reads: Dict[int, list] = {}
+        self._next_read_ticket = 0
+        #   Batched ReadIndex queue: ticket -> [row, noted index, status]
+        #   (submit_read / read_confirmed / _confirm_reads).
         self._quorum_contact_at: Dict[int, float] = {}
         #   Per-leader: when it last contacted a member majority
         #   (CheckQuorum's lease clock).
@@ -537,6 +541,9 @@ class RaftEngine:
                     )
                 self._account_chunk_prefix(r, chunk, take, leader_last, eff)
                 pending = pending[take:]
+                self._confirm_reads(
+                    r, self.leader_term, eff, int(info.max_term)
+                )
                 self._update_steady(r, info.match, eff)
                 if int(info.max_term) > self.leader_term:
                     self._step_down_leader(r, int(info.max_term))
@@ -580,6 +587,7 @@ class RaftEngine:
             self.terms[eff] = np.maximum(self.terms[eff], self.leader_term)
             self._persist_votes()
             self._advance_commit(r, final_commit)
+            self._confirm_reads(r, self.leader_term, eff, max_term)
             self._update_steady(r, infos.match[-1], eff)
             if max_term > self.leader_term:
                 # deposed mid-chunk: hand the rest back to the queue
@@ -694,6 +702,100 @@ class RaftEngine:
             if seq not in self.commit_time
         )
 
+    # ------------------------------------------------- batched ReadIndex
+    def submit_read(self, r: Optional[int] = None) -> int:
+        """Queue a linearizable read (the dissertation's batched
+        ReadIndex optimization over §6.4): note the current watermark
+        NOW, and let the next successful quorum round — a write
+        replication tick, a pipelined chunk, or an explicit
+        ``read_linearizable`` confirmation — confirm leadership for
+        every queued read at once. Under sustained write load a read
+        therefore costs ZERO extra replication rounds (the write
+        traffic IS the confirmation evidence); a dedicated empty round
+        is only ever paid on an idle cluster, and one such round serves
+        the whole queue. Returns a ticket for ``read_confirmed``.
+
+        Refusal semantics match ``read_linearizable``: not a live
+        leader / deposed / quorum unreachable raise immediately;
+        leadership loss while queued marks the ticket refused (the
+        split-brain guarantee — a minority-side stale leader can never
+        confirm, so its queued reads never serve)."""
+        if r is None:
+            r = self.leader_id
+        if r is None or self.roles[r] != LEADER or not self.alive[r]:
+            raise LinearizableReadRefused("not a live leader")
+        if int(self.terms[r]) > int(self.lead_terms[r]):
+            self._step_down_leader(r, int(self.terms[r]))
+            raise LinearizableReadRefused("deposed (higher term seen)")
+        eff = self._reach(r)
+        if int(eff.sum()) <= int(self.member.sum()) // 2:
+            raise LinearizableReadRefused(
+                f"quorum unreachable ({int(eff.sum())} of "
+                f"{int(self.member.sum())} members)"
+            )
+        tk = self._next_read_ticket
+        self._next_read_ticket += 1
+        self._reads[tk] = [r, self.commit_watermark,
+                           int(self.lead_terms[r]), "pending"]
+        if len(self._reads) > (1 << 16):
+            # abandoned-ticket bound: tickets are poll-once, so a client
+            # that stops polling would otherwise leak records forever —
+            # evict the OLDEST tickets (FIFO) beyond the cap; an evicted
+            # ticket reads as unknown, which an abandoning client by
+            # definition never observes
+            for old in sorted(self._reads)[:len(self._reads) - (1 << 16)]:
+                del self._reads[old]
+        return tk
+
+    def read_confirmed(self, ticket: int) -> Optional[int]:
+        """Poll a ``submit_read`` ticket: the confirmed read index once
+        a quorum round has run (serve from state applied to AT LEAST
+        that index), None while pending, ``LinearizableReadRefused`` if
+        leadership was lost first. Terminal outcomes pop the ticket.
+
+        Refusal is detected lazily from the ticket's bound (row, term):
+        a pending ticket whose row no longer leads in that term can
+        never be confirmed (``_confirm_reads`` requires an exact term
+        match), so no step-down path needs a hook here."""
+        rec = self._reads.get(ticket)
+        if rec is None:
+            raise KeyError(f"unknown or already-consumed ticket {ticket}")
+        row, idx, tterm, st = rec
+        if st == "ready":
+            del self._reads[ticket]
+            return idx
+        if st == "refused" or (
+                self.roles[row] != LEADER or not self.alive[row]
+                or int(self.lead_terms[row]) != tterm
+                or int(self.terms[row]) > tterm):
+            del self._reads[ticket]
+            raise LinearizableReadRefused(
+                "leadership lost before confirmation"
+            )
+        return None
+
+    def _confirm_reads(self, r: int, term: int, eff, max_term: int) -> None:
+        """A quorum round sourced at ``r`` just completed: it confirms
+        leadership for every read queued on ``r`` IN THIS TERM when it
+        reached a member majority and surfaced no higher term — §6.4's
+        confirmation, shared by every round flavor (write tick,
+        pipelined chunk, explicit read round)."""
+        if not self._reads:
+            return
+        if max_term > term or int(eff.sum()) <= int(self.member.sum()) // 2:
+            return
+        for rec in self._reads.values():
+            if rec[3] != "pending":
+                continue
+            if rec[0] == r and rec[2] == term:
+                rec[3] = "ready"
+            elif (self.roles[rec[0]] != LEADER or not self.alive[rec[0]]
+                    or int(self.lead_terms[rec[0]]) != rec[2]):
+                # dead binding: mark terminal now (same predicate
+                # read_confirmed applies lazily) so the pending set this
+                # sweep walks stays bounded by live leadership
+                rec[3] = "refused"
+
     def read_linearizable(self, r: Optional[int] = None) -> int:
         """ReadIndex (dissertation §6.4): confirm leadership with a quorum
         round, then return the commit index the read may be served at.
@@ -716,7 +818,16 @@ class RaftEngine:
         is the control plane's global monotone watermark, so the note
         taken before confirmation already covers every acknowledged
         write. ``r`` defaults to the routed leader; pass an explicit row
-        to probe a specific (possibly stale split-brain) leader."""
+        to probe a specific (possibly stale split-brain) leader.
+
+        Simulation-framing note: the quorum-reachability check (b)
+        reads the engine's injected fault/partition masks — the ground
+        truth a real deployment would instead discover as a failed or
+        timed-out confirmation round. The refusal SEMANTICS are
+        identical; only the discovery latency differs.
+
+        Reads queued via ``submit_read`` share this round's
+        confirmation (batched ReadIndex — see ``submit_read``)."""
         if r is None:
             r = self.leader_id
         if r is None or self.roles[r] != LEADER or not self.alive[r]:
@@ -747,6 +858,7 @@ class RaftEngine:
         self.terms[eff] = np.maximum(self.terms[eff], term)
         self._persist_votes()
         self._advance_commit(r, int(info.commit_index))
+        self._confirm_reads(r, term, eff, max_term)  # the round is shared
         self._reset_heard_timers(r)
         return read_index
 
@@ -1472,6 +1584,9 @@ class RaftEngine:
                 self._note_config_ingest(idx, seq, term)
             self._queue = self._queue[ingested:]
         self._advance_commit(r, int(info.commit_index))
+        self._confirm_reads(r, term, eff, max_term)
+        #   every successful tick round doubles as the §6.4 read
+        #   confirmation: queued reads ride the write traffic for free
         if routed:
             # heal bookkeeping and the shared steady flag belong to the
             # routed leader only — a stale split-brain leader must not

@@ -189,3 +189,95 @@ class TestLinearizableReads:
         e.run_for(6 * e.cfg.heartbeat_period)
         assert e.roles[old] != LEADER
         assert e.read_linearizable() >= idx
+
+
+# ------------------------------------------------------ batched ReadIndex
+class TestBatchedReadIndex:
+    def test_reads_ride_write_rounds_for_free(self):
+        """Queued reads confirm on the next write replication tick —
+        ZERO additional transport rounds beyond the writes."""
+        e = mk(seed=31)
+        e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(4, seed=4)]
+        e.run_until_committed(seqs[-1])
+        wm0 = e.commit_watermark
+        calls = [0]
+        orig = e.t.replicate
+
+        def counting(*a, **k):
+            calls[0] += 1
+            return orig(*a, **k)
+
+        e.t.replicate = counting
+        tickets = [e.submit_read() for _ in range(16)]
+        assert calls[0] == 0, "submit_read must cost no device round"
+        assert all(e.read_confirmed(t) is None for t in tickets[:1])
+        # write traffic arrives; its tick round confirms the whole queue
+        s2 = [e.submit(p) for p in payloads(4, seed=5)]
+        e.run_until_committed(s2[-1])
+        writes_rounds = calls[0]
+        got = [e.read_confirmed(t) for t in tickets[1:]]
+        # confirmed, no extra rounds, and the noted index covers every
+        # write acked before the read
+        assert all(g is not None and g >= wm0 for g in got)
+        assert calls[0] == writes_rounds, "confirmation cost extra rounds"
+
+    def test_idle_cluster_one_round_serves_all(self):
+        e = mk(seed=32)
+        e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(4, seed=6)]
+        e.run_until_committed(seqs[-1])
+        tickets = [e.submit_read() for _ in range(8)]
+        calls = [0]
+        orig = e.t.replicate
+
+        def counting(*a, **k):
+            calls[0] += 1
+            return orig(*a, **k)
+
+        e.t.replicate = counting
+        # one explicit confirmation round serves the whole queue
+        idx = e.read_linearizable()
+        assert calls[0] == 1
+        got = [e.read_confirmed(t) for t in tickets]
+        assert all(g is not None and g <= idx for g in got)
+
+    def test_leadership_loss_refuses_queued_reads(self):
+        from raft_tpu.raft.engine import LinearizableReadRefused
+
+        e = mk(seed=33)
+        lead = e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(4, seed=7)]
+        e.run_until_committed(seqs[-1])
+        tickets = [e.submit_read() for _ in range(4)]
+        e.fail(lead)
+        e.run_until_leader()
+        for t in tickets:
+            with pytest.raises(LinearizableReadRefused):
+                e.read_confirmed(t)
+
+    def test_minority_leader_cannot_queue_or_confirm(self):
+        """Split-brain: the stale minority-side leader refuses new reads
+        outright, and reads queued BEFORE the partition never confirm
+        through its quorumless heartbeats."""
+        from raft_tpu.raft.engine import LinearizableReadRefused
+
+        e = mk(n_replicas=5, seed=34)
+        lead = e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(4, seed=8)]
+        e.run_until_committed(seqs[-1])
+        pre = e.submit_read()
+        others = [q for q in range(5) if q != lead]
+        e.partition([[lead, others[0]], others[1:]])
+        # the stale leader keeps ticking on its side: its quorumless
+        # rounds must NEVER confirm the queued read — the only legal
+        # outcomes are still-pending or refused (a majority-side
+        # election deposed the binding)
+        e.run_for(6 * e.cfg.heartbeat_period)
+        try:
+            assert e.read_confirmed(pre) is None, \
+                "quorumless round confirmed a read"
+        except LinearizableReadRefused:
+            pass
+        with pytest.raises(LinearizableReadRefused):
+            e.submit_read(lead)
