@@ -51,6 +51,7 @@ def run_demo(
     rs_m: Optional[int] = None,
     entry_bytes: int = 256,
     checkpoint: Optional[str] = None,
+    hardened: bool = False,
     emit=print,
 ) -> RaftEngine:
     """Run a live cluster for ``duration`` virtual seconds; returns the
@@ -67,6 +68,8 @@ def run_demo(
         rs_m=rs_m,
         entry_bytes=entry_bytes,
         transport="single",  # a live demo is a one-process, one-chip affair
+        prevote=hardened,
+        check_quorum=hardened,  # §9.6 liveness hardening (--hardened)
     )
     if checkpoint is not None and os.path.exists(checkpoint):
         engine = RaftEngine.restore(cfg, checkpoint, trace=emit)
@@ -166,6 +169,9 @@ def main(argv=None) -> None:
     ap.add_argument("--checkpoint", type=str, default=None, metavar="PATH",
                     help="resume from PATH if it exists; write durable "
                     "cluster state there on session end")
+    ap.add_argument("--hardened", action="store_true",
+                    help="enable the §9.6 liveness flags (PreVote + "
+                    "CheckQuorum); default off = reference dynamics")
     args = ap.parse_args(argv)
     rs_k = rs_m = None
     if args.rs:
@@ -179,6 +185,7 @@ def main(argv=None) -> None:
         rs_m=rs_m,
         entry_bytes=args.entry_bytes,
         checkpoint=args.checkpoint,
+        hardened=args.hardened,
     )
 
 

@@ -449,10 +449,12 @@ class RaftEngine:
             deferred = pending[cut:]
             pending = pending[:cut]
         B = cfg.batch_size
+        T_ring = cfg.log_capacity // B
         while pending:
             if self.leader_id != r or not self.alive[r]:
                 break
             leader_last = int(self._fetch(self.state.last_index)[r])
+            eff = self._reach(r)
             steps = (
                 self.state.capacity - (leader_last - self.commit_watermark)
             ) // B
@@ -461,12 +463,35 @@ class RaftEngine:
                 # must drain commits first; leave the rest queued
                 break
             take = min(len(pending), steps * B)
-            chunk = pending[:take]
             # Fixed scan length: pad the chunk with zero-count (heartbeat)
             # steps so every chunk compiles to the SAME [T, B, L] program —
             # a varying T would trigger a fresh XLA compile per chunk
             # length, dwarfing the scan itself.
-            T = cfg.log_capacity // B
+            T = T_ring
+            eligible = self._pipeline_eligible(r, take, T, leader_last, eff)
+            # ALL rows in the gate's verified accept set — the kernel's
+            # own turnover predicate evaluated on the same evidence. Only
+            # the write-only turnover branch is certified across ring
+            # laps, so the lap decision and allow_turnover below share
+            # this one value: a quorum-but-not-all accept set must
+            # neither take the lapped shape (the aliased fallback is
+            # uncertified past one turnover) nor compile the turnover
+            # branch it cannot reach.
+            all_accept = bool(eligible and self._gate_accept.all())
+            # Multi-lap fast path: the eligibility legs are T-independent
+            # given take == T*B, and on an all-accept cluster the
+            # write-only turnover kernel is valid across ring laps (each
+            # step commits before its slots are revisited), so a backlog
+            # covering pipeline_max_laps ring turnovers rides ONE launch.
+            # All-or-nothing on the lap count keeps the compile set at
+            # exactly two programs.
+            if (
+                all_accept and cfg.pipeline_max_laps > 1
+                and len(pending) >= cfg.pipeline_max_laps * T_ring * B
+            ):
+                T = cfg.pipeline_max_laps * T_ring
+                take = T * B
+            chunk = pending[:take]
             used = -(-take // B)
             counts = np.zeros(T, np.int32)
             counts[:used] = B
@@ -482,10 +507,9 @@ class RaftEngine:
                 payload_stack = fold_batch(data, cfg.rows).reshape(
                     T, B, -1
                 )
-            eff = self._reach(r)
             pre_lasts = self._pre_lasts()
             floor, fpt = self._floor_attest(r)
-            if self._pipeline_eligible(r, take, T, leader_last, eff):
+            if eligible:
                 # The saturated fast path: the whole full-ring chunk as
                 # ONE kernel launch (core.step_pallas.steady_pipeline_tpu
                 # via the transport). The host gate below implies the
@@ -498,11 +522,11 @@ class RaftEngine:
                     jnp.asarray(self.slow), member=self._member_arg(),
                     repair_floor=floor, floor_prev_term=fpt,
                     term_floor=self._term_floor,
-                    # write-only turnover only when the host knows EVERY
-                    # row accepts (all rows reachable members, none slow —
-                    # one np.all covers both); with False the program is
-                    # the plain pipeline-vs-scan two-way cond
-                    allow_turnover=bool(np.all(eff & ~self.slow)),
+                    # write-only turnover only when the host's verified
+                    # accept set covers EVERY row (same value as the lap
+                    # gate above — see its comment); with False the
+                    # program is the plain pipeline-vs-scan two-way cond
+                    allow_turnover=all_accept,
                 )
                 self._note_truncations(pre_lasts)
                 final_commit = int(info.commit_index)
@@ -681,6 +705,11 @@ class RaftEngine:
             lasts[r] == leader_last and dterms[r] <= self.leader_term
         )
         accept = eff & ~self.slow & verified
+        # stashed for the caller: the multi-lap gate and allow_turnover
+        # must see the SAME per-row accept set this gate counted —
+        # all-rows-accept is the kernel's turnover predicate, and only
+        # the turnover branch is certified across ring laps
+        self._gate_accept = accept
         if cfg.max_replicas is not None:
             # mirror core.step_pallas._params_and_masks EXACTLY: member
             # majority, clamped to the static commit_quorum only under EC

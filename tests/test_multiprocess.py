@@ -453,7 +453,9 @@ if os.environ.get("RAFT_SUPERVISED") != "1":
             raise SystemExit(1)
         time.sleep(1.0)
 
-import hashlib, threading, time
+import faulthandler, hashlib, threading, time
+
+faulthandler.dump_traceback_later(240, repeat=True)  # hang forensics
 
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=3"
 import jax
@@ -514,6 +516,7 @@ from raft_tpu.raft import RaftEngine
 from raft_tpu.transport.multihost import multihost_transport
 
 t = multihost_transport(cfg)
+print(f"TRANSPORT-OK n={ep.n} pid={PID}", flush=True)
 if ep.ckpt is None:
     e = RaftEngine(cfg, t, vote_log=VLOG)
 else:
@@ -550,6 +553,7 @@ for r_ in range(R):
     elif r_ not in ep.dead_rows and not e.alive[r_]:
         e.recover(r_)
 e.run_until_leader()
+print(f"LEADER-OK n={ep.n} pid={PID} lead={e.leader_id}", flush=True)
 
 last_progress = [time.time()]
 armed = [False]

@@ -642,6 +642,113 @@ def test_engine_pipeline_gate_negative_cases(monkeypatch):
     assert not e._pipeline_eligible(r, T * B, T, 0, eff)
 
 
+def test_engine_multi_lap_chunk(monkeypatch):
+    """cfg.pipeline_max_laps > 1: a backlog covering several ring
+    turnovers rides ONE replicate_pipeline launch (the write-only
+    turnover kernel is lap-legal and interpret-faithful, so CI drives
+    the REAL kernel here) — byte-identical to the single-lap engine."""
+    import raft_tpu.raft.engine as engine_mod
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    monkeypatch.setattr(engine_mod, "_pipeline_backend_ok", lambda: True)
+    rng = np.random.default_rng(51)
+    ps = [rng.integers(0, 256, 8, dtype=np.uint8).tobytes()
+          for _ in range(3 * C)]          # 3 ring turnovers of backlog
+
+    def run(max_laps):
+        cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                         log_capacity=C, seed=13,
+                         pipeline_max_laps=max_laps)
+        t = SingleDeviceTransport(cfg)
+        calls = []
+        orig = t.replicate_pipeline
+
+        def counting(state, payloads, counts, *a, **k):
+            calls.append(int(counts.shape[0]))
+            return orig(state, payloads, counts, *a, **k)
+
+        t.replicate_pipeline = counting
+        e = RaftEngine(cfg, t)
+        e.run_until_leader()
+        e._steady = True                 # fresh cluster, all rows at 0
+        seqs = e.submit_pipelined(ps)
+        e.run_until_committed(seqs[-1], limit=900.0)
+        got = [bytes(x) for x in np.asarray(
+            e.committed_entries(e.commit_watermark - C + 1,
+                                e.commit_watermark))]
+        return e, calls, got
+
+    e1, calls1, got1 = run(max_laps=2)
+    e2, calls2, got2 = run(max_laps=1)
+    T_ring = C // B
+    assert 2 * T_ring in calls1, f"no lapped launch happened: {calls1}"
+    assert all(c == T_ring for c in calls2)
+    assert len(calls1) < len(calls2), "laps did not reduce launch count"
+    assert e1.commit_watermark == e2.commit_watermark == 3 * C
+    assert got1 == got2 == ps[-C:]
+
+
+def test_multi_lap_requires_all_rows_verified(monkeypatch):
+    """A quorum-but-not-ALL accept set must refuse the lapped shape:
+    only the write-only turnover branch is certified across ring laps,
+    and the kernel would silently fall back to the aliased pipeline for
+    a row outside the accept set. The single-ring launch (which that
+    fallback IS certified for) must still run."""
+    import raft_tpu.raft.engine as engine_mod
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    monkeypatch.setattr(engine_mod, "_pipeline_backend_ok", lambda: True)
+    cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
+                     log_capacity=C, seed=14, pipeline_max_laps=2)
+    t = SingleDeviceTransport(cfg)
+    calls = []
+    orig = t.replicate_pipeline
+
+    def counting(state, payloads, counts, *a, **k):
+        calls.append((int(counts.shape[0]), k.get("allow_turnover")))
+        return orig(state, payloads, counts, *a, **k)
+
+    t.replicate_pipeline = counting
+    e = RaftEngine(cfg, t)
+    e.run_until_leader()
+    e._steady = True
+    # degrade ONE follower's verified match on the quiet: quorum still
+    # holds (leader + other follower at tail 0) but all-accept does not
+    victim = (e.leader_id + 1) % N
+    e.state = e.state.replace(
+        match_index=e.state.match_index.at[victim].set(0),
+        match_term=e.state.match_term.at[victim].set(-1),
+        last_index=e.state.last_index.at[victim].set(0),
+        term=e.state.term.at[victim].add(0),
+    )
+    # force a non-empty prefix so verified needs a real match (the
+    # leader_last==0 clause would trivially verify everyone)
+    rng = np.random.default_rng(60)
+    warm = [e.submit(rng.integers(0, 256, 8, np.uint8).tobytes())
+            for _ in range(B)]
+    e.run_until_committed(warm[-1])
+    e.run_for(4 * cfg.heartbeat_period)
+    e.set_slow(victim, True)    # keep it from re-verifying...
+    e.set_slow(victim, False)   # ...but leave it in the accept masks
+    e.state = e.state.replace(
+        match_index=e.state.match_index.at[victim].set(0),
+        match_term=e.state.match_term.at[victim].set(-1),
+    )
+    e._steady = True
+    calls.clear()
+    ps = [rng.integers(0, 256, 8, np.uint8).tobytes()
+          for _ in range(2 * C)]
+    seqs = e.submit_pipelined(ps)
+    e.run_until_committed(seqs[-1], limit=900.0)
+    assert calls, "pipeline fast path never ran"
+    T_ring = C // B
+    first_T, first_turnover = calls[0]
+    assert first_T == T_ring, f"lapped shape launched: {calls[0]}"
+    assert first_turnover is False
+
+
 def test_pipeline_gate_verifies_current_accept_set(monkeypatch):
     """ADVICE r4 (low): the gate must not trust the (possibly vacuously
     true) ``_steady`` flag — rows counted toward the launch quorum are
