@@ -420,7 +420,12 @@ class RaftEngine:
         periodically" design SURVEY.md §7 hard part 1 calls for. A chunk is
         as many full batches as are *guaranteed* ring room before the scan
         starts (commits inside the scan free more; the bound is
-        conservative, never lossy).
+        conservative, never lossy) — EXCEPT on the verified all-accept
+        fast path with ``cfg.pipeline_max_laps > 1``, where a chunk may
+        span several ring turnovers in one launch: there the turnover
+        kernel commits every step before its slots are revisited, so
+        room is created exactly as it is consumed (and the host buffers
+        the whole chunk's bytes for the archive regardless).
 
         Requires a current leader. Returns the entries' sequence numbers;
         durability reporting matches ``submit`` (leadership loss mid-chunk

@@ -721,7 +721,6 @@ def test_multi_lap_requires_all_rows_verified(monkeypatch):
         match_index=e.state.match_index.at[victim].set(0),
         match_term=e.state.match_term.at[victim].set(-1),
         last_index=e.state.last_index.at[victim].set(0),
-        term=e.state.term.at[victim].add(0),
     )
     # force a non-empty prefix so verified needs a real match (the
     # leader_last==0 clause would trivially verify everyone)
