@@ -407,9 +407,9 @@ def bench_client_latency() -> dict:
     tl.replicate_pipeline = counting
     el = RaftEngine(cfg_l, tl)
     el.run_until_leader()
-    big = LAPS * n
+    big = LAPS * cfg_l.log_capacity
     T_lap = LAPS * (cfg_l.log_capacity // cfg_l.batch_size)
-    mk_big = lambda: [rng.integers(0, 256, cfg.entry_bytes,
+    mk_big = lambda: [rng.integers(0, 256, cfg_l.entry_bytes,
                                    np.uint8).tobytes() for _ in range(big)]
     seqs = el.submit_pipelined(mk_big())     # warm
     assert el.is_durable(seqs[-1])

@@ -93,24 +93,7 @@ def run_lap_chaos(seed):
 def test_ring_bytes_match_archive_after_lap_chaos(seed):
     e = run_lap_chaos(seed)
     assert e.commit_watermark > CAP, "ring never lapped — schedule too light"
-    lasts = np.asarray(e.state.last_index)
-    commits = np.asarray(e.state.commit_index)
-    wm = e.commit_watermark
-    checked = 0
-    for r in range(e.cfg.rows):
-        hi = min(int(commits[r]), wm)
-        lo = max(1, int(lasts[r]) - CAP + 1, int(e._ring_floor[r]))
-        if hi < lo:
-            continue
-        got = log_entries(e.state, r, lo, hi)
-        for i in range(lo, hi + 1):
-            ent = e.store.get(i)
-            if ent is not None:
-                assert ent[0] == got[i - lo].tobytes(), (
-                    f"replica {r} serves wrong bytes for committed {i}"
-                )
-                checked += 1
-    assert checked > 0
+    assert _ring_matches_archive(e) > 0
 
 
 def run_ec_lap_chaos(seed):
@@ -180,3 +163,98 @@ def test_ec_full_ring_old_term_deadlock_escapes(seed):
                 assert ent[0] == got[i - lo].tobytes(), f"idx {i}"
     except ValueError:
         pass   # no eligible read quorum at quiescence: refusal is legal
+
+
+def _ring_matches_archive(e):
+    """Every retained committed index on every row byte-matches the
+    archive (shared by the lap-chaos asserts)."""
+    lasts = np.asarray(e.state.last_index)
+    commits = np.asarray(e.state.commit_index)
+    wm = e.commit_watermark
+    cap = e.state.capacity
+    checked = 0
+    for r in range(e.cfg.rows):
+        hi = min(int(commits[r]), wm)
+        lo = max(1, int(lasts[r]) - cap + 1, int(e._ring_floor[r]))
+        if hi < lo:
+            continue
+        got = log_entries(e.state, r, lo, hi)
+        for i in range(lo, hi + 1):
+            ent = e.store.get(i)
+            if ent is not None:
+                assert ent[0] == got[i - lo].tobytes(), (
+                    f"replica {r} serves wrong bytes for committed {i}"
+                )
+                checked += 1
+    return checked
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_pipelined_multi_lap_under_chaos(seed, monkeypatch):
+    """The submit_pipelined fast path — including multi-lap turnover
+    flights (pipeline_max_laps=2) — interleaved with the fault
+    adversary, on the REAL kernels in interpret mode. The host gate must
+    refuse or launch consistently (a gate/kernel desync raises the
+    shortfall error and fails the test), and every retained committed
+    byte must match the archive at quiescence."""
+    import raft_tpu.raft.engine as engine_mod
+    from raft_tpu.core import ring
+
+    monkeypatch.setattr(engine_mod, "_pipeline_backend_ok", lambda: True)
+    prior = ring._force_interpret
+    ring.force_pallas_interpret(True)
+    try:
+        rng = random.Random(91000 + seed)
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=16, batch_size=128,
+            log_capacity=256, transport="single", seed=seed,
+            pipeline_max_laps=2,
+        )
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+        e.run_until_leader()
+        T_lap = 2 * (cfg.log_capacity // cfg.batch_size)
+        lapped = [0]
+        orig = e.t.replicate_pipeline
+
+        def counting(state, payloads, counts, *a, **k):
+            if int(counts.shape[0]) == T_lap:
+                lapped[0] += 1
+            return orig(state, payloads, counts, *a, **k)
+
+        e.t.replicate_pipeline = counting
+        partitioned = False
+        for _ in range(6):
+            n = rng.randrange(2, 5) * 256
+            ps = [bytes(rng.getrandbits(8) for _ in range(16))
+                  for _ in range(n)]
+            e.submit_pipelined(ps)   # a shortfall RuntimeError fails here
+            action = rng.choice(["kill", "recover", "partition", "heal",
+                                 "campaign", "none"])
+            victim = rng.randrange(3)
+            if action == "kill" and e.alive[victim] \
+                    and int((~e.alive).sum()) < 1:
+                e.fail(victim)
+            elif action == "recover" and not e.alive[victim]:
+                e.recover(victim)
+            elif action == "partition" and not partitioned:
+                e.partition([[victim],
+                             [r for r in range(3) if r != victim]])
+                partitioned = True
+            elif action == "heal" and partitioned:
+                e.heal_partition()
+                partitioned = False
+            elif action == "campaign":
+                e.force_campaign(victim)
+            e.run_for(60.0)
+        e.heal_partition()
+        for r in range(3):
+            if not e.alive[r]:
+                e.recover(r)
+        probe = e.submit(bytes(16))
+        e.run_until_committed(probe, limit=1800.0)
+        e.run_for(6 * cfg.heartbeat_period)
+        assert e.commit_watermark > cfg.log_capacity
+        assert _ring_matches_archive(e) > 0
+        assert lapped[0] > 0, "the lapped shape never launched"
+    finally:
+        ring.force_pallas_interpret(prior)
