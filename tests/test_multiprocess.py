@@ -81,8 +81,35 @@ es, einfo = et.replicate(
 ecommit = int(einfo.commit_index)
 assert ecommit == 4, f"ec commit {ecommit}"
 
+# the FUSED per-device mesh kernels across the OS-process boundary
+# (core.step_mesh in interpret mode): the launch all_gathers ride the
+# gloo fabric, the kernel bodies run per process on the local row
+from raft_tpu.core import ring as _ring
+import raft_tpu.core.step_mesh as step_mesh
+
+_ring.force_pallas_interpret(True)
+kcfg = RaftConfig(n_replicas=R, entry_bytes=16, batch_size=128,
+                  log_capacity=256, transport="multihost")
+kt = multihost_transport(kcfg)
+ks = kt.init()
+ks, kvi = kt.request_votes(ks, 0, 1, alive)
+step_mesh.LAST_DISPATCH = None
+kb = rng.integers(0, 256, (128, 16), dtype=np.uint8)
+ks, kinfo = kt.replicate(ks, fold_batch(kb, R), 128, 0, 1, alive, slow,
+                         repair=False, term_floor=1)
+assert step_mesh.LAST_DISPATCH == "step", step_mesh.LAST_DISPATCH
+kcommit = int(kinfo.commit_index)
+assert kcommit == 128, f"fused mesh commit {kcommit}"
+wins = jnp.asarray(fold_batch(kb, R))[None]
+counts = jnp.full((2,), 128, jnp.int32)
+ks, kinfo = kt.replicate_pipeline(ks, wins, counts, 0, 1, alive, slow,
+                                  term_floor=1, allow_turnover=False)
+assert step_mesh.LAST_DISPATCH == "pipeline"
+assert int(kinfo.commit_index) == 3 * 128, int(kinfo.commit_index)
+_ring.force_pallas_interpret(False)
+
 print(f"MPOK proc={jax.process_index()} commit={commit} "
-      f"votes={int(vi.votes)} ec_commit={ecommit}")
+      f"votes={int(vi.votes)} ec_commit={ecommit} fused={kcommit}")
 '''
 
 
@@ -117,8 +144,8 @@ def test_two_process_cluster_data_plane(tmp_path):
         outs.append(out)
     for i, (p, out) in enumerate(zip(ps, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
-        assert f"MPOK proc={i} commit=12 votes=3 ec_commit=4" in out, \
-            out[-500:]
+        assert (f"MPOK proc={i} commit=12 votes=3 ec_commit=4 fused=128"
+                in out), out[-500:]
 
 
 ENGINE_CHILD = r'''
