@@ -5,6 +5,7 @@ from raft_tpu.transport.multihost import (
     multihost_transport,
     replica_devices_across_hosts,
 )
+from raft_tpu.transport.reform import Epoch, Rendezvous
 from raft_tpu.transport.tpu_mesh import TpuMeshTransport
 
 __all__ = [
@@ -14,5 +15,7 @@ __all__ = [
     "initialize_multihost",
     "multihost_transport",
     "replica_devices_across_hosts",
+    "Epoch",
+    "Rendezvous",
     "TpuMeshTransport",
 ]

@@ -113,15 +113,18 @@ print(f"MPOK proc={jax.process_index()} commit={commit} "
 '''
 
 
-def test_two_process_cluster_data_plane(tmp_path):
+def _spawn_pair(tmp_path, name, child_src, timeout, hang_msg=None):
+    """Shared two-OS-process harness: free coordinator port, two child
+    processes, collected (returncode, output) pairs — both killed on a
+    hang. Every two-process drill in this file runs through here so
+    harness fixes (ports, env, capture) live in one place."""
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
     port = sock.getsockname()[1]
     sock.close()
     coord = f"127.0.0.1:{port}"
-
-    script = tmp_path / "child.py"
-    script.write_text(CHILD)
+    script = tmp_path / f"{name}.py"
+    script.write_text(child_src)
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)   # children pick CPU themselves
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -136,14 +139,19 @@ def test_two_process_cluster_data_plane(tmp_path):
     outs = []
     for p in ps:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in ps:
                 q.kill()
-            pytest.fail("multi-process child timed out")
-        outs.append(out)
-    for i, (p, out) in enumerate(zip(ps, outs)):
-        assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+            pytest.fail(hang_msg or f"{name} child timed out")
+        outs.append((p.returncode, out))
+    return outs
+
+
+def test_two_process_cluster_data_plane(tmp_path):
+    outs = _spawn_pair(tmp_path, "child", CHILD, 240)
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert (f"MPOK proc={i} commit=12 votes=3 ec_commit=4 fused=128"
                 in out), out[-500:]
 
@@ -206,43 +214,96 @@ def test_two_process_full_engine(tmp_path):
     two OS processes as mirrored deterministic event loops. Both
     processes must drive the same leadership change and finish with
     byte-identical committed logs."""
-    sock = socket.socket()
-    sock.bind(("127.0.0.1", 0))
-    port = sock.getsockname()[1]
-    sock.close()
-    coord = f"127.0.0.1:{port}"
-
-    script = tmp_path / "engine_child.py"
-    script.write_text(ENGINE_CHILD)
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    ps = [
-        subprocess.Popen(
-            [sys.executable, str(script), coord, str(i)],
-            env=env, cwd=here, text=True,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in ps:
-        try:
-            out, _ = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in ps:
-                q.kill()
-            pytest.fail("full-engine multiprocess child timed out")
-        outs.append(out)
+    outs = _spawn_pair(tmp_path, "engine_child", ENGINE_CHILD, 300)
     marks = []
-    for i, (p, out) in enumerate(zip(ps, outs)):
-        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"proc {i} failed:\n{out[-3000:]}"
         mark = [l for l in out.splitlines() if l.startswith("ENGOK")]
         assert mark, out[-500:]
         marks.append(mark[0].split(" ", 1)[1])   # drop proc=i prefix
     # both processes converged on the identical cluster state
     assert marks[0].split("wm=")[1] == marks[1].split("wm=")[1]
     assert "wm=12" in marks[0]
+
+
+KERNEL_ENGINE_CHILD = r'''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=3"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=2, process_id=int(sys.argv[2]))
+import hashlib
+import numpy as np
+sys.path.insert(0, os.getcwd())
+from raft_tpu.config import RaftConfig
+from raft_tpu.core import ring
+import raft_tpu.core.step_mesh as step_mesh
+from raft_tpu.raft import RaftEngine
+from raft_tpu.transport.multihost import multihost_transport
+
+# The DEPLOYMENT SHAPE end to end: the full mirrored engine, replica
+# rows across OS processes, at a KERNEL-ELIGIBLE shape — every tick
+# rides the per-device fused mesh kernels (interpret mode), and the
+# pipelined fast path takes the per-device single-launch pipeline.
+ring.force_pallas_interpret(True)
+cfg = RaftConfig(n_replicas=3, entry_bytes=16, batch_size=128,
+                 log_capacity=256, transport="multihost", seed=7)
+import raft_tpu.raft.engine as engine_mod
+engine_mod._pipeline_backend_ok = lambda: True   # interpret CI override
+e = RaftEngine(cfg, multihost_transport(cfg))
+e.run_until_leader()
+step_mesh.LAST_DISPATCH = None
+rng = np.random.default_rng(42)
+ps = [rng.integers(0, 256, 16, np.uint8).tobytes() for _ in range(256)]
+seqs = [e.submit(p) for p in ps]          # 256: a BLOCK-ALIGNED tail,
+#                                           which the pipelined gate needs
+e.run_until_committed(seqs[-1], limit=900.0)
+assert step_mesh.LAST_DISPATCH is not None, "tick path not fused"
+# a full-ring pipelined chunk must ride the per-device single-launch
+# pipeline across the process boundary (the host gate verifies the
+# CURRENT device state collectively, then ONE launch per process)
+e.run_for(4 * cfg.heartbeat_period)
+dispatches = []
+ps_pipe = [rng.integers(0, 256, 16, np.uint8).tobytes()
+           for _ in range(cfg.log_capacity)]
+seqs_pipe = e.submit_pipelined(ps_pipe)
+dispatches.append(step_mesh.LAST_DISPATCH)
+e.run_until_committed(seqs_pipe[-1], limit=900.0)
+assert "pipeline" in dispatches, dispatches
+# leadership change + catch-up, all through the fused mesh kernels
+lead1 = e.leader_id
+e.fail(lead1)
+e.run_until_leader()
+ps2 = [rng.integers(0, 256, 16, np.uint8).tobytes() for _ in range(56)]
+seqs2 = [e.submit(p) for p in ps2]
+e.run_until_committed(seqs2[-1], limit=900.0)
+e.recover(lead1)
+e.run_for(8 * cfg.heartbeat_period)
+lo = max(1, e.commit_watermark - cfg.log_capacity + 1)
+got = e.committed_entries(lo, e.commit_watermark)
+want = (ps + ps_pipe + ps2)[lo - 1:]
+assert [bytes(x) for x in np.asarray(got)] == want
+h = hashlib.sha256(np.asarray(got).tobytes()).hexdigest()[:16]
+print(f"KENGOK proc={jax.process_index()} wm={e.commit_watermark} "
+      f"sha={h} pipeline={'pipeline' in dispatches}", flush=True)
+'''
+
+
+def test_two_process_full_engine_fused_kernels(tmp_path):
+    """The complete engine at a kernel-eligible shape across two OS
+    processes: client traffic, a leadership change, and catch-up all
+    ride the per-device fused mesh kernels, finishing with
+    byte-identical committed logs on every process."""
+    outs = _spawn_pair(tmp_path, "kernel_engine_child", KERNEL_ENGINE_CHILD, 480)
+    marks = []
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"proc {i} failed:\n{out[-3000:]}"
+        mark = [l for l in out.splitlines() if l.startswith("KENGOK")]
+        assert mark, out[-500:]
+        marks.append(mark[0].split(" ", 2)[2])   # drop "KENGOK proc=i"
+    assert marks[0] == marks[1], f"processes diverged: {marks}"
+    assert "wm=568" in marks[0] and "pipeline=True" in marks[0]
 
 
 SURVIVOR_CHILD = r'''
@@ -411,36 +472,9 @@ def test_two_process_desync_fail_stop(tmp_path):
     mirrored engines must become a CLEAN MirrorDesyncError on every
     process — with both digests in the message — not a silent wrong
     collective or a hang."""
-    sock = socket.socket()
-    sock.bind(("127.0.0.1", 0))
-    port = sock.getsockname()[1]
-    sock.close()
-    coord = f"127.0.0.1:{port}"
-
-    script = tmp_path / "desync_child.py"
-    script.write_text(DESYNC_CHILD)
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    ps = [
-        subprocess.Popen(
-            [sys.executable, str(script), coord, str(i)],
-            env=env, cwd=here, text=True,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in ps:
-        try:
-            out, _ = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in ps:
-                q.kill()
-            pytest.fail("desync child hung — fail-stop did not happen")
-        outs.append(out)
-    for i, (p, out) in enumerate(zip(ps, outs)):
-        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+    outs = _spawn_pair(tmp_path, "desync_child", DESYNC_CHILD, 300, hang_msg='desync child hung — fail-stop did not happen')
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert f"SYNCED proc={i} " in out, out[-500:]
         assert f"DESYNC-CAUGHT proc={i}" in out, (
             f"proc {i} never detected the divergence:\n" + out[-1500:]
