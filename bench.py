@@ -414,30 +414,38 @@ def bench_client_latency() -> dict:
     seqs = el.submit_pipelined(mk_big())     # warm
     assert el.is_durable(seqs[-1])
     lap_samples = []
+    lap_error = None
     for _ in range(2):
         ps = mk_big()
         launches.clear()
         t0 = time.perf_counter()
         seqs = el.submit_pipelined(ps)
         assert el.is_durable(seqs[-1])
+        if launches != [T_lap]:
+            # the row's amortization claim is only honest if the backlog
+            # really rode ONE lapped launch — a gate fallback to
+            # single-ring chunks must surface as an explicit error field,
+            # never publish as lapped (and never kill the whole suite)
+            lap_error = f"lapped launch not taken: launches={launches}"
+            break
         lap_samples.append(time.perf_counter() - t0)
-        # the row's amortization claim is only honest if the backlog
-        # really rode ONE lapped launch — a silent gate fallback to
-        # single-ring chunks must fail the bench, not publish as lapped
-        assert launches == [T_lap], launches
-    lwall = min(lap_samples)
-    return {
-        "chunk_entries": n,
-        "chunk_wall_ms": round(wall * 1e3, 1),
-        "wall_us_per_entry": round(wall * 1e6 / n, 3),
-        "entries_per_sec_wall": round(n / wall, 1),
-        "lapped_chunk": {
+    if lap_error is None:
+        lwall = min(lap_samples)
+        lapped = {
             "laps": LAPS,
             "chunk_entries": big,
             "chunk_wall_ms": round(lwall * 1e3, 1),
             "wall_us_per_entry": round(lwall * 1e6 / big, 3),
             "entries_per_sec_wall": round(big / lwall, 1),
-        },
+        }
+    else:
+        lapped = {"laps": LAPS, "error": lap_error}
+    return {
+        "chunk_entries": n,
+        "chunk_wall_ms": round(wall * 1e3, 1),
+        "wall_us_per_entry": round(wall * 1e6 / n, 3),
+        "entries_per_sec_wall": round(n / wall, 1),
+        "lapped_chunk": lapped,
         "note": ("submit->durable-ack through the axon tunnel (20-80 ms "
                  "dispatch RTT) incl. host durability bookkeeping; the "
                  "device-time rows measure the kernel only"),
