@@ -22,8 +22,12 @@ BASELINE.md for the target numbers.
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import ReplicaState, init_state
+from raft_tpu.multi import MultiEngine, Router
 from raft_tpu.raft.engine import RaftEngine
 
-__all__ = ["RaftConfig", "RaftEngine", "ReplicaState", "init_state"]
+__all__ = [
+    "MultiEngine", "RaftConfig", "RaftEngine", "ReplicaState", "Router",
+    "init_state",
+]
 
 __version__ = "0.1.0"

@@ -117,6 +117,44 @@ def init_state(cfg: RaftConfig, rows: Optional[int] = None) -> ReplicaState:
     )
 
 
+def init_group_state(
+    cfg: RaftConfig, n_groups: int, rows: Optional[int] = None
+) -> ReplicaState:
+    """Zero state for ``n_groups`` independent Raft groups as ONE batched
+    pytree: every ``ReplicaState`` leaf gains a leading group axis, so G
+    groups' transitions run as a single vmapped device program
+    (``core.step.group_replicate_step``) instead of G host-dispatched
+    launches — the multi-Raft recast of the replica-major batching.
+
+    The result is intentionally the same dataclass: inside ``jax.vmap``
+    each group's slice is an ordinary unbatched ``ReplicaState``, so the
+    single-group kernels run unmodified (byte-equivalent per group).
+    Host-side readers must slice a group out first (``group_view``) —
+    the shape-derived properties (``words_per_entry``) assume the
+    unbatched layout.
+    """
+    r = cfg.rows if rows is None else rows
+    c, w = cfg.log_capacity, cfg.shard_words
+    g = n_groups
+    return ReplicaState(
+        term=jnp.zeros((g, r), jnp.int32),
+        voted_for=jnp.full((g, r), NO_VOTE, jnp.int32),
+        last_index=jnp.zeros((g, r), jnp.int32),
+        commit_index=jnp.zeros((g, r), jnp.int32),
+        match_index=jnp.zeros((g, r), jnp.int32),
+        match_term=jnp.zeros((g, r), jnp.int32),
+        log_term=jnp.zeros((g, r, c), jnp.int32),
+        log_payload=jnp.zeros((g, c, r * w), jnp.int32),
+    )
+
+
+def group_view(state: ReplicaState, g: int) -> ReplicaState:
+    """One group's unbatched ``ReplicaState`` view of a group-batched
+    state (``init_group_state``) — the layout every host-side read
+    helper in this module expects."""
+    return jax.tree.map(lambda a: a[g], state)
+
+
 def slot_of(index: jax.Array, capacity: int) -> jax.Array:
     """Ring slot of 1-based log index ``index``."""
     return (index - 1) % capacity

@@ -136,6 +136,12 @@ def replicate_step(
     #   runs as one fused Pallas program (core.step_pallas) using
     #   ``commit_cand >= term_floor`` as the §5.4.2 gate — equivalent to
     #   the ring-read formulation below. None = general path.
+    use_pallas: bool = True,  # False forces the XLA formulation even at
+    #   kernel-eligible shapes. The group-batched multi-Raft path vmaps
+    #   this function (group_replicate_step): batching rules for the
+    #   in-place aliased pallas_call are not certified, and the XLA ops
+    #   vmap exactly — byte-equivalence per group is preserved because
+    #   the two formulations are equivalence-gated (bench._ring_kernel_gate).
 ) -> tuple[ReplicaState, RepInfo]:
     """One leader tick: ingest + repair + replicate + quorum commit, on device.
 
@@ -172,7 +178,7 @@ def replicate_step(
     from raft_tpu.core.comm import MeshComm, SingleDeviceComm
 
     if (
-        term_floor is not None and (not repair or ec)
+        use_pallas and term_floor is not None and (not repair or ec)
         and isinstance(comm, MeshComm) and _pallas_ok(cap, B)
         and M == state.log_payload.shape[1]
     ):
@@ -191,7 +197,7 @@ def replicate_step(
             interpret=pallas_interpret(),
         )
     if (
-        term_floor is not None and (not repair or ec)
+        use_pallas and term_floor is not None and (not repair or ec)
         and isinstance(comm, SingleDeviceComm) and _pallas_ok(cap, B)
     ):
         # The EC program has no repair window (shards are healed by
@@ -299,7 +305,7 @@ def replicate_step(
             # window's source); its prev point is its own log tail
             accept = accept | ingest_row
         start_slot = slot_of(ws, cap)
-        if _pallas_ok(cap, B):
+        if use_pallas and _pallas_ok(cap, B):
             # TPU: payload + term window writes AND the §5.3 conflict
             # check fused into ONE in-place pallas_call
             # (core.ring_pallas) — the XLA formulation below splits into
@@ -537,6 +543,63 @@ def scan_replicate(
         return st, info
 
     return jax.lax.scan(body, state, (payloads, counts))
+
+
+def group_replicate_step(n_replicas: int, *, repair: bool = True):
+    """G independent Raft groups' replication ticks as ONE batched device
+    program: ``jax.vmap`` of ``replicate_step`` over a leading group axis
+    on every operand (state from ``core.state.init_group_state``).
+
+    This is the multi-Raft data plane (``raft_tpu.multi``): where a
+    sharded store would launch G host round-trips — one AppendEntries
+    fan-out per group — the vmapped program moves all G groups' windows,
+    acks, and quorum commits in one launch. Per group the math is the
+    single-group kernel's exactly (vmap batches the same ops), so each
+    group's state stays byte-identical to a lone-group run with the same
+    inputs — the equivalence ``tests/test_multi_raft.py`` pins.
+
+    Masking convention (no separate "active" plumbing): a group with
+    nothing to do this round passes ``leader_term=0`` and an all-False
+    ``alive`` row. Term 0 is "no election ever held" (``legit`` fails:
+    no ingest, no commit) and a dead cluster hears nothing, so the
+    group's state passes through bit-unchanged.
+
+    Returned callable signature (all leading axes G):
+    ``(state, payloads[G,B,R*W], counts[G], leaders[G], terms[G],
+    alive[G,R], slow[G,R], member[G,R]) -> (state, RepInfo[G])``.
+
+    Non-EC only (multi-group EC shard planes are future work), fixed
+    membership quorum via the always-supplied member mask, and the XLA
+    formulation (``use_pallas=False`` — see the parameter note).
+    """
+    from raft_tpu.core.comm import SingleDeviceComm
+
+    comm = SingleDeviceComm(n_replicas)
+
+    def one(state, payload, count, leader, term, alive, slow, member):
+        return replicate_step(
+            comm, state, payload, count, leader, term, alive, slow,
+            member=member, ec=False, commit_quorum=None, repair=repair,
+            use_pallas=False,
+        )
+
+    return jax.vmap(one)
+
+
+def group_vote_step(n_replicas: int):
+    """G groups' election rounds as one batched launch: ``jax.vmap`` of
+    ``vote_step`` over the leading group axis. Masking: a group with no
+    campaign this round passes an all-False ``alive`` row — no grants,
+    no term adoption, state bit-unchanged. Signature (leading axes G):
+    ``(state, candidates[G], cand_terms[G], alive[G,R])``."""
+    from raft_tpu.core.comm import SingleDeviceComm
+
+    comm = SingleDeviceComm(n_replicas)
+
+    def one(state, candidate, cand_term, alive):
+        return vote_step(comm, state, candidate, cand_term, alive)
+
+    return jax.vmap(one)
 
 
 def vote_step(

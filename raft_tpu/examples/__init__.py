@@ -1,9 +1,12 @@
 """Worked examples built on the public raft_tpu API."""
 
 from raft_tpu.examples.kv import ReplicatedKV
+from raft_tpu.examples.kv_sharded import ShardedKV
 from raft_tpu.examples.sessions import (
     ReplicatedCounter,
     SessionedStateMachine,
 )
 
-__all__ = ["ReplicatedKV", "ReplicatedCounter", "SessionedStateMachine"]
+__all__ = [
+    "ReplicatedKV", "ShardedKV", "ReplicatedCounter", "SessionedStateMachine",
+]

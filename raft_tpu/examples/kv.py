@@ -39,6 +39,27 @@ _SET, _DELETE = 1, 2
 _HDR = struct.Struct("<BHH")
 
 
+def encode_op(entry_bytes: int, op: int, key: bytes, value: bytes) -> bytes:
+    """One KV operation as a fixed-size log entry (module docstring
+    format). Shared by ``ReplicatedKV`` and the sharded store
+    (``examples.kv_sharded.ShardedKV``) so both speak one wire format."""
+    body = _HDR.pack(op, len(key), len(value)) + key + value
+    if len(body) > entry_bytes:
+        raise ValueError(f"op needs {len(body)} bytes, entries are {entry_bytes}")
+    return body + bytes(entry_bytes - len(body))
+
+
+def apply_op(data: Dict[bytes, bytes], payload: bytes) -> None:
+    """Apply one committed entry to a dict state machine (op 0 =
+    padding/heartbeat: ignore)."""
+    op, klen, vlen = _HDR.unpack_from(payload)
+    if op == _SET:
+        k = payload[_HDR.size:_HDR.size + klen]
+        data[k] = payload[_HDR.size + klen:_HDR.size + klen + vlen]
+    elif op == _DELETE:
+        data.pop(payload[_HDR.size:_HDR.size + klen], None)
+
+
 class ReplicatedKV:
     """Dict-shaped state machine over the replicated log."""
 
@@ -50,13 +71,7 @@ class ReplicatedKV:
 
     # ------------------------------------------------------------ client
     def _encode(self, op: int, key: bytes, value: bytes) -> bytes:
-        size = self.engine.cfg.entry_bytes
-        body = _HDR.pack(op, len(key), len(value)) + key + value
-        if len(body) > size:
-            raise ValueError(
-                f"op needs {len(body)} bytes, entries are {size}"
-            )
-        return body + bytes(size - len(body))
+        return encode_op(self.engine.cfg.entry_bytes, op, key, value)
 
     def set(self, key: bytes, value: bytes) -> int:
         """Queue a SET; returns the engine seq. Durable (and visible to
@@ -100,12 +115,5 @@ class ReplicatedKV:
 
     # ------------------------------------------------------ state machine
     def _apply(self, index: int, payload: bytes) -> None:
-        op, klen, vlen = _HDR.unpack_from(payload)
-        if op == _SET:
-            k = payload[_HDR.size:_HDR.size + klen]
-            v = payload[_HDR.size + klen:_HDR.size + klen + vlen]
-            self._data[k] = v
-        elif op == _DELETE:
-            self._data.pop(payload[_HDR.size:_HDR.size + klen], None)
-        # op 0 = padding/heartbeat entry: ignore
+        apply_op(self._data, payload)
         self.last_applied = index

@@ -33,6 +33,12 @@ class FaultEvent:
     action: str
     replica: int = 0          # unused by partition/heal_partition
     groups: Optional[Tuple[Tuple[int, ...], ...]] = None  # partition only
+    group: Optional[int] = None
+    #   Optional Raft-GROUP scope for multi-Raft runs
+    #   (``multi.MultiEngine.schedule_faults``): the event hits only that
+    #   consensus group's replicas. None = every group — and the
+    #   single-group ``RaftEngine`` ignores the field entirely, so
+    #   existing plans drive either engine unchanged.
 
     def __post_init__(self):
         if self.action not in ACTIONS:

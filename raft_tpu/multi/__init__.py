@@ -1,0 +1,8 @@
+"""Multi-Raft: G independent consensus groups as one batched device
+program (``MultiEngine``), behind a key-routed sharding front end
+(``Router``). See ``multi.engine`` for the design notes."""
+
+from raft_tpu.multi.engine import MultiEngine, NotLeader
+from raft_tpu.multi.router import Router
+
+__all__ = ["MultiEngine", "NotLeader", "Router"]

@@ -1,0 +1,805 @@
+"""Multi-Raft: G independent consensus groups multiplexed over one device.
+
+Production Raft stores (TiKV, CockroachDB) shard the keyspace into many
+independent Raft groups so no single leader/log/commit stream caps
+throughput. raft_tpu's data plane is already replica-major arrays stepped
+by one batched program, so the multi-group recast is a *leading group
+axis*, not G engines: all groups' state lives in one group-batched
+``ReplicaState`` (``core.state.init_group_state``) and same-tick
+replication rounds across groups ride ONE vmapped launch
+(``core.step.group_replicate_step``) instead of G host round-trips.
+
+Division of labor mirrors ``raft.engine.RaftEngine`` (which stays the
+single-group engine with the full feature surface — EC, membership
+change, pipelined ingest, checkpoint/restore):
+
+- **device**: one ``group_replicate_step`` / ``group_vote_step`` launch
+  per event-loop round covers every group active in that round; inactive
+  groups are masked to a bit-exact no-op (term 0 + dead cluster), so one
+  compiled program serves every activity subset.
+- **host**: one event heap drives all G groups' timers. Each group's
+  control plane (roles, terms, election draws) is an independent column
+  of vectorized host state with its OWN seeded rng stream, so a group's
+  election schedule is identical to a lone engine's given the same
+  draws — groups interact only by sharing launches, never by protocol.
+
+Leadership placement: G commit streams through one leader row would
+serialize on that replica's ingest. ``seed_leaders`` campaigns replica
+``g % n_replicas`` for group ``g`` (round-robin) in one batched vote
+launch, and ``rebalance`` is the standing hook that re-spreads
+leadership after faults concentrate it.
+
+Scope: non-EC, fixed membership (``max_replicas=None``). Per-group fault
+masks (``fail``/``set_slow``/``partition``) mirror the single engine's;
+``faults.FaultPlan`` events carry an optional ``group`` scope. The
+committed bytes of every group are archived host-side for the ordered
+apply stream (``register_apply``) and for differential reads. Not yet at
+this layer (single-engine features that generalize the same way):
+pipelined chunk ingest, checkpoint/restore, and snapshot-install healing
+for followers lapped past the ring horizon — the repair window heals any
+follower within one ``log_capacity`` of the leader's tail, which bounds
+the lag the event loop's tick cadence can create.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.state import (
+    ReplicaState,
+    fold_batch,
+    group_view,
+    init_group_state,
+    log_entries,
+)
+from raft_tpu.core.step import group_replicate_step, group_vote_step
+from raft_tpu.raft.engine import CANDIDATE, FOLLOWER, LEADER, VirtualClock
+
+
+class NotLeader(Exception):
+    """A leader-required group operation (``submit_to_leader``,
+    ``read_index``) found no live, confirmable leader for the target
+    group. Carries ``group`` so a router can rebucket/retry; the retry
+    protocol is: drive the engine until the group re-elects
+    (``run_until_leader``), then resubmit (``multi.router.Router``).
+    When raised out of a batched router call, ``partial`` carries the
+    per-item results placed before the failure (None = unplaced) so the
+    caller can await what DID land instead of blind-resubmitting."""
+
+    def __init__(self, group: int, msg: str = ""):
+        super().__init__(msg or f"group {group} has no current leader")
+        self.group = group
+        self.partial: Optional[list] = None
+
+
+_PROGRAMS: Dict[int, tuple] = {}
+
+
+def _programs(n_replicas: int) -> tuple:
+    """Process-wide (replicate, vote) jitted group programs per cluster
+    size: every MultiEngine over the same R shares ONE compiled program
+    per distinct G (jit caches per input shape), instead of retracing
+    per engine instance."""
+    if n_replicas not in _PROGRAMS:
+        _PROGRAMS[n_replicas] = (
+            jax.jit(group_replicate_step(n_replicas), donate_argnums=(0,)),
+            jax.jit(group_vote_step(n_replicas), donate_argnums=(0,)),
+        )
+    return _PROGRAMS[n_replicas]
+
+
+class MultiEngine:
+    """G Raft groups: one host event loop, one batched device program.
+
+    The public per-group surface intentionally tracks ``RaftEngine``'s
+    (``submit``/``is_durable``/``run_until_committed``/``register_apply``/
+    fault toggles), with a leading ``g`` argument; the router layers the
+    key-routed client surface on top.
+    """
+
+    def __init__(
+        self,
+        cfg: RaftConfig,
+        n_groups: int,
+        trace: Optional[Callable[[str], None]] = None,
+    ):
+        if cfg.ec_enabled:
+            raise ValueError(
+                "MultiEngine does not support erasure coding; use the "
+                "single-group RaftEngine for EC clusters"
+            )
+        if cfg.max_replicas is not None:
+            raise ValueError(
+                "MultiEngine runs fixed membership; max_replicas must be "
+                "None"
+            )
+        if cfg.transport != "single":
+            # loud, like the other unsupported knobs: the group axis is
+            # resident (SingleDeviceComm under vmap) — a mesh/multihost
+            # transport setting would otherwise be silently ignored.
+            # Sharding the group axis over a mesh is the natural next
+            # step but is not built yet.
+            raise ValueError(
+                "MultiEngine runs the resident single-device layout; set "
+                f"transport='single' (got {cfg.transport!r})"
+            )
+        if n_groups < 1:
+            raise ValueError("n_groups must be >= 1")
+        self.cfg = cfg
+        self.G = n_groups
+        R = cfg.n_replicas
+        self.state: ReplicaState = init_group_state(cfg, n_groups)
+        # One compiled program per entry point for EVERY activity subset:
+        # masked groups no-op bit-exactly, so the launch shape never varies
+        # — and the programs are process-cached across engines (_programs).
+        self._replicate, self._vote = _programs(R)
+        self._member = jnp.ones((n_groups, R), bool)
+        self._hb_payloads = None   # cached all-zero batch (ingest-free rounds)
+
+        self.clock = VirtualClock()
+        self._trace = trace
+        # Per-group rng streams: group g's election draws are its own
+        # deterministic sequence (a lone engine with the same stream
+        # makes the same draws), so adding groups never perturbs an
+        # existing group's schedule.
+        self.rngs = [random.Random(f"{cfg.seed}:{g}") for g in range(n_groups)]
+
+        self.roles: List[List[str]] = [[FOLLOWER] * R for _ in range(n_groups)]
+        self.terms = np.zeros((n_groups, R), np.int64)
+        self.lead_terms = np.zeros((n_groups, R), np.int64)
+        self.alive = np.ones((n_groups, R), bool)
+        self.slow = np.zeros((n_groups, R), bool)
+        self.connectivity = np.ones((n_groups, R, R), bool)
+        self.leader_id: List[Optional[int]] = [None] * n_groups
+        self.commit_watermark = np.zeros(n_groups, np.int64)
+
+        self._queue: List[List[Tuple[int, bytes]]] = [[] for _ in range(n_groups)]
+        self._next_seq = [1] * n_groups
+        self._seq_at_index: List[Dict[int, int]] = [{} for _ in range(n_groups)]
+        self._uncommitted: List[Dict[int, Tuple[bytes, int]]] = [
+            {} for _ in range(n_groups)
+        ]
+        self._archive: List[Dict[int, bytes]] = [{} for _ in range(n_groups)]
+        #   idx -> committed payload bytes, per group — the apply stream's
+        #   source and the differential tests' read surface. Unbounded by
+        #   design at this layer (a production deployment snapshots +
+        #   truncates, as the single engine's CheckpointStore does).
+        self.submit_time: List[Dict[int, float]] = [{} for _ in range(n_groups)]
+        self.commit_time: List[Dict[int, float]] = [{} for _ in range(n_groups)]
+        self._apply_fns: List[List[Callable[[int, bytes], None]]] = [
+            [] for _ in range(n_groups)
+        ]
+        self.applied_index = np.zeros(n_groups, np.int64)
+
+        self._q: List[Tuple[float, int, str, int, int]] = []
+        #   (t, tiebreak, kind, group, replica)
+        self._seq_events = 0
+        self._timer_gen = np.zeros((n_groups, R), np.int64)
+        self._fault_events: list = []
+        for g in range(n_groups):
+            for r in range(R):
+                self._arm_follower(g, r)
+
+    # ------------------------------------------------------------------ util
+    def nodelog(self, g: int, r: int, msg: str) -> str:
+        """The reference nodelog schema with a group tag in the id field:
+        ``[g{G}/Server{r}:Term:Commit:Last][role]msg``. The tag survives
+        ``obs.trace.TraceRecord`` parsing (id = everything before the
+        first colon), and ``TraceRecord.group`` recovers the scope."""
+        if self._trace is None:
+            return ""
+        ci_li = np.asarray(
+            jnp.stack(
+                [self.state.commit_index[g, r], self.state.last_index[g, r]]
+            )
+        )
+        line = (
+            f"[g{g}/Server{r}:{self.terms[g, r]}:{int(ci_li[0])}:"
+            f"{int(ci_li[1])}][{self.roles[g][r]}]{msg}"
+        )
+        self._trace(line)
+        return line
+
+    def _push(self, t: float, kind: str, g: int, r: int) -> None:
+        heapq.heappush(self._q, (t, self._seq_events, kind, g, r))
+        self._seq_events += 1
+
+    def _arm_follower(self, g: int, r: int) -> None:
+        self._timer_gen[g, r] += 1
+        lo, hi = self.cfg.follower_timeout
+        self._push(
+            self.clock.now + self.rngs[g].uniform(lo, hi),
+            f"e:{self._timer_gen[g, r]}", g, r,
+        )
+
+    def _arm_candidate(self, g: int, r: int) -> None:
+        self._timer_gen[g, r] += 1
+        lo, hi = self.cfg.candidate_timeout
+        self._push(
+            self.clock.now + self.rngs[g].uniform(lo, hi),
+            f"c:{self._timer_gen[g, r]}", g, r,
+        )
+
+    def _reach(self, g: int, src: int) -> np.ndarray:
+        return self.alive[g] & self.connectivity[g, src]
+
+    # ------------------------------------------------------------- client API
+    def submit(self, g: int, payload: bytes) -> int:
+        """Queue one entry on group ``g``; returns its per-group sequence
+        number. Durability semantics match ``RaftEngine.submit``: durable
+        once ``is_durable(g, seq)``; entries in flight across a
+        leadership change may be dropped and simply never read durable."""
+        if len(payload) != self.cfg.entry_bytes:
+            raise ValueError(
+                f"payload must be exactly {self.cfg.entry_bytes} bytes"
+            )
+        seq = self._next_seq[g]
+        self._next_seq[g] += 1
+        self._queue[g].append((seq, payload))
+        self.submit_time[g][seq] = self.clock.now
+        return seq
+
+    def submit_to_leader(self, g: int, payload: bytes) -> int:
+        """``submit`` that refuses when the group has no routed leader —
+        the router's entry point (``NotLeader`` drives its retry)."""
+        r = self.leader_id[g]
+        if r is None or self.roles[g][r] != LEADER or not self.alive[g, r]:
+            raise NotLeader(g)
+        return self.submit(g, payload)
+
+    def is_durable(self, g: int, seq: int) -> bool:
+        return seq in self.commit_time[g]
+
+    def read_index(self, g: int, r: Optional[int] = None) -> int:
+        """Per-group ReadIndex (dissertation §6.4): confirm group ``g``'s
+        leadership with one empty quorum round, return the commit index
+        the read may serve at. Raises ``NotLeader`` when there is no live
+        leader, the leader is deposed during confirmation, or a member
+        majority is unreachable (a minority-side stale leader can never
+        confirm — the split-brain guarantee, per group)."""
+        if r is None:
+            r = self.leader_id[g]
+        if r is None or self.roles[g][r] != LEADER or not self.alive[g, r]:
+            raise NotLeader(g)
+        term = int(self.lead_terms[g, r])
+        if int(self.terms[g, r]) > term:
+            self._step_down_leader(g, r, int(self.terms[g, r]))
+            raise NotLeader(g, f"group {g} leader deposed (higher term seen)")
+        eff = self._reach(g, r)
+        if int(eff.sum()) <= self.cfg.n_replicas // 2:
+            raise NotLeader(
+                g, f"group {g}: quorum unreachable "
+                f"({int(eff.sum())} of {self.cfg.n_replicas})"
+            )
+        read_idx = int(self.commit_watermark[g])
+        max_terms, commits = self._replicate_round({g: (r, term, 0, None)})
+        if int(max_terms[g]) > term:
+            self._step_down_leader(g, r, int(max_terms[g]))
+            raise NotLeader(g, f"group {g} leader deposed during confirmation")
+        self.terms[g][eff] = np.maximum(self.terms[g][eff], term)
+        self._advance_commit(g, r, int(commits[g]))
+        self._reset_heard_timers(g, r)
+        return read_idx
+
+    # ------------------------------------------------- leadership placement
+    def seed_leaders(self) -> None:
+        """Round-robin leadership seeding: replica ``g % n_replicas``
+        campaigns for group ``g``, every leaderless group in ONE batched
+        vote launch, so no single replica row serializes all G commit
+        streams. The winners' first ticks are pushed at the same virtual
+        instant — steady-state replication rounds then stay in lockstep
+        and keep batching into shared launches."""
+        cands = []
+        for g in range(self.G):
+            if self.leader_id[g] is not None:
+                continue
+            r = g % self.cfg.n_replicas
+            if not self.alive[g, r]:
+                continue
+            self.roles[g][r] = CANDIDATE
+            self.terms[g, r] += 1
+            self.nodelog(g, r, "state changed to candidate (seeded)")
+            cands.append((g, r))
+        if cands:
+            self._campaign_many(cands)
+
+    def rebalance(self, max_moves: Optional[int] = None) -> int:
+        """Leadership rebalance hook: campaign each group's round-robin
+        target replica where leadership has drifted onto another row
+        (post-fault concentration). A group whose target's log is not
+        §5.4.1 up-to-date with every reachable member is SKIPPED, not
+        attempted: the campaign would lose the vote yet its term bump
+        would depose the incumbent, leaving the group leaderless for an
+        election window — worse than the imbalance. Call at quiescence
+        (every follower caught up) for guaranteed moves. Returns the
+        number of campaigns attempted."""
+        from raft_tpu.core.state import last_log_term
+
+        cands = []
+        for g in range(self.G):
+            target = g % self.cfg.n_replicas
+            cur = self.leader_id[g]
+            if cur is None or cur == target:
+                continue
+            if not self.alive[g, target] or not self.connectivity[g, target, cur]:
+                continue
+            eff = self._reach(g, target)
+            if int(eff.sum()) <= self.cfg.n_replicas // 2:
+                continue
+            gv = group_view(self.state, g)
+            lasts = np.asarray(gv.last_index)
+            lterms = np.asarray(last_log_term(gv))
+            tkey = (int(lterms[target]), int(lasts[target]))
+            if any(
+                (int(lterms[p]), int(lasts[p])) > tkey
+                for p in np.flatnonzero(eff)
+            ):
+                continue  # target would lose the up-to-date check
+            self.roles[g][target] = CANDIDATE
+            self.terms[g, target] = int(self.terms[g].max()) + 1
+            self.nodelog(g, target, "state changed to candidate (rebalance)")
+            cands.append((g, target))
+            if max_moves is not None and len(cands) >= max_moves:
+                break
+        if cands:
+            self._campaign_many(cands)
+        return len(cands)
+
+    def leader_spread(self) -> Dict[int, int]:
+        """replica row -> number of groups it currently leads."""
+        out: Dict[int, int] = {}
+        for lid in self.leader_id:
+            if lid is not None:
+                out[lid] = out.get(lid, 0) + 1
+        return out
+
+    # ---------------------------------------------------------- fault toggles
+    def fail(self, g: int, r: int) -> None:
+        self.alive[g, r] = False
+        if self.leader_id[g] == r:
+            self.leader_id[g] = None
+        self.roles[g][r] = FOLLOWER
+        self.nodelog(g, r, "killed")
+
+    def recover(self, g: int, r: int) -> None:
+        self.alive[g, r] = True
+        self.roles[g][r] = FOLLOWER
+        self.nodelog(g, r, "recovered")
+        self._arm_follower(g, r)
+
+    def set_slow(self, g: int, r: int, is_slow: bool) -> None:
+        self.slow[g, r] = is_slow
+
+    def partition(self, g: int, groups) -> None:
+        """Link-level partition of Raft group ``g``'s replicas (same
+        semantics as ``RaftEngine.partition``, scoped to one group —
+        other groups' connectivity is untouched, which is exactly the
+        independence the multi-group tests pin)."""
+        R = self.cfg.n_replicas
+        listed = sorted(x for grp in groups for x in grp)
+        if listed != list(range(R)):
+            # exact cover, duplicates included (RaftEngine.partition's
+            # contract): an overlapping replica would bridge the split
+            # and silently partition nothing
+            raise ValueError(
+                "groups must cover every replica exactly once (no "
+                "repeats, no gaps)"
+            )
+        self.connectivity[g] = False
+        for grp in groups:
+            for a in grp:
+                for b in grp:
+                    self.connectivity[g, a, b] = True
+        self.nodelog(g, 0, f"partition installed: {[sorted(x) for x in groups]}")
+
+    def heal_partition(self, g: int) -> None:
+        self.connectivity[g] = True
+        self.nodelog(g, 0, "partition healed")
+
+    def schedule_faults(self, plan) -> None:
+        """Merge a ``faults.FaultPlan`` into the heap. Each event's
+        optional ``group`` field scopes it to one Raft group; ``None``
+        applies it to every group (the single-engine plans keep working
+        unchanged — their events are unscoped)."""
+        base = len(self._fault_events)
+        self._fault_events.extend(plan.events)
+        for i, ev in enumerate(plan.events):
+            self._push(ev.t, f"f:{base + i}", -1, ev.replica)
+
+    def _fire_fault(self, idx: int) -> None:
+        ev = self._fault_events[idx]
+        targets = range(self.G) if ev.group is None else (ev.group,)
+        for g in targets:
+            {
+                "kill": lambda p: self.fail(g, p),
+                "recover": lambda p: self.recover(g, p),
+                "slow": lambda p: self.set_slow(g, p, True),
+                "unslow": lambda p: self.set_slow(g, p, False),
+                "campaign": lambda p: self.force_campaign(g, p),
+                "partition": lambda p: self.partition(g, ev.groups),
+                "heal_partition": lambda p: self.heal_partition(g),
+            }[ev.action](ev.replica)
+
+    def force_campaign(self, g: int, r: int) -> None:
+        if not self.alive[g, r]:
+            return
+        if self.roles[g][r] == LEADER and self.leader_id[g] == r:
+            return
+        self.roles[g][r] = CANDIDATE
+        self.terms[g, r] += 1
+        self.nodelog(g, r, "state changed to candidate (injected)")
+        self._campaign_many([(g, r)])
+
+    # ------------------------------------------------------------- event loop
+    def step_event(self) -> bool:
+        """Advance the clock to the next timer and handle it. Leader-tick
+        events sharing the SAME virtual instant are drained together and
+        their replication rounds fused into one batched launch — the
+        shared-launch batching the group axis exists for."""
+        if not self._q:
+            return False
+        t, _, kind, g, r = heapq.heappop(self._q)
+        self.clock.now = max(self.clock.now, t)
+        tag, _, gen = kind.partition(":")
+        if tag == "l":
+            ticks = [(g, r)]
+            while self._q and self._q[0][0] == t and self._q[0][2] == "l":
+                _, _, _, g2, r2 = heapq.heappop(self._q)
+                ticks.append((g2, r2))
+            self._fire_leader_ticks(ticks)
+            return True
+        if tag in ("e", "c") and int(gen) != self._timer_gen[g, r]:
+            return True  # stale timer generation
+        if tag == "e":
+            self._fire_follower(g, r)
+        elif tag == "c":
+            self._fire_candidate(g, r)
+        elif tag == "f":
+            self._fire_fault(int(gen))
+        return True
+
+    def run_for(self, seconds: float, max_events: int = 100_000) -> None:
+        end = self.clock.now + seconds
+        for _ in range(max_events):
+            if not self._q or self._q[0][0] > end:
+                break
+            self.step_event()
+        self.clock.now = end
+
+    def run_until_leader(self, g: int, limit: float = 600.0) -> int:
+        end = self.clock.now + limit
+        while self.leader_id[g] is None and self.clock.now < end and self._q:
+            self.step_event()
+        if self.leader_id[g] is None:
+            raise NotLeader(g, f"group {g}: no leader within {limit}s")
+        return self.leader_id[g]
+
+    def run_until_committed(self, g: int, seq: int, limit: float = 600.0) -> None:
+        end = self.clock.now + limit
+        while (
+            not self.is_durable(g, seq) and self.clock.now < end and self._q
+        ):
+            self.step_event()
+        assert self.is_durable(g, seq), (
+            f"group {g} seq {seq} not committed "
+            f"(watermark {self.commit_watermark[g]})"
+        )
+
+    # ----------------------------------------------------------- role actions
+    def _fire_follower(self, g: int, r: int) -> None:
+        if not self.alive[g, r] or self.roles[g][r] != FOLLOWER:
+            return
+        self.roles[g][r] = CANDIDATE
+        self.terms[g, r] += 1
+        self.nodelog(g, r, "state changed to candidate")
+        self._campaign_many([(g, r)])
+
+    def _fire_candidate(self, g: int, r: int) -> None:
+        if not self.alive[g, r] or self.roles[g][r] != CANDIDATE:
+            return
+        self.terms[g, r] += 1
+        self._campaign_many([(g, r)])
+
+    def _campaign_many(self, cands: List[Tuple[int, int]]) -> None:
+        """One batched vote launch for every (group, candidate) pair —
+        groups without a campaign this round are masked to a no-op."""
+        G, R = self.G, self.cfg.n_replicas
+        candidates = np.zeros(G, np.int32)
+        cterms = np.zeros(G, np.int32)
+        eff = np.zeros((G, R), bool)
+        for g, r in cands:
+            candidates[g] = r
+            cterms[g] = int(self.terms[g, r])
+            eff[g] = self._reach(g, r)
+        self.state, info = self._vote(
+            self.state, jnp.asarray(candidates), jnp.asarray(cterms),
+            jnp.asarray(eff),
+        )
+        votes = np.asarray(info.votes)
+        max_terms = np.asarray(info.max_term)
+        for g, r in cands:
+            cand_term = int(cterms[g])
+            e = eff[g]
+            self.terms[g][e] = np.maximum(self.terms[g][e], cand_term)
+            if int(max_terms[g]) > cand_term:
+                self.terms[g, r] = int(max_terms[g])
+                self.roles[g][r] = FOLLOWER
+                self._arm_follower(g, r)
+                continue
+            if int(votes[g]) > R // 2:
+                if self.leader_id[g] != r:
+                    # a different winner's log may diverge above the
+                    # watermark: uncommitted index->seq mappings are no
+                    # longer trustworthy (their seqs read as lost, like
+                    # the single engine). The ingest-byte buffer is kept:
+                    # the archive path term-checks each entry against the
+                    # committing leader's log before trusting it.
+                    wm = int(self.commit_watermark[g])
+                    self._seq_at_index[g] = {
+                        i: s for i, s in self._seq_at_index[g].items()
+                        if i <= wm
+                    }
+                self.roles[g][r] = LEADER
+                self.leader_id[g] = r
+                self.lead_terms[g, r] = cand_term
+                for p in range(R):
+                    if (
+                        p != r and self.roles[g][p] == LEADER
+                        and self.connectivity[g, r, p]
+                    ):
+                        self.roles[g][p] = FOLLOWER
+                        self._arm_follower(g, p)
+                self.nodelog(g, r, "state changed to leader")
+                self._push(self.clock.now, "l", g, r)
+            else:
+                self._arm_candidate(g, r)
+
+    def _step_down_leader(self, g: int, r: int, max_term: int) -> None:
+        self.roles[g][r] = FOLLOWER
+        self.terms[g, r] = max_term
+        if self.leader_id[g] == r:
+            self.leader_id[g] = None
+        self.nodelog(g, r, "step down to follower")
+        self._arm_follower(g, r)
+
+    def _replicate_round(self, active: Dict[int, tuple]):
+        """One batched replicate launch. ``active``: g -> (leader, term,
+        take, packed u8 batch or None). Returns (max_term[G], commit[G])
+        as host arrays; ingest bookkeeping is the caller's."""
+        cfg = self.cfg
+        G, R, B = self.G, cfg.n_replicas, cfg.batch_size
+        counts = np.zeros(G, np.int32)
+        leaders = np.zeros(G, np.int32)
+        lterms = np.zeros(G, np.int32)
+        eff = np.zeros((G, R), bool)
+        if any(take for (_, _, take, _) in active.values()):
+            payloads = np.zeros((G, B, R * cfg.shard_words), np.int32)
+            for g, (_, _, take, data) in active.items():
+                if take:
+                    payloads[g] = np.asarray(fold_batch(data, R, B))
+            payloads_dev = jnp.asarray(payloads)
+        else:
+            # heartbeat / read-confirmation round: nothing to ingest —
+            # reuse one device-resident zero batch instead of building
+            # and transferring a fresh (G, B, R*W) buffer per round
+            if self._hb_payloads is None:
+                self._hb_payloads = jnp.zeros(
+                    (G, B, R * cfg.shard_words), jnp.int32
+                )
+            payloads_dev = self._hb_payloads
+        for g, (r, term, take, _) in active.items():
+            leaders[g] = r
+            lterms[g] = term
+            eff[g] = self._reach(g, r)
+            counts[g] = take
+        self.state, info = self._replicate(
+            self.state, payloads_dev, jnp.asarray(counts),
+            jnp.asarray(leaders), jnp.asarray(lterms), jnp.asarray(eff),
+            jnp.asarray(self.slow), self._member,
+        )
+        self._last_info = info
+        return np.asarray(info.max_term), np.asarray(info.commit_index)
+
+    def _fire_leader_ticks(self, ticks: List[Tuple[int, int]]) -> None:
+        """All leader ticks that share this virtual instant, as ONE
+        batched device launch (ingest + repair + replicate + commit per
+        group). Two leaders of the SAME group on one instant (split-brain:
+        a stale minority leader plus the current one) cannot share a
+        launch — the batched program takes one source per group — so the
+        second rides an immediate follow-up round rather than being
+        dropped (dropping it would end its heartbeat re-arm chain)."""
+        cfg = self.cfg
+        B = cfg.batch_size
+        active: Dict[int, tuple] = {}
+        overflow: List[Tuple[int, int]] = []
+        for g, r in ticks:
+            if not self.alive[g, r] or self.roles[g][r] != LEADER:
+                continue
+            term = int(self.lead_terms[g, r])
+            if int(self.terms[g, r]) > term:
+                self._step_down_leader(g, r, int(self.terms[g, r]))
+                continue
+            if g in active:
+                overflow.append((g, r))
+                continue
+            routed = self.leader_id[g] == r
+            take = min(len(self._queue[g]), B) if routed else 0
+            data = None
+            if take:
+                data = np.frombuffer(
+                    b"".join(p for _, p in self._queue[g][:take]), np.uint8
+                ).reshape(take, cfg.entry_bytes)
+            active[g] = (r, term, take, data)
+        if not active:
+            if overflow:
+                self._fire_leader_ticks(overflow)
+            return
+        max_terms, commits = self._replicate_round(active)
+        frontier = np.asarray(self._last_info.frontier_len)
+        lasts = None
+        for g, (r, term, take, _) in active.items():
+            if int(max_terms[g]) > term:
+                # nothing was consumed: the device refused the stale term
+                self._step_down_leader(g, r, int(max_terms[g]))
+                continue
+            e = self._reach(g, r)
+            self.terms[g][e] = np.maximum(self.terms[g][e], term)
+            ingested = int(frontier[g])
+            if ingested:
+                if lasts is None:
+                    lasts = np.asarray(self.state.last_index)
+                last = int(lasts[g, r])
+                for i, (seq, p) in enumerate(self._queue[g][:ingested]):
+                    idx = last - ingested + 1 + i
+                    self._seq_at_index[g][idx] = seq
+                    self._uncommitted[g][idx] = (p, term)
+                self._queue[g] = self._queue[g][ingested:]
+            self._advance_commit(g, r, int(commits[g]))
+            self._reset_heard_timers(g, r)
+            self._push(self.clock.now + cfg.heartbeat_period, "l", g, r)
+        if overflow:
+            # same-group second leaders: their own round (and their own
+            # heartbeat re-arm). The first round's traffic may already
+            # have deposed them — the role checks above re-filter.
+            self._fire_leader_ticks(overflow)
+
+    def _reset_heard_timers(self, g: int, r: int) -> None:
+        for p in range(self.cfg.n_replicas):
+            if p == r or not self.alive[g, p] or not self.connectivity[g, r, p]:
+                continue
+            if self.roles[g][p] == FOLLOWER:
+                self._arm_follower(g, p)
+            elif self.roles[g][p] == CANDIDATE:
+                self.roles[g][p] = FOLLOWER
+                self._arm_follower(g, p)
+            elif (
+                self.roles[g][p] == LEADER
+                and self.lead_terms[g, r] > self.lead_terms[g, p]
+            ):
+                self.roles[g][p] = FOLLOWER
+                self.nodelog(g, p, "step down to follower")
+                self._arm_follower(g, p)
+
+    # ------------------------------------------------------------ commit side
+    def _advance_commit(self, g: int, leader: int, commit: int) -> None:
+        wm = int(self.commit_watermark[g])
+        if commit <= wm:
+            return
+        for idx in range(wm + 1, commit + 1):
+            seq = self._seq_at_index[g].get(idx)
+            if seq is not None and seq not in self.commit_time[g]:
+                self.commit_time[g][seq] = self.clock.now
+        self._archive_committed(g, leader, wm + 1, commit)
+        self.commit_watermark[g] = commit
+        self.nodelog(g, leader, f"commit index changed to {commit}")
+        for idx in [i for i in self._uncommitted[g] if i <= commit]:
+            del self._uncommitted[g][idx]
+        for idx in [i for i in self._seq_at_index[g] if i <= commit]:
+            del self._seq_at_index[g][idx]
+        self._drain_apply(g)
+
+    def _archive_committed(self, g: int, leader: int, lo: int, hi: int) -> None:
+        """Move group ``g``'s just-committed range into the host archive.
+
+        Steady case — NO device sync: a buffer entry whose ingest term is
+        the committing leader's CURRENT lead term is provably that
+        leader's log content at that index (the leader ingested it there
+        in this term; Election Safety gives the term one leader, a
+        frontier window never rewrites an existing index within a term,
+        and any truncation of it would ride a higher term that first
+        deposes this leader — bumping its lead term on re-election, which
+        routes the entry to the checked path below). Per-group device
+        round-trips here would otherwise serialize right behind every
+        fused G-group launch, undoing the shared-launch amortization.
+
+        Failover case: entries from older terms (committed transitively,
+        Leader Completeness) are term-checked against ONE fetched window
+        of the leader's log — the single engine's supersession guard —
+        and entries the buffer cannot serve are read back from the
+        leader's device ring (the just-committed window is inside the
+        ring by construction)."""
+        term_now = int(self.lead_terms[g, leader])
+        pend = []
+        for idx in range(lo, hi + 1):
+            ent = self._uncommitted[g].get(idx)
+            if ent is not None and ent[1] == term_now:
+                self._archive[g][idx] = ent[0]
+            else:
+                pend.append(idx)
+        if not pend:
+            return
+        cap = self.cfg.log_capacity
+        plo, phi = min(pend), max(pend)
+        slots = (np.arange(plo, phi + 1) - 1) % cap
+        lead_terms = np.asarray(self.state.log_term[g, leader])[slots]
+        missing = []
+        for idx in pend:
+            ent = self._uncommitted[g].get(idx)
+            if ent is not None and ent[1] == int(lead_terms[idx - plo]):
+                self._archive[g][idx] = ent[0]
+            else:
+                missing.append(idx)
+        if not missing:
+            return
+        mlo, mhi = min(missing), max(missing)
+        data = log_entries(group_view(self.state, g), leader, mlo, mhi)
+        for idx in missing:
+            self._archive[g][idx] = data[idx - mlo].tobytes()
+
+    # ---------------------------------------------------- state machine
+    def register_apply(
+        self, g: int, fn: Callable[[int, bytes], None], replay: bool = False
+    ) -> int:
+        """Register group ``g``'s state-machine apply callback:
+        ``fn(index, payload)`` for every committed entry of the group, in
+        log order, exactly once. ``replay=True`` first replays the
+        archived history (index 1 up to the watermark). Returns the first
+        index the callback will have seen."""
+        if replay:
+            for idx in range(1, int(self.commit_watermark[g]) + 1):
+                fn(idx, self._archive[g][idx])
+            start = 1
+        else:
+            start = int(self.commit_watermark[g]) + 1
+        if not self._apply_fns[g]:
+            self.applied_index[g] = self.commit_watermark[g]
+        self._apply_fns[g].append(fn)
+        return start
+
+    def _drain_apply(self, g: int) -> None:
+        if not self._apply_fns[g]:
+            return
+        while self.applied_index[g] < self.commit_watermark[g]:
+            nxt = int(self.applied_index[g]) + 1
+            payload = self._archive[g][nxt]
+            self.applied_index[g] = nxt
+            for fn in self._apply_fns[g]:
+                fn(nxt, payload)
+
+    # ------------------------------------------------------------- read side
+    def committed_payloads(self, g: int, replica: Optional[int] = None):
+        """Group ``g``'s committed log as a list of payload byte strings
+        (from ``replica``'s device ring via the group view — the
+        differential-test surface). Defaults to the routed leader, else
+        replica 0."""
+        from raft_tpu.core.state import committed_payloads as _cp
+
+        if replica is None:
+            replica = self.leader_id[g] if self.leader_id[g] is not None else 0
+        return [bytes(row) for row in _cp(group_view(self.state, g), replica)]
+
+    def commit_latencies(self, g: Optional[int] = None) -> np.ndarray:
+        """Per-entry commit latency (virtual seconds) for every durable
+        entry — one group's, or every group's pooled (``g=None``)."""
+        gs = range(self.G) if g is None else (g,)
+        return np.array([
+            self.commit_time[gg][s] - self.submit_time[gg][s]
+            for gg in gs for s in self.commit_time[gg]
+        ])

@@ -1,0 +1,150 @@
+"""Key-routed client surface over a ``MultiEngine``.
+
+The router is the sharding front end: it hashes each key onto one of the
+G consensus groups (stable, process-independent — CRC32 of the key
+bytes), fans submits/reads out to the owning group's leader, and owns
+the ``NotLeader`` retry loop so callers never see a leadership gap
+unless the group truly cannot elect.
+
+Batched entry points (``submit_many`` / ``read_index_many``) bucket
+requests by group first: each group's entries land in the group's queue
+in caller order (per-key ordering is preserved — a key always maps to
+the same group), and leadership is confirmed once per *group*, not once
+per request. With the engine's same-tick launch fusion, a bucketed
+submit burst across all G groups then replicates via shared batched
+launches rather than G independent dispatch streams.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu.multi.engine import MultiEngine, NotLeader
+
+
+class Router:
+    """Key -> group routing + per-group NotLeader retry.
+
+    ``drive=True`` (default, the in-process deployment): on
+    ``NotLeader`` the router drives the engine's event loop until the
+    group re-elects, then retries — the in-process analogue of a client
+    redialing the new leader. ``drive=False`` re-raises on the first
+    refusal (an external driver owns the event loop; without driving it,
+    a retry is guaranteed to see identical state)."""
+
+    def __init__(
+        self, engine: MultiEngine, max_retries: int = 8, drive: bool = True,
+        elect_limit: float = 600.0,
+    ):
+        self.engine = engine
+        self.max_retries = max_retries
+        self.drive = drive
+        self.elect_limit = elect_limit
+
+    # ------------------------------------------------------------- routing
+    def group_of(self, key: bytes) -> int:
+        """Stable key -> group hash. CRC32 rather than ``hash()``:
+        Python's string hashing is salted per process, and a sharded
+        store's placement must agree across restarts and processes."""
+        return zlib.crc32(key) % self.engine.G
+
+    def _with_leader(self, g: int, fn: Callable):
+        """Run ``fn`` with the NotLeader retry protocol for group ``g``."""
+        for attempt in range(self.max_retries + 1):
+            try:
+                return fn()
+            except NotLeader:
+                if attempt >= self.max_retries or not self.drive:
+                    # without driving, nothing changes engine state
+                    # between attempts (single-threaded host) — a retry
+                    # is guaranteed identical, so fail on first refusal
+                    raise
+                if self.engine.leader_id[g] is None:
+                    # leaderless: drive the event loop until the group
+                    # re-elects (the redial); a group that cannot elect
+                    # lets run_until_leader's own NotLeader propagate
+                    self.engine.run_until_leader(g, limit=self.elect_limit)
+                else:
+                    # a leader is still ROUTED but cannot confirm (the
+                    # minority side of a partition: quorum unreachable /
+                    # deposed mid-round). run_until_leader would return
+                    # immediately without processing an event — instead
+                    # drive one election window so the majority side can
+                    # elect; its winner replaces leader_id[g] and the
+                    # retry redials it.
+                    self.engine.run_for(self.engine.cfg.follower_timeout[1])
+        raise AssertionError("unreachable")
+
+    # ------------------------------------------------------------- submits
+    def submit(self, key: bytes, payload: bytes) -> Tuple[int, int]:
+        """Route one entry to its key's group leader; returns
+        ``(group, seq)`` — durable once ``engine.is_durable(group, seq)``."""
+        g = self.group_of(key)
+        seq = self._with_leader(
+            g, lambda: self.engine.submit_to_leader(g, payload)
+        )
+        return g, seq
+
+    def submit_many(
+        self, items: Sequence[Tuple[bytes, bytes]]
+    ) -> List[Tuple[int, int]]:
+        """Batched submit: bucket ``(key, payload)`` pairs by group, then
+        submit each bucket under ONE leadership check + retry. Returns
+        ``(group, seq)`` per item, aligned with the input order; within
+        a group, queue order is input order (per-key ordering holds
+        because a key's group is fixed).
+
+        Partial failure: buckets are placed sequentially, and a bucket
+        that exhausts its retries does NOT un-place earlier buckets'
+        entries (they are already queued and will commit). The raised
+        ``NotLeader`` carries the aligned results so far as
+        ``.partial`` (None = unplaced item) — await those seqs rather
+        than resubmitting them."""
+        buckets: Dict[int, List[int]] = {}
+        for i, (key, _) in enumerate(items):
+            buckets.setdefault(self.group_of(key), []).append(i)
+        out: List[Optional[Tuple[int, int]]] = [None] * len(items)
+
+        for g, idxs in buckets.items():
+            def _submit_bucket(g=g, idxs=idxs):
+                # leader checked once per bucket; entries then ride the
+                # ordinary queue (ticks batch them across groups)
+                r = self.engine.leader_id[g]
+                if r is None:
+                    raise NotLeader(g)
+                return [
+                    self.engine.submit_to_leader(g, items[i][1]) for i in idxs
+                ]
+            try:
+                seqs = self._with_leader(g, _submit_bucket)
+            except NotLeader as ex:
+                ex.partial = out
+                raise
+            for i, s in zip(idxs, seqs):
+                out[i] = (g, s)
+        return out
+
+    # --------------------------------------------------------------- reads
+    def read_index(self, key: bytes) -> Tuple[int, int]:
+        """Confirm leadership of the key's group (engine ``read_index``,
+        §6.4) and return ``(group, read_index)``: a linearizable read of
+        the key must serve from state applied to at least that index."""
+        g = self.group_of(key)
+        idx = self._with_leader(g, lambda: self.engine.read_index(g))
+        return g, idx
+
+    def read_index_many(
+        self, keys: Sequence[bytes]
+    ) -> List[Tuple[int, int]]:
+        """Batched ReadIndex: ONE leadership confirmation round per
+        distinct group covers every key routed to it (the multi-group
+        analogue of the single engine's batched ``submit_read``).
+        Returns ``(group, read_index)`` aligned with ``keys``."""
+        groups = [self.group_of(k) for k in keys]
+        per_group: Dict[int, int] = {}
+        for g in set(groups):
+            per_group[g] = self._with_leader(
+                g, lambda g=g: self.engine.read_index(g)
+            )
+        return [(g, per_group[g]) for g in groups]

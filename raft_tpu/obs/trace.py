@@ -4,6 +4,11 @@ Format (main.go:399-401): ``[Id:Term:CommitIndex:LastApplied][state]msg``.
 Both the golden model and the engine emit it through their ``trace``
 callbacks; a ``TraceRecorder`` is that callback plus parsing/filtering for
 assertions (e.g. Election Safety: at most one leader transition per term).
+
+Multi-Raft runs (``raft_tpu.multi``) tag the id field with the consensus
+group — ``g3/Server0`` — which parses as an ordinary node id here;
+``TraceRecord.group`` recovers the scope so per-group assertions (e.g.
+Election Safety per group) filter without string surgery.
 """
 
 from __future__ import annotations
@@ -40,6 +45,14 @@ class TraceRecord:
             state=m["state"],
             message=m["msg"],
         )
+
+    @property
+    def group(self) -> Optional[int]:
+        """Raft-group scope of a multi-Raft nodelog line (``gN/ServerR``
+        ids, ``multi.MultiEngine.nodelog``); None for single-group
+        lines."""
+        m = re.match(r"^g(\d+)/", self.node)
+        return int(m.group(1)) if m else None
 
 
 class TraceRecorder:

@@ -86,6 +86,18 @@ class LinearizableReadRefused(Exception):
     leader — serving it here could return stale state."""
 
 
+class TicketEvicted(LinearizableReadRefused):
+    """A ``submit_read`` ticket was FIFO-evicted at the outstanding-ticket
+    cap (2^16) before it was polled. Subclasses
+    ``LinearizableReadRefused`` because the recovery action is the same —
+    re-issue the read — but kept distinct so a client can tell "my
+    binding died" from "I fell off the queue under fan-out pressure"
+    (multi-group routers multiply outstanding tickets). Tickets are
+    poll-once: a ticket already consumed by ``read_confirmed`` that is
+    re-polled after the eviction floor passed it also reads as evicted,
+    not ``KeyError`` — indistinguishable by design, identical action."""
+
+
 class MirrorDesyncError(Exception):
     """The mirrored multihost control planes' decision streams diverged
     (``RaftConfig.mirror_check_every``): a fail-stop with both digests
@@ -108,6 +120,11 @@ class VirtualClock:
 class RaftEngine:
     """One process hosting all replica control planes.
 
+    ``READ_TICKET_CAP``: outstanding ``submit_read`` tickets retained
+    before FIFO eviction (evicted tickets poll as ``TicketEvicted``).
+    Class attribute so tests exercise the eviction path at test-sized
+    volume.
+
     The reference runs one goroutine per node against shared channels; here
     one host thread owns every replica's timers and roles, and the *data*
     plane (all replicas' state transitions) is the batched device program.
@@ -121,6 +138,8 @@ class RaftEngine:
     config: mirrored deterministic event loops issue identical collective
     launches (transport.multihost).
     """
+
+    READ_TICKET_CAP = 1 << 16
 
     def __init__(
         self,
@@ -178,8 +197,15 @@ class RaftEngine:
         #   (multihost mirror desync guard — _mirror_digest_step).
         self._reads: Dict[int, list] = {}
         self._next_read_ticket = 0
-        #   Batched ReadIndex queue: ticket -> [row, noted index, status]
-        #   (submit_read / read_confirmed / _confirm_reads).
+        #   Batched ReadIndex queue: ticket -> [row, noted index, bound
+        #   term, status] (submit_read / read_confirmed / _confirm_reads).
+        self._read_buckets: Dict[Tuple[int, int], set] = {}
+        #   (row, bound term) -> pending tickets. A confirming quorum
+        #   round touches exactly its own (r, term) bucket instead of
+        #   walking all (up to 2^16) outstanding tickets per tick.
+        self._read_evict_floor = 0
+        #   Every ticket below this was either consumed or FIFO-evicted;
+        #   polling one raises TicketEvicted, not an opaque KeyError.
         self._quorum_contact_at: Dict[int, float] = {}
         #   Per-leader: when it last contacted a member majority
         #   (CheckQuorum's lease clock).
@@ -751,9 +777,10 @@ class RaftEngine:
 
         Refusal semantics match ``read_linearizable``: not a live
         leader / deposed / quorum unreachable raise immediately;
-        leadership loss while queued marks the ticket refused (the
-        split-brain guarantee — a minority-side stale leader can never
-        confirm, so its queued reads never serve)."""
+        leadership loss while queued is detected lazily — the ticket's
+        (row, term) binding can no longer confirm, and the next poll
+        raises (the split-brain guarantee — a minority-side stale
+        leader can never confirm, so its queued reads never serve)."""
         if r is None:
             r = self.leader_id
         if r is None or self.roles[r] != LEADER or not self.alive[r]:
@@ -769,17 +796,36 @@ class RaftEngine:
             )
         tk = self._next_read_ticket
         self._next_read_ticket += 1
-        self._reads[tk] = [r, self.commit_watermark,
-                           int(self.lead_terms[r]), "pending"]
-        if len(self._reads) > (1 << 16):
+        bind = (r, int(self.lead_terms[r]))
+        self._reads[tk] = [r, self.commit_watermark, bind[1], "pending"]
+        self._read_buckets.setdefault(bind, set()).add(tk)
+        n_evict = len(self._reads) - self.READ_TICKET_CAP
+        if n_evict > 0:
             # abandoned-ticket bound: tickets are poll-once, so a client
             # that stops polling would otherwise leak records forever —
-            # evict the OLDEST tickets (FIFO) beyond the cap; an evicted
-            # ticket reads as unknown, which an abandoning client by
-            # definition never observes
-            for old in sorted(self._reads)[:len(self._reads) - (1 << 16)]:
-                del self._reads[old]
+            # evict the OLDEST tickets (FIFO) beyond the cap. An evicted
+            # ticket that IS later polled (a slow, not abandoned, client
+            # — multi-group fan-out multiplies outstanding tickets) reads
+            # as TicketEvicted via the floor below, never a bare KeyError.
+            # Tickets mint monotonically and dict order survives deletes,
+            # so the first n keys ARE the oldest — no sort at the cap.
+            from itertools import islice
+
+            for old in list(islice(iter(self._reads), n_evict)):
+                self._drop_read_ticket(old)
+                self._read_evict_floor = max(self._read_evict_floor, old + 1)
         return tk
+
+    def _drop_read_ticket(self, ticket: int) -> None:
+        """Remove a ticket from the queue AND its (row, term) bucket."""
+        rec = self._reads.pop(ticket, None)
+        if rec is None:
+            return
+        bucket = self._read_buckets.get((rec[0], rec[2]))
+        if bucket is not None:
+            bucket.discard(ticket)
+            if not bucket:
+                del self._read_buckets[(rec[0], rec[2])]
 
     def read_confirmed(self, ticket: int) -> Optional[int]:
         """Poll a ``submit_read`` ticket: the confirmed read index once
@@ -793,16 +839,20 @@ class RaftEngine:
         match), so no step-down path needs a hook here."""
         rec = self._reads.get(ticket)
         if rec is None:
+            if 0 <= ticket < self._read_evict_floor:
+                raise TicketEvicted(
+                    f"ticket {ticket} was evicted at the outstanding-read "
+                    "cap before confirmation; re-issue the read"
+                )
             raise KeyError(f"unknown or already-consumed ticket {ticket}")
         row, idx, tterm, st = rec
         if st == "ready":
-            del self._reads[ticket]
+            self._drop_read_ticket(ticket)
             return idx
-        if st == "refused" or (
-                self.roles[row] != LEADER or not self.alive[row]
+        if (self.roles[row] != LEADER or not self.alive[row]
                 or int(self.lead_terms[row]) != tterm
                 or int(self.terms[row]) > tterm):
-            del self._reads[ticket]
+            self._drop_read_ticket(ticket)
             raise LinearizableReadRefused(
                 "leadership lost before confirmation"
             )
@@ -813,22 +863,25 @@ class RaftEngine:
         leadership for every read queued on ``r`` IN THIS TERM when it
         reached a member majority and surfaced no higher term — §6.4's
         confirmation, shared by every round flavor (write tick,
-        pipelined chunk, explicit read round)."""
+        pipelined chunk, explicit read round).
+
+        Pending tickets are indexed by their (row, term) binding, so the
+        sweep pops exactly the confirming bucket — O(confirmed), not a
+        walk of all (up to 2^16) outstanding tickets per tick. Tickets
+        in OTHER buckets need no visit: a dead binding is detected
+        lazily by ``read_confirmed``'s own predicate, and total volume
+        stays bounded by the FIFO eviction cap."""
         if not self._reads:
             return
         if max_term > term or int(eff.sum()) <= int(self.member.sum()) // 2:
             return
-        for rec in self._reads.values():
-            if rec[3] != "pending":
-                continue
-            if rec[0] == r and rec[2] == term:
+        bucket = self._read_buckets.pop((r, term), None)
+        if not bucket:
+            return
+        for tk in bucket:
+            rec = self._reads.get(tk)
+            if rec is not None and rec[3] == "pending":
                 rec[3] = "ready"
-            elif (self.roles[rec[0]] != LEADER or not self.alive[rec[0]]
-                    or int(self.lead_terms[rec[0]]) != rec[2]):
-                # dead binding: mark terminal now (same predicate
-                # read_confirmed applies lazily) so the pending set this
-                # sweep walks stays bounded by live leadership
-                rec[3] = "refused"
 
     def read_linearizable(self, r: Optional[int] = None) -> int:
         """ReadIndex (dissertation §6.4): confirm leadership with a quorum

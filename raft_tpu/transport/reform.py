@@ -42,6 +42,25 @@ storage — the stand-in for the deployment's supervisor or config service
   entirely from the elected checkpoint (the snapshot-install of the
   mirrored model); its device row then heals forward through the
   engine's repair window / snapshot heal like any lapped replica.
+  A *survivor* that finds itself excluded from a newly published epoch
+  (its heartbeat went stale past the detector window while it was
+  wedged — GC pause, NFS stall, clock skew) takes the same path:
+  ``reform`` falls through to ``request_join`` instead of proposing
+  epochs the members will never join.
+
+**Clock assumption (failure detector)**: heartbeat freshness compares
+the OBSERVER's wall clock against the WRITER's ``time.time()`` stamp
+(``fresh_peers``), so survivor detection assumes process wall clocks
+agree to well within ``stall_s`` (NTP-grade sync; the deployments this
+stands in for — k8s nodes, TPU pods — provide it). The failure mode is
+bounded and recoverable, not silent: a peer whose clock lags the
+observer's by more than the staleness window reads as dead and is
+excluded from the next epoch, upon which it detects the exclusion and
+re-enters via the join path above; a peer whose clock runs AHEAD reads
+as fresh for longer, which only delays re-formation by the skew. A
+deployment that cannot bound skew should derive freshness from a single
+clock domain instead — e.g. the rendezvous store's own mtimes where the
+store sets server-side times, or a supervisor's liveness API.
 """
 
 from __future__ import annotations
@@ -126,7 +145,14 @@ class Rendezvous:
 
     def fresh_peers(self, stale_s: float) -> Dict[int, dict]:
         """pids (self included) whose heartbeat is younger than
-        ``stale_s`` — the failure detector's survivor estimate."""
+        ``stale_s`` — the failure detector's survivor estimate.
+
+        Freshness = this process's ``time.time()`` minus the WRITER's
+        stamp: a cross-clock comparison that assumes wall clocks agree
+        to well within ``stale_s`` (see the module-doc clock-assumption
+        note — mis-detection is recoverable via the excluded-survivor
+        join path in ``reform``, but re-formation latency degrades with
+        skew)."""
         now = time.time()
         out: Dict[int, dict] = {}
         for f in os.listdir(self.root):
@@ -287,8 +313,24 @@ class Rendezvous:
         settle_s = 6.0
         while time.time() < deadline:
             ep = self.latest_epoch()
-            if ep is not None and ep.n > cur.n and self.pid in ep.members:
-                return ep
+            if ep is not None and ep.n > cur.n:
+                if self.pid in ep.members:
+                    return ep
+                # A newer epoch EXCLUDED this survivor: its heartbeat went
+                # stale past the detector window while it was wedged (GC
+                # pause, storage stall, clock skew — module doc). Spinning
+                # here on proposals derived from ``cur`` can never
+                # succeed — ``cur.n + 1`` is already taken, and the new
+                # epoch's members owe a silent non-member nothing. Take
+                # the rejoin path instead: announce the join and wait to
+                # be folded into a following epoch (the coordinator sees
+                # the fresh join on its next round).
+                self.request_join()
+                return self.await_epoch_including_me(
+                    after=ep.n,
+                    timeout_s=max(deadline - time.time(), 1.0),
+                    hb=hb,
+                )
             self.heartbeat(cur.n, hb.get("round", -1), hb.get("wm", -1),
                            hb.get("ckpt"))
             fresh = self.fresh_peers(stall_s)

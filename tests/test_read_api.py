@@ -281,3 +281,65 @@ class TestBatchedReadIndex:
             pass
         with pytest.raises(LinearizableReadRefused):
             e.submit_read(lead)
+
+
+class TestTicketEvictionAndBuckets:
+    """ADVICE r5: FIFO eviction at the outstanding-ticket cap must
+    surface as ``TicketEvicted`` (a ``LinearizableReadRefused``), never a
+    bare ``KeyError``; and confirmation touches only its own (row, term)
+    bucket instead of walking every pending ticket. The cap is the
+    class attribute ``READ_TICKET_CAP`` (2^16 in production), shrunk
+    here so the eviction path runs at test-sized volume."""
+
+    def test_evicted_ticket_raises_ticket_evicted(self, monkeypatch):
+        from raft_tpu.raft.engine import (
+            LinearizableReadRefused, TicketEvicted,
+        )
+
+        e = mk(seed=41)
+        e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(4, seed=9)]
+        e.run_until_committed(seqs[-1])
+        monkeypatch.setattr(type(e), "READ_TICKET_CAP", 16)
+        first = e.submit_read()
+        for _ in range(16 + 4):
+            e.submit_read()
+        assert first < e._read_evict_floor
+        with pytest.raises(TicketEvicted):
+            e.read_confirmed(first)
+        # TicketEvicted IS a LinearizableReadRefused (one except clause
+        # handles both refusal flavors)
+        assert issubclass(TicketEvicted, LinearizableReadRefused)
+        # a genuinely unknown (never minted) ticket is still a KeyError
+        with pytest.raises(KeyError):
+            e.read_confirmed(10**9)
+
+    def test_confirmation_touches_only_its_bucket(self):
+        e = mk(seed=42)
+        lead = e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(4, seed=10)]
+        e.run_until_committed(seqs[-1])
+        tickets = [e.submit_read() for _ in range(8)]
+        term = int(e.lead_terms[lead])
+        assert set(e._read_buckets) == {(lead, term)}
+        assert e._read_buckets[(lead, term)] == set(tickets)
+        # a confirming round pops exactly that bucket and readies all
+        sq = e.submit(payloads(1, seed=11)[0])
+        e.run_until_committed(sq)
+        assert (lead, term) not in e._read_buckets
+        got = [e.read_confirmed(t) for t in tickets]
+        assert all(g is not None for g in got)
+        # polled tickets left the queue entirely (no leaks)
+        assert not e._reads and not e._read_buckets
+
+    def test_eviction_keeps_buckets_consistent(self, monkeypatch):
+        e = mk(seed=43)
+        lead = e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(4, seed=12)]
+        e.run_until_committed(seqs[-1])
+        monkeypatch.setattr(type(e), "READ_TICKET_CAP", 8)
+        for _ in range(3 * 8):
+            e.submit_read()
+        assert len(e._reads) == 8
+        term = int(e.lead_terms[lead])
+        assert e._read_buckets[(lead, term)] == set(e._reads)
