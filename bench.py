@@ -77,6 +77,38 @@ def _emit_leg(name: str, row: dict) -> dict:
     return row
 
 
+class _Deadline:
+    """Overall run budget (``--deadline-s``): once elapsed wall time
+    crosses it, every remaining leg is SKIPPED (an explicit
+    ``{"skipped": "deadline"}`` row, so consumers can tell "not run"
+    from "ran and failed") and the final combined JSON still prints —
+    the self-truncating alternative to an external ``timeout`` kill,
+    which leaves ``parsed: null`` and rc=124 (BENCH_r05). The budget is
+    checked BETWEEN legs; a leg in flight runs to completion, so give
+    the harness a deadline comfortably below any external kill."""
+
+    def __init__(self, seconds: float | None):
+        self.seconds = seconds
+        # monotonic, not time.time(): an NTP step mid-run would either
+        # disarm the budget (backward — the external kill this exists to
+        # replace fires instead) or skip legs that had ample time left
+        self.t0 = time.monotonic()
+        self.skipped: list = []
+
+    @property
+    def expired(self) -> bool:
+        return (
+            self.seconds is not None
+            and time.monotonic() - self.t0 >= self.seconds
+        )
+
+    def run(self, name: str, fn: Callable[[], dict]) -> dict:
+        if self.expired:
+            self.skipped.append(name)
+            return _emit_leg(name, {"skipped": "deadline"})
+        return _emit_leg(name, fn())
+
+
 def _percentiles(vals):
     v = np.asarray([x for x in vals if np.isfinite(x)])
     if v.size == 0:
@@ -1006,29 +1038,56 @@ def bench_multi_group() -> dict:
     return rows
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="raft_tpu benchmark suite")
+    ap.add_argument(
+        "--deadline-s", type=float, default=None,
+        help="overall wall-clock budget: remaining legs are skipped "
+             "once exceeded, and the final combined JSON still prints "
+             "(see _Deadline)",
+    )
+    args = ap.parse_args(argv)
+    dl = _Deadline(args.deadline_s)
+
     rng = np.random.default_rng(0)
-    _ring_kernel_gate(rng)
-    _pipeline_lap_gate(rng)
+    if dl.expired:
+        # record that the kernel-equivalence gates never ran: a consumer
+        # must not read surviving leg rows as gate-validated numbers
+        dl.skipped.append("kernel_gates")
+    else:
+        _ring_kernel_gate(rng)
+        _pipeline_lap_gate(rng)
 
     # -- config 2: the headline ------------------------------------------
     cfg2 = RaftConfig()          # 3 replicas, 256 B, batch 1024
-    fn2 = _fixed_payload_scan(cfg2, np.zeros(3, bool), rng)
-    c2 = _emit_leg("c2_batched", _best_program(
-        bench_scan(cfg2, fn2),
-        bench_scan(
-            cfg2,
-            _fixed_payload_scan(cfg2, np.zeros(3, bool), rng, repair=True),
-        ),
-    ))
+    fn2 = None
+    wall_slope = float("nan")
 
-    # wall-clock cross-check (upper bound: one dispatch RTT amortized / T)
-    def run_wall():
-        st = init_state(cfg2)
-        _ = np.asarray(st.term)
-        return _timed_wall_call(fn2, st)
-    run_wall()
-    wall_slope = min(run_wall() for _ in range(6)) / T_STEPS * 1e6
+    def _leg_c2() -> dict:
+        nonlocal fn2, wall_slope
+        fn2 = _fixed_payload_scan(cfg2, np.zeros(3, bool), rng)
+        row = _best_program(
+            bench_scan(cfg2, fn2),
+            bench_scan(
+                cfg2,
+                _fixed_payload_scan(cfg2, np.zeros(3, bool), rng,
+                                    repair=True),
+            ),
+        )
+
+        # wall-clock cross-check (upper bound: one dispatch RTT
+        # amortized / T)
+        def run_wall():
+            st = init_state(cfg2)
+            _ = np.asarray(st.term)
+            return _timed_wall_call(fn2, st)
+        run_wall()
+        wall_slope = min(run_wall() for _ in range(6)) / T_STEPS * 1e6
+        return row
+
+    c2 = dl.run("c2_batched", _leg_c2)
 
     # -- config 4: 5 replicas, 1 slow follower ---------------------------
     # (steady dispatch applies: the slow replica is excluded from the
@@ -1042,7 +1101,7 @@ def main() -> None:
     cfg4 = RaftConfig(n_replicas=5)
     slow4 = np.zeros(5, bool)
     slow4[4] = True
-    c4 = _emit_leg("c4_slow", _best_program(
+    c4 = dl.run("c4_slow", lambda: _best_program(
         bench_scan(cfg4, _fixed_payload_scan(cfg4, slow4, rng)),
         bench_scan(
             cfg4, _fixed_payload_scan(cfg4, slow4, rng, repair=True)
@@ -1063,39 +1122,43 @@ def main() -> None:
     # entries/s. The old capacity is re-measured into
     # ``p50_us_ring131k`` so the trade (throughput vs uncommitted-lag
     # headroom, docs/PERF.md) stays visible.
-    cfg2x = RaftConfig(batch_size=4096, log_capacity=1 << 15)
-    c2x = _best_program(
-        bench_scan(
-            cfg2x, _fixed_payload_scan(cfg2x, np.zeros(3, bool), rng),
-            reps=3,
-        ),
-        bench_scan(
-            cfg2x,
-            _fixed_payload_scan(cfg2x, np.zeros(3, bool), rng, repair=True),
-            reps=3,
-        ),
-    )
-    c2x["log_capacity"] = cfg2x.log_capacity
-    cfg2x_big = RaftConfig(batch_size=4096, log_capacity=1 << 17)
-    c2x["p50_us_ring131k"] = _best_program(
-        bench_scan(
-            cfg2x_big,
-            _fixed_payload_scan(cfg2x_big, np.zeros(3, bool), rng),
-            reps=3,
-        ),
-        bench_scan(
-            cfg2x_big,
-            _fixed_payload_scan(cfg2x_big, np.zeros(3, bool), rng,
-                                repair=True),
-            reps=3,
-        ),
-    )["p50_us"]
-    _emit_leg("c2_batch4096", c2x)
+    def _leg_c2x() -> dict:
+        cfg2x = RaftConfig(batch_size=4096, log_capacity=1 << 15)
+        row = _best_program(
+            bench_scan(
+                cfg2x, _fixed_payload_scan(cfg2x, np.zeros(3, bool), rng),
+                reps=3,
+            ),
+            bench_scan(
+                cfg2x,
+                _fixed_payload_scan(cfg2x, np.zeros(3, bool), rng,
+                                    repair=True),
+                reps=3,
+            ),
+        )
+        row["log_capacity"] = cfg2x.log_capacity
+        cfg2x_big = RaftConfig(batch_size=4096, log_capacity=1 << 17)
+        row["p50_us_ring131k"] = _best_program(
+            bench_scan(
+                cfg2x_big,
+                _fixed_payload_scan(cfg2x_big, np.zeros(3, bool), rng),
+                reps=3,
+            ),
+            bench_scan(
+                cfg2x_big,
+                _fixed_payload_scan(cfg2x_big, np.zeros(3, bool), rng,
+                                    repair=True),
+                reps=3,
+            ),
+        )["p50_us"]
+        return row
+
+    c2x = dl.run("c2_batch4096", _leg_c2x)
 
     # The remaining legs emit their own JSON rows as each completes (the
-    # multi-group sweep emits per-G rows internally), so a deadline-killed
-    # run still yields partial numbers; the combined object stays the
-    # final line for existing consumers.
+    # multi-group sweep emits per-G rows internally), so a deadline- or
+    # externally-killed run still yields partial numbers; the combined
+    # object stays the final line for existing consumers.
     configs = {
         "c2_batched": c2,
         "c2_batch4096": c2x,
@@ -1109,24 +1172,44 @@ def main() -> None:
         ("read_index", bench_read_index),
         ("client_chunk", bench_client_latency),
     ):
-        configs[name] = _emit_leg(name, leg())
-    configs["multi_group"] = bench_multi_group()
+        configs[name] = dl.run(name, leg)
+    if dl.expired:
+        dl.skipped.append("multi_group")
+        configs["multi_group"] = _emit_leg(
+            "multi_group", {"skipped": "deadline"}
+        )
+    else:
+        configs["multi_group"] = bench_multi_group()
 
+    # Deadline-degraded runs carry nulls for the headline fields rather
+    # than dying with no JSON at all (the rc=124 / parsed:null failure
+    # mode this budget replaces).
+    have_c2 = c2 is not None and "p50_us" in c2
     out = {
         "metric": "commit_p50_latency",
-        "value": c2["p50_us"],
+        "value": c2["p50_us"] if have_c2 else None,
         "unit": "us",
-        "vs_baseline": round(REFERENCE_TICK_US / c2["p50_us"], 1),
-        "p99_us": c2["p99_us"],
-        "entries_per_sec": c2["entries_per_sec"],
+        "vs_baseline": (
+            round(REFERENCE_TICK_US / c2["p50_us"], 1) if have_c2 else None
+        ),
+        "p99_us": c2["p99_us"] if have_c2 else None,
+        "entries_per_sec": c2["entries_per_sec"] if have_c2 else None,
         "batch": cfg2.batch_size,
         "entry_bytes": cfg2.entry_bytes,
         "n_replicas": cfg2.n_replicas,
         "backend": jax.devices()[0].platform,
-        "method": f"jax.profiler {c2['method']}-time over {T_STEPS}-step scans",
-        "wall_slope_us": round(wall_slope, 3),
+        "method": (
+            f"jax.profiler {c2['method']}-time over {T_STEPS}-step scans"
+            if have_c2 else None
+        ),
+        "wall_slope_us": (
+            round(wall_slope, 3) if np.isfinite(wall_slope) else None
+        ),
         "configs": configs,
     }
+    if dl.seconds is not None:
+        out["deadline_s"] = dl.seconds
+        out["deadline_skipped"] = dl.skipped
     print(json.dumps(out))
 
 

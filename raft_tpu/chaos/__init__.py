@@ -1,0 +1,56 @@
+"""Jepsen-style torture harness: client-history linearizability under a
+randomized nemesis.
+
+The package closes the verification gap the Raft-internal suites leave
+open: ``tests/test_properties.py`` / ``tests/test_chaos.py`` prove what
+the *replicas* agree on; this harness records what the *clients* were
+told — every submit and linearizable read as an invoke/ok/fail/info
+interval on the virtual clock — and checks the history against the
+sequential KV model (Raft §8's client contract, the property users
+actually observe).
+
+- ``chaos.history``   — the event model (History / OpRecord).
+- ``chaos.checker``   — Wing–Gong/Lowe linearizability search with
+  P-compositional per-key decomposition and a step budget
+  (``UNDETERMINED`` instead of a hang).
+- ``chaos.nemesis``   — the seeded adversary: FaultPlan process faults,
+  transport drop/dup/delay windows, crash cycles with storage faults.
+- ``chaos.transport`` — ``ChaosTransport``, message faults at the
+  Transport seam.
+- ``chaos.storage``   — ``MirroredStore``, the simulated durable disk
+  set (mirrored checkpoints + vote WAL) the storage faults target.
+- ``chaos.runner``    — ``torture_run`` / ``torture_run_multi``: the
+  end-to-end loop, reported with a one-line seed repro.
+
+One-command repro of any run: ``python -m raft_tpu.chaos --seed N``.
+"""
+
+from raft_tpu.chaos.checker import (
+    LINEARIZABLE,
+    UNDETERMINED,
+    VIOLATION,
+    CheckResult,
+    check_history,
+)
+from raft_tpu.chaos.history import History, OpRecord
+from raft_tpu.chaos.nemesis import Nemesis, NemesisAction
+from raft_tpu.chaos.runner import TortureReport, torture_run, torture_run_multi
+from raft_tpu.chaos.storage import MirroredStore
+from raft_tpu.chaos.transport import ChaosTransport
+
+__all__ = [
+    "LINEARIZABLE",
+    "UNDETERMINED",
+    "VIOLATION",
+    "CheckResult",
+    "check_history",
+    "History",
+    "OpRecord",
+    "Nemesis",
+    "NemesisAction",
+    "TortureReport",
+    "torture_run",
+    "torture_run_multi",
+    "MirroredStore",
+    "ChaosTransport",
+]

@@ -1,0 +1,79 @@
+"""One-command torture repro: ``python -m raft_tpu.chaos --seed N``.
+
+Runs one torture run (or a ``--sweep K`` batch) with the given seed and
+knobs, prints each run's summary plus a JSON result line, and exits
+non-zero unless every history checked LINEARIZABLE — the exact
+invocation a failing run's report names as its repro.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from raft_tpu.chaos.runner import torture_run, torture_run_multi
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m raft_tpu.chaos",
+        description="Jepsen-style torture run with linearizability check",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sweep", type=int, default=1,
+                    help="run seeds [seed, seed+sweep)")
+    ap.add_argument("--phases", type=int, default=12)
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--keys", type=int, default=4)
+    ap.add_argument("--phase-s", type=float, default=30.0)
+    ap.add_argument("--step-budget", type=int, default=500_000)
+    ap.add_argument("--multi", action="store_true",
+                    help="multi-Raft Router/ShardedKV torture instead")
+    ap.add_argument("--groups", type=int, default=4, help="--multi groups")
+    ap.add_argument("--no-crash", action="store_true")
+    ap.add_argument("--no-msg", action="store_true")
+    ap.add_argument("--no-storage", action="store_true")
+    ap.add_argument("--broken", choices=["dirty_reads"], default=None,
+                    help="deliberately broken client variant; the run "
+                         "SUCCEEDS (exit 0) only if the checker rejects "
+                         "it — a passing broken run means the harness "
+                         "lost its teeth")
+    args = ap.parse_args(argv)
+    if args.multi and args.broken:
+        ap.error("--broken applies to the single-engine runner only")
+
+    expect = "VIOLATION" if args.broken else "LINEARIZABLE"
+    ok = True
+    for seed in range(args.seed, args.seed + args.sweep):
+        if args.multi:
+            rep = torture_run_multi(
+                seed, n_groups=args.groups, phases=args.phases,
+                clients=args.clients, keys=args.keys,
+                phase_s=args.phase_s, step_budget=args.step_budget,
+            )
+        else:
+            rep = torture_run(
+                seed, phases=args.phases, clients=args.clients,
+                keys=args.keys, phase_s=args.phase_s,
+                crash=not args.no_crash, msg_faults=not args.no_msg,
+                storage_faults=not args.no_storage, broken=args.broken,
+                step_budget=args.step_budget,
+            )
+        print(rep.summary())
+        print(json.dumps({
+            "seed": seed,
+            "verdict": rep.verdict,
+            "expected": expect,
+            "ops": rep.ops,
+            "op_counts": rep.op_counts,
+            "crashes": rep.crashes,
+            "msg_stats": rep.msg_stats,
+            "checker_steps": rep.check.steps,
+        }), flush=True)
+        ok = ok and rep.verdict == expect
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

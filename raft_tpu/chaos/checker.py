@@ -1,0 +1,248 @@
+"""Linearizability checking over recorded client histories.
+
+The algorithm is Wing & Gong's exhaustive search with Lowe's
+memoization (the same family Knossos/Porcupine implement): depth-first
+over the choices of "which outstanding operation linearizes next",
+pruning configurations — a (set of linearized ops, model state) pair —
+that have already failed. An operation may be chosen next only if no
+OTHER un-linearized operation *completed strictly before* it was
+invoked (the real-time order linearizability must respect); reads must
+match the model state, writes/deletes advance it.
+
+Status handling (see chaos.history):
+
+- ``fail`` ops provably took no effect and are removed up front;
+- ``info`` READS constrain nothing (no result was observed) and are
+  removed;
+- ``info`` WRITES/DELETES keep an unbounded interval ``[invoke, inf)``:
+  the search may linearize them at any admissible point or never —
+  success requires only that every *completed* op is linearized.
+
+Tractability comes from P-compositionality (Herlihy–Wing locality): a
+history over independent keys is linearizable iff each key's
+subhistory is against a single-register model, so ``check_history``
+checks each key independently — exponential worst cases shrink from
+"all ops" to "ops per key". The whole-history mode (``per_key=False``,
+one dict-shaped model) exists to *validate* that optimization
+(tests pin per-key == whole-history verdicts on small cases), not for
+production use.
+
+The search is budgeted: every explored configuration costs one step,
+and an exhausted budget returns ``UNDETERMINED`` instead of hanging —
+a torture harness must never turn a hard history into a wedged CI run.
+``UNDETERMINED`` means exactly "neither a witness nor a refutation was
+found within the budget".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from raft_tpu.chaos.history import (
+    DELETE,
+    FAIL,
+    INFO,
+    OK,
+    READ,
+    History,
+    OpRecord,
+)
+
+LINEARIZABLE = "LINEARIZABLE"
+VIOLATION = "VIOLATION"
+UNDETERMINED = "UNDETERMINED"
+
+_INF = float("inf")
+
+
+@dataclasses.dataclass
+class CheckResult:
+    verdict: str                     # LINEARIZABLE | VIOLATION | UNDETERMINED
+    steps: int                       # search configurations explored
+    key: Optional[bytes] = None      # offending / exhausted key, if any
+    detail: str = ""
+
+    def __bool__(self) -> bool:
+        return self.verdict == LINEARIZABLE
+
+
+def _prepare(ops: Iterable[OpRecord]) -> Optional[List[OpRecord]]:
+    """Drop constraint-free ops; None = history uses a PENDING op (the
+    caller forgot ``History.close()``) — refuse rather than guess."""
+    out = []
+    for rec in ops:
+        if rec.status == FAIL:
+            continue                 # provably never took effect
+        if rec.status == INFO and rec.op == READ:
+            continue                 # result never observed: no constraint
+        if rec.status not in (OK, INFO):
+            return None
+        out.append(rec)
+    return out
+
+
+def _search_key(
+    ops: List[OpRecord],
+    budget: int,
+    state0,
+    apply_op,
+) -> Tuple[str, int]:
+    """Budgeted WG/Lowe search over one object's subhistory.
+
+    ``state0``/``apply_op`` parameterize the sequential model:
+    ``apply_op(state, rec) -> (ok, new_state)`` — hashable states only
+    (memoization keys on them). Returns (verdict, steps used)."""
+    n = len(ops)
+    if n == 0:
+        return LINEARIZABLE, 0
+    inv = [op.invoke_t for op in ops]
+    ret = [op.complete_t if op.complete_t is not None else _INF
+           for op in ops]
+    must = 0                          # ops that MUST linearize (completed)
+    for i, op in enumerate(ops):
+        if op.status == OK:
+            must |= 1 << i
+    full = (1 << n) - 1
+
+    seen = set()                      # failed (remaining_mask, state) configs
+    steps = 0
+
+    def candidates(remaining: int) -> List[int]:
+        """Ops admissible as the next linearization point: no OTHER
+        remaining op completed strictly before this one was invoked."""
+        rem = [i for i in range(n) if remaining >> i & 1]
+        out = []
+        for i in rem:
+            if all(ret[j] >= inv[i] for j in rem if j != i):
+                out.append(i)
+        return out
+
+    # Explicit stack of (remaining_mask, state, candidate list, cursor):
+    # recursion depth equals history length, and an explicit stack makes
+    # the budget check one place instead of every call site.
+    stack = [[full, state0, None, 0]]
+    while stack:
+        frame = stack[-1]
+        remaining, state, cands, cur = frame
+        if remaining & must == 0:
+            return LINEARIZABLE, steps
+        if cands is None:
+            cands = candidates(remaining)
+            frame[2] = cands
+        advanced = False
+        while frame[3] < len(cands):
+            i = cands[frame[3]]
+            frame[3] += 1
+            okd, nstate = apply_op(state, ops[i])
+            if not okd:
+                continue
+            nxt = remaining & ~(1 << i)
+            if (nxt, nstate) in seen:
+                continue
+            steps += 1
+            if steps > budget:
+                return UNDETERMINED, steps
+            stack.append([nxt, nstate, None, 0])
+            advanced = True
+            break
+        if not advanced:
+            seen.add((remaining, state))
+            stack.pop()
+    return VIOLATION, steps
+
+
+def _prune_unobserved(kops: List[OpRecord]) -> List[OpRecord]:
+    """Drop ``info`` writes/deletes of ONE key whose effect value no
+    completed read of that key ever returned. Sound and complete for a
+    register: an optional (info) op need never be linearized, and any
+    valid schedule that DOES include such a write maps to a valid
+    schedule without it — removing a last-writer-wins write can only
+    invalidate reads that returned its value, and there are none. This
+    is the pruning that keeps violation proofs tractable: without it
+    every crash-lost write (unbounded interval, never observed)
+    multiplies the configuration space for nothing."""
+    seen = {rec.value for rec in kops if rec.op == READ and rec.status == OK}
+    out = []
+    for rec in kops:
+        if rec.status == INFO:
+            effect = None if rec.op == DELETE else rec.value
+            if effect not in seen:
+                continue
+        out.append(rec)
+    return out
+
+
+def _register_apply(state, rec: OpRecord):
+    """Single-key register model: state = current value (None = absent)."""
+    if rec.op == READ:
+        return state == rec.value, state
+    if rec.op == DELETE:
+        return True, None
+    return True, rec.value            # WRITE
+
+
+def _kv_apply(state, rec: OpRecord):
+    """Whole-map model (validation mode): state = frozenset of items."""
+    d = dict(state)
+    if rec.op == READ:
+        return d.get(rec.key) == rec.value, state
+    if rec.op == DELETE:
+        d.pop(rec.key, None)
+    else:
+        d[rec.key] = rec.value
+    return True, frozenset(d.items())
+
+
+def check_history(
+    history,
+    step_budget: int = 500_000,
+    per_key: bool = True,
+) -> CheckResult:
+    """Check a recorded history against the KV register model.
+
+    ``history`` is a ``chaos.History`` or a plain list of ``OpRecord``.
+    ``per_key=True`` (default) exploits P-compositionality: each key's
+    subhistory checks independently against a register, and the budget
+    is shared across keys. Any key's violation fails the whole history;
+    otherwise any budget exhaustion is ``UNDETERMINED``.
+    """
+    ops = history.ops if isinstance(history, History) else list(history)
+    prepared = _prepare(ops)
+    if prepared is None:
+        raise ValueError(
+            "history contains PENDING ops; call History.close() first"
+        )
+    total = 0
+    sub: Dict[bytes, List[OpRecord]] = {}
+    for rec in prepared:
+        sub.setdefault(rec.key, []).append(rec)
+    sub = {k: _prune_unobserved(kops) for k, kops in sub.items()}
+    if not per_key:
+        flat = [rec for kops in sub.values() for rec in kops]
+        verdict, steps = _search_key(
+            flat, step_budget, frozenset(), _kv_apply
+        )
+        return CheckResult(verdict, steps, detail="whole-history mode")
+    exhausted: Optional[bytes] = None
+    for key, kops in sorted(sub.items()):
+        verdict, steps = _search_key(
+            kops, step_budget - total, None, _register_apply
+        )
+        total += steps
+        if verdict == VIOLATION:
+            return CheckResult(
+                VIOLATION, total, key=key,
+                detail=f"key {key!r}: no linearization of "
+                       f"{len(kops)} ops exists",
+            )
+        if verdict == UNDETERMINED and exhausted is None:
+            exhausted = key
+            if total >= step_budget:
+                break
+    if exhausted is not None:
+        return CheckResult(
+            UNDETERMINED, total, key=exhausted,
+            detail=f"step budget ({step_budget}) exhausted",
+        )
+    return CheckResult(LINEARIZABLE, total)

@@ -1,0 +1,204 @@
+"""The nemesis: a seed-driven adversary schedule generator.
+
+One ``random.Random(seed)`` stream decides everything the adversary
+does, so a torture run is replayed exactly by its seed + config — the
+one-line repro the runner prints on failure. The vocabulary composes
+three fault planes:
+
+- **process faults** — the existing ``faults.FaultPlan`` vocabulary
+  (kill/recover, slow windows, disruptive candidacies, link
+  partitions), emitted as real ``FaultPlan`` fragments and merged into
+  the engine's event heap through ``schedule_faults`` after the plan's
+  own strict majority validation (``FaultPlan.validate``);
+- **message faults** — windows of transport-level drop/dup/delay
+  toggled on a ``chaos.ChaosTransport``;
+- **crash cycles** — whole-process crash + checkpoint-restore +
+  restart, optionally composed with a storage fault against the
+  durability stack (``chaos.MirroredStore``: torn vote-WAL append,
+  checkpoint bit-flip, stale-file rollback).
+
+Liveness discipline: every choice is gated so the run can quiesce —
+kills never leave fewer than a majority of members alive (the same rule
+``FaultPlan.validate`` enforces, applied adaptively here), partitions
+always leave a majority side, and storage faults never touch the last
+healthy mirror. The nemesis makes the runs *mean* something: a torture
+sweep that wedges proves nothing about linearizability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional
+
+from raft_tpu.faults.plan import FaultEvent, FaultPlan
+
+STORAGE_FAULTS = ("none", "tear_votelog", "flip_bit", "rollback")
+
+
+@dataclasses.dataclass
+class NemesisAction:
+    """One adversary decision for the runner to execute."""
+
+    kind: str                      # see Nemesis.KINDS
+    replica: int = 0
+    plan: Optional[FaultPlan] = None        # kind == "plan"
+    groups: Optional[list] = None           # kind == "partition"
+    drop: float = 0.0                       # kind == "msg_on"
+    dup: float = 0.0
+    delay: float = 0.0
+    storage: str = "none"                   # kind == "crash_restart"
+
+    def describe(self) -> str:
+        if self.kind == "msg_on":
+            return (f"msg_on(drop={self.drop:.2f}, dup={self.dup:.2f}, "
+                    f"delay={self.delay:.2f})")
+        if self.kind == "crash_restart":
+            return f"crash_restart(storage={self.storage})"
+        if self.kind == "partition":
+            return f"partition({self.groups})"
+        if self.kind == "plan":
+            return f"plan({[(e.t, e.action, e.replica) for e in self.plan.events]})"
+        return f"{self.kind}({self.replica})"
+
+
+class Nemesis:
+    """Seeded adversary policy over a live cluster view.
+
+    ``view`` duck-type (the runner adapts either engine): ``members()``
+    -> list of member rows, ``alive(r)`` -> bool, ``partitioned`` flag
+    maintained by the runner, ``now`` -> virtual clock.
+    """
+
+    KINDS = (
+        "kill", "recover", "slow", "unslow", "campaign",
+        "partition", "heal", "plan", "msg_on", "msg_off",
+        "crash_restart", "none",
+    )
+
+    def __init__(
+        self,
+        seed: int,
+        n_rows: int,
+        allow_crash: bool = True,
+        allow_msg: bool = True,
+        allow_storage: bool = True,
+    ):
+        self.rng = random.Random(f"nemesis:{seed}")
+        self.n_rows = n_rows
+        self.allow_crash = allow_crash
+        self.allow_msg = allow_msg
+        self.allow_storage = allow_storage
+        self.msg_window = False
+        self.cut: List[int] = []
+        #   minority side of the active partition; kill gating consults
+        #   it so kill x partition can never strand BOTH sides below
+        #   quorum (see _kill_ok)
+        self.log: List[str] = []
+
+    # ------------------------------------------------------------- policy
+    def _kill_ok(self, members: List[int], dead: int,
+                 victim: int, partitioned: bool) -> bool:
+        # mirror tests/test_chaos.py's rule: a strict majority of the
+        # CURRENT membership stays alive after one more kill — and while
+        # a partition is up, only minority-cut members may die: a kill
+        # on the majority side would compose with the cut into no live
+        # quorum on EITHER side (every write then stalls until a random
+        # heal, collapsing the run's discriminating power)
+        if partitioned and victim not in self.cut:
+            return False
+        return dead + 1 <= (len(members) - 1) // 2
+
+    def next_action(
+        self, members: List[int], alive: Dict[int, bool],
+        partitioned: bool, now: float,
+    ) -> NemesisAction:
+        rng = self.rng
+        if not partitioned:
+            self.cut = []   # heal or crash-restart dissolved the split
+        kinds = ["kill", "recover", "slow", "unslow", "campaign",
+                 "partition", "heal", "plan", "none"]
+        if self.allow_msg:
+            kinds += ["msg_on", "msg_off"]
+        if self.allow_crash:
+            kinds.append("crash_restart")
+        kind = rng.choice(kinds)
+        dead = sum(1 for r in members if not alive[r])
+        victim = rng.randrange(self.n_rows)
+        act = NemesisAction("none")
+        if kind == "kill":
+            if (victim in members and alive[victim]
+                    and self._kill_ok(members, dead, victim, partitioned)):
+                act = NemesisAction("kill", victim)
+        elif kind == "recover":
+            if not alive[victim]:
+                act = NemesisAction("recover", victim)
+        elif kind == "slow":
+            if victim in members and alive[victim]:
+                act = NemesisAction("slow", victim)
+        elif kind == "unslow":
+            act = NemesisAction("unslow", victim)
+        elif kind == "campaign":
+            act = NemesisAction("campaign", victim)
+        elif kind == "partition" and not partitioned:
+            # cut one LIVE member; the rest side must keep a live
+            # majority of the membership or no side could ever commit
+            live = [r for r in members if alive[r]]
+            if len(live) - 1 > len(members) // 2:
+                cut = [rng.choice(live)]      # minority side of one member
+                rest = [r for r in range(self.n_rows) if r not in cut]
+                self.cut = cut
+                act = NemesisAction("partition", groups=[cut, rest])
+        elif kind == "heal" and partitioned:
+            act = NemesisAction("heal")
+        elif kind == "plan":
+            act = self._compose_plan(members, alive, dead, partitioned, now)
+        elif kind == "msg_on" and self.allow_msg:
+            self.msg_window = True
+            act = NemesisAction(
+                "msg_on",
+                drop=rng.uniform(0.05, 0.35),
+                dup=rng.uniform(0.0, 0.3),
+                delay=rng.uniform(0.0, 0.25),
+            )
+        elif kind == "msg_off" and self.msg_window:
+            self.msg_window = False
+            act = NemesisAction("msg_off")
+        elif kind == "crash_restart" and self.allow_crash:
+            pool = STORAGE_FAULTS if self.allow_storage else ("none",)
+            act = NemesisAction(
+                "crash_restart", storage=rng.choice(pool)
+            )
+        self.log.append(f"t={now:.1f} {act.describe()}")
+        return act
+
+    def _compose_plan(
+        self, members: List[int], alive: Dict[int, bool], dead: int,
+        partitioned: bool, now: float,
+    ) -> NemesisAction:
+        """A scheduled FaultPlan fragment over the next phase window —
+        the classic vocabulary riding the engine's own heap, validated
+        by the plan's strict majority check before it is handed over."""
+        rng = self.rng
+        flavor = rng.choice(["slow_window", "crash_recover", "storm"])
+        live = [r for r in members if alive[r]]
+        r = rng.choice(live) if live else 0
+        if flavor == "slow_window" and live:
+            plan = FaultPlan.slow_window(r, now + 1.0, now + rng.uniform(10, 30))
+        elif flavor == "crash_recover" and live and self._kill_ok(
+            members, dead, r, partitioned
+        ):
+            plan = FaultPlan.crash_recover(
+                r, now + 1.0, now + rng.uniform(15, 40)
+            )
+        else:
+            plan = FaultPlan.election_storm(
+                len(members), now + 1.0, now + rng.uniform(10, 25),
+                mean_interval=5.0, seed=rng.randrange(1 << 30),
+            )
+        # belt and braces: the fragment itself must pass the strict
+        # majority validation (it schedules recover after kill, so the
+        # adaptive gate above is the binding one)
+        alive0 = [alive.get(r, True) for r in range(self.n_rows)]
+        plan.validate(self.n_rows, alive=alive0, strict=True)
+        return NemesisAction("plan", plan=plan)

@@ -1,0 +1,626 @@
+"""The torture runner: workload + nemesis + history + checker, end to end.
+
+``torture_run`` drives a single-group ``RaftEngine`` (with a recorded
+``ReplicatedKV`` workload) and ``torture_run_multi`` a key-sharded
+``MultiEngine``+``Router`` stack, through a seeded nemesis schedule —
+process faults, message faults, and whole-process crash /
+checkpoint-restore / restart cycles with storage faults against the
+durability files — then quiesces, closes the client history, and hands
+it to the linearizability checker. Every random choice (workload and
+nemesis alike) derives from the one seed, so a failing run's report
+carries a one-line repro: ``python -m raft_tpu.chaos --seed N ...``.
+
+Crash model. The engine is one process simulating R replicas, so a
+"crash" is the loss of every replica's VOLATILE state at one instant:
+queues, in-flight ops, roles, timers. Durable state is what the
+durability stack had on disk — the mirrored checkpoint
+(``MirroredStore``) and the vote WAL — which is exactly what
+``RaftEngine.restore`` rebuilds from. The runner snapshots the durable
+state at the crash instant (the archive IS the simulated disk: every
+committed entry was "written" when it committed), lets the nemesis
+corrupt it within the keep-one-mirror-healthy rule, restores, and
+carries the virtual clock forward so history timestamps stay monotone.
+Writes in flight across a crash resolve as ``info`` (they may have
+committed just before the crash — the checker explores both worlds);
+in-flight reads resolve as ``fail`` (a read that never returned has no
+effect to account for).
+
+Client model. Each virtual client runs ONE op at a time (serial — the
+§6.3 discipline) against its own rng stream: mostly writes of fresh
+values (every written value is unique, which maximizes the checker's
+discriminating power: a stale read names its exact culprit), reads via
+the batched ReadIndex ticket path (``submit_read``/``read_confirmed``),
+and occasional deletes. ``broken="dirty_reads"`` swaps the read path
+for one that serves the latest SUBMITTED (possibly uncommitted) value
+without leadership confirmation — the deliberately broken variant the
+checker must reject, proving the harness has teeth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import tempfile
+from typing import Dict, List, Optional
+
+from raft_tpu.chaos.checker import (
+    LINEARIZABLE,
+    CheckResult,
+    check_history,
+)
+from raft_tpu.chaos.history import DELETE, READ, WRITE, History, OpRecord
+from raft_tpu.chaos.nemesis import Nemesis, NemesisAction
+from raft_tpu.chaos.storage import MirroredStore
+from raft_tpu.chaos.transport import ChaosTransport
+from raft_tpu.config import RaftConfig
+
+
+@dataclasses.dataclass
+class TortureReport:
+    seed: int
+    check: CheckResult
+    ops: int
+    op_counts: Dict[str, int]
+    crashes: int
+    msg_stats: Dict[str, int]
+    nemesis_log: List[str]
+    repro: str
+
+    @property
+    def verdict(self) -> str:
+        return self.check.verdict
+
+    def summary(self) -> str:
+        line = (
+            f"seed {self.seed}: {self.verdict} over {self.ops} ops "
+            f"({self.op_counts}), {self.crashes} crash cycles, "
+            f"msg {self.msg_stats}"
+        )
+        if self.verdict != LINEARIZABLE:
+            line += f"\n  {self.check.detail}\n  REPRO: {self.repro}"
+        return line
+
+
+def _default_cfg(seed: int) -> RaftConfig:
+    return RaftConfig(
+        n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
+        transport="single", seed=seed,
+    )
+
+
+class _Client:
+    """One serial client: at most one op outstanding, its own rng."""
+
+    def __init__(self, cid: int, seed: int, keys: List[bytes]):
+        self.cid = cid
+        self.rng = random.Random(f"client:{seed}:{cid}")
+        self.keys = keys
+        self.rec: Optional[OpRecord] = None
+        self.ticket: Optional[int] = None   # read ticket (single-engine)
+        self.seq = None                     # write seq (engine-specific)
+        self.counter = 0
+
+    def fresh_value(self) -> bytes:
+        self.counter += 1
+        return f"c{self.cid}v{self.counter}".encode()
+
+    def pick(self) -> tuple:
+        """(op, key, value) for the next invocation."""
+        key = self.rng.choice(self.keys)
+        roll = self.rng.random()
+        if roll < 0.45:
+            return WRITE, key, self.fresh_value()
+        if roll < 0.52:
+            return DELETE, key, None
+        return READ, key, None
+
+
+class _TortureBase:
+    """Shared phase loop: invoke / drive / poll / nemesis / quiesce."""
+
+    #: virtual seconds a client waits on one op before giving up. A
+    #: write dropped across a leadership change never reads durable, and
+    #: a serial client with no give-up would starve the workload for the
+    #: rest of the run (seed sweeps showed 3-op histories). Giving up is
+    #: recorded honestly: an abandoned write resolves ``info`` (it may
+    #: STILL commit later — the unbounded interval covers that), an
+    #: abandoned read ``fail`` (a read that served no value has no
+    #: effect); the client then moves on.
+    OP_TIMEOUT_S = 90.0
+
+    def __init__(self, seed, phases, clients, keys, phase_s):
+        self.seed = seed
+        self.phases = phases
+        self.phase_s = phase_s
+        self.history = History()
+        self.keys = [f"k{i}".encode() for i in range(keys)]
+        self.clients = [_Client(c, seed, self.keys) for c in range(clients)]
+        self.crashes = 0
+
+    def _give_up(self, cl: _Client) -> bool:
+        """Client-side op timeout (see OP_TIMEOUT_S); True if resolved."""
+        rec = cl.rec
+        if rec is None or self.now() - rec.invoke_t <= self.OP_TIMEOUT_S:
+            return False
+        if rec.op == READ:
+            rec.fail(self.history.stamp(self.now()))
+        else:
+            rec.info()
+        cl.rec, cl.ticket, cl.seq = None, None, None
+        return True
+
+    # engine adapters ----------------------------------------------------
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def drive(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    def invoke(self, cl: _Client) -> None:
+        raise NotImplementedError
+
+    def poll(self, cl: _Client) -> None:
+        raise NotImplementedError
+
+    def apply_nemesis(self, act: NemesisAction) -> None:
+        raise NotImplementedError
+
+    def quiesce(self) -> None:
+        raise NotImplementedError
+
+    # the loop -----------------------------------------------------------
+    def _poll_all(self) -> None:
+        for cl in self.clients:
+            if cl.rec is not None:
+                self.poll(cl)
+
+    def _invoke_idle(self) -> None:
+        for cl in self.clients:
+            if cl.rec is None:
+                self.invoke(cl)
+
+    def run_phases(self, nemesis: Nemesis) -> None:
+        for _ in range(self.phases):
+            self._invoke_idle()
+            act = nemesis.next_action(
+                self.members(), self.alive_map(), self.partitioned,
+                self.now(),
+            )
+            self.apply_nemesis(act)
+            # drive in slices so completions are stamped near the event
+            # that produced them, not at phase granularity
+            for _ in range(4):
+                self.drive(self.phase_s / 4)
+                self._poll_all()
+                self._invoke_idle()
+        self.quiesce()
+        self.history.close()
+
+
+def torture_run(
+    seed: int,
+    phases: int = 12,
+    clients: int = 3,
+    keys: int = 4,
+    phase_s: float = 30.0,
+    cfg: Optional[RaftConfig] = None,
+    workdir: Optional[str] = None,
+    crash: bool = True,
+    msg_faults: bool = True,
+    storage_faults: bool = True,
+    broken: Optional[str] = None,
+    step_budget: int = 500_000,
+) -> TortureReport:
+    """One full single-engine torture run; see module docstring."""
+    run = _SingleTorture(
+        seed, phases, clients, keys, phase_s,
+        cfg or _default_cfg(seed), workdir, broken,
+    )
+    nemesis = Nemesis(
+        seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
+        allow_storage=storage_faults,
+    )
+    run.run_phases(nemesis)
+    check = check_history(run.history, step_budget=step_budget)
+    flags = []
+    if not crash:
+        flags.append("--no-crash")
+    if not msg_faults:
+        flags.append("--no-msg")
+    if not storage_faults:
+        flags.append("--no-storage")
+    if broken:
+        flags.append(f"--broken {broken}")
+    repro = (
+        f"python -m raft_tpu.chaos --seed {seed} --phases {phases} "
+        f"--clients {clients} --keys {keys} --phase-s {phase_s:g}"
+        + ("".join(" " + f for f in flags))
+    )
+    return TortureReport(
+        seed=seed, check=check, ops=len(run.history),
+        op_counts=run.history.counts(), crashes=run.crashes,
+        msg_stats=run.chaos_t.stats, nemesis_log=nemesis.log, repro=repro,
+    )
+
+
+class _SingleTorture(_TortureBase):
+    def __init__(self, seed, phases, clients, keys, phase_s, cfg,
+                 workdir, broken):
+        super().__init__(seed, phases, clients, keys, phase_s)
+        from raft_tpu.transport.device import SingleDeviceTransport
+
+        self.cfg = cfg
+        self.broken = broken
+        self._tmp = None
+        if workdir is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="raft_torture_")
+            workdir = self._tmp.name
+        self.store = MirroredStore(workdir, mirrors=2)
+        self.storage_rng = random.Random(f"storage:{seed}")
+        self.chaos_t = ChaosTransport(SingleDeviceTransport(cfg), seed)
+        self._msg_params = None
+        self.partitioned = False
+        self._boot_fresh()
+        # dirty-read oracle for the broken variant: key -> last value
+        # SUBMITTED (not committed) — exactly the cache a naive server
+        # would serve reads from without waiting for consensus
+        self._dirty: Dict[bytes, Optional[bytes]] = {}
+
+    # -------------------------------------------------------------- boot
+    def _boot_fresh(self) -> None:
+        from raft_tpu.examples.kv import ReplicatedKV
+        from raft_tpu.raft.engine import RaftEngine
+
+        self.engine = RaftEngine(
+            self.cfg, self.chaos_t, vote_log=self.store.votelog_path
+        )
+        self.kv = ReplicatedKV(self.engine)
+        self.engine.run_until_leader()
+
+    def _restart(self) -> None:
+        from raft_tpu.examples.kv import ReplicatedKV
+        from raft_tpu.raft.engine import RaftEngine
+
+        t0 = self.now()
+        path, _, _rejected = self.store.load_best()
+        old_stats = self.chaos_t.stats
+        self.chaos_t = ChaosTransport(
+            self._fresh_base(), self.seed * 1000 + self.crashes
+        )
+        for k, v in old_stats.items():   # stats survive the restart
+            self.chaos_t.stats[k] += v
+        self.engine = RaftEngine.restore(
+            self.cfg, path, self.chaos_t,
+            vote_log=self.store.votelog_path,
+        )
+        # carry virtual time forward: a restart must not rewind the
+        # history clock (heap entries armed below t0 simply fire "now")
+        self.engine.clock.now = t0
+        self.kv = ReplicatedKV(self.engine, replay=True)
+        if self._msg_params is not None:
+            self.chaos_t.set_message_faults(*self._msg_params)
+        self.partitioned = False
+        self.engine.run_until_leader()
+
+    def _fresh_base(self):
+        from raft_tpu.transport.device import SingleDeviceTransport
+
+        return SingleDeviceTransport(self.cfg)
+
+    # ----------------------------------------------------------- adapters
+    def members(self) -> List[int]:
+        return [r for r in range(self.cfg.rows) if self.engine.member[r]]
+
+    def alive_map(self) -> Dict[int, bool]:
+        return {r: bool(self.engine.alive[r]) for r in range(self.cfg.rows)}
+
+    def now(self) -> float:
+        return self.engine.clock.now
+
+    def drive(self, seconds: float) -> None:
+        self.engine.run_for(seconds)
+
+    def invoke(self, cl: _Client) -> None:
+        from raft_tpu.raft.engine import LinearizableReadRefused
+
+        op, key, value = cl.pick()
+        if op == READ:
+            cl.rec = self.history.invoke(cl.cid, READ, key, None, self.now())
+            if self.broken == "dirty_reads":
+                # deliberately broken: no leadership confirmation, no
+                # apply wait — half the reads serve the latest SUBMITTED
+                # (possibly uncommitted) value, half the applied state.
+                # A dirty read of an in-flight write followed by an
+                # applied read of the same key before it commits (or a
+                # crash that loses it) is the unjustifiable pair the
+                # checker must reject.
+                if cl.rng.random() < 0.5 and key in self._dirty:
+                    value = self._dirty[key]
+                else:
+                    value = self.kv.get(key)
+                cl.rec.ok(self.history.stamp(self.now()), value)
+                cl.rec = None
+                return
+            try:
+                cl.ticket = self.engine.submit_read()
+            except LinearizableReadRefused:
+                cl.rec.fail(self.history.stamp(self.now()))   # refused before any effect
+                cl.rec, cl.ticket = None, None
+            return
+        cl.rec = self.history.invoke(cl.cid, op, key, value, self.now())
+        cl.seq = (
+            self.kv.set(key, value) if op == WRITE else self.kv.delete(key)
+        )
+        self._dirty[key] = value if op == WRITE else None
+
+    def poll(self, cl: _Client) -> None:
+        from raft_tpu.raft.engine import LinearizableReadRefused
+
+        if self._give_up(cl):
+            return
+        rec = cl.rec
+        if rec.op == READ:
+            if isinstance(cl.ticket, tuple):
+                idx = cl.ticket[1]     # confirmed, waiting on the apply
+            else:
+                try:
+                    idx = self.engine.read_confirmed(cl.ticket)
+                except LinearizableReadRefused:
+                    rec.fail(self.history.stamp(self.now()))
+                    cl.rec, cl.ticket = None, None
+                    return
+                if idx is None:
+                    return
+                # confirmed; tickets are poll-once, so note the bound —
+                # the value may only serve once applied state covers it
+                cl.ticket = ("applied", idx)
+            if self.kv.last_applied < idx:
+                return
+            rec.ok(self.history.stamp(self.now()), self.kv.get(rec.key))
+            cl.rec, cl.ticket = None, None
+            return
+        if self.engine.is_durable(cl.seq):
+            rec.ok(self.history.stamp(self.now()))
+            cl.rec, cl.seq = None, None
+
+    def apply_nemesis(self, act: NemesisAction) -> None:
+        e = self.engine
+        if act.kind == "kill":
+            e.fail(act.replica)
+        elif act.kind == "recover":
+            e.recover(act.replica)
+        elif act.kind == "slow":
+            e.set_slow(act.replica, True)
+        elif act.kind == "unslow":
+            e.set_slow(act.replica, False)
+        elif act.kind == "campaign":
+            e.force_campaign(act.replica)
+        elif act.kind == "partition":
+            e.partition(act.groups)
+            self.partitioned = True
+        elif act.kind == "heal":
+            e.heal_partition()
+            self.partitioned = False
+        elif act.kind == "plan":
+            e.schedule_faults(act.plan)
+        elif act.kind == "msg_on":
+            self._msg_params = (act.drop, act.dup, act.delay)
+            self.chaos_t.set_message_faults(*self._msg_params)
+        elif act.kind == "msg_off":
+            self._msg_params = None
+            self.chaos_t.clear_message_faults()
+        elif act.kind == "crash_restart":
+            self._crash_restart(act.storage)
+
+    def _crash_restart(self, storage: str) -> None:
+        # resolve in-flight ops against the dying engine: writes may
+        # have committed unobserved (info — both worlds stay open);
+        # reads never returned (fail — no effect to account for)
+        for cl in self.clients:
+            if cl.rec is None:
+                continue
+            if cl.rec.op == READ:
+                cl.rec.fail(self.history.stamp(self.now()))
+            else:
+                cl.rec.info()
+            cl.rec, cl.ticket, cl.seq = None, None, None
+        self.store.save(self.engine)
+        if storage == "tear_votelog":
+            self.store.tear_votelog(self.storage_rng)
+        elif storage == "flip_bit":
+            self.store.flip_bit(
+                self.storage_rng.randrange(self.store.mirrors),
+                self.storage_rng,
+            )
+        elif storage == "rollback":
+            self.store.rollback(
+                self.storage_rng.randrange(self.store.mirrors)
+            )
+        self.crashes += 1
+        self._restart()
+
+    def quiesce(self) -> None:
+        """Heal every fault plane, then resolve all outstanding ops."""
+        e = self.engine
+        self._msg_params = None
+        self.chaos_t.clear_message_faults()
+        e.heal_partition()
+        self.partitioned = False
+        for r in range(self.cfg.rows):
+            if e.member[r] and not e.alive[r]:
+                e.recover(r)
+            e.set_slow(r, False)
+        probe = e.submit(bytes(self.cfg.entry_bytes))
+        e.run_until_committed(probe, limit=3000.0)
+        for _ in range(40):
+            self._poll_all()
+            if all(cl.rec is None for cl in self.clients):
+                break
+            e.run_for(4 * self.cfg.heartbeat_period)
+        # anything still unresolved closes as info/fail via History.close
+        for cl in self.clients:
+            if cl.rec is not None and cl.rec.op == READ:
+                cl.rec.fail(self.history.stamp(self.now()))
+                cl.rec, cl.ticket = None, None
+
+
+def torture_run_multi(
+    seed: int,
+    n_groups: int = 4,
+    phases: int = 10,
+    clients: int = 3,
+    keys: int = 6,
+    phase_s: float = 30.0,
+    cfg: Optional[RaftConfig] = None,
+    step_budget: int = 500_000,
+) -> TortureReport:
+    """Multi-Raft torture: the sharded Router/ShardedKV client surface
+    under per-group process faults. No crash cycles or message faults —
+    ``MultiEngine`` has no checkpoint/restore or pluggable transport yet
+    (its module docstring scopes both); per-key histories across groups
+    are the point: the Router must keep every key's subhistory
+    linearizable while sibling groups fail independently."""
+    run = _MultiTorture(
+        seed, phases, clients, keys, phase_s, cfg, n_groups
+    )
+    nemesis = Nemesis(
+        seed, run.cfg.n_replicas, allow_crash=False, allow_msg=False,
+        allow_storage=False,
+    )
+    run.run_phases(nemesis)
+    check = check_history(run.history, step_budget=step_budget)
+    repro = (
+        f"python -m raft_tpu.chaos --seed {seed} --multi "
+        f"--groups {n_groups} --phases {phases} --clients {clients} "
+        f"--keys {keys} --phase-s {phase_s:g}"
+    )
+    return TortureReport(
+        seed=seed, check=check, ops=len(run.history),
+        op_counts=run.history.counts(), crashes=0,
+        msg_stats={}, nemesis_log=nemesis.log, repro=repro,
+    )
+
+
+class _MultiTorture(_TortureBase):
+    def __init__(self, seed, phases, clients, keys, phase_s, cfg, n_groups):
+        super().__init__(seed, phases, clients, keys, phase_s)
+        from raft_tpu.examples.kv_sharded import ShardedKV
+        from raft_tpu.multi.engine import MultiEngine
+        from raft_tpu.multi.router import Router
+
+        self.cfg = cfg or RaftConfig(
+            n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
+            transport="single", seed=seed,
+        )
+        self.engine = MultiEngine(self.cfg, n_groups)
+        self.engine.seed_leaders()
+        self.router = Router(self.engine)
+        self.kv = ShardedKV(self.engine, self.router)
+        self.partitioned = False
+        self._part_group: Optional[int] = None
+        self.nem_rng = random.Random(f"multi-nemesis:{seed}")
+
+    def members(self) -> List[int]:
+        return list(range(self.cfg.n_replicas))
+
+    def alive_map(self) -> Dict[int, bool]:
+        # a replica counts as dead for the kill gate if ANY group lost
+        # it (faults below are applied per-group or globally)
+        return {
+            r: bool(self.engine.alive[:, r].all())
+            for r in range(self.cfg.n_replicas)
+        }
+
+    def now(self) -> float:
+        return self.engine.clock.now
+
+    def drive(self, seconds: float) -> None:
+        self.engine.run_for(seconds)
+
+    def invoke(self, cl: _Client) -> None:
+        from raft_tpu.multi.engine import NotLeader
+
+        op, key, value = cl.pick()
+        cl.rec = self.history.invoke(cl.cid, op, key, value, self.now())
+        try:
+            if op == READ:
+                g, idx = self.router.read_index(key)
+                if self.kv.last_applied[g] < idx:
+                    self.drive(2 * self.cfg.heartbeat_period)
+                if self.kv.last_applied[g] < idx:
+                    cl.rec.fail(self.history.stamp(self.now()))   # apply lag: no value served
+                else:
+                    cl.rec.ok(self.history.stamp(self.now()), self.kv.get(key))
+                cl.rec = None
+                return
+            cl.seq = (
+                self.kv.set(key, value) if op == WRITE
+                else self.kv.delete(key)
+            )
+        except NotLeader:
+            # nothing was queued (submit_to_leader refuses before
+            # queueing; read_index confirms nothing): provably no effect
+            cl.rec.fail(self.history.stamp(self.now()))
+            cl.rec, cl.seq = None, None
+
+    def poll(self, cl: _Client) -> None:
+        if cl.rec is None or cl.rec.op == READ:
+            return
+        if self._give_up(cl):
+            return
+        g, seq = cl.seq
+        if self.engine.is_durable(g, seq):
+            cl.rec.ok(self.history.stamp(self.now()))
+            cl.rec, cl.seq = None, None
+
+    def apply_nemesis(self, act: NemesisAction) -> None:
+        e = self.engine
+        rng = self.nem_rng
+        g = rng.randrange(e.G)
+        if act.kind == "kill":
+            e.fail(g, act.replica)
+        elif act.kind == "recover":
+            for gg in range(e.G):
+                if not e.alive[gg, act.replica]:
+                    e.recover(gg, act.replica)
+        elif act.kind == "slow":
+            e.set_slow(g, act.replica, True)
+        elif act.kind == "unslow":
+            for gg in range(e.G):
+                e.set_slow(gg, act.replica, False)
+        elif act.kind == "campaign":
+            e.force_campaign(g, act.replica)
+        elif act.kind == "partition":
+            self._part_group = g
+            e.partition(g, act.groups)
+            self.partitioned = True
+        elif act.kind == "heal":
+            if self._part_group is not None:
+                e.heal_partition(self._part_group)
+            self._part_group = None
+            self.partitioned = False
+        elif act.kind == "plan":
+            # scope the classic fragment to one group (the multi-Raft
+            # FaultEvent.group field)
+            from raft_tpu.faults.plan import FaultPlan
+
+            e.schedule_faults(FaultPlan([
+                dataclasses.replace(ev, group=g) for ev in act.plan.events
+            ]))
+
+    def quiesce(self) -> None:
+        e = self.engine
+        for g in range(e.G):
+            e.heal_partition(g)
+            for r in range(self.cfg.n_replicas):
+                if not e.alive[g, r]:
+                    e.recover(g, r)
+                e.set_slow(g, r, False)
+        self.partitioned = False
+        for g in range(e.G):
+            e.run_until_leader(g, limit=3000.0)
+        for _ in range(40):
+            self._poll_all()
+            if all(cl.rec is None for cl in self.clients):
+                break
+            e.run_for(4 * self.cfg.heartbeat_period)

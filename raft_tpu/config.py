@@ -102,6 +102,14 @@ class RaftConfig:
     # surface as a silently wrong collective or a hang. 0 = off (the
     # single-process default; the digest fold itself is skipped too).
     mirror_check_every: int = 0
+    # Bound on the digest exchange itself (seconds, wall clock). The
+    # guard only compares digests at aligned decision COUNTS; if one
+    # process stalls or dies between checks, the surviving side's
+    # process_allgather would BE the indefinite hang the guard exists
+    # to prevent (ADVICE r5 #4). The exchange runs under this timeout
+    # and a stall raises MirrorDesyncError exactly like a value
+    # mismatch — fail-stop either way.
+    mirror_exchange_timeout_s: float = 60.0
 
     # --- steady-state program dispatch ---
     # "auto": run the repair-free step program whenever the last step showed
@@ -179,6 +187,8 @@ class RaftConfig:
             raise ValueError('steady_dispatch must be "auto" or "off"')
         if self.pipeline_max_laps < 1:
             raise ValueError("pipeline_max_laps must be >= 1")
+        if self.mirror_exchange_timeout_s <= 0:
+            raise ValueError("mirror_exchange_timeout_s must be > 0")
         if self.shard_bytes % 4:
             # device payload storage is packed as int32 lanes (core.state
             # layout); each replica's per-entry bytes must fill whole words

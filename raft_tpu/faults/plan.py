@@ -93,4 +93,58 @@ class FaultPlan:
         return cls(events)
 
     def merged(self, other: "FaultPlan") -> "FaultPlan":
+        """Merge two plans into one time-ordered plan.
+
+        Tie order is STABLE and documented: events sharing the same
+        ``t`` keep ``self``'s events before ``other``'s, each side in
+        its original list order (``sorted`` is stable and the key is
+        ``t`` alone). The engine's heap adds its own monotone tiebreak
+        on top, so same-``t`` events also FIRE in exactly this order —
+        a schedule's behavior never depends on sort internals."""
         return FaultPlan(sorted(self.events + other.events, key=lambda e: e.t))
+
+    def validate(
+        self,
+        n_replicas: int,
+        alive=None,
+        strict: bool = True,
+    ) -> List[FaultEvent]:
+        """Check the plan's kill events against the quorum-liveness rule:
+        simulated in time order (same-``t`` ties in list order, matching
+        ``merged``), no ``kill`` may leave fewer than a strict majority
+        of the ``n_replicas`` cluster alive — a plan that does cannot
+        quiesce and proves nothing. ``alive`` optionally seeds the
+        per-replica aliveness (default: all up). Returns the offending
+        kill events (each treated as NOT executed for the rest of the
+        walk, so later events are judged against the best repairable
+        schedule); with ``strict=True`` (the default) raises
+        ``ValueError`` on the first one instead.
+
+        The walk models only kill/recover (partitions and slow windows
+        do not change aliveness) and assumes fixed membership — plans
+        driving a live-membership engine should validate against the
+        smallest membership the schedule reaches."""
+        up = list(alive) if alive is not None else [True] * n_replicas
+        if len(up) != n_replicas:
+            raise ValueError(
+                f"alive has {len(up)} entries for {n_replicas} replicas"
+            )
+        majority = n_replicas // 2 + 1
+        offending: List[FaultEvent] = []
+        for ev in sorted(self.events, key=lambda e: e.t):
+            if ev.action == "recover":
+                if 0 <= ev.replica < n_replicas:
+                    up[ev.replica] = True
+            elif ev.action == "kill" and 0 <= ev.replica < n_replicas:
+                if up[ev.replica] and sum(up) - 1 < majority:
+                    if strict:
+                        raise ValueError(
+                            f"kill of replica {ev.replica} at t={ev.t} "
+                            f"leaves {sum(up) - 1} of {n_replicas} alive "
+                            f"(majority is {majority}); a plan below "
+                            "majority cannot quiesce"
+                        )
+                    offending.append(ev)
+                else:
+                    up[ev.replica] = False
+        return offending

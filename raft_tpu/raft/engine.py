@@ -1302,14 +1302,57 @@ class RaftEngine:
         """One tiny cross-process allgather of the digest scalar (rides
         the same fabric as every other collective — and, like them, is
         itself issued in lockstep because the decision COUNT is part of
-        the mirrored stream). Single-process: no-op."""
+        the mirrored stream). Single-process: no-op.
+
+        The exchange itself is BOUNDED (``cfg.mirror_exchange_timeout_s``,
+        ADVICE r5 #4): a digest comparison only happens at aligned
+        decision counts, so a peer that stalls, dies, or diverges in
+        COUNT between checks leaves this process blocked inside the
+        allgather — the exact indefinite hang the guard exists to
+        prevent. The collective therefore runs on a worker thread with a
+        wall-clock bound; a stall or a transport error raises
+        ``MirrorDesyncError`` exactly like a value mismatch. The stuck
+        daemon thread is deliberately abandoned: the raise is a
+        fail-stop and the process is expected to terminate (recovery is
+        a process-group restart, transport.reform)."""
         if jax.process_count() == 1:
             return
+        import threading
+
         from jax.experimental import multihost_utils
 
-        digests = np.asarray(multihost_utils.process_allgather(
-            np.int64(self._mirror_digest)
-        )).ravel()
+        box: dict = {}
+
+        def _exchange() -> None:
+            try:
+                box["digests"] = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.int64(self._mirror_digest)
+                    )
+                ).ravel()
+            except BaseException as ex:   # surfaced on the engine thread
+                box["error"] = ex
+
+        th = threading.Thread(
+            target=_exchange, daemon=True, name="mirror-digest-exchange"
+        )
+        th.start()
+        th.join(self.cfg.mirror_exchange_timeout_s)
+        if "digests" not in box:
+            err = box.get("error")
+            why = (
+                f"failed ({err!r})" if err is not None else
+                f"did not complete within "
+                f"{self.cfg.mirror_exchange_timeout_s:g}s — a peer "
+                "process stalled, died, or diverged in decision count"
+            )
+            raise MirrorDesyncError(
+                f"mirror digest exchange at decision "
+                f"{self._mirror_decisions} {why}. The mirrored control "
+                "planes can no longer be trusted to issue matching "
+                "collectives — failing stop instead of hanging."
+            )
+        digests = box["digests"]
         if not (digests == digests[0]).all():
             raise MirrorDesyncError(
                 f"mirrored control planes diverged at decision "

@@ -1,0 +1,42 @@
+"""bench.py --deadline-s: a budget-exceeded run must degrade to explicit
+per-leg skip rows and a VALID final combined JSON object — never the
+rc=124 / ``parsed: null`` shape an external timeout kill leaves behind
+(BENCH_r05)."""
+
+import json
+import time
+
+
+def test_deadline_zero_skips_all_legs_and_emits_valid_json(capsys):
+    import bench
+
+    bench.main(["--deadline-s", "0"])
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    rows = [json.loads(ln) for ln in lines]       # every line parses
+    final = rows[-1]
+    assert final["metric"] == "commit_p50_latency"
+    assert final["value"] is None                 # nulls, not absence
+    assert final["deadline_s"] == 0.0
+    # every leg is an explicit skip row, and the combined object agrees
+    legs = {r["leg"]: r for r in rows if "leg" in r}
+    assert legs and all(r.get("skipped") == "deadline" for r in legs.values())
+    assert set(final["deadline_skipped"]) == set(legs) | {"kernel_gates"}
+    #   the kernel-equivalence gates never ran either — recorded so
+    #   surviving rows are not read as gate-validated
+    assert all(
+        final["configs"][name].get("skipped") == "deadline" for name in legs
+    )
+
+
+def test_deadline_object_contract():
+    import bench
+
+    dl = bench._Deadline(None)
+    assert not dl.expired                         # no budget: never expires
+    assert dl.run("x", lambda: {"v": 1}) == {"v": 1}
+
+    dl = bench._Deadline(1e-9)
+    time.sleep(0.01)
+    assert dl.expired
+    assert dl.run("y", lambda: {"v": 1}) == {"skipped": "deadline"}
+    assert dl.skipped == ["y"]

@@ -72,6 +72,51 @@ class TestFaultPlan:
         )
         assert [e.t for e in p.events] == sorted(e.t for e in p.events)
 
+    def test_merged_same_t_tie_order_is_stable(self):
+        """Documented tie order: same-t events keep self's before
+        other's, each side in original order — a schedule's behavior
+        must not depend on sort internals."""
+        a = FaultPlan([FaultEvent(5.0, "kill", 0),
+                       FaultEvent(5.0, "slow", 1)])
+        b = FaultPlan([FaultEvent(5.0, "recover", 0),
+                       FaultEvent(1.0, "campaign", 2)])
+        m = a.merged(b)
+        assert [(e.t, e.action) for e in m.events] == [
+            (1.0, "campaign"),               # earlier t first
+            (5.0, "kill"), (5.0, "slow"),    # self's same-t block...
+            (5.0, "recover"),                # ...then other's
+        ]
+        # and merge order flips the tie order accordingly
+        m2 = b.merged(a)
+        assert [e.action for e in m2.events] == [
+            "campaign", "recover", "kill", "slow",
+        ]
+
+    def test_validate_rejects_sub_majority_kill(self):
+        plan = FaultPlan([
+            FaultEvent(1.0, "kill", 0),
+            FaultEvent(2.0, "kill", 1),      # 1 of 3 alive: below majority
+        ])
+        with pytest.raises(ValueError, match="majority"):
+            plan.validate(3)
+        offenders = plan.validate(3, strict=False)
+        assert [e.replica for e in offenders] == [1]
+
+    def test_validate_accepts_recover_interleaved_kills(self):
+        plan = FaultPlan([
+            FaultEvent(1.0, "kill", 0),
+            FaultEvent(2.0, "recover", 0),
+            FaultEvent(3.0, "kill", 1),
+        ])
+        assert plan.validate(3) == []
+
+    def test_validate_honors_initial_aliveness(self):
+        plan = FaultPlan([FaultEvent(1.0, "kill", 0)])
+        assert plan.validate(3) == []
+        with pytest.raises(ValueError, match="majority"):
+            # one replica already down: this kill leaves 1 of 3
+            plan.validate(3, alive=[True, True, False])
+
 
 class TestElectionStorm:
     """BASELINE config 5: randomized term bumps under churn."""

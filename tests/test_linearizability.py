@@ -1,0 +1,207 @@
+"""The linearizability checker, checked.
+
+A checker with a bug in the ACCEPT direction silently blesses broken
+histories (the torture harness becomes theater); a bug in the REJECT
+direction fails good runs and buries real signal. These tests pin both
+directions on hand-built histories with known verdicts, the budget
+contract (UNDETERMINED, never a hang), and the P-compositionality
+optimization against the whole-history model it must agree with.
+"""
+
+import pytest
+
+from raft_tpu.chaos.checker import (
+    LINEARIZABLE,
+    UNDETERMINED,
+    VIOLATION,
+    check_history,
+)
+from raft_tpu.chaos.history import DELETE, READ, WRITE, History
+
+
+def H(*events):
+    """events: (client, op, key, value, invoke, complete, status)."""
+    h = History()
+    for client, op, key, value, inv, comp, status in events:
+        rec = h.invoke(client, op, key, value, inv)
+        if status == "ok":
+            rec.ok(comp, value)
+        elif status == "fail":
+            rec.fail(comp)
+        elif status == "info":
+            rec.info()
+    h.close()
+    return h
+
+
+class TestAccepts:
+    def test_sequential_read_your_writes(self):
+        h = H(
+            (1, WRITE, b"k", b"A", 0.0, 1.0, "ok"),
+            (2, READ, b"k", b"A", 2.0, 3.0, "ok"),
+            (1, WRITE, b"k", b"B", 4.0, 5.0, "ok"),
+            (2, READ, b"k", b"B", 6.0, 7.0, "ok"),
+        )
+        assert check_history(h).verdict == LINEARIZABLE
+
+    def test_concurrent_read_may_see_either_side(self):
+        # write [0,10] concurrent with both reads: absent-then-present
+        # is explainable by a linearization point between them
+        h = H(
+            (1, WRITE, b"k", b"A", 0.0, 10.0, "ok"),
+            (2, READ, b"k", None, 1.0, 2.0, "ok"),
+            (3, READ, b"k", b"A", 3.0, 4.0, "ok"),
+        )
+        assert check_history(h).verdict == LINEARIZABLE
+
+    def test_info_write_both_worlds(self):
+        # an unacknowledged write may have applied...
+        applied = H(
+            (1, WRITE, b"k", b"A", 0.0, 1.0, "ok"),
+            (1, WRITE, b"k", b"B", 2.0, None, "info"),
+            (2, READ, b"k", b"B", 3.0, 4.0, "ok"),
+        )
+        assert check_history(applied).verdict == LINEARIZABLE
+        # ...or never
+        lost = H(
+            (1, WRITE, b"k", b"A", 0.0, 1.0, "ok"),
+            (1, WRITE, b"k", b"B", 2.0, None, "info"),
+            (2, READ, b"k", b"A", 3.0, 4.0, "ok"),
+        )
+        assert check_history(lost).verdict == LINEARIZABLE
+
+    def test_failed_ops_constrain_nothing(self):
+        h = H(
+            (1, WRITE, b"k", b"A", 0.0, 1.0, "ok"),
+            (2, WRITE, b"k", b"Z", 2.0, 3.0, "fail"),
+            (3, READ, b"k", b"A", 4.0, 5.0, "ok"),
+        )
+        assert check_history(h).verdict == LINEARIZABLE
+
+    def test_delete_reads_absent(self):
+        h = H(
+            (1, WRITE, b"k", b"A", 0.0, 1.0, "ok"),
+            (1, DELETE, b"k", None, 2.0, 3.0, "ok"),
+            (2, READ, b"k", None, 4.0, 5.0, "ok"),
+        )
+        assert check_history(h).verdict == LINEARIZABLE
+
+
+class TestRejects:
+    def test_stale_read(self):
+        # B overwrote A strictly before the read was even invoked
+        h = H(
+            (1, WRITE, b"k", b"A", 0.0, 1.0, "ok"),
+            (1, WRITE, b"k", b"B", 2.0, 3.0, "ok"),
+            (2, READ, b"k", b"A", 4.0, 5.0, "ok"),
+        )
+        res = check_history(h)
+        assert res.verdict == VIOLATION
+        assert res.key == b"k"
+
+    def test_read_of_never_written_value(self):
+        h = H(
+            (1, WRITE, b"k", b"A", 0.0, 1.0, "ok"),
+            (2, READ, b"k", b"GHOST", 2.0, 3.0, "ok"),
+        )
+        assert check_history(h).verdict == VIOLATION
+
+    def test_flip_flop_over_lost_write(self):
+        # a dirty read observed an in-flight write that then never
+        # applied for the second read — no register schedule explains
+        # B-then-A without a second write of A
+        h = H(
+            (1, WRITE, b"k", b"A", 0.0, 1.0, "ok"),
+            (1, WRITE, b"k", b"B", 2.0, None, "info"),
+            (2, READ, b"k", b"B", 3.0, 3.5, "ok"),
+            (2, READ, b"k", b"A", 4.0, 5.0, "ok"),
+        )
+        assert check_history(h).verdict == VIOLATION
+
+    def test_violation_in_one_key_fails_whole_history(self):
+        h = H(
+            (1, WRITE, b"good", b"A", 0.0, 1.0, "ok"),
+            (2, READ, b"good", b"A", 2.0, 3.0, "ok"),
+            (1, WRITE, b"bad", b"X", 4.0, 5.0, "ok"),
+            (2, READ, b"bad", b"Y", 6.0, 7.0, "ok"),
+        )
+        res = check_history(h)
+        assert res.verdict == VIOLATION
+        assert res.key == b"bad"
+
+
+class TestBudget:
+    def _wide_history(self):
+        # 8 fully-concurrent writes + a read: large honest search space
+        h = History()
+        for i in range(8):
+            h.invoke(i, WRITE, b"k", f"v{i}".encode(), 0.0).ok(100.0)
+        h.invoke(9, READ, b"k", None, 101.0).ok(102.0, b"v3")
+        h.close()
+        return h
+
+    def test_full_budget_decides(self):
+        assert check_history(self._wide_history()).verdict == LINEARIZABLE
+
+    def test_tiny_budget_returns_undetermined(self):
+        res = check_history(self._wide_history(), step_budget=2)
+        assert res.verdict == UNDETERMINED
+        assert res.steps <= 3
+        # UNDETERMINED is a verdict about the SEARCH, not the history —
+        # it must never masquerade as a pass
+        assert not res
+
+    def test_pending_history_is_refused(self):
+        h = History()
+        h.invoke(1, WRITE, b"k", b"A", 0.0)
+        with pytest.raises(ValueError, match="PENDING"):
+            check_history(h)
+
+
+class TestPCompositionality:
+    """Per-key decomposition must agree with the whole-history dict
+    model on small cases — the locality theorem, executed."""
+
+    CASES = [
+        # interleaved good history over two keys
+        H(
+            (1, WRITE, b"a", b"A1", 0.0, 1.0, "ok"),
+            (2, WRITE, b"b", b"B1", 0.5, 1.5, "ok"),
+            (1, READ, b"b", b"B1", 2.0, 3.0, "ok"),
+            (2, READ, b"a", b"A1", 2.5, 3.5, "ok"),
+            (1, WRITE, b"a", b"A2", 4.0, 5.0, "ok"),
+            (2, READ, b"a", b"A2", 6.0, 7.0, "ok"),
+        ),
+        # cross-key concurrency with deletes and an info write
+        H(
+            (1, WRITE, b"a", b"A1", 0.0, 4.0, "ok"),
+            (2, WRITE, b"b", b"B1", 0.0, 4.0, "ok"),
+            (3, READ, b"a", None, 1.0, 2.0, "ok"),
+            (3, DELETE, b"b", None, 5.0, 6.0, "ok"),
+            (1, WRITE, b"b", b"B2", 7.0, None, "info"),
+            (3, READ, b"b", b"B2", 8.0, 9.0, "ok"),
+        ),
+        # per-key violation (stale read on one key)
+        H(
+            (1, WRITE, b"a", b"A1", 0.0, 1.0, "ok"),
+            (1, WRITE, b"a", b"A2", 2.0, 3.0, "ok"),
+            (2, READ, b"a", b"A1", 4.0, 5.0, "ok"),
+            (2, READ, b"b", None, 4.5, 5.5, "ok"),
+        ),
+        # violation only visible as a cross-read pair on one key
+        H(
+            (1, WRITE, b"a", b"A1", 0.0, 1.0, "ok"),
+            (2, READ, b"a", b"A1", 2.0, 3.0, "ok"),
+            (1, WRITE, b"a", b"A2", 2.0, None, "info"),
+            (2, READ, b"a", b"A2", 4.0, 5.0, "ok"),
+            (2, READ, b"a", b"A1", 6.0, 7.0, "ok"),
+        ),
+    ]
+
+    @pytest.mark.parametrize("idx", range(len(CASES)))
+    def test_per_key_equals_whole_history(self, idx):
+        h = self.CASES[idx]
+        per_key = check_history(h, per_key=True).verdict
+        whole = check_history(h, per_key=False).verdict
+        assert per_key == whole
+        assert per_key in (LINEARIZABLE, VIOLATION)

@@ -1,0 +1,212 @@
+"""Jepsen-style torture runs: client-history linearizability under the
+randomized nemesis (raft_tpu.chaos).
+
+Tier-1 pins a few seeds of the full composition — process crashes with
+checkpoint-restore/restart, partitions, message drop/dup/delay, and
+storage faults against the votelog/snapshot mirrors — plus the teeth
+test (a deliberately broken client variant the checker must reject) and
+the storage-recovery unit contracts. The ≥20-seed sweeps are marked
+``slow`` and run at build time (tier-1 runtime unchanged); any failure
+prints a one-line repro (``python -m raft_tpu.chaos --seed N ...``).
+"""
+
+import random
+
+import pytest
+
+from raft_tpu.chaos import (
+    LINEARIZABLE,
+    VIOLATION,
+    MirroredStore,
+    torture_run,
+    torture_run_multi,
+)
+
+# seeds chosen to pin distinct adversary mixes (verified at build time):
+# 3 composes 4 crash cycles + message faults + storage faults; 5 a crash
+# cycle with no message window; 2 a message-fault-heavy run (55 drops,
+# dup + delayed-echo delivery) with no crash.
+PINNED_SEEDS = [2, 3, 5]
+
+
+def _assert_linearizable(rep):
+    assert rep.verdict == LINEARIZABLE, rep.summary()
+    # a run that recorded nothing proves nothing
+    assert rep.op_counts.get("ok", 0) >= 10, rep.summary()
+
+
+def test_torture_pinned_seeds_cover_every_fault_plane():
+    """The tier-1 pinned runs: every history linearizable AND the set
+    actually covers the adversary vocabulary — a sweep of green runs
+    that never crashed or dropped a message would be vacuous."""
+    reps = [torture_run(s, phases=10) for s in PINNED_SEEDS]
+    assert any(r.crashes > 0 for r in reps)
+    assert any(r.msg_stats.get("drop", 0) > 0 for r in reps)
+    assert any(r.msg_stats.get("dup", 0) > 0 for r in reps)
+    assert any(r.msg_stats.get("delivered", 0) > 0 for r in reps), \
+        "no delayed echo was ever delivered"
+    assert any("storage" in line and "none" not in line
+               for r in reps for line in r.nemesis_log
+               if "crash_restart" in line), \
+        "no crash cycle composed a storage fault"
+    for r in reps:
+        _assert_linearizable(r)
+
+
+def test_torture_multi_router_histories_linearizable():
+    """Sharded per-key histories through the multi-Raft Router stay
+    linearizable under per-group faults."""
+    rep = torture_run_multi(0, n_groups=4, phases=8)
+    _assert_linearizable(rep)
+
+
+@pytest.mark.parametrize("seed", [0, 4])
+def test_broken_client_variant_is_rejected(seed):
+    """Teeth: a client that serves reads without leadership
+    confirmation — mixing applied state with dirty (uncommitted)
+    values — must produce a history the checker REJECTS. If these
+    seeds ever pass, the harness has lost its discrimination."""
+    rep = torture_run(seed, phases=10, keys=2, broken="dirty_reads")
+    assert rep.verdict == VIOLATION, rep.summary()
+    assert rep.check.key is not None
+    assert "--broken dirty_reads" in rep.repro
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(24))
+def test_torture_sweep(seed):
+    """The acceptance sweep: >= 20 seeds of the full composition."""
+    _assert_linearizable(torture_run(seed, phases=12))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_torture_multi_sweep(seed):
+    _assert_linearizable(torture_run_multi(seed, n_groups=4, phases=10))
+
+
+# ------------------------------------------------- storage recovery unit
+class _FakeEngine:
+    """Just enough engine surface for MirroredStore.save."""
+
+    def __init__(self, path_payload):
+        self._payloads = path_payload
+
+    def save_checkpoint(self, path):
+        # a real .npz so EngineCheckpoint.load round-trips
+        import numpy as np
+
+        from raft_tpu.ckpt import EngineCheckpoint, Snapshot
+
+        n = self._payloads.pop(0)
+        ents = np.zeros((n, 8), np.uint8)
+        EngineCheckpoint(
+            snap=Snapshot(1, n, ents, np.ones(n, np.int32)),
+            terms=np.ones(3, np.int32),
+            voted_for=np.full(3, -1, np.int32),
+        ).save(path)
+
+
+class TestMirroredStore:
+    def test_bit_flip_detected_and_other_mirror_wins(self, tmp_path):
+        store = MirroredStore(str(tmp_path), mirrors=2)
+        store.save(_FakeEngine([5]))
+        store.flip_bit(0, random.Random(7))
+        path, wm, rejected = store.load_best()
+        assert rejected == [0]
+        assert path == store.mirror_path(1)
+        assert wm == 5
+
+    def test_rollback_outranked_by_current_generation(self, tmp_path):
+        store = MirroredStore(str(tmp_path), mirrors=2)
+        store.save(_FakeEngine([5]))
+        store.save(_FakeEngine([5]))     # same watermark, newer generation
+        assert store.rollback(0)
+        path, wm, rejected = store.load_best()
+        # the stale mirror is internally VALID — only the generation
+        # rank keeps recovery off it (terms could have regressed)
+        assert rejected == []
+        assert path == store.mirror_path(1)
+        assert wm == 5
+
+    def test_all_mirrors_corrupt_refuses(self, tmp_path):
+        store = MirroredStore(str(tmp_path), mirrors=2)
+        store.save(_FakeEngine([3]))
+        rng = random.Random(1)
+        store.flip_bit(0, rng)
+        store.flip_bit(1, rng)
+        with pytest.raises(RuntimeError, match="no healthy"):
+            store.load_best()
+
+    def test_torn_votelog_trimmed_on_reopen(self, tmp_path):
+        from raft_tpu.ckpt import VoteLog
+
+        store = MirroredStore(str(tmp_path), mirrors=2)
+        log = VoteLog(store.votelog_path)
+        log.record_many([(0, 3, 1), (1, 3, 1)])
+        log.close()
+        store.tear_votelog(random.Random(9))
+        # reopen trims the torn suffix; replay sees the durable records
+        log2 = VoteLog(store.votelog_path)
+        log2.record_many([(2, 4, 0)])
+        log2.close()
+        out = VoteLog.replay(store.votelog_path)
+        assert out == {0: (3, 1), 1: (3, 1), 2: (4, 0)}
+
+
+# ------------------------------------------- mirror digest exchange bound
+def test_mirror_digest_exchange_timeout_fail_stops(monkeypatch):
+    """ADVICE r5 #4: a stalled peer must turn the digest exchange into
+    MirrorDesyncError within the configured bound, not an indefinite
+    process_allgather hang."""
+    import time
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    from raft_tpu.config import RaftConfig
+    from raft_tpu.raft.engine import MirrorDesyncError, RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=16, batch_size=4, log_capacity=64,
+        transport="single", mirror_check_every=1,
+        mirror_exchange_timeout_s=0.2,
+    )
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+    def _stall(x):
+        time.sleep(60.0)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather", _stall)
+    t0 = time.monotonic()
+    with pytest.raises(MirrorDesyncError, match="did not complete"):
+        e.step_event()
+    assert time.monotonic() - t0 < 5.0, "bound was not enforced"
+
+
+def test_mirror_digest_exchange_error_fail_stops(monkeypatch):
+    """A transport error inside the exchange surfaces as the same
+    fail-stop, with the cause attached."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from raft_tpu.config import RaftConfig
+    from raft_tpu.raft.engine import MirrorDesyncError, RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=16, batch_size=4, log_capacity=64,
+        transport="single", mirror_check_every=1,
+        mirror_exchange_timeout_s=5.0,
+    )
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+    def _boom(x):
+        raise OSError("fabric gone")
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather", _boom)
+    with pytest.raises(MirrorDesyncError, match="fabric gone"):
+        e.step_event()
