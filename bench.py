@@ -57,6 +57,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.admission import Overloaded
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.comm import SingleDeviceComm
 from raft_tpu.core.state import init_state
@@ -561,6 +562,69 @@ def bench_read_index() -> dict:
         "note": ("batched reads confirm on the write ticks' rounds; "
                  "batched wall time includes the write traffic itself"),
     }
+
+
+# ------------------------------------------------------ overload sweep
+def bench_overload() -> dict:
+    """Offered-load sweep (docs/OVERLOAD.md): open-loop Poisson arrivals
+    at 1x / 2x / 5x the cluster's ingest capacity against an
+    admission-gated engine on the VIRTUAL clock, reporting goodput
+    (committed entries per virtual second), shed rate, and the p50/p99
+    admission queue delay (head-of-queue sojourn). The virtual clock
+    makes the rows deterministic and backend-independent — this leg
+    measures the admission POLICY (what fraction of offered load becomes
+    goodput, and what queueing the admitted traffic pays), not device
+    speed; the other legs own the kernel numbers. Each multiplier's row
+    is emitted incrementally like the multi-group sweep."""
+    import random as _random
+
+    from raft_tpu.chaos.runner import poisson
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=64, batch_size=64, log_capacity=1 << 11,
+        transport="single", seed=11,
+        admission_max_writes=256, admission_max_reads=1024,
+        admission_target_delay_s=4.0, admission_interval_s=20.0,
+    )
+    t = SingleDeviceTransport(cfg)     # compiled programs shared by rows
+    capacity = cfg.batch_size / cfg.heartbeat_period
+    window_s = 240.0
+    payload = bytes(cfg.entry_bytes)
+    rows = {}
+    for mult in (1, 2, 5):
+        e = RaftEngine(cfg, t)
+        e.run_until_leader()
+        rng = _random.Random(f"bench-overload:{mult}")
+        slice_s = cfg.heartbeat_period
+        offered = shed = 0
+        t0v = e.clock.now
+        while e.clock.now < t0v + window_s:
+            for _ in range(poisson(rng, mult * capacity * slice_s)):
+                offered += 1
+                try:
+                    e.submit(payload)
+                except Overloaded:
+                    shed += 1
+            e.run_for(slice_s)
+        elapsed = e.clock.now - t0v
+        rep = e.admission.report(queue_depth=len(e._queue))
+        rows[f"x{mult}"] = _emit_leg(f"overload_x{mult}", {
+            "rate_mult": mult,
+            "capacity_eps": capacity,
+            "offered": offered,
+            "shed": shed,
+            "shed_rate": round(shed / max(offered, 1), 4),
+            "goodput_eps": round(len(e.commit_time) / elapsed, 2),
+            "queue_delay_p50_s": round(rep.queue_delay_p50_s, 3),
+            "queue_delay_p99_s": round(rep.queue_delay_p99_s, 3),
+            "depth_high_water": rep.depth_high_water,
+            "depth_bound": rep.max_writes,
+            "shed_by_reason": rep.shed,
+            "virtual_window_s": window_s,
+        })
+    return rows
 
 
 # ------------------------------------------------- mesh per-device kernel
@@ -1171,6 +1235,7 @@ def main(argv=None) -> None:
         ("mesh1_per_device", lambda: bench_mesh1(rng)),
         ("read_index", bench_read_index),
         ("client_chunk", bench_client_latency),
+        ("overload", bench_overload),
     ):
         configs[name] = dl.run(name, leg)
     if dl.expired:

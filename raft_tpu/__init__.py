@@ -20,13 +20,15 @@ See SURVEY.md for the full structural analysis of the reference and
 BASELINE.md for the target numbers.
 """
 
+from raft_tpu.admission import Overloaded
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import ReplicaState, init_state
 from raft_tpu.multi import MultiEngine, Router
 from raft_tpu.raft.engine import RaftEngine
 
 __all__ = [
-    "MultiEngine", "RaftConfig", "RaftEngine", "ReplicaState", "Router",
+    "MultiEngine", "Overloaded", "RaftConfig", "RaftEngine",
+    "ReplicaState", "Router",
     "init_state",
 ]
 

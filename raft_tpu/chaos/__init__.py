@@ -34,7 +34,14 @@ from raft_tpu.chaos.checker import (
 )
 from raft_tpu.chaos.history import History, OpRecord
 from raft_tpu.chaos.nemesis import Nemesis, NemesisAction
-from raft_tpu.chaos.runner import TortureReport, torture_run, torture_run_multi
+from raft_tpu.chaos.runner import (
+    OverloadReport,
+    TortureReport,
+    overload_run,
+    poisson,
+    torture_run,
+    torture_run_multi,
+)
 from raft_tpu.chaos.storage import MirroredStore
 from raft_tpu.chaos.transport import ChaosTransport
 
@@ -48,7 +55,10 @@ __all__ = [
     "OpRecord",
     "Nemesis",
     "NemesisAction",
+    "OverloadReport",
     "TortureReport",
+    "overload_run",
+    "poisson",
     "torture_run",
     "torture_run_multi",
     "MirroredStore",

@@ -12,7 +12,7 @@ import argparse
 import json
 import sys
 
-from raft_tpu.chaos.runner import torture_run, torture_run_multi
+from raft_tpu.chaos.runner import overload_run, torture_run, torture_run_multi
 
 
 def main(argv=None) -> int:
@@ -34,6 +34,19 @@ def main(argv=None) -> int:
     ap.add_argument("--no-crash", action="store_true")
     ap.add_argument("--no-msg", action="store_true")
     ap.add_argument("--no-storage", action="store_true")
+    ap.add_argument("--overload", action="store_true",
+                    help="arm admission and let the nemesis open "
+                         "open-loop arrival storms at 2-10x capacity, "
+                         "composed with the other fault planes "
+                         "(docs/OVERLOAD.md)")
+    ap.add_argument("--overload-recovery", type=float, default=None,
+                    metavar="MULT",
+                    help="run the deterministic overload-and-recover "
+                         "scenario at MULT x capacity instead of a "
+                         "torture run; succeeds only if the history "
+                         "checks linearizable, the queue bound held, "
+                         "AND goodput recovered inside the documented "
+                         "window")
     ap.add_argument("--broken", choices=["dirty_reads"], default=None,
                     help="deliberately broken client variant; the run "
                          "SUCCEEDS (exit 0) only if the checker rejects "
@@ -42,15 +55,44 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.multi and args.broken:
         ap.error("--broken applies to the single-engine runner only")
+    if args.overload_recovery is not None and (args.multi or args.broken):
+        ap.error("--overload-recovery is a standalone single-engine run")
+
+    ok = True
+    if args.overload_recovery is not None:
+        for seed in range(args.seed, args.seed + args.sweep):
+            rep = overload_run(
+                seed, rate_mult=args.overload_recovery,
+                step_budget=args.step_budget,
+            )
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "rate_mult": rep.rate_mult,
+                "baseline_goodput": rep.baseline_goodput,
+                "overload_goodput": rep.overload_goodput,
+                "recovery_goodput": rep.recovery_goodput,
+                "shed": rep.shed,
+                "queue_depth_max": rep.queue_depth_max,
+                "depth_bound": rep.depth_bound,
+                "recovered_in_s": rep.recovered_in_s,
+                "recovery_ok": rep.recovery_ok,
+            }), flush=True)
+            ok = ok and (
+                rep.verdict == "LINEARIZABLE" and rep.recovery_ok
+                and rep.queue_depth_max <= rep.depth_bound
+            )
+        return 0 if ok else 1
 
     expect = "VIOLATION" if args.broken else "LINEARIZABLE"
-    ok = True
     for seed in range(args.seed, args.seed + args.sweep):
         if args.multi:
             rep = torture_run_multi(
                 seed, n_groups=args.groups, phases=args.phases,
                 clients=args.clients, keys=args.keys,
-                phase_s=args.phase_s, step_budget=args.step_budget,
+                phase_s=args.phase_s, overload=args.overload,
+                step_budget=args.step_budget,
             )
         else:
             rep = torture_run(
@@ -58,7 +100,7 @@ def main(argv=None) -> int:
                 keys=args.keys, phase_s=args.phase_s,
                 crash=not args.no_crash, msg_faults=not args.no_msg,
                 storage_faults=not args.no_storage, broken=args.broken,
-                step_budget=args.step_budget,
+                overload=args.overload, step_budget=args.step_budget,
             )
         print(rep.summary())
         print(json.dumps({
@@ -69,6 +111,8 @@ def main(argv=None) -> int:
             "op_counts": rep.op_counts,
             "crashes": rep.crashes,
             "msg_stats": rep.msg_stats,
+            "shed_ops": rep.shed_ops,
+            "open_loop_ops": rep.open_loop_ops,
             "checker_steps": rep.check.steps,
         }), flush=True)
         ok = ok and rep.verdict == expect

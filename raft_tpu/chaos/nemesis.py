@@ -15,7 +15,14 @@ three fault planes:
 - **crash cycles** — whole-process crash + checkpoint-restore +
   restart, optionally composed with a storage fault against the
   durability stack (``chaos.MirroredStore``: torn vote-WAL append,
-  checkpoint bit-flip, stale-file rollback).
+  checkpoint bit-flip, stale-file rollback);
+- **overload windows** (opt-in, ``allow_overload=True`` — off by
+  default so existing seeds' rng streams replay unchanged) — open-loop
+  Poisson arrival storms at 2-10x the cluster's measured ingest
+  capacity, composable with every other plane. The runner converts the
+  rate into open-loop client traffic; admission-shed arrivals are
+  recorded as sound no-effect failures, so the linearizability verdict
+  must stay ACCEPT through the storm (docs/OVERLOAD.md).
 
 Liveness discipline: every choice is gated so the run can quiesce —
 kills never leave fewer than a majority of members alive (the same rule
@@ -31,7 +38,7 @@ import dataclasses
 import random
 from typing import Dict, List, Optional
 
-from raft_tpu.faults.plan import FaultEvent, FaultPlan
+from raft_tpu.faults.plan import FaultPlan
 
 STORAGE_FAULTS = ("none", "tear_votelog", "flip_bit", "rollback")
 
@@ -48,11 +55,14 @@ class NemesisAction:
     dup: float = 0.0
     delay: float = 0.0
     storage: str = "none"                   # kind == "crash_restart"
+    rate_mult: float = 0.0                  # kind == "overload_on"
 
     def describe(self) -> str:
         if self.kind == "msg_on":
             return (f"msg_on(drop={self.drop:.2f}, dup={self.dup:.2f}, "
                     f"delay={self.delay:.2f})")
+        if self.kind == "overload_on":
+            return f"overload_on(rate={self.rate_mult:.1f}x capacity)"
         if self.kind == "crash_restart":
             return f"crash_restart(storage={self.storage})"
         if self.kind == "partition":
@@ -73,7 +83,7 @@ class Nemesis:
     KINDS = (
         "kill", "recover", "slow", "unslow", "campaign",
         "partition", "heal", "plan", "msg_on", "msg_off",
-        "crash_restart", "none",
+        "crash_restart", "overload_on", "overload_off", "none",
     )
 
     def __init__(
@@ -83,13 +93,19 @@ class Nemesis:
         allow_crash: bool = True,
         allow_msg: bool = True,
         allow_storage: bool = True,
+        allow_overload: bool = False,
     ):
         self.rng = random.Random(f"nemesis:{seed}")
         self.n_rows = n_rows
         self.allow_crash = allow_crash
         self.allow_msg = allow_msg
         self.allow_storage = allow_storage
+        self.allow_overload = allow_overload
+        #   off by default: adding kinds to the choice pool perturbs the
+        #   decision stream, and existing pinned seeds must replay
+        #   byte-identically
         self.msg_window = False
+        self.overload_window = False
         self.cut: List[int] = []
         #   minority side of the active partition; kill gating consults
         #   it so kill x partition can never strand BOTH sides below
@@ -122,6 +138,8 @@ class Nemesis:
             kinds += ["msg_on", "msg_off"]
         if self.allow_crash:
             kinds.append("crash_restart")
+        if self.allow_overload:
+            kinds += ["overload_on", "overload_off"]
         kind = rng.choice(kinds)
         dead = sum(1 for r in members if not alive[r])
         victim = rng.randrange(self.n_rows)
@@ -169,6 +187,17 @@ class Nemesis:
             act = NemesisAction(
                 "crash_restart", storage=rng.choice(pool)
             )
+        elif kind == "overload_on" and not self.overload_window:
+            # open-loop arrival storm: the ISSUE's 2-10x band over the
+            # cluster's measured ingest capacity (the runner converts
+            # the multiplier into a Poisson rate)
+            self.overload_window = True
+            act = NemesisAction(
+                "overload_on", rate_mult=rng.uniform(2.0, 10.0)
+            )
+        elif kind == "overload_off" and self.overload_window:
+            self.overload_window = False
+            act = NemesisAction("overload_off")
         self.log.append(f"t={now:.1f} {act.describe()}")
         return act
 

@@ -34,15 +34,32 @@ and occasional deletes. ``broken="dirty_reads"`` swaps the read path
 for one that serves the latest SUBMITTED (possibly uncommitted) value
 without leadership confirmation — the deliberately broken variant the
 checker must reject, proving the harness has teeth.
+
+Overload model (``overload=True`` / ``overload_run``; docs/OVERLOAD.md).
+The closed-loop clients above are polite — they wait for outcomes — so
+they can never overrun admission. The overload phases add OPEN-LOOP
+traffic: Poisson arrivals at a nemesis-chosen 2-10x multiple of the
+cluster's measured ingest capacity (``batch_size / heartbeat_period``
+entries/s — the most a leader tick can drain), each arrival a one-shot
+write from its own client id (fully concurrent, exactly the
+open-loop assumption). An arrival the admission gate refuses resolves
+``fail`` at once — ``Overloaded`` is raised before anything is queued,
+so failed-without-effect is SOUND and the linearizability verdict must
+stay ACCEPT through the storm. Admitted arrivals resolve like any
+write (ok once durable, info across a crash or at give-up). The
+admission bound is what keeps the harness itself bounded: outstanding
+open-loop state never exceeds the configured queue depth.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import random
 import tempfile
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
+from raft_tpu.admission import Overloaded
 from raft_tpu.chaos.checker import (
     LINEARIZABLE,
     CheckResult,
@@ -55,6 +72,23 @@ from raft_tpu.chaos.transport import ChaosTransport
 from raft_tpu.config import RaftConfig
 
 
+def poisson(rng: random.Random, lam: float) -> int:
+    """One Poisson(lam) draw from a seeded stream (open-loop arrival
+    counts per drive slice). Knuth's product method below ~700 (exp
+    underflow bound), normal approximation above."""
+    if lam <= 0:
+        return 0
+    if lam > 700.0:
+        return max(0, int(round(rng.gauss(lam, math.sqrt(lam)))))
+    limit = math.exp(-lam)
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
 @dataclasses.dataclass
 class TortureReport:
     seed: int
@@ -65,6 +99,8 @@ class TortureReport:
     msg_stats: Dict[str, int]
     nemesis_log: List[str]
     repro: str
+    shed_ops: int = 0          # admission-refused arrivals (fail, no effect)
+    open_loop_ops: int = 0     # open-loop arrivals generated in total
 
     @property
     def verdict(self) -> str:
@@ -85,6 +121,21 @@ def _default_cfg(seed: int) -> RaftConfig:
     return RaftConfig(
         n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
         transport="single", seed=seed,
+    )
+
+
+def _overload_cfg(seed: int) -> RaftConfig:
+    """The torture config with admission armed: bounded queues + the
+    delay controller, sized to the toy cluster (capacity 2 entries/s:
+    batch 4 per 2 s tick). Depth 16 = 8 s of queue at capacity; the
+    delay controller targets two ticks of sojourn judged over one
+    election-timeout-scale interval."""
+    return dataclasses.replace(
+        _default_cfg(seed),
+        admission_max_writes=16,
+        admission_max_reads=64,
+        admission_target_delay_s=4.0,
+        admission_interval_s=20.0,
     )
 
 
@@ -136,6 +187,17 @@ class _TortureBase:
         self.keys = [f"k{i}".encode() for i in range(keys)]
         self.clients = [_Client(c, seed, self.keys) for c in range(clients)]
         self.crashes = 0
+        # open-loop overload state (only driven when a runner arms it)
+        self.shed_ops = 0          # admission refusals (recorded fail)
+        self.ol_submitted = 0      # open-loop arrivals generated
+        self._ol_rate = 0.0        # arrivals/s while a window is open
+        self._ol_counter = 0
+        self._ol_rng = random.Random(f"openloop:{seed}")
+        self._ol_pending: List[Tuple[OpRecord, object, float]] = []
+        #   (record, engine-specific seq handle, invoke time) of admitted
+        #   open-loop writes awaiting durability — bounded by the
+        #   admission depth bound, which is what keeps the harness's own
+        #   memory bounded under any offered load
 
     def _give_up(self, cl: _Client) -> bool:
         """Client-side op timeout (see OP_TIMEOUT_S); True if resolved."""
@@ -168,16 +230,44 @@ class _TortureBase:
     def quiesce(self) -> None:
         raise NotImplementedError
 
+    def _ol_durable(self, handle) -> bool:
+        """Engine-specific durability check for an open-loop write."""
+        raise NotImplementedError
+
+    def _poll_open_loop(self) -> None:
+        keep = []
+        for rec, handle, t0 in self._ol_pending:
+            if self._ol_durable(handle):
+                rec.ok(self.history.stamp(self.now()))
+            elif self.now() - t0 > self.OP_TIMEOUT_S:
+                rec.info()     # may still commit: both worlds stay open
+            else:
+                keep.append((rec, handle, t0))
+        self._ol_pending = keep
+
+    def _resolve_open_loop_info(self) -> None:
+        """Crash path: every admitted-but-unresolved open-loop write may
+        or may not have committed — close as info."""
+        for rec, _, _ in self._ol_pending:
+            rec.info()
+        self._ol_pending = []
+
     # the loop -----------------------------------------------------------
     def _poll_all(self) -> None:
         for cl in self.clients:
             if cl.rec is not None:
                 self.poll(cl)
+        if self._ol_pending:
+            self._poll_open_loop()
 
     def _invoke_idle(self) -> None:
         for cl in self.clients:
             if cl.rec is None:
                 self.invoke(cl)
+
+    def pump_open_loop(self, dt: float) -> None:
+        """Open-loop arrival hook, called once per drive slice; the
+        base workload is closed-loop only (overload runners override)."""
 
     def run_phases(self, nemesis: Nemesis) -> None:
         for _ in range(self.phases):
@@ -190,6 +280,7 @@ class _TortureBase:
             # drive in slices so completions are stamped near the event
             # that produced them, not at phase granularity
             for _ in range(4):
+                self.pump_open_loop(self.phase_s / 4)
                 self.drive(self.phase_s / 4)
                 self._poll_all()
                 self._invoke_idle()
@@ -209,16 +300,21 @@ def torture_run(
     msg_faults: bool = True,
     storage_faults: bool = True,
     broken: Optional[str] = None,
+    overload: bool = False,
     step_budget: int = 500_000,
 ) -> TortureReport:
-    """One full single-engine torture run; see module docstring."""
+    """One full single-engine torture run; see module docstring.
+    ``overload=True`` arms admission (``_overload_cfg`` unless ``cfg``
+    is given) and lets the nemesis open 2-10x open-loop arrival
+    windows, composable with every other fault plane."""
     run = _SingleTorture(
         seed, phases, clients, keys, phase_s,
-        cfg or _default_cfg(seed), workdir, broken,
+        cfg or (_overload_cfg(seed) if overload else _default_cfg(seed)),
+        workdir, broken,
     )
     nemesis = Nemesis(
         seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
-        allow_storage=storage_faults,
+        allow_storage=storage_faults, allow_overload=overload,
     )
     run.run_phases(nemesis)
     check = check_history(run.history, step_budget=step_budget)
@@ -231,6 +327,8 @@ def torture_run(
         flags.append("--no-storage")
     if broken:
         flags.append(f"--broken {broken}")
+    if overload:
+        flags.append("--overload")
     repro = (
         f"python -m raft_tpu.chaos --seed {seed} --phases {phases} "
         f"--clients {clients} --keys {keys} --phase-s {phase_s:g}"
@@ -240,6 +338,7 @@ def torture_run(
         seed=seed, check=check, ops=len(run.history),
         op_counts=run.history.counts(), crashes=run.crashes,
         msg_stats=run.chaos_t.stats, nemesis_log=nemesis.log, repro=repro,
+        shed_ops=run.shed_ops, open_loop_ops=run.ol_submitted,
     )
 
 
@@ -320,6 +419,44 @@ class _SingleTorture(_TortureBase):
     def drive(self, seconds: float) -> None:
         self.engine.run_for(seconds)
 
+    # ------------------------------------------------------ open loop
+    @property
+    def capacity_eps(self) -> float:
+        """Measured ingest capacity (entries/s): a leader tick drains at
+        most one batch, so batch_size per heartbeat_period is the most
+        the cluster can commit sustained — the base the nemesis's 2-10x
+        multipliers scale."""
+        return self.cfg.batch_size / self.cfg.heartbeat_period
+
+    def set_overload_rate(self, rate_mult: float) -> None:
+        self._ol_rate = rate_mult * self.capacity_eps
+
+    def pump_open_loop(self, dt: float) -> None:
+        """Poisson(rate * dt) one-shot writers, each its own client id
+        (fully concurrent — the open-loop model). A refusal resolves
+        ``fail`` immediately: ``Overloaded`` raises before anything is
+        queued, so no-effect is provable."""
+        if self._ol_rate <= 0:
+            return
+        n = poisson(self._ol_rng, self._ol_rate * dt)
+        for _ in range(n):
+            self._ol_counter += 1
+            self.ol_submitted += 1
+            cid = 1000 + self._ol_counter
+            key = self._ol_rng.choice(self.keys)
+            value = f"ol{self._ol_counter}".encode()
+            rec = self.history.invoke(cid, WRITE, key, value, self.now())
+            try:
+                seq = self.kv.set(key, value, client=cid)
+            except Overloaded:
+                self.shed_ops += 1
+                rec.fail(self.history.stamp(self.now()))
+                continue
+            self._ol_pending.append((rec, seq, self.now()))
+
+    def _ol_durable(self, handle) -> bool:
+        return self.engine.is_durable(handle)
+
     def invoke(self, cl: _Client) -> None:
         from raft_tpu.raft.engine import LinearizableReadRefused
 
@@ -343,14 +480,24 @@ class _SingleTorture(_TortureBase):
                 return
             try:
                 cl.ticket = self.engine.submit_read()
-            except LinearizableReadRefused:
-                cl.rec.fail(self.history.stamp(self.now()))   # refused before any effect
+            except (LinearizableReadRefused, Overloaded):
+                # refused before any effect (read-lane admission refuses
+                # before minting a ticket)
+                cl.rec.fail(self.history.stamp(self.now()))
                 cl.rec, cl.ticket = None, None
             return
         cl.rec = self.history.invoke(cl.cid, op, key, value, self.now())
-        cl.seq = (
-            self.kv.set(key, value) if op == WRITE else self.kv.delete(key)
-        )
+        try:
+            cl.seq = (
+                self.kv.set(key, value, client=cl.cid) if op == WRITE
+                else self.kv.delete(key, client=cl.cid)
+            )
+        except Overloaded:
+            # shed before queueing: provably no effect
+            self.shed_ops += 1
+            cl.rec.fail(self.history.stamp(self.now()))
+            cl.rec, cl.seq = None, None
+            return
         self._dirty[key] = value if op == WRITE else None
 
     def poll(self, cl: _Client) -> None:
@@ -411,6 +558,10 @@ class _SingleTorture(_TortureBase):
             self.chaos_t.clear_message_faults()
         elif act.kind == "crash_restart":
             self._crash_restart(act.storage)
+        elif act.kind == "overload_on":
+            self.set_overload_rate(act.rate_mult)
+        elif act.kind == "overload_off":
+            self._ol_rate = 0.0
 
     def _crash_restart(self, storage: str) -> None:
         # resolve in-flight ops against the dying engine: writes may
@@ -424,6 +575,7 @@ class _SingleTorture(_TortureBase):
             else:
                 cl.rec.info()
             cl.rec, cl.ticket, cl.seq = None, None, None
+        self._resolve_open_loop_info()
         self.store.save(self.engine)
         if storage == "tear_votelog":
             self.store.tear_votelog(self.storage_rng)
@@ -442,6 +594,7 @@ class _SingleTorture(_TortureBase):
     def quiesce(self) -> None:
         """Heal every fault plane, then resolve all outstanding ops."""
         e = self.engine
+        self._ol_rate = 0.0        # overload window ends with the run
         self._msg_params = None
         self.chaos_t.clear_message_faults()
         e.heal_partition()
@@ -450,7 +603,18 @@ class _SingleTorture(_TortureBase):
             if e.member[r] and not e.alive[r]:
                 e.recover(r)
             e.set_slow(r, False)
-        probe = e.submit(bytes(self.cfg.entry_bytes))
+        probe = None
+        for _ in range(200):
+            try:
+                probe = e.submit(bytes(self.cfg.entry_bytes))
+                break
+            except Overloaded:
+                # the gate is still draining the overload backlog; give
+                # it ticks — arrivals have stopped, so depth and delay
+                # both fall monotonically from here
+                e.run_for(2 * self.cfg.heartbeat_period)
+                self._poll_all()
+        assert probe is not None, "admission never re-opened at quiesce"
         e.run_until_committed(probe, limit=3000.0)
         for _ in range(40):
             self._poll_all()
@@ -472,6 +636,7 @@ def torture_run_multi(
     keys: int = 6,
     phase_s: float = 30.0,
     cfg: Optional[RaftConfig] = None,
+    overload: bool = False,
     step_budget: int = 500_000,
 ) -> TortureReport:
     """Multi-Raft torture: the sharded Router/ShardedKV client surface
@@ -479,13 +644,18 @@ def torture_run_multi(
     ``MultiEngine`` has no checkpoint/restore or pluggable transport yet
     (its module docstring scopes both); per-key histories across groups
     are the point: the Router must keep every key's subhistory
-    linearizable while sibling groups fail independently."""
+    linearizable while sibling groups fail independently.
+    ``overload=True`` arms the per-group queue bounds and lets the
+    nemesis open open-loop arrival windows routed through a no-retry
+    Router (shed = ``fail``, same soundness argument as the single
+    engine)."""
     run = _MultiTorture(
-        seed, phases, clients, keys, phase_s, cfg, n_groups
+        seed, phases, clients, keys, phase_s, cfg, n_groups,
+        overload=overload,
     )
     nemesis = Nemesis(
         seed, run.cfg.n_replicas, allow_crash=False, allow_msg=False,
-        allow_storage=False,
+        allow_storage=False, allow_overload=overload,
     )
     run.run_phases(nemesis)
     check = check_history(run.history, step_budget=step_budget)
@@ -493,16 +663,19 @@ def torture_run_multi(
         f"python -m raft_tpu.chaos --seed {seed} --multi "
         f"--groups {n_groups} --phases {phases} --clients {clients} "
         f"--keys {keys} --phase-s {phase_s:g}"
+        + (" --overload" if overload else "")
     )
     return TortureReport(
         seed=seed, check=check, ops=len(run.history),
         op_counts=run.history.counts(), crashes=0,
         msg_stats={}, nemesis_log=nemesis.log, repro=repro,
+        shed_ops=run.shed_ops, open_loop_ops=run.ol_submitted,
     )
 
 
 class _MultiTorture(_TortureBase):
-    def __init__(self, seed, phases, clients, keys, phase_s, cfg, n_groups):
+    def __init__(self, seed, phases, clients, keys, phase_s, cfg, n_groups,
+                 overload: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s)
         from raft_tpu.examples.kv_sharded import ShardedKV
         from raft_tpu.multi.engine import MultiEngine
@@ -511,10 +684,15 @@ class _MultiTorture(_TortureBase):
         self.cfg = cfg or RaftConfig(
             n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
             transport="single", seed=seed,
+            admission_max_writes=(16 if overload else None),
         )
         self.engine = MultiEngine(self.cfg, n_groups)
         self.engine.seed_leaders()
         self.router = Router(self.engine)
+        self._ol_router = Router(self.engine, max_retries=0)
+        #   open-loop arrivals do not retry: a refused one-shot writer
+        #   is SHED (fail, no effect) — retrying it would re-close the
+        #   loop the overload model exists to open
         self.kv = ShardedKV(self.engine, self.router)
         self.partitioned = False
         self._part_group: Optional[int] = None
@@ -537,6 +715,51 @@ class _MultiTorture(_TortureBase):
     def drive(self, seconds: float) -> None:
         self.engine.run_for(seconds)
 
+    # ------------------------------------------------------ open loop
+    @property
+    def capacity_eps(self) -> float:
+        """Aggregate measured ingest capacity over the groups the key
+        set actually routes to (each group drains at most one batch per
+        tick)."""
+        covered = len({self.router.group_of(k) for k in self.keys})
+        return covered * self.cfg.batch_size / self.cfg.heartbeat_period
+
+    def set_overload_rate(self, rate_mult: float) -> None:
+        self._ol_rate = rate_mult * self.capacity_eps
+
+    def pump_open_loop(self, dt: float) -> None:
+        from raft_tpu.examples.kv import _SET, encode_op
+        from raft_tpu.multi.engine import NotLeader
+
+        if self._ol_rate <= 0:
+            return
+        n = poisson(self._ol_rng, self._ol_rate * dt)
+        for _ in range(n):
+            self._ol_counter += 1
+            self.ol_submitted += 1
+            cid = 1000 + self._ol_counter
+            key = self._ol_rng.choice(self.keys)
+            value = f"ol{self._ol_counter}".encode()
+            rec = self.history.invoke(cid, WRITE, key, value, self.now())
+            try:
+                handle = self._ol_router.submit(
+                    key, encode_op(self.cfg.entry_bytes, _SET, key, value)
+                )
+            except Overloaded:
+                self.shed_ops += 1
+                rec.fail(self.history.stamp(self.now()))
+                continue
+            except NotLeader:
+                # leadership gap, not admission — still provably no
+                # effect (refused before queueing), still a clean fail
+                rec.fail(self.history.stamp(self.now()))
+                continue
+            self._ol_pending.append((rec, handle, self.now()))
+
+    def _ol_durable(self, handle) -> bool:
+        g, seq = handle
+        return self.engine.is_durable(g, seq)
+
     def invoke(self, cl: _Client) -> None:
         from raft_tpu.multi.engine import NotLeader
 
@@ -557,9 +780,13 @@ class _MultiTorture(_TortureBase):
                 self.kv.set(key, value) if op == WRITE
                 else self.kv.delete(key)
             )
-        except NotLeader:
+        except (NotLeader, Overloaded) as ex:
             # nothing was queued (submit_to_leader refuses before
-            # queueing; read_index confirms nothing): provably no effect
+            # queueing; read_index confirms nothing; admission and the
+            # router's breaker refuse before any effect): provably no
+            # effect
+            if isinstance(ex, Overloaded):
+                self.shed_ops += 1
             cl.rec.fail(self.history.stamp(self.now()))
             cl.rec, cl.seq = None, None
 
@@ -607,9 +834,14 @@ class _MultiTorture(_TortureBase):
             e.schedule_faults(FaultPlan([
                 dataclasses.replace(ev, group=g) for ev in act.plan.events
             ]))
+        elif act.kind == "overload_on":
+            self.set_overload_rate(act.rate_mult)
+        elif act.kind == "overload_off":
+            self._ol_rate = 0.0
 
     def quiesce(self) -> None:
         e = self.engine
+        self._ol_rate = 0.0
         for g in range(e.G):
             e.heal_partition(g)
             for r in range(self.cfg.n_replicas):
@@ -624,3 +856,196 @@ class _MultiTorture(_TortureBase):
             if all(cl.rec is None for cl in self.clients):
                 break
             e.run_for(4 * self.cfg.heartbeat_period)
+
+
+# ---------------------------------------------------- overload recovery
+@dataclasses.dataclass
+class OverloadReport:
+    """One seeded overload-and-recover scenario (``overload_run``):
+    baseline -> open-loop storm at ``rate_mult`` x capacity -> arrivals
+    subside -> recovery. The anti-metastability property is
+    ``recovery_ok``: goodput back to >= ``recover_frac`` of the
+    pre-overload baseline, with the delay controller quiet, within
+    ``recovery_window_s`` virtual seconds of the storm ending — plus
+    the safety half: the host queue never exceeded its bound and the
+    client history (shed ops recorded as no-effect failures) checked
+    linearizable."""
+
+    seed: int
+    rate_mult: float
+    capacity_eps: float
+    baseline_goodput: float          # committed entries/s, pre-storm
+    overload_goodput: float          # committed entries/s, during
+    recovery_goodput: float          # rolling goodput at recovery detect
+    shed: Dict[str, int]             # gate refusals by reason
+    admitted: Dict[str, int]
+    open_loop_ops: int
+    depth_bound: int
+    depth_high_water: int            # gate-observed arrival-time max
+    queue_depth_max: int             # directly sampled queue depth max
+    queue_delay_p99_overload_s: float
+    queue_delay_p99_recovery_s: float
+    recovered_in_s: Optional[float]  # None = never within the window
+    recovery_window_s: float
+    recovery_ok: bool
+    check: CheckResult
+    ops: int
+    op_counts: Dict[str, int]
+    repro: str
+
+    @property
+    def verdict(self) -> str:
+        return self.check.verdict
+
+    def summary(self) -> str:
+        rec = ("never" if self.recovered_in_s is None
+               else f"{self.recovered_in_s:.0f}s")
+        return (
+            f"seed {self.seed} x{self.rate_mult:g}: {self.verdict}, "
+            f"goodput {self.baseline_goodput:.2f}->"
+            f"{self.overload_goodput:.2f}->{self.recovery_goodput:.2f} e/s, "
+            f"shed {sum(self.shed.values())}/{self.open_loop_ops}, "
+            f"depth max {self.queue_depth_max}/{self.depth_bound}, "
+            f"recovered in {rec} (window {self.recovery_window_s:g}s)"
+        )
+
+
+def overload_run(
+    seed: int,
+    rate_mult: float = 5.0,
+    baseline_s: float = 120.0,
+    overload_s: float = 180.0,
+    recovery_window_s: float = 300.0,
+    recover_frac: float = 0.9,
+    cfg: Optional[RaftConfig] = None,
+    step_budget: int = 500_000,
+) -> OverloadReport:
+    """The deterministic overload scenario behind the acceptance
+    criterion (no composed process faults — ``torture_run(overload=
+    True)`` composes; this run isolates the admission story so the
+    recovery assertion is crisp):
+
+    1. *Baseline*: closed-loop clients plus a polite open-loop trickle
+       at half capacity; measure goodput (committed entries/s).
+    2. *Storm*: open-loop Poisson arrivals at ``rate_mult`` x measured
+       capacity for ``overload_s``. The queue must never exceed its
+       bound; excess arrivals shed as typed no-effect refusals.
+    3. *Recovery*: arrivals drop back to the trickle. Goodput must
+       return to >= ``recover_frac`` of baseline — with the delay
+       controller out of its shedding state — within
+       ``recovery_window_s`` (the documented recovery window,
+       docs/OVERLOAD.md). A system with queues allowed to grow
+       unboundedly fails exactly this: it keeps paying the backlog long
+       after the storm (the metastable signature).
+
+    The client history (closed-loop ops + every open-loop arrival, shed
+    ones as ``fail``) goes through the linearizability checker like any
+    torture run.
+    """
+    run = _SingleTorture(
+        seed, 0, 2, 3, 30.0,
+        cfg or _overload_cfg(seed), None, None,
+    )
+    e = run.engine
+    gate = e.admission
+    assert gate is not None, "overload_run needs admission configured"
+    base_rate = 0.5 * run.capacity_eps
+    slice_s = 2 * run.cfg.heartbeat_period
+    depth_max = 0
+
+    def window(seconds: float, rate: float) -> None:
+        nonlocal depth_max
+        run._ol_rate = rate
+        t_end = run.now() + seconds
+        while run.now() < t_end:
+            run._invoke_idle()
+            run.pump_open_loop(slice_s)
+            depth_max = max(depth_max, len(e._queue))
+            run.drive(slice_s)
+            depth_max = max(depth_max, len(e._queue))
+            run._poll_all()
+
+    def commits_in(t0: float, t1: float) -> int:
+        return sum(1 for t in e.commit_time.values() if t0 < t <= t1)
+
+    # 1. baseline ------------------------------------------------------
+    def delay_mark() -> int:
+        # CUMULATIVE sample index: stable across the gate's buffer trim
+        # (delay_samples drops its older half past MAX_DELAY_SAMPLES)
+        return gate.delay_dropped + len(gate.delay_samples)
+
+    t0 = run.now()
+    window(baseline_s, base_rate)
+    t1 = run.now()
+    baseline_goodput = commits_in(t0, t1) / (t1 - t0)
+    delay_mark_base = delay_mark()
+
+    # 2. storm ---------------------------------------------------------
+    window(overload_s, rate_mult * run.capacity_eps)
+    t2 = run.now()
+    overload_goodput = commits_in(t1, t2) / (t2 - t1)
+    delay_mark_storm = delay_mark()
+
+    # 3. recovery ------------------------------------------------------
+    run._ol_rate = base_rate
+    roll_s = min(60.0, recovery_window_s / 2)
+    recovered_in = None
+    recovery_goodput = 0.0
+    while run.now() < t2 + recovery_window_s:
+        window(slice_s, base_rate)
+        now = run.now()
+        rolling = commits_in(now - roll_s, now) / roll_s
+        head_delay = 0.0
+        if e._queue:
+            head_delay = now - e.submit_time.get(e._queue[0][0], now)
+        if (now - t2 >= roll_s
+                and rolling >= recover_frac * baseline_goodput
+                and not gate.shedding
+                and head_delay < gate.target_delay_s):
+            recovered_in = now - t2
+            recovery_goodput = rolling
+            break
+    if recovered_in is None:
+        now = run.now()
+        recovery_goodput = commits_in(now - roll_s, now) / roll_s
+    def delay_p99(lo: int, hi: int) -> float:
+        # cumulative marks -> current buffer offsets; samples trimmed
+        # away mid-phase just shrink the slice (the retained half is
+        # the recent one, which is the regime the percentile reports)
+        lo = max(0, lo - gate.delay_dropped)
+        hi = max(0, hi - gate.delay_dropped)
+        window = gate.delay_samples[lo:hi]
+        if not window:
+            return float("nan")
+        import numpy as np
+
+        return float(np.percentile(window, 99))
+
+    q_p99_storm = delay_p99(delay_mark_base, delay_mark_storm)
+    q_p99_rec = delay_p99(delay_mark_storm, delay_mark())
+
+    run._ol_rate = 0.0
+    run.quiesce()
+    run.history.close()
+    check = check_history(run.history, step_budget=step_budget)
+    report = gate.report(queue_depth=len(e._queue))
+    return OverloadReport(
+        seed=seed, rate_mult=rate_mult, capacity_eps=run.capacity_eps,
+        baseline_goodput=baseline_goodput,
+        overload_goodput=overload_goodput,
+        recovery_goodput=recovery_goodput,
+        shed=report.shed, admitted=report.admitted,
+        open_loop_ops=run.ol_submitted,
+        depth_bound=gate.max_writes,
+        depth_high_water=report.depth_high_water,
+        queue_depth_max=depth_max,
+        queue_delay_p99_overload_s=q_p99_storm,
+        queue_delay_p99_recovery_s=q_p99_rec,
+        recovered_in_s=recovered_in,
+        recovery_window_s=recovery_window_s,
+        recovery_ok=recovered_in is not None,
+        check=check, ops=len(run.history),
+        op_counts=run.history.counts(),
+        repro=(f"python -m raft_tpu.chaos --seed {seed} "
+               f"--overload-recovery {rate_mult:g}"),
+    )

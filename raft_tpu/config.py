@@ -111,6 +111,33 @@ class RaftConfig:
     # mismatch — fail-stop either way.
     mirror_exchange_timeout_s: float = 60.0
 
+    # --- overload admission (raft_tpu.admission; docs/OVERLOAD.md) ---
+    # Bounded host-queue admission with typed refusals. Both caps default
+    # None = the legacy unbounded behavior (no gate is built at all).
+    # admission_max_writes: write-queue depth bound. An arrival that finds
+    #   the queue at the bound is refused with ``Overloaded("depth")``
+    #   before anything is queued; host memory stays bounded no matter
+    #   the offered load.
+    # admission_max_reads: outstanding read-ticket bound. Beyond it,
+    #   ``submit_read`` refuses with ``Overloaded("read_depth")`` instead
+    #   of silently FIFO-evicting someone else's ticket (the 2^16
+    #   eviction cap remains as the abandoned-ticket backstop).
+    admission_max_writes: Optional[int] = None
+    admission_max_reads: Optional[int] = None
+    # CoDel-style queue-delay controller (write lane only; virtual
+    # clock): once the head-of-queue sojourn has stayed >= target for a
+    # full interval, new writes are refused (``Overloaded("delay")``)
+    # until an observation comes back under target. Defaults sized to
+    # the reference's 2 s tick cadence — target two ticks of queueing,
+    # judged over an election-timeout-scale interval.
+    admission_target_delay_s: float = 4.0
+    admission_interval_s: float = 30.0
+    # Per-client fair-share accounting under congestion: a client whose
+    # share of recently admitted writes exceeds twice its fair share is
+    # refused (``Overloaded("fair_share")``) while lighter clients are
+    # still admitted. Only applies to submits that carry a client id.
+    admission_fair_share: bool = True
+
     # --- steady-state program dispatch ---
     # "auto": run the repair-free step program whenever the last step showed
     #   every live non-slow follower caught up (~11% faster on the 3-replica
@@ -187,6 +214,14 @@ class RaftConfig:
             raise ValueError('steady_dispatch must be "auto" or "off"')
         if self.pipeline_max_laps < 1:
             raise ValueError("pipeline_max_laps must be >= 1")
+        if self.admission_max_writes is not None and self.admission_max_writes < 1:
+            raise ValueError("admission_max_writes must be >= 1 (or None)")
+        if self.admission_max_reads is not None and self.admission_max_reads < 1:
+            raise ValueError("admission_max_reads must be >= 1 (or None)")
+        if self.admission_target_delay_s <= 0 or self.admission_interval_s <= 0:
+            raise ValueError(
+                "admission_target_delay_s and admission_interval_s must be > 0"
+            )
         if self.mirror_exchange_timeout_s <= 0:
             raise ValueError("mirror_exchange_timeout_s must be > 0")
         if self.shard_bytes % 4:

@@ -73,14 +73,19 @@ class ReplicatedKV:
     def _encode(self, op: int, key: bytes, value: bytes) -> bytes:
         return encode_op(self.engine.cfg.entry_bytes, op, key, value)
 
-    def set(self, key: bytes, value: bytes) -> int:
+    def set(self, key: bytes, value: bytes, client=None) -> int:
         """Queue a SET; returns the engine seq. Durable (and visible to
         ``get``) once the engine commits it — check
-        ``engine.is_durable(seq)`` or run until committed."""
-        return self.engine.submit(self._encode(_SET, key, value))
+        ``engine.is_durable(seq)`` or run until committed. ``client``
+        is the opaque id the admission gate's fair-share accounting
+        keys on (``raft_tpu.admission``); with admission configured the
+        submit may raise ``Overloaded`` before anything is queued."""
+        return self.engine.submit(self._encode(_SET, key, value),
+                                  client=client)
 
-    def delete(self, key: bytes) -> int:
-        return self.engine.submit(self._encode(_DELETE, key, b""))
+    def delete(self, key: bytes, client=None) -> int:
+        return self.engine.submit(self._encode(_DELETE, key, b""),
+                                  client=client)
 
     def get(self, key: bytes) -> Optional[bytes]:
         """Read from LOCAL applied (committed) state.

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.admission import Overloaded
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import (
     ReplicaState,
@@ -161,6 +162,18 @@ class MultiEngine:
         self.commit_watermark = np.zeros(n_groups, np.int64)
 
         self._queue: List[List[Tuple[int, bytes]]] = [[] for _ in range(n_groups)]
+        self._admit_cap = cfg.admission_max_writes
+        #   Per-group bounded admission (docs/OVERLOAD.md): each group's
+        #   queue refuses at the same configured depth bound with
+        #   ``admission.Overloaded`` carrying the group, so the Router's
+        #   backoff/budget/breaker discipline can act per group. The
+        #   single engine's fuller gate (delay controller, fair share)
+        #   is not replicated here — the depth bound is what bounds host
+        #   memory, and the Router is the front end that sheds.
+        self.shed_by_group: List[Dict[str, int]] = [
+            {} for _ in range(n_groups)
+        ]
+        self.depth_high_water = np.zeros(n_groups, np.int64)
         self._next_seq = [1] * n_groups
         self._seq_at_index: List[Dict[int, int]] = [{} for _ in range(n_groups)]
         self._uncommitted: List[Dict[int, Tuple[bytes, int]]] = [
@@ -235,10 +248,23 @@ class MultiEngine:
         """Queue one entry on group ``g``; returns its per-group sequence
         number. Durability semantics match ``RaftEngine.submit``: durable
         once ``is_durable(g, seq)``; entries in flight across a
-        leadership change may be dropped and simply never read durable."""
+        leadership change may be dropped and simply never read durable.
+        With ``cfg.admission_max_writes`` set, an arrival that finds the
+        group's queue at the bound raises ``admission.Overloaded``
+        (``.group`` set) before anything is queued."""
         if len(payload) != self.cfg.entry_bytes:
             raise ValueError(
                 f"payload must be exactly {self.cfg.entry_bytes} bytes"
+            )
+        depth = len(self._queue[g])
+        self.depth_high_water[g] = max(int(self.depth_high_water[g]), depth)
+        if self._admit_cap is not None and depth >= self._admit_cap:
+            shed = self.shed_by_group[g]
+            shed["depth"] = shed.get("depth", 0) + 1
+            raise Overloaded(
+                "depth", self.cfg.heartbeat_period,
+                f"group {g} write queue at bound {self._admit_cap}",
+                group=g,
             )
         seq = self._next_seq[g]
         self._next_seq[g] += 1

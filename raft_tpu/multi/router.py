@@ -13,34 +13,78 @@ the same group), and leadership is confirmed once per *group*, not once
 per request. With the engine's same-tick launch fusion, a bucketed
 submit burst across all G groups then replicates via shared batched
 launches rather than G independent dispatch streams.
+
+The retry loop carries the full client-side overload discipline
+(``raft_tpu.admission.retry``; docs/OVERLOAD.md): jittered exponential
+backoff between attempts, a router-wide retry BUDGET (a token bucket
+refilled by successes — sustained retry traffic is capped at a fraction
+of goodput, so a refusal wave cannot amplify itself), and a per-group
+circuit breaker that converts repeated ``NotLeader`` / ``Overloaded``
+refusals into fast-fail ``CircuitOpen`` until a cooldown-gated probe
+succeeds.
 """
 
 from __future__ import annotations
 
+import random
 import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from raft_tpu.admission import (
+    Backoff,
+    CircuitBreaker,
+    CircuitOpen,
+    Overloaded,
+    RetryBudget,
+)
 from raft_tpu.multi.engine import MultiEngine, NotLeader
 
 
 class Router:
-    """Key -> group routing + per-group NotLeader retry.
+    """Key -> group routing + per-group refusal/retry discipline.
 
-    ``drive=True`` (default, the in-process deployment): on
-    ``NotLeader`` the router drives the engine's event loop until the
-    group re-elects, then retries — the in-process analogue of a client
-    redialing the new leader. ``drive=False`` re-raises on the first
-    refusal (an external driver owns the event loop; without driving it,
-    a retry is guaranteed to see identical state)."""
+    ``drive=True`` (default, the in-process deployment): on a refusal
+    (``NotLeader`` from a leadership gap, ``Overloaded`` from a group's
+    bounded queue) the router backs off — driving the engine's event
+    loop for the jittered delay, the in-process analogue of a client
+    sleeping then redialing — and retries, spending from the retry
+    budget. ``drive=False`` re-raises on the first refusal and applies
+    none of the discipline (an external driver owns the event loop AND
+    the retry policy; without driving, a retry is guaranteed to see
+    identical state).
+
+    Defaults derive from the engine's config: backoff base = one
+    heartbeat period, capped at the max election timeout (so a
+    NotLeader retry naturally spans an election window); breaker
+    cooldown = the max election timeout; budget = ``retry_budget``
+    tokens refilled ``retry_refill`` per success."""
 
     def __init__(
         self, engine: MultiEngine, max_retries: int = 8, drive: bool = True,
         elect_limit: float = 600.0,
+        retry_budget: float = 32.0, retry_refill: float = 0.5,
+        breaker_threshold: int = 8, breaker_cooldown_s: Optional[float] = None,
     ):
         self.engine = engine
         self.max_retries = max_retries
         self.drive = drive
         self.elect_limit = elect_limit
+        cfg = engine.cfg
+        self.backoff = Backoff(
+            base_s=cfg.heartbeat_period, max_s=cfg.follower_timeout[1],
+            rng=random.Random(f"router:{cfg.seed}"),
+        )
+        self.budget = RetryBudget(
+            capacity=retry_budget, refill_per_success=retry_refill,
+        )
+        cooldown = (breaker_cooldown_s if breaker_cooldown_s is not None
+                    else cfg.follower_timeout[1])
+        self.breakers = [
+            CircuitBreaker(
+                failure_threshold=breaker_threshold, cooldown_s=cooldown,
+            )
+            for _ in range(engine.G)
+        ]
 
     # ------------------------------------------------------------- routing
     def group_of(self, key: bytes) -> int:
@@ -50,30 +94,60 @@ class Router:
         return zlib.crc32(key) % self.engine.G
 
     def _with_leader(self, g: int, fn: Callable):
-        """Run ``fn`` with the NotLeader retry protocol for group ``g``."""
+        """Run ``fn`` under group ``g``'s refusal/retry discipline:
+        breaker gate, jittered backoff, retry budget, redial."""
+        breaker = self.breakers[g]
+        if self.drive and not breaker.allow(self.engine.clock.now):
+            # fast-fail without touching the engine: the group refused
+            # repeatedly and its cooldown has not elapsed (the next
+            # allowed call after cooldown is the half-open probe)
+            raise CircuitOpen(breaker.retry_after(self.engine.clock.now), g)
         for attempt in range(self.max_retries + 1):
             try:
-                return fn()
-            except NotLeader:
-                if attempt >= self.max_retries or not self.drive:
+                out = fn()
+            except (NotLeader, Overloaded) as ex:
+                if not self.drive:
                     # without driving, nothing changes engine state
                     # between attempts (single-threaded host) — a retry
                     # is guaranteed identical, so fail on first refusal
+                    # (and the external driver owns the retry policy)
                     raise
-                if self.engine.leader_id[g] is None:
+                breaker.on_failure(self.engine.clock.now)
+                if attempt >= self.max_retries:
+                    raise
+                if not self.budget.try_spend():
+                    # retry budget exhausted: retries are capped at a
+                    # fraction of goodput — surface the refusal instead
+                    # of feeding the overload
+                    raise
+                delay = self.backoff.delay(
+                    attempt, getattr(ex, "retry_after_s", None)
+                )
+                if (isinstance(ex, NotLeader)
+                        and self.engine.leader_id[g] is not None):
+                    # a leader is still ROUTED but cannot confirm (the
+                    # minority side of a partition: quorum unreachable /
+                    # deposed mid-round): a short backoff would redial
+                    # frozen state — drive a full election window so
+                    # the majority side can elect; its winner replaces
+                    # leader_id[g] and the retry redials it.
+                    delay = max(delay, self.engine.cfg.follower_timeout[1])
+                self.engine.run_for(delay)
+                if (isinstance(ex, NotLeader)
+                        and self.engine.leader_id[g] is None):
                     # leaderless: drive the event loop until the group
                     # re-elects (the redial); a group that cannot elect
                     # lets run_until_leader's own NotLeader propagate
                     self.engine.run_until_leader(g, limit=self.elect_limit)
-                else:
-                    # a leader is still ROUTED but cannot confirm (the
-                    # minority side of a partition: quorum unreachable /
-                    # deposed mid-round). run_until_leader would return
-                    # immediately without processing an event — instead
-                    # drive one election window so the majority side can
-                    # elect; its winner replaces leader_id[g] and the
-                    # retry redials it.
-                    self.engine.run_for(self.engine.cfg.follower_timeout[1])
+                if not breaker.allow(self.engine.clock.now):
+                    raise CircuitOpen(
+                        breaker.retry_after(self.engine.clock.now), g
+                    )
+            else:
+                if self.drive:
+                    breaker.on_success()
+                    self.budget.on_success()
+                return out
         raise AssertionError("unreachable")
 
     # ------------------------------------------------------------- submits
@@ -98,9 +172,12 @@ class Router:
         Partial failure: buckets are placed sequentially, and a bucket
         that exhausts its retries does NOT un-place earlier buckets'
         entries (they are already queued and will commit). The raised
-        ``NotLeader`` carries the aligned results so far as
-        ``.partial`` (None = unplaced item) — await those seqs rather
-        than resubmitting them."""
+        ``NotLeader`` / ``Overloaded`` carries the aligned results so
+        far as ``.partial`` (None = unplaced item) — await those seqs
+        rather than resubmitting them. A bucket refused mid-way (a
+        bounded queue filling between items) resumes from its first
+        UNPLACED item on retry, so a retried bucket can never queue an
+        entry twice."""
         buckets: Dict[int, List[int]] = {}
         for i, (key, _) in enumerate(items):
             buckets.setdefault(self.group_of(key), []).append(i)
@@ -109,20 +186,22 @@ class Router:
         for g, idxs in buckets.items():
             def _submit_bucket(g=g, idxs=idxs):
                 # leader checked once per bucket; entries then ride the
-                # ordinary queue (ticks batch them across groups)
+                # ordinary queue (ticks batch them across groups).
+                # Placement lands in ``out`` item by item so a retry
+                # after a mid-bucket refusal resumes, never re-submits.
                 r = self.engine.leader_id[g]
                 if r is None:
                     raise NotLeader(g)
-                return [
-                    self.engine.submit_to_leader(g, items[i][1]) for i in idxs
-                ]
+                for i in idxs:
+                    if out[i] is None:
+                        out[i] = (g, self.engine.submit_to_leader(
+                            g, items[i][1]
+                        ))
             try:
-                seqs = self._with_leader(g, _submit_bucket)
-            except NotLeader as ex:
+                self._with_leader(g, _submit_bucket)
+            except (NotLeader, Overloaded) as ex:
                 ex.partial = out
                 raise
-            for i, s in zip(idxs, seqs):
-                out[i] = (g, s)
         return out
 
     # --------------------------------------------------------------- reads

@@ -13,6 +13,8 @@ from typing import Optional
 
 import numpy as np
 
+from raft_tpu.admission.gate import AdmissionReport
+
 
 @dataclasses.dataclass(frozen=True)
 class LatencySummary:
@@ -44,6 +46,12 @@ class EngineReport:
     in_flight_entries: int     # ingested, commit pending (healthy pipeline)
     lost_entries: int          # submitted, never durable (leadership changes)
     leader_changes: int
+    # Overload observability (None when admission is disabled): queue
+    # depth + high-water, shed counts by reason, admitted counts, the
+    # delay controller's state, and head-of-queue sojourn p50/p99 —
+    # goodput is ``entries_per_sec`` above (committed work only; shed
+    # arrivals never count).
+    admission: Optional[AdmissionReport] = None
 
 
 def summarize_engine(engine, trace=None) -> EngineReport:
@@ -66,4 +74,8 @@ def summarize_engine(engine, trace=None) -> EngineReport:
             len(engine.submit_time) - committed - len(engine._queue) - in_flight
         ),
         leader_changes=leader_changes,
+        admission=(
+            engine.admission.report(queue_depth=len(engine._queue))
+            if getattr(engine, "admission", None) is not None else None
+        ),
     )

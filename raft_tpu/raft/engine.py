@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.admission import AdmissionGate
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import NO_VOTE, ReplicaState, fold_batch
 from raft_tpu.transport.base import Transport, make_transport
@@ -140,6 +141,11 @@ class RaftEngine:
     """
 
     READ_TICKET_CAP = 1 << 16
+    READ_TICKET_TTL_FACTOR = 3.0
+    #   With admission configured, a ticket idle this many max election
+    #   timeouts is treated as abandoned and evicted at the gate (see
+    #   submit_read) — the age analogue of the FIFO cap, which a smaller
+    #   admission bound can never reach.
 
     def __init__(
         self,
@@ -198,7 +204,9 @@ class RaftEngine:
         self._reads: Dict[int, list] = {}
         self._next_read_ticket = 0
         #   Batched ReadIndex queue: ticket -> [row, noted index, bound
-        #   term, status] (submit_read / read_confirmed / _confirm_reads).
+        #   term, status, mint time] (submit_read / read_confirmed /
+        #   _confirm_reads; the mint time drives the admission-path
+        #   idle-TTL eviction).
         self._read_buckets: Dict[Tuple[int, int], set] = {}
         #   (row, bound term) -> pending tickets. A confirming quorum
         #   round touches exactly its own (r, term) bucket instead of
@@ -285,6 +293,14 @@ class RaftEngine:
         #   replicated log into a replicated state machine.
         self._lost_gaps: set = set()   # unrecoverable apply gaps, logged once
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
+        self.admission = AdmissionGate.from_config(cfg, self.clock)
+        #   Bounded admission (raft_tpu.admission; None = legacy
+        #   unbounded): submit/submit_read arrivals pass the gate before
+        #   anything is queued, and the leader tick feeds the gate the
+        #   head-of-queue sojourn for the CoDel delay controller. The
+        #   depth bound governs ADMISSION — entries re-queued by failover
+        #   truncation were already admitted once and may transiently
+        #   push the queue past it (they are re-queued, never re-shed).
         self._config_seqs: Dict[int, Tuple[tuple, tuple]] = {}
         #   seq -> (old member mask, new member mask) for in-flight
         #   configuration-change entries (add_server / remove_server)
@@ -390,18 +406,28 @@ class RaftEngine:
         self._push(self.clock.now + self.rng.uniform(lo, hi), f"c:{self._timer_gen[r]}", r)
 
     # ------------------------------------------------------------- client API
-    def submit(self, payload: bytes) -> int:
+    def submit(self, payload: bytes, client=None) -> int:
         """Queue one entry; returns its sequence number. The entry is
         durable once ``seq in engine.commit_time`` (``is_durable(seq)``).
         The reference's client never learns the fate of an entry
         (main.go:330); here the engine reports it honestly — including the
         loss case: entries queued or ingested-but-uncommitted across a
         leadership change may be dropped (the reference drops them too) and
-        their seq simply never becomes durable; clients resubmit."""
+        their seq simply never becomes durable; clients resubmit.
+
+        With admission configured (``cfg.admission_max_writes``), an
+        arrival that finds the queue at its bound, the delay controller
+        shedding, or — when ``client`` is given — its fair share
+        exceeded, raises ``admission.Overloaded`` BEFORE anything is
+        queued (no seq is minted; provably no effect; retry after the
+        carried hint). ``client`` is an opaque id used only for the
+        fair-share accounting."""
         if len(payload) != self.cfg.entry_bytes:
             raise ValueError(
                 f"payload must be exactly {self.cfg.entry_bytes} bytes"
             )
+        if self.admission is not None:
+            self.admission.admit_write(len(self._queue), client)
         seq = self._next_seq
         self._next_seq += 1
         self._queue.append((seq, payload))
@@ -780,7 +806,32 @@ class RaftEngine:
         leadership loss while queued is detected lazily — the ticket's
         (row, term) binding can no longer confirm, and the next poll
         raises (the split-brain guarantee — a minority-side stale
-        leader can never confirm, so its queued reads never serve)."""
+        leader can never confirm, so its queued reads never serve).
+
+        With admission configured (``cfg.admission_max_reads``), an
+        arrival beyond the outstanding-ticket bound raises
+        ``admission.Overloaded("read_depth")`` instead of minting a
+        ticket that would silently FIFO-evict someone else's. The
+        abandoned-ticket backstop at this bound is AGE, not count: the
+        2^16 FIFO cap can never be reached under a smaller admission
+        bound, so tickets idle for ``READ_TICKET_TTL_FACTOR`` max
+        election timeouts (far beyond any live client's poll cadence)
+        are evicted first — they poll as ``TicketEvicted``, the same
+        re-issue contract as the legacy cap — and only then is the
+        survivor count held against the bound. Without this, ``max_
+        reads`` abandoned tickets would refuse every future read
+        forever."""
+        if self.admission is not None:
+            ttl = self.READ_TICKET_TTL_FACTOR * self.cfg.follower_timeout[1]
+            # tickets mint monotonically and dict order survives
+            # deletes, so the front of the dict is the oldest — stop at
+            # the first young ticket (amortized O(1) per admission)
+            for tk in list(self._reads):
+                if self.clock.now - self._reads[tk][4] < ttl:
+                    break
+                self._drop_read_ticket(tk)
+                self._read_evict_floor = max(self._read_evict_floor, tk + 1)
+            self.admission.admit_read(len(self._reads))
         if r is None:
             r = self.leader_id
         if r is None or self.roles[r] != LEADER or not self.alive[r]:
@@ -797,7 +848,9 @@ class RaftEngine:
         tk = self._next_read_ticket
         self._next_read_ticket += 1
         bind = (r, int(self.lead_terms[r]))
-        self._reads[tk] = [r, self.commit_watermark, bind[1], "pending"]
+        self._reads[tk] = [
+            r, self.commit_watermark, bind[1], "pending", self.clock.now,
+        ]
         self._read_buckets.setdefault(bind, set()).add(tk)
         n_evict = len(self._reads) - self.READ_TICKET_CAP
         if n_evict > 0:
@@ -845,7 +898,7 @@ class RaftEngine:
                     "cap before confirmation; re-issue the read"
                 )
             raise KeyError(f"unknown or already-consumed ticket {ticket}")
-        row, idx, tterm, st = rec
+        row, idx, tterm, st = rec[:4]
         if st == "ready":
             self._drop_read_ticket(ticket)
             return idx
@@ -1155,8 +1208,26 @@ class RaftEngine:
         The attested term comes from the archive — the device must not
         read a below-floor ring slot for the prev-check (junk tags can
         collide). 0 when unattestable: followers at the boundary then
-        stall into snapshot install rather than accept on a junk match."""
-        floor = int(self._ring_floor[r])
+        stall into snapshot install rather than accept on a junk match.
+
+        The floor is the truncation floor (``_ring_floor``) raised to
+        the LAP horizon, ``last - capacity + 1``: a leader that legally
+        wrapped its ring over committed slots holds another entry's
+        bytes below the horizon, so the prev-check for a repair window
+        STARTING exactly at the horizon must come from the archive too.
+        Without the raise, a follower sitting precisely one entry below
+        a fully-wrapped leader wedges forever: the repair window reads
+        the wrapped slot's term for its prev-check (mismatch, refused
+        every tick) while ``_snapshot_heal`` sees ``match + 1 ==
+        horizon`` and keeps deferring to that same repair window —
+        found by the overload harness (sustained saturation runs the
+        ring at full uncommitted depth, parking followers at the
+        horizon across elections)."""
+        cap = self.state.capacity
+        floor = max(
+            int(self._ring_floor[r]),
+            int(self._pre_lasts()[r]) - cap + 1,
+        )
         if floor <= 1:
             return floor, 0
         ent = self.store.get(floor - 1)
@@ -1619,6 +1690,27 @@ class RaftEngine:
         B = cfg.batch_size
         routed = self.leader_id == r
         eff = self._reach(r)
+        if routed and self.admission is not None:
+            # Feed the delay controller the head-of-queue sojourn (0 on
+            # an empty queue, which is what exits the shedding state).
+            # Ticks are the drain cadence, so this is also the natural
+            # observation cadence.
+            head_delay = 0.0
+            if self._queue:
+                head_delay = self.clock.now - self.submit_time.get(
+                    self._queue[0][0], self.clock.now
+                )
+            transition = self.admission.observe_delay(head_delay)
+            if transition == "shed_start":
+                self.nodelog(
+                    r, f"admission shedding ON (head delay "
+                    f"{head_delay:.1f}s >= target "
+                    f"{self.admission.target_delay_s:g}s for a full "
+                    f"interval)"
+                )
+            elif transition == "shed_stop":
+                self.nodelog(r, "admission shedding OFF (delay back "
+                                "under target)")
         if routed:
             # must run BEFORE the batch is taken from the queue: it may
             # prepend re-queued entries, and the post-step bookkeeping

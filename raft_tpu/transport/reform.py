@@ -48,19 +48,26 @@ storage — the stand-in for the deployment's supervisor or config service
   ``reform`` falls through to ``request_join`` instead of proposing
   epochs the members will never join.
 
-**Clock assumption (failure detector)**: heartbeat freshness compares
-the OBSERVER's wall clock against the WRITER's ``time.time()`` stamp
-(``fresh_peers``), so survivor detection assumes process wall clocks
-agree to well within ``stall_s`` (NTP-grade sync; the deployments this
-stands in for — k8s nodes, TPU pods — provide it). The failure mode is
-bounded and recoverable, not silent: a peer whose clock lags the
-observer's by more than the staleness window reads as dead and is
-excluded from the next epoch, upon which it detects the exclusion and
-re-enters via the join path above; a peer whose clock runs AHEAD reads
-as fresh for longer, which only delays re-formation by the skew. A
-deployment that cannot bound skew should derive freshness from a single
-clock domain instead — e.g. the rendezvous store's own mtimes where the
-store sets server-side times, or a supervisor's liveness API.
+**Failure detector (single-clock-domain)**: heartbeat freshness is
+derived from per-writer stamp *progression*, observed entirely on the
+OBSERVER's monotonic clock (ADVICE r5 #1). Each heartbeat carries a
+``beat`` counter (plus the wall stamp, kept for humans); ``fresh_peers``
+remembers, per writer, the last distinct (beat, stamp) pair it saw and
+WHEN it saw it on ``time.monotonic()``. A peer is fresh iff its pair
+changed within the last ``stale_s`` of observation. No cross-host clock
+comparison exists anywhere in the protocol: wall-clock skew between
+processes — any amount, in either direction, including NTP steps
+mid-run — cannot mis-detect a live peer as dead or hold a dead peer
+fresh. The price is one bounded latency term: a peer seen for the FIRST
+time by a given observer (fresh process, or a restart that lost its
+observation state) counts as fresh until ``stale_s`` of observation
+passes without progression, so detecting an already-dead peer takes up
+to one staleness window from first sight instead of zero. For an
+observer that was already watching when the peer died, detection
+latency is the same as before. Deadline loops (``reform``,
+``await_epoch_including_me``) run on ``time.monotonic()`` for the same
+reason: an NTP step must not expire — or immortalize — a re-formation
+budget.
 """
 
 from __future__ import annotations
@@ -120,15 +127,25 @@ class Rendezvous:
         self.root = root
         self.pid = pid
         os.makedirs(root, exist_ok=True)
+        self._beats = 0
+        self._seen: Dict[int, tuple] = {}
+        #   pid -> ((beat, stamp), monotonic time this observer first saw
+        #   that exact pair) — the progression detector's whole state
+        #   (see fresh_peers / the module-doc failure-detector note)
 
     # ---- heartbeats ----------------------------------------------------
     def heartbeat(self, epoch: int, round_no: int, wm: int,
                   ckpt: Optional[str]) -> None:
         path = os.path.join(self.root, f"hb-{self.pid}.json")
         tmp = path + ".tmp"
+        self._beats += 1
         with open(tmp, "w") as f:
-            json.dump({"time": time.time(), "epoch": epoch,
-                       "round": round_no, "wm": wm, "ckpt": ckpt}, f)
+            # ``beat`` is the progression counter freshness derives from
+            # (it advances even if the wall clock is frozen or stepped
+            # backward); ``time`` is kept for humans reading the files
+            json.dump({"time": time.time(), "beat": self._beats,
+                       "epoch": epoch, "round": round_no, "wm": wm,
+                       "ckpt": ckpt}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -144,16 +161,20 @@ class Rendezvous:
             return None
 
     def fresh_peers(self, stale_s: float) -> Dict[int, dict]:
-        """pids (self included) whose heartbeat is younger than
-        ``stale_s`` — the failure detector's survivor estimate.
+        """pids (self included) whose heartbeat PROGRESSED within the
+        last ``stale_s`` seconds of this observer's ``time.monotonic()``
+        — the failure detector's survivor estimate.
 
-        Freshness = this process's ``time.time()`` minus the WRITER's
-        stamp: a cross-clock comparison that assumes wall clocks agree
-        to well within ``stale_s`` (see the module-doc clock-assumption
-        note — mis-detection is recoverable via the excluded-survivor
-        join path in ``reform``, but re-formation latency degrades with
-        skew)."""
-        now = time.time()
+        Progression, not wall-clock age: the observer remembers each
+        writer's last distinct (beat, stamp) pair and when it saw it on
+        its OWN monotonic clock; a peer is fresh iff the pair changed
+        within the window. No cross-host clock comparison — skew of any
+        magnitude cannot mis-detect (module-doc failure-detector note).
+        A writer seen for the first time counts as fresh from that
+        sighting: detection of an already-dead peer costs at most one
+        staleness window of observation, which is the bounded price of
+        skew immunity."""
+        now = time.monotonic()
         out: Dict[int, dict] = {}
         for f in os.listdir(self.root):
             # exact-shape match: a concurrent writer's hb-N.json.tmp must
@@ -164,8 +185,14 @@ class Rendezvous:
                 hb = json.load(open(os.path.join(self.root, f)))
             except (json.JSONDecodeError, OSError):
                 continue                      # torn concurrent write
-            if now - hb["time"] <= stale_s:
-                out[int(f[3:-5])] = hb
+            pid = int(f[3:-5])
+            mark = (hb.get("beat"), hb["time"])
+            seen = self._seen.get(pid)
+            if seen is None or seen[0] != mark:
+                self._seen[pid] = (mark, now)     # progressed: stamp NOW
+                out[pid] = hb
+            elif now - seen[1] <= stale_s:
+                out[pid] = hb                     # unchanged but recent
         return out
 
     # ---- epochs --------------------------------------------------------
@@ -283,8 +310,10 @@ class Rendezvous:
         placeholders could silently drop the max-watermark checkpoint
         from the next epoch's restore choice)."""
         hb = hb or {}
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        # monotonic deadline (ADVICE r5 #1): a wall-clock step must not
+        # expire the wait early or extend it indefinitely
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             ep = self.latest_epoch()
             if ep is not None and ep.n > after and self.pid in ep.members:
                 self.clear_join(self.pid)
@@ -308,10 +337,10 @@ class Rendezvous:
         would-be coordinator is itself dead (its heartbeat goes stale
         and the next-lowest survivor takes over)."""
         hb = hb or {}
-        deadline = time.time() + timeout_s
-        seen, seen_at = None, time.time()
+        deadline = time.monotonic() + timeout_s
+        seen, seen_at = None, time.monotonic()
         settle_s = 6.0
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             ep = self.latest_epoch()
             if ep is not None and ep.n > cur.n:
                 if self.pid in ep.members:
@@ -328,7 +357,7 @@ class Rendezvous:
                 self.request_join()
                 return self.await_epoch_including_me(
                     after=ep.n,
-                    timeout_s=max(deadline - time.time(), 1.0),
+                    timeout_s=max(deadline - time.monotonic(), 1.0),
                     hb=hb,
                 )
             self.heartbeat(cur.n, hb.get("round", -1), hb.get("wm", -1),
@@ -340,10 +369,10 @@ class Rendezvous:
             # the faster one forming a smaller epoch without the other
             key = tuple(sorted(fresh))
             if key != seen:
-                seen, seen_at = key, time.time()
+                seen, seen_at = key, time.monotonic()
             if (
                 self.is_coordinator(fresh, cur.members)
-                and time.time() - seen_at >= settle_s
+                and time.monotonic() - seen_at >= settle_s
             ):
                 self.propose_next_epoch(cur, fresh, list(joiners))
             time.sleep(0.5)

@@ -1,0 +1,502 @@
+"""Overload admission: the gate (depth/delay/priority/fairness), the
+client-side retry discipline (backoff / retry budget / circuit
+breaker), and their integration into ``RaftEngine``, ``MultiEngine``,
+and the ``Router`` (docs/OVERLOAD.md)."""
+
+import pytest
+
+from raft_tpu.admission import (
+    AdmissionGate,
+    Backoff,
+    CircuitBreaker,
+    CircuitOpen,
+    Overloaded,
+    RetryBudget,
+)
+from raft_tpu.config import RaftConfig
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+# ------------------------------------------------------------- gate unit
+class TestAdmissionGate:
+    def test_depth_bound_refuses_with_hint(self):
+        gate = AdmissionGate(_Clock(), max_writes=4, drain_hint_s=2.0)
+        for d in range(4):
+            gate.admit_write(d)
+        with pytest.raises(Overloaded) as ei:
+            gate.admit_write(4)
+        assert ei.value.reason == "depth"
+        assert ei.value.retry_after_s == 2.0
+        assert gate.shed == {"depth": 1}
+        assert gate.admitted["write"] == 4
+
+    def test_read_lane_independent_of_write_lane(self):
+        """Priority lanes: a write queue at its bound (or delay-shedding)
+        must not refuse reads, and vice versa."""
+        clk = _Clock()
+        gate = AdmissionGate(clk, max_writes=2, max_reads=3,
+                             target_delay_s=1.0, interval_s=5.0)
+        gate.admit_write(0)
+        gate.admit_write(1)
+        with pytest.raises(Overloaded):
+            gate.admit_write(2)
+        gate.admit_read(0)                     # write lane full: reads fine
+        # drive the write lane into delay shedding
+        gate.observe_delay(2.0)
+        clk.now = 6.0
+        assert gate.observe_delay(2.0) == "shed_start"
+        with pytest.raises(Overloaded) as ei:
+            gate.admit_write(0)
+        assert ei.value.reason == "delay"
+        gate.admit_read(1)                     # delay shedding: reads fine
+        gate.admit_read(2)
+        with pytest.raises(Overloaded) as ei:
+            gate.admit_read(3)                 # reads refuse at THEIR bound
+        assert ei.value.reason == "read_depth"
+
+    def test_delay_controller_codel_state_machine(self):
+        """Above-target sojourn must persist a full interval before
+        shedding starts; one under-target observation stops it."""
+        clk = _Clock()
+        gate = AdmissionGate(clk, max_writes=100,
+                             target_delay_s=4.0, interval_s=10.0)
+        assert gate.observe_delay(5.0) is None        # first above: armed
+        clk.now = 5.0
+        assert gate.observe_delay(5.0) is None        # interval not elapsed
+        assert not gate.shedding
+        clk.now = 10.0
+        assert gate.observe_delay(5.0) == "shed_start"
+        assert gate.shedding
+        with pytest.raises(Overloaded) as ei:
+            gate.admit_write(0)
+        assert ei.value.reason == "delay"
+        assert gate.observe_delay(1.0) == "shed_stop"   # back under target
+        gate.admit_write(0)                             # admits again
+        # a dip below target between two excursions re-arms the interval
+        gate.observe_delay(5.0)
+        clk.now = 15.0
+        gate.observe_delay(0.0)
+        clk.now = 30.0
+        assert gate.observe_delay(5.0) is None          # fresh excursion
+        assert not gate.shedding
+
+    def test_fair_share_refuses_hot_client_only(self):
+        clk = _Clock()
+        gate = AdmissionGate(clk, max_writes=16, target_delay_s=4.0,
+                             interval_s=100.0)
+        for _ in range(8):
+            gate.admit_write(0, client="hot")   # quiet lane: all admitted
+        gate.admit_write(8, client="cold")      # congested, but not hot
+        with pytest.raises(Overloaded) as ei:
+            gate.admit_write(9, client="hot")   # over 2x fair share
+        assert ei.value.reason == "fair_share"
+        gate.admit_write(9, client="cold")      # light client still admitted
+        assert gate.shed == {"fair_share": 1}
+
+    def test_fair_share_counts_decay(self):
+        clk = _Clock()
+        gate = AdmissionGate(clk, max_writes=16, target_delay_s=4.0,
+                             interval_s=10.0)
+        for _ in range(8):
+            gate.admit_write(0, client="hot")
+        gate.admit_write(8, client="cold")
+        clk.now = 60.0     # 6 intervals: hot's window share decays away
+        gate.admit_write(9, client="hot")
+
+    def test_report_shape(self):
+        gate = AdmissionGate(_Clock(), max_writes=4, max_reads=2)
+        gate.admit_write(0)
+        gate.observe_delay(1.0)
+        rep = gate.report(queue_depth=1)
+        assert rep.queue_depth == 1
+        assert rep.max_writes == 4 and rep.max_reads == 2
+        assert rep.admitted["write"] == 1
+        assert rep.total_shed == 0
+        assert rep.queue_delay_p50_s == 1.0
+
+    def test_delay_sample_trim_keeps_cumulative_index(self):
+        """The sample buffer keeps its recent half past the cap;
+        ``delay_dropped`` must account for the trimmed prefix so
+        cumulative indexes (overload_run's phase marks) stay valid."""
+        clk = _Clock()
+        gate = AdmissionGate(clk, max_writes=100)
+        for i in range(gate.MAX_DELAY_SAMPLES + 10):
+            gate.observe_delay(0.0)
+        assert gate.delay_dropped == gate.MAX_DELAY_SAMPLES // 2
+        assert (gate.delay_dropped + len(gate.delay_samples)
+                == gate.MAX_DELAY_SAMPLES + 10)
+
+
+# ------------------------------------------------------------ retry unit
+class TestRetryDiscipline:
+    def test_backoff_jitter_bounded_and_growing(self):
+        import random
+
+        bo = Backoff(base_s=1.0, max_s=30.0, rng=random.Random(7))
+        for attempt in range(8):
+            cap = min(30.0, 2.0 ** attempt)
+            for _ in range(50):
+                assert 0.0 <= bo.delay(attempt) <= cap
+
+    def test_backoff_server_hint_floors_the_draw(self):
+        import random
+
+        bo = Backoff(base_s=1.0, max_s=30.0, rng=random.Random(7))
+        assert all(bo.delay(0, hint_s=5.0) >= 5.0 for _ in range(20))
+        # a hint beyond the cap clamps to the cap, not beyond
+        assert bo.delay(0, hint_s=100.0) <= 30.0
+
+    def test_retry_budget_caps_retries_at_refill_fraction(self):
+        b = RetryBudget(capacity=2.0, refill_per_success=0.5)
+        assert b.try_spend() and b.try_spend()
+        assert not b.try_spend()          # empty: fail fast
+        for _ in range(4):
+            b.on_success()
+        assert b.balance == 2.0           # capped at capacity
+        assert b.try_spend()
+        assert b.spent == 3 and b.denied == 1
+
+    def test_breaker_state_machine(self):
+        br = CircuitBreaker(failure_threshold=3, cooldown_s=10.0)
+        assert br.state(0.0) == "closed"
+        br.on_failure(0.0)
+        br.on_failure(0.0)
+        assert br.allow(0.0)              # below threshold
+        br.on_failure(0.0)
+        assert br.state(0.0) == "open"
+        assert not br.allow(5.0)
+        assert br.retry_after(5.0) == 5.0
+        assert br.state(10.0) == "half_open"
+        assert br.allow(10.0)             # the probe
+        br.on_failure(10.0)               # failed probe: fresh cooldown
+        assert not br.allow(15.0)
+        assert br.allow(20.0)
+        br.on_success()                   # probe succeeded: fully closed
+        assert br.state(20.0) == "closed"
+        assert br.opened_count == 2
+
+    def test_success_resets_consecutive_failures(self):
+        br = CircuitBreaker(failure_threshold=3, cooldown_s=10.0)
+        for _ in range(2):
+            br.on_failure(0.0)
+        br.on_success()
+        for _ in range(2):
+            br.on_failure(1.0)
+        assert br.state(1.0) == "closed"
+
+
+# ----------------------------------------------------- engine integration
+def _gated_cfg(**kw):
+    base = dict(
+        n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
+        transport="single", seed=3,
+        admission_max_writes=8, admission_max_reads=4,
+        admission_target_delay_s=4.0, admission_interval_s=20.0,
+    )
+    base.update(kw)
+    return RaftConfig(**base)
+
+
+def _engine(cfg):
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e.run_until_leader()
+    return e
+
+
+class TestEngineAdmission:
+    def test_depth_bound_holds_and_reopens_after_drain(self):
+        e = _engine(_gated_cfg())
+        shed = 0
+        for _ in range(20):
+            try:
+                e.submit(bytes(32))
+            except Overloaded as ex:
+                assert ex.reason == "depth"
+                shed += 1
+        assert len(e._queue) == 8 and shed == 12
+        e.run_for(10 * e.cfg.heartbeat_period)
+        assert len(e._queue) == 0
+        e.submit(bytes(32))                    # gate reopened
+        assert e.admission.shed["depth"] == 12
+
+    def test_default_config_is_unbounded_legacy(self):
+        e = _engine(RaftConfig(
+            n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
+            transport="single",
+        ))
+        assert e.admission is None
+        for _ in range(200):
+            e.submit(bytes(32))                # no gate, no refusal
+        assert len(e._queue) == 200
+
+    def test_read_refusal_instead_of_silent_eviction(self):
+        e = _engine(_gated_cfg())
+        tickets = [e.submit_read() for _ in range(4)]
+        with pytest.raises(Overloaded) as ei:
+            e.submit_read()
+        assert ei.value.reason == "read_depth"
+        # the earlier tickets were NOT evicted to make room
+        e.run_for(4 * e.cfg.heartbeat_period)
+        assert all(e.read_confirmed(tk) is not None for tk in tickets)
+
+    def test_metrics_export(self):
+        from raft_tpu.obs.metrics import summarize_engine
+
+        e = _engine(_gated_cfg())
+        for _ in range(12):
+            try:
+                e.submit(bytes(32))
+            except Overloaded:
+                pass
+        rep = summarize_engine(e)
+        assert rep.admission is not None
+        assert rep.admission.shed["depth"] == 4
+        assert rep.admission.queue_depth == 8
+        assert rep.admission.depth_high_water == 8
+        # legacy engines still report admission=None
+        e2 = _engine(RaftConfig(
+            n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
+            transport="single",
+        ))
+        assert summarize_engine(e2).admission is None
+
+    def test_delay_shedding_engages_under_stall_and_recovers(self):
+        """Kill a majority AND fill the leader's ring so the queue
+        cannot drain (the ring absorbs queued entries even without a
+        quorum — only a full ring backs the queue up): the head-of-queue
+        sojourn grows, the controller starts shedding within ~interval,
+        and recovery (heal -> commits -> drain) stops it — with the
+        transitions in the trace stream."""
+        lines = []
+        from raft_tpu.raft import RaftEngine
+        from raft_tpu.transport import SingleDeviceTransport
+
+        cfg = _gated_cfg(admission_max_writes=64)
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg),
+                       trace=lines.append)
+        lead = e.run_until_leader()
+        others = [r for r in range(3) if r != lead]
+        e.fail(others[0])
+        e.fail(others[1])
+        # fill the ring: batch per tick, no commits without a quorum
+        for _ in range(cfg.log_capacity // cfg.batch_size):
+            for _ in range(cfg.batch_size):
+                e.submit(bytes(32))
+            e.run_for(cfg.heartbeat_period)
+        for _ in range(8):
+            e.submit(bytes(32))                # these CANNOT drain
+        e.run_for(cfg.admission_interval_s + 8 * cfg.heartbeat_period)
+        assert e.admission.shedding
+        with pytest.raises(Overloaded) as ei:
+            e.submit(bytes(32))
+        assert ei.value.reason == "delay"
+        assert any("admission shedding ON" in ln for ln in lines)
+        for r in others:
+            e.recover(r)
+        e.run_for(40 * cfg.heartbeat_period)
+        assert not e.admission.shedding
+        assert any("admission shedding OFF" in ln for ln in lines)
+        e.submit(bytes(32))                    # admitting again
+
+    def test_fair_share_under_congestion(self):
+        e = _engine(_gated_cfg(admission_max_writes=16))
+        for _ in range(8):
+            e.submit(bytes(32), client="hot")
+        e.submit(bytes(32), client="cold")
+        with pytest.raises(Overloaded) as ei:
+            e.submit(bytes(32), client="hot")
+        assert ei.value.reason == "fair_share"
+        e.submit(bytes(32), client="cold")
+
+    def test_abandoned_read_tickets_cannot_wedge_the_read_lane(self):
+        """Tickets never polled to a terminal state must not consume
+        the admission read bound forever: past the idle TTL they evict
+        (polling as TicketEvicted — the legacy re-issue contract) and
+        fresh reads admit again."""
+        from raft_tpu.raft.engine import RaftEngine, TicketEvicted
+
+        e = _engine(_gated_cfg())
+        abandoned = [e.submit_read() for _ in range(4)]   # fill the bound
+        with pytest.raises(Overloaded):
+            e.submit_read()
+        ttl = RaftEngine.READ_TICKET_TTL_FACTOR * e.cfg.follower_timeout[1]
+        e.run_for(ttl + 1.0)
+        tk = e.submit_read()               # the lane re-opened
+        e.run_for(4 * e.cfg.heartbeat_period)
+        assert e.read_confirmed(tk) is not None
+        for old in abandoned:
+            with pytest.raises(TicketEvicted):
+                e.read_confirmed(old)
+
+    def test_reads_only_admission_never_gates_writes(self):
+        """cfg with ONLY admission_max_reads: legacy submit() keeps the
+        no-exception contract even when the head-of-queue sojourn would
+        trip the delay controller (kill the quorum, fill the ring)."""
+        cfg = _gated_cfg(admission_max_writes=None, admission_max_reads=4,
+                         admission_interval_s=10.0)
+        e = _engine(cfg)
+        lead = e.leader_id
+        for r in range(3):
+            if r != lead:
+                e.fail(r)
+        for _ in range(cfg.log_capacity // cfg.batch_size):
+            for _ in range(cfg.batch_size):
+                e.submit(bytes(32))
+            e.run_for(cfg.heartbeat_period)
+        for _ in range(8):
+            e.submit(bytes(32))            # stuck behind the full ring
+        e.run_for(cfg.admission_interval_s + 8 * cfg.heartbeat_period)
+        assert not e.admission.shedding
+        e.submit(bytes(32))                # still never refused
+        assert e.admission.shed == {}
+
+
+# ------------------------------------------------- multi-engine + router
+def _multi(G=2, **kw):
+    from raft_tpu.multi import MultiEngine
+
+    base = dict(
+        n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
+        transport="single", seed=5,
+    )
+    base.update(kw)
+    me = MultiEngine(RaftConfig(**base), G)
+    me.seed_leaders()
+    return me
+
+
+class TestMultiAdmission:
+    def test_group_queue_bound(self):
+        me = _multi(admission_max_writes=4)
+        shed = 0
+        for _ in range(10):
+            try:
+                me.submit(0, bytes(32))
+            except Overloaded as ex:
+                assert ex.reason == "depth" and ex.group == 0
+                shed += 1
+        assert shed == 6 and len(me._queue[0]) == 4
+        assert me.shed_by_group[0] == {"depth": 6}
+        me.submit(1, bytes(32))        # sibling group's lane unaffected
+        assert me.shed_by_group[1] == {}
+
+    def test_router_retry_budget_fails_fast(self):
+        """An exhausted retry budget surfaces the refusal instead of
+        retrying: attempts = 1 initial + budget retries."""
+        from raft_tpu.multi import Router
+
+        me = _multi()
+        router = Router(me, max_retries=5, retry_budget=2.0,
+                        elect_limit=5.0)
+        calls = [0]
+
+        def always_overloaded(g, payload):
+            calls[0] += 1
+            raise Overloaded("depth", 0.5, group=g)
+
+        me.submit_to_leader = always_overloaded
+        with pytest.raises(Overloaded):
+            router.submit(b"x4", bytes(32))    # b"x4" routes to group 0
+        assert router.group_of(b"x4") == 0
+        assert calls[0] == 3           # initial + 2 budgeted retries
+        assert router.budget.denied == 1
+
+    def test_router_breaker_opens_then_probe_closes(self):
+        from raft_tpu.multi import Router
+
+        me = _multi()
+        router = Router(me, max_retries=1, retry_budget=64.0,
+                        breaker_threshold=4, elect_limit=5.0)
+        g = 0
+        key = b"x4"
+        assert router.group_of(key) == g
+        orig = me.submit_to_leader
+
+        def always_overloaded(gg, payload):
+            raise Overloaded("depth", 0.5, group=gg)
+
+        me.submit_to_leader = always_overloaded
+        for _ in range(2):             # 2 calls x 2 failures = threshold
+            with pytest.raises(Overloaded):
+                router.submit(key, bytes(32))
+        with pytest.raises(CircuitOpen) as ei:
+            router.submit(key, bytes(32))      # fast-fail, no engine work
+        assert ei.value.group == g
+        assert ei.value.retry_after_s > 0
+        # heal the seam, wait out the cooldown: the next call is the
+        # half-open probe and its success closes the breaker
+        me.submit_to_leader = orig
+        me.run_for(me.cfg.follower_timeout[1] + 1)
+        g2, seq = router.submit(key, bytes(32))
+        assert g2 == g
+        assert router.breakers[g].state(me.clock.now) == "closed"
+        me.run_until_committed(g, seq)
+
+    def test_router_sheds_overloaded_group_and_sibling_flows(self):
+        """A group whose ring AND queue are both full (quorum down, so
+        nothing commits and nothing drains) refuses through the router
+        after its budgeted backoff retries, while a sibling group's
+        traffic flows untouched."""
+        from raft_tpu.multi import Router
+
+        me = _multi(admission_max_writes=4)
+        router = Router(me, max_retries=1, retry_budget=2.0)
+        cfg = me.cfg
+        lead = me.leader_id[0]
+        for r in range(3):
+            if r != lead:
+                me.fail(0, r)          # group 0: leader alone, no quorum
+        # fill group 0's ring (ingest continues without commits), then
+        # its bounded queue — nothing can drain from here on
+        for _ in range(cfg.log_capacity // cfg.batch_size):
+            for _ in range(cfg.batch_size):
+                me.submit(0, bytes(32))
+            me.run_for(cfg.heartbeat_period)
+        for _ in range(4):
+            me.submit(0, bytes(32))
+        with pytest.raises(Overloaded):
+            router.submit(b"x4", bytes(32))        # x4 -> group 0
+        g, seq = router.submit(b"x0", bytes(32))   # x0 -> group 1
+        assert g == 1
+        me.run_until_committed(g, seq)
+
+    def test_submit_many_mid_bucket_refusal_never_duplicates(self):
+        """A bounded queue filling mid-bucket must resume from the first
+        UNPLACED item on retry — the already-queued prefix is never
+        re-submitted (it would double-apply)."""
+        from raft_tpu.multi import Router
+
+        me = _multi(G=1, admission_max_writes=3)
+        router = Router(me, max_retries=8, retry_budget=32.0)
+        items = [(f"mk{i}".encode(), bytes(32)) for i in range(6)]
+        out = router.submit_many(items)    # retries drain between refusals
+        seqs = [s for _, s in out]
+        assert sorted(seqs) == seqs and len(set(seqs)) == 6
+        for g, s in out:
+            me.run_until_committed(g, s)
+        # exactly 6 entries committed for these submissions — no dupes
+        assert me.commit_watermark[0] >= 6
+
+
+# ------------------------------------------------------- config plumbing
+def test_config_validation():
+    with pytest.raises(ValueError):
+        RaftConfig(admission_max_writes=0)
+    with pytest.raises(ValueError):
+        RaftConfig(admission_max_reads=-1)
+    with pytest.raises(ValueError):
+        RaftConfig(admission_target_delay_s=0.0)
+    cfg = RaftConfig(admission_max_reads=4)    # reads-only gating is legal
+    gate = AdmissionGate.from_config(cfg, _Clock())
+    assert gate is not None and gate.max_reads == 4
+    assert AdmissionGate.from_config(RaftConfig(), _Clock()) is None

@@ -6,7 +6,6 @@ The reference has no state machine — values are stored, never applied
 per lifetime, committed-only, and survives restart via replay.
 """
 
-import numpy as np
 import pytest
 
 from raft_tpu.config import RaftConfig

@@ -8,7 +8,6 @@ snapshot install + repair window.
 """
 
 import numpy as np
-import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.ckpt import CheckpointStore, Snapshot, install_snapshot

@@ -5,15 +5,12 @@ AppendEntries gates (main.go:121-156), vote rules (main.go:157-170),
 leader tick + commit (main.go:332-395) — implemented paper-correct.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.comm import SingleDeviceComm
 from raft_tpu.core.state import (
-    NO_VOTE,
     fold_batch,
     init_state,
     payload_slot_bytes,

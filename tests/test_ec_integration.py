@@ -6,7 +6,6 @@ follower under EC, and reconstruction healing."""
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.ec.reconstruct import reconstruct

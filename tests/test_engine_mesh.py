@@ -11,7 +11,6 @@ RS(5,3) rides a 5-device mesh, EC x payload_shards=2 rides RS(4,2) on a
 
 import jax
 import numpy as np
-import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import committed_payloads, log_entries

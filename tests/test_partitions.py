@@ -204,7 +204,7 @@ class TestSplitBrain:
 def test_safety_properties_under_partition_schedule(seed, n):
     """The four Raft safety properties under randomized schedules that
     now include partitions (extends test_properties' fault space)."""
-    from tests.test_properties import replica_log, run_random_schedule
+    from tests.test_properties import replica_log
 
     rng = random.Random(7000 * n + seed)
     tr = TraceRecorder()

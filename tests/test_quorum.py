@@ -1,7 +1,6 @@
 """Quorum kernel unit tests (paper rule vs reference exact-bucket rule)."""
 
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.quorum import (
     commit_from_match,

@@ -1,0 +1,70 @@
+"""The re-formation failure detector's clock discipline (ADVICE r5 #1):
+freshness from per-writer stamp PROGRESSION on the observer's monotonic
+clock — no cross-host wall-clock comparison anywhere — and monotonic
+deadlines in the wait loops."""
+
+import json
+import os
+import time
+
+from raft_tpu.transport.reform import Rendezvous
+
+
+def _write_hb(root, pid, stamp, beat):
+    with open(os.path.join(root, f"hb-{pid}.json"), "w") as f:
+        json.dump({"time": stamp, "beat": beat, "epoch": 1,
+                   "round": 0, "wm": 0, "ckpt": None}, f)
+
+
+class TestProgressionDetector:
+    def test_absolute_skew_cannot_kill_a_progressing_peer(self, tmp_path):
+        """A writer whose wall clock is YEARS off stays fresh as long as
+        its stamps keep changing — the old observer-wall-minus-writer-
+        stamp comparison would have declared it dead instantly."""
+        rv = Rendezvous(str(tmp_path), pid=0)
+        _write_hb(tmp_path, 7, stamp=12345.0, beat=1)     # epoch-1970 clock
+        assert 7 in rv.fresh_peers(0.2)
+        time.sleep(0.3)                                   # past stale_s...
+        _write_hb(tmp_path, 7, stamp=12345.0, beat=2)     # ...but progressed
+        assert 7 in rv.fresh_peers(0.2)
+
+    def test_frozen_writer_goes_stale_after_observation_window(self, tmp_path):
+        rv = Rendezvous(str(tmp_path), pid=0)
+        _write_hb(tmp_path, 7, stamp=time.time(), beat=1)
+        assert 7 in rv.fresh_peers(0.2)          # first sighting: fresh
+        time.sleep(0.3)
+        assert 7 not in rv.fresh_peers(0.2)      # never progressed: dead
+        _write_hb(tmp_path, 7, stamp=time.time(), beat=2)
+        assert 7 in rv.fresh_peers(0.2)          # came back: fresh again
+
+    def test_backward_wall_step_still_counts_as_progression(self, tmp_path):
+        """An NTP step moving the writer's wall clock BACKWARD between
+        beats must not read as staleness (the beat counter advances
+        regardless)."""
+        rv = Rendezvous(str(tmp_path), pid=0)
+        _write_hb(tmp_path, 7, stamp=5000.0, beat=1)
+        rv.fresh_peers(0.2)
+        time.sleep(0.25)
+        _write_hb(tmp_path, 7, stamp=1000.0, beat=2)      # clock stepped back
+        assert 7 in rv.fresh_peers(0.2)
+
+    def test_own_heartbeat_carries_beat_counter(self, tmp_path):
+        rv = Rendezvous(str(tmp_path), pid=3)
+        rv.heartbeat(1, 0, 10, None)
+        rv.heartbeat(1, 1, 12, None)
+        hb = rv.my_heartbeat()
+        assert hb["beat"] == 2 and hb["wm"] == 12
+        # and the writer observes itself as fresh via its own progression
+        assert 3 in rv.fresh_peers(60.0)
+
+    def test_detection_latency_bounded_from_first_sight(self, tmp_path):
+        """A leftover heartbeat file from a long-dead process costs at
+        most ONE staleness window of observation before exclusion — the
+        documented price of skew immunity."""
+        _write_hb(tmp_path, 9, stamp=time.time() - 9999.0, beat=42)
+        rv = Rendezvous(str(tmp_path), pid=0)     # fresh observer
+        t0 = time.monotonic()
+        assert 9 in rv.fresh_peers(0.2)           # first sight: fresh
+        while 9 in rv.fresh_peers(0.2):
+            assert time.monotonic() - t0 < 2.0, "never went stale"
+            time.sleep(0.05)

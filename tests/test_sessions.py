@@ -2,7 +2,6 @@
 retries of a non-idempotent operation apply once, including retries that
 BOTH commit, and the dedup table survives restart via log replay."""
 
-import numpy as np
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.examples import ReplicatedCounter
