@@ -627,6 +627,112 @@ def bench_overload() -> dict:
     return rows
 
 
+# ---------------------------------------------------- reconfiguration leg
+def bench_reconfig() -> dict:
+    """Membership-change costs on the VIRTUAL clock (docs/MEMBERSHIP.md):
+
+    - ``wipe_logN`` rows: time-to-promote a WIPED voter back through the
+      full replace ladder (remove -> learner re-admission -> repair /
+      snapshot-install catch-up -> promote) as a function of committed
+      log size — the catch-up half should scale with the log, the
+      config commits should not;
+    - ``latency_dip`` row: p50/p99 commit latency of steady traffic in a
+      baseline window vs DURING a learner-first grow and DURING a
+      shrink — the learner phase's whole claim is that the dip is a
+      blip, not a stall.
+
+    Like the overload leg this measures membership POLICY (virtual
+    seconds, deterministic, backend-independent), not device speed; rows
+    emit incrementally (``_emit_leg``)."""
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    rows = {}
+    payload = None
+
+    # -- wipe-replace catch-up vs log size ------------------------------
+    for log_len in (64, 256, 1024):
+        cfg = RaftConfig(
+            n_replicas=3, max_replicas=4, entry_bytes=64, batch_size=16,
+            log_capacity=256, transport="single", seed=21,
+        )
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+        e.run_until_leader()
+        payload = bytes(cfg.entry_bytes)
+        s_add = e.add_voter(3)        # row 3 joins (empty) as a voter...
+        e.run_until_committed(s_add, limit=4000.0)
+        seqs = e.submit_pipelined([payload] * log_len)
+        e.run_until_committed(seqs[-1], limit=20000.0)
+        e.fail(3)                     # ...then loses its disk entirely
+        e.wipe(3)
+        t0v, t0w = e.clock.now, time.monotonic()
+        e.replace(3, 3)
+        removed = False
+        while e.clock.now < t0v + 20000.0:
+            if not e.member[3]:
+                removed = True        # the removal half committed
+                if not e.alive[3]:
+                    e.recover(3)      # rejoin under the fresh identity
+            if removed and e.member[3]:
+                break                 # ...and the promotion landed
+            e.run_for(4 * cfg.heartbeat_period)
+        rows[f"wipe_log{log_len}"] = _emit_leg(f"reconfig_log{log_len}", {
+            "log_entries": log_len,
+            "rejoined": bool(removed and e.member[3]),
+            "replace_virtual_s": round(e.clock.now - t0v, 1),
+            "replace_wall_s": round(time.monotonic() - t0w, 2),
+            "via_snapshot": log_len > cfg.log_capacity,
+        })
+
+    # -- commit-latency dip during grow / shrink ------------------------
+    cfg = RaftConfig(
+        n_replicas=3, max_replicas=5, entry_bytes=64, batch_size=16,
+        log_capacity=256, transport="single", seed=22,
+    )
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e.run_until_leader()
+    payload = bytes(cfg.entry_bytes)
+
+    def pump(seconds, bucket, until=None):
+        t_end = e.clock.now + seconds
+        while e.clock.now < t_end and (until is None or not until()):
+            bucket.append(e.submit(payload))
+            e.run_for(cfg.heartbeat_period)
+
+    base, grow, shrink = [], [], []
+    pump(120.0, base)
+    e.add_server(3)                              # learner-first grow
+    pump(2000.0, grow, until=lambda: bool(e.member[3]))
+    victim = next(r for r in range(cfg.rows)
+                  if e.member[r] and r != e.leader_id)
+    s_rm = e.remove_server(victim)
+    pump(2000.0, shrink, until=lambda: e.is_durable(s_rm))
+    pump(30.0, shrink)                           # post-commit settling
+    e.run_for(120.0)                             # drain commits
+
+    def pcts(bucket):
+        lats = [
+            e.commit_time[s] - e.submit_time[s]
+            for s in bucket if s in e.commit_time
+        ]
+        if not lats:
+            return {"p50_s": None, "p99_s": None, "n": 0}
+        p50, p99 = _percentiles(lats)
+        return {"p50_s": round(p50, 3), "p99_s": round(p99, 3),
+                "n": len(lats)}
+
+    rows["latency_dip"] = _emit_leg("reconfig_latency_dip", {
+        "baseline": pcts(base),
+        "during_grow": pcts(grow),
+        "during_shrink": pcts(shrink),
+        "note": ("per-window p50/p99 commit latency (virtual s) of "
+                 "steady 1-entry-per-tick traffic; grow window spans "
+                 "learner attach -> promotion commit, shrink window "
+                 "spans removal submit -> commit + 30 s"),
+    })
+    return rows
+
+
 # ------------------------------------------------- mesh per-device kernel
 def bench_mesh1(rng) -> dict:
     """Per-device fused-kernel overhead (VERDICT r4 #1 'Done' row): the
@@ -1236,6 +1342,7 @@ def main(argv=None) -> None:
         ("read_index", bench_read_index),
         ("client_chunk", bench_client_latency),
         ("overload", bench_overload),
+        ("reconfig", bench_reconfig),
     ):
         configs[name] = dl.run(name, leg)
     if dl.expired:

@@ -20,7 +20,14 @@ actually observe).
 - ``chaos.storage``   — ``MirroredStore``, the simulated durable disk
   set (mirrored checkpoints + vote WAL) the storage faults target.
 - ``chaos.runner``    — ``torture_run`` / ``torture_run_multi``: the
-  end-to-end loop, reported with a one-line seed repro.
+  end-to-end loop, reported with a one-line seed repro; plus the
+  deterministic ``overload_run`` (anti-metastability) and
+  ``reconfig_run`` (reconfiguration availability) drills.
+
+Opt-in nemesis planes (existing seeds replay byte-identically with
+them off): ``overload`` (open-loop arrival storms, round 8) and
+``membership`` (grow / shrink / remove-the-leader / wipe-replace under
+fire, round 9 — docs/CHAOS.md).
 
 One-command repro of any run: ``python -m raft_tpu.chaos --seed N``.
 """
@@ -33,12 +40,14 @@ from raft_tpu.chaos.checker import (
     check_history,
 )
 from raft_tpu.chaos.history import History, OpRecord
-from raft_tpu.chaos.nemesis import Nemesis, NemesisAction
+from raft_tpu.chaos.nemesis import MembershipView, Nemesis, NemesisAction
 from raft_tpu.chaos.runner import (
     OverloadReport,
+    ReconfigReport,
     TortureReport,
     overload_run,
     poisson,
+    reconfig_run,
     torture_run,
     torture_run_multi,
 )
@@ -53,12 +62,15 @@ __all__ = [
     "check_history",
     "History",
     "OpRecord",
+    "MembershipView",
     "Nemesis",
     "NemesisAction",
     "OverloadReport",
+    "ReconfigReport",
     "TortureReport",
     "overload_run",
     "poisson",
+    "reconfig_run",
     "torture_run",
     "torture_run_multi",
     "MirroredStore",

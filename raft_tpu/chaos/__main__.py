@@ -12,7 +12,12 @@ import argparse
 import json
 import sys
 
-from raft_tpu.chaos.runner import overload_run, torture_run, torture_run_multi
+from raft_tpu.chaos.runner import (
+    overload_run,
+    reconfig_run,
+    torture_run,
+    torture_run_multi,
+)
 
 
 def main(argv=None) -> int:
@@ -39,6 +44,19 @@ def main(argv=None) -> int:
                          "open-loop arrival storms at 2-10x capacity, "
                          "composed with the other fault planes "
                          "(docs/OVERLOAD.md)")
+    ap.add_argument("--membership", action="store_true",
+                    help="arm the membership plane: nemesis grow/shrink/"
+                         "remove-the-leader/wipe-replace cycles over a "
+                         "headroom cluster, composed with the other "
+                         "fault planes (docs/CHAOS.md round 9)")
+    ap.add_argument("--reconfig", action="store_true",
+                    help="run the deterministic reconfiguration drill "
+                         "(grow via learner, shrink, leader removal, "
+                         "wipe-replace) instead of a torture run; "
+                         "succeeds only if the history checks "
+                         "linearizable AND commit progress resumes "
+                         "within the documented window after every "
+                         "configuration commit")
     ap.add_argument("--overload-recovery", type=float, default=None,
                     metavar="MULT",
                     help="run the deterministic overload-and-recover "
@@ -57,8 +75,32 @@ def main(argv=None) -> int:
         ap.error("--broken applies to the single-engine runner only")
     if args.overload_recovery is not None and (args.multi or args.broken):
         ap.error("--overload-recovery is a standalone single-engine run")
+    if args.membership and args.multi:
+        ap.error("--membership applies to the single-engine runner only "
+                 "(MultiEngine is fixed-membership by design)")
+    if args.reconfig and (args.multi or args.broken or args.overload
+                          or args.overload_recovery is not None):
+        ap.error("--reconfig is a standalone single-engine drill")
 
     ok = True
+    if args.reconfig:
+        for seed in range(args.seed, args.seed + args.sweep):
+            rep = reconfig_run(seed, step_budget=args.step_budget)
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "availability_ok": rep.availability_ok,
+                "events": rep.events,
+                "promote_s": rep.promote_s,
+                "replace_promote_s": rep.replace_promote_s,
+                "ops": rep.ops,
+                "op_counts": rep.op_counts,
+            }), flush=True)
+            ok = ok and (
+                rep.verdict == "LINEARIZABLE" and rep.availability_ok
+            )
+        return 0 if ok else 1
     if args.overload_recovery is not None:
         for seed in range(args.seed, args.seed + args.sweep):
             rep = overload_run(
@@ -100,7 +142,8 @@ def main(argv=None) -> int:
                 keys=args.keys, phase_s=args.phase_s,
                 crash=not args.no_crash, msg_faults=not args.no_msg,
                 storage_faults=not args.no_storage, broken=args.broken,
-                overload=args.overload, step_budget=args.step_budget,
+                overload=args.overload, membership=args.membership,
+                step_budget=args.step_budget,
             )
         print(rep.summary())
         print(json.dumps({
@@ -113,6 +156,7 @@ def main(argv=None) -> int:
             "msg_stats": rep.msg_stats,
             "shed_ops": rep.shed_ops,
             "open_loop_ops": rep.open_loop_ops,
+            "membership_ops": rep.membership_ops,
             "checker_steps": rep.check.steps,
         }), flush=True)
         ok = ok and rep.verdict == expect

@@ -23,6 +23,15 @@ three fault planes:
   rate into open-loop client traffic; admission-shed arrivals are
   recorded as sound no-effect failures, so the linearizability verdict
   must stay ACCEPT through the storm (docs/OVERLOAD.md).
+- **membership plane** (opt-in, ``allow_membership=True`` — off by
+  default for the same replay reason) — seeded reconfiguration under
+  fire: grow (learner-then-promote ``add_server``), shrink, removal of
+  the current LEADER, and wipe-replace cycles (kill + total durable
+  loss + ``replace`` rejoin-from-nothing through snapshot install as a
+  learner), composed with every other plane. Every choice is gated so
+  a strict majority of the *current* voter set stays alive through the
+  op — the quorum-liveness rule applied to the post-change
+  configuration (docs/CHAOS.md round 9).
 
 Liveness discipline: every choice is gated so the run can quiesce —
 kills never leave fewer than a majority of members alive (the same rule
@@ -44,6 +53,20 @@ STORAGE_FAULTS = ("none", "tear_votelog", "flip_bit", "rollback")
 
 
 @dataclasses.dataclass
+class MembershipView:
+    """The runner's live configuration snapshot for membership
+    decisions: voter rows, learner rows, unconfigured spare rows, the
+    routed leader (None between leaderships), and whether any
+    configuration change is in flight (pending, queued or staged)."""
+
+    voters: List[int]
+    learners: List[int]
+    spares: List[int]
+    leader: Optional[int]
+    in_flight: bool
+
+
+@dataclasses.dataclass
 class NemesisAction:
     """One adversary decision for the runner to execute."""
 
@@ -56,6 +79,7 @@ class NemesisAction:
     delay: float = 0.0
     storage: str = "none"                   # kind == "crash_restart"
     rate_mult: float = 0.0                  # kind == "overload_on"
+    spare: int = 0                          # kind == "mem_replace"
 
     def describe(self) -> str:
         if self.kind == "msg_on":
@@ -67,6 +91,8 @@ class NemesisAction:
             return f"crash_restart(storage={self.storage})"
         if self.kind == "partition":
             return f"partition({self.groups})"
+        if self.kind == "mem_replace":
+            return f"mem_replace({self.replica} -> {self.spare})"
         if self.kind == "plan":
             return f"plan({[(e.t, e.action, e.replica) for e in self.plan.events]})"
         return f"{self.kind}({self.replica})"
@@ -83,7 +109,9 @@ class Nemesis:
     KINDS = (
         "kill", "recover", "slow", "unslow", "campaign",
         "partition", "heal", "plan", "msg_on", "msg_off",
-        "crash_restart", "overload_on", "overload_off", "none",
+        "crash_restart", "overload_on", "overload_off",
+        "mem_grow", "mem_shrink", "mem_remove_leader", "mem_replace",
+        "none",
     )
 
     def __init__(
@@ -94,6 +122,7 @@ class Nemesis:
         allow_msg: bool = True,
         allow_storage: bool = True,
         allow_overload: bool = False,
+        allow_membership: bool = False,
     ):
         self.rng = random.Random(f"nemesis:{seed}")
         self.n_rows = n_rows
@@ -101,6 +130,7 @@ class Nemesis:
         self.allow_msg = allow_msg
         self.allow_storage = allow_storage
         self.allow_overload = allow_overload
+        self.allow_membership = allow_membership
         #   off by default: adding kinds to the choice pool perturbs the
         #   decision stream, and existing pinned seeds must replay
         #   byte-identically
@@ -125,9 +155,23 @@ class Nemesis:
             return False
         return dead + 1 <= (len(members) - 1) // 2
 
+    def _shrink_ok(self, victim: int, voters: List[int],
+                   alive: Dict[int, bool]) -> bool:
+        """A removal is admissible iff the POST-change voter set keeps a
+        live strict majority — the quorum-liveness rule counted over the
+        configuration the cluster is about to be in, not the initial
+        ``n`` (the FaultPlan.validate membership-timeline rule, applied
+        adaptively)."""
+        new = [v for v in voters if v != victim]
+        if len(new) < 2:
+            return False
+        live = sum(1 for v in new if alive.get(v, False))
+        return live >= len(new) // 2 + 1
+
     def next_action(
         self, members: List[int], alive: Dict[int, bool],
         partitioned: bool, now: float,
+        membership: Optional[MembershipView] = None,
     ) -> NemesisAction:
         rng = self.rng
         if not partitioned:
@@ -140,6 +184,9 @@ class Nemesis:
             kinds.append("crash_restart")
         if self.allow_overload:
             kinds += ["overload_on", "overload_off"]
+        if self.allow_membership and membership is not None:
+            kinds += ["mem_grow", "mem_shrink", "mem_remove_leader",
+                      "mem_replace"]
         kind = rng.choice(kinds)
         dead = sum(1 for r in members if not alive[r])
         victim = rng.randrange(self.n_rows)
@@ -198,8 +245,72 @@ class Nemesis:
         elif kind == "overload_off" and self.overload_window:
             self.overload_window = False
             act = NemesisAction("overload_off")
+        elif kind.startswith("mem_") and membership is not None:
+            act = self._membership_action(
+                kind, membership, alive, partitioned
+            )
         self.log.append(f"t={now:.1f} {act.describe()}")
         return act
+
+    def _membership_action(
+        self, kind: str, mv: MembershipView, alive: Dict[int, bool],
+        partitioned: bool,
+    ) -> NemesisAction:
+        """Gate and parameterize one reconfiguration op. Ops only start
+        with no change in flight and no active partition (a change may
+        still be MID-FLIGHT when a later partition/kill/crash lands —
+        that interleaving is the point of the plane); every choice keeps
+        a live strict majority of the post-change voter set."""
+        rng = self.rng
+        none = NemesisAction("none")
+        if mv.in_flight or partitioned or mv.leader is None:
+            return none
+        if kind == "mem_grow":
+            if not mv.spares:
+                return none
+            return NemesisAction("mem_grow", rng.choice(mv.spares))
+        if kind == "mem_shrink":
+            # learners are removable for free; voters only under the
+            # post-change quorum-liveness gate (never the leader here —
+            # that is mem_remove_leader's job, kept distinct so coverage
+            # of the removed-leader path is seed-addressable)
+            cands = list(mv.learners) + [
+                v for v in mv.voters
+                if v != mv.leader and self._shrink_ok(v, mv.voters, alive)
+            ]
+            if not cands or len(mv.voters) <= 2:
+                return none
+            return NemesisAction("mem_shrink", rng.choice(cands))
+        if kind == "mem_remove_leader":
+            if len(mv.voters) <= 2 or mv.leader not in mv.voters:
+                return none
+            if not self._shrink_ok(mv.leader, mv.voters, alive):
+                return none
+            return NemesisAction("mem_remove_leader", mv.leader)
+        if kind == "mem_replace":
+            # wipe-replace: kill (if needed) + total durable loss +
+            # rejoin-from-nothing as a learner. Prefer an already-dead
+            # voter; else a live non-leader voter the kill gate admits.
+            dead_voters = [v for v in mv.voters if not alive.get(v, False)]
+            if dead_voters:
+                victim = rng.choice(dead_voters)
+            else:
+                dead = sum(1 for v in mv.voters if not alive.get(v, False))
+                live = [
+                    v for v in mv.voters
+                    if alive.get(v, False) and v != mv.leader
+                    and self._kill_ok(mv.voters, dead, v, partitioned)
+                ]
+                if not live:
+                    return none
+                victim = rng.choice(live)
+            a2 = dict(alive)
+            a2[victim] = False
+            if not self._shrink_ok(victim, mv.voters, a2):
+                return none
+            spare = rng.choice(mv.spares) if mv.spares else victim
+            return NemesisAction("mem_replace", victim, spare=spare)
+        return none
 
     def _compose_plan(
         self, members: List[int], alive: Dict[int, bool], dead: int,
@@ -227,7 +338,13 @@ class Nemesis:
             )
         # belt and braces: the fragment itself must pass the strict
         # majority validation (it schedules recover after kill, so the
-        # adaptive gate above is the binding one)
+        # adaptive gate above is the binding one). The validation counts
+        # the CURRENT voter set, not the initial n — under the
+        # membership plane the two diverge (FaultPlan.validate's
+        # membership timeline).
         alive0 = [alive.get(r, True) for r in range(self.n_rows)]
-        plan.validate(self.n_rows, alive=alive0, strict=True)
+        plan.validate(
+            self.n_rows, alive=alive0, strict=True,
+            membership=[(0.0, list(members))],
+        )
         return NemesisAction("plan", plan=plan)

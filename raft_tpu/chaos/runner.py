@@ -66,7 +66,7 @@ from raft_tpu.chaos.checker import (
     check_history,
 )
 from raft_tpu.chaos.history import DELETE, READ, WRITE, History, OpRecord
-from raft_tpu.chaos.nemesis import Nemesis, NemesisAction
+from raft_tpu.chaos.nemesis import MembershipView, Nemesis, NemesisAction
 from raft_tpu.chaos.storage import MirroredStore
 from raft_tpu.chaos.transport import ChaosTransport
 from raft_tpu.config import RaftConfig
@@ -101,6 +101,10 @@ class TortureReport:
     repro: str
     shed_ops: int = 0          # admission-refused arrivals (fail, no effect)
     open_loop_ops: int = 0     # open-loop arrivals generated in total
+    membership_ops: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #   reconfiguration ops the membership plane actually started
+    #   (grow/shrink/remove_leader/replace) — coverage evidence for the
+    #   pinned seeds
 
     @property
     def verdict(self) -> str:
@@ -112,6 +116,8 @@ class TortureReport:
             f"({self.op_counts}), {self.crashes} crash cycles, "
             f"msg {self.msg_stats}"
         )
+        if self.membership_ops:
+            line += f", membership {self.membership_ops}"
         if self.verdict != LINEARIZABLE:
             line += f"\n  {self.check.detail}\n  REPRO: {self.repro}"
         return line
@@ -137,6 +143,13 @@ def _overload_cfg(seed: int) -> RaftConfig:
         admission_target_delay_s=4.0,
         admission_interval_s=20.0,
     )
+
+
+def _membership_cfg(base: RaftConfig) -> RaftConfig:
+    """Arm a torture config for the membership plane: two spare rows of
+    headroom over the 3-voter start, so grow / replace always have a
+    row to admit."""
+    return dataclasses.replace(base, max_replicas=5)
 
 
 class _Client:
@@ -269,12 +282,21 @@ class _TortureBase:
         """Open-loop arrival hook, called once per drive slice; the
         base workload is closed-loop only (overload runners override)."""
 
+    def pump_membership(self) -> None:
+        """Membership-plane housekeeping hook, called once per drive
+        slice (wipe-replace rejoin timing — see _SingleTorture)."""
+
+    def membership_view(self) -> Optional[MembershipView]:
+        """The nemesis's configuration snapshot; None = plane disabled
+        (the default — membership kinds never enter the choice pool)."""
+        return None
+
     def run_phases(self, nemesis: Nemesis) -> None:
         for _ in range(self.phases):
             self._invoke_idle()
             act = nemesis.next_action(
                 self.members(), self.alive_map(), self.partitioned,
-                self.now(),
+                self.now(), membership=self.membership_view(),
             )
             self.apply_nemesis(act)
             # drive in slices so completions are stamped near the event
@@ -282,6 +304,7 @@ class _TortureBase:
             for _ in range(4):
                 self.pump_open_loop(self.phase_s / 4)
                 self.drive(self.phase_s / 4)
+                self.pump_membership()
                 self._poll_all()
                 self._invoke_idle()
         self.quiesce()
@@ -301,20 +324,29 @@ def torture_run(
     storage_faults: bool = True,
     broken: Optional[str] = None,
     overload: bool = False,
+    membership: bool = False,
     step_budget: int = 500_000,
 ) -> TortureReport:
     """One full single-engine torture run; see module docstring.
     ``overload=True`` arms admission (``_overload_cfg`` unless ``cfg``
     is given) and lets the nemesis open 2-10x open-loop arrival
-    windows, composable with every other fault plane."""
+    windows, composable with every other fault plane.
+    ``membership=True`` arms the reconfiguration plane: a
+    membership-headroom config (``_membership_cfg`` unless ``cfg`` is
+    given) and nemesis grow/shrink/remove-the-leader/wipe-replace
+    cycles, composed with every other plane — client-visible
+    linearizability under reconfiguration is the property under test."""
+    base = _overload_cfg(seed) if overload else _default_cfg(seed)
+    if membership and cfg is None:
+        base = _membership_cfg(base)
     run = _SingleTorture(
         seed, phases, clients, keys, phase_s,
-        cfg or (_overload_cfg(seed) if overload else _default_cfg(seed)),
-        workdir, broken,
+        cfg or base, workdir, broken, membership=membership,
     )
     nemesis = Nemesis(
         seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
         allow_storage=storage_faults, allow_overload=overload,
+        allow_membership=membership,
     )
     run.run_phases(nemesis)
     check = check_history(run.history, step_budget=step_budget)
@@ -329,6 +361,8 @@ def torture_run(
         flags.append(f"--broken {broken}")
     if overload:
         flags.append("--overload")
+    if membership:
+        flags.append("--membership")
     repro = (
         f"python -m raft_tpu.chaos --seed {seed} --phases {phases} "
         f"--clients {clients} --keys {keys} --phase-s {phase_s:g}"
@@ -339,17 +373,25 @@ def torture_run(
         op_counts=run.history.counts(), crashes=run.crashes,
         msg_stats=run.chaos_t.stats, nemesis_log=nemesis.log, repro=repro,
         shed_ops=run.shed_ops, open_loop_ops=run.ol_submitted,
+        membership_ops=run.membership_ops,
     )
 
 
 class _SingleTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg,
-                 workdir, broken):
+                 workdir, broken, membership: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s)
         from raft_tpu.transport.device import SingleDeviceTransport
 
         self.cfg = cfg
         self.broken = broken
+        self.membership = membership
+        self.membership_ops: Dict[str, int] = {}
+        self._wipe_rejoin: set = set()
+        #   rows awaiting recovery after a wipe-replace: a wiped row must
+        #   stay down until its old voter identity leaves the
+        #   configuration (the engine's recover guard), then rejoins as
+        #   a fresh learner
         self._tmp = None
         if workdir is None:
             self._tmp = tempfile.TemporaryDirectory(prefix="raft_torture_")
@@ -562,6 +604,91 @@ class _SingleTorture(_TortureBase):
             self.set_overload_rate(act.rate_mult)
         elif act.kind == "overload_off":
             self._ol_rate = 0.0
+        elif act.kind == "mem_grow":
+            self._mem_op("grow", lambda: e.add_server(act.replica))
+        elif act.kind == "mem_shrink":
+            self._mem_op("shrink", lambda: e.remove_server(act.replica))
+        elif act.kind == "mem_remove_leader":
+            lead = e.leader_id
+            if lead is not None and e.member[lead]:
+                self._mem_op(
+                    "remove_leader", lambda: e.remove_server(lead)
+                )
+        elif act.kind == "mem_replace":
+            self._mem_replace(act.replica, act.spare)
+
+    # -------------------------------------------------- membership plane
+    def membership_view(self) -> Optional[MembershipView]:
+        if not self.membership:
+            return None
+        e = self.engine
+        rows = range(self.cfg.rows)
+        return MembershipView(
+            voters=[r for r in rows if e.member[r]],
+            learners=[r for r in rows if e.learner[r]],
+            spares=[
+                r for r in rows if not e.member[r] and not e.learner[r]
+            ],
+            leader=e.leader_id,
+            in_flight=(
+                e._pending_config is not None
+                or bool(e._staged_config)
+                or any(q in e._config_seqs for q, _ in e._queue)
+            ),
+        )
+
+    def _mem_op(self, name: str, fn) -> bool:
+        """Run one reconfiguration op; an engine refusal (leadership
+        gap, change already in flight, admission shedding under an
+        overload window) is a logged no-op — the nemesis gates on a
+        snapshot that may have gone stale by apply time."""
+        try:
+            fn()
+        except (RuntimeError, ValueError, Overloaded):
+            return False
+        self.membership_ops[name] = self.membership_ops.get(name, 0) + 1
+        return True
+
+    def _mem_replace(self, victim: int, spare: int) -> None:
+        """The wipe-replace cycle: crash the victim if needed, start the
+        replace ladder (removal now, learner re-admission + promotion
+        staged behind it), and only once the ladder is ACCEPTED destroy
+        the victim's durable state in full (device row + checkpoint
+        mirrors + vote WAL). Ordering matters: replace() can be refused
+        (leadership gap, admission shedding under a composed overload
+        window), and wiping first would strand a wiped, still-configured
+        voter that nothing may ever restart. A refusal therefore leaves
+        an ordinary crashed — recoverable — member behind. The rejoining
+        row stays down until its old identity durably leaves the
+        configuration (``pump_membership`` recovers it)."""
+        e = self.engine
+        if not e.member[victim]:
+            return
+        if e.alive[victim]:
+            e.fail(victim)
+        if self._mem_op("replace", lambda: e.replace(victim, spare)):
+            e.wipe(victim)
+            self.store.wipe_node(victim)
+            self._wipe_rejoin.add(spare)
+            if spare != victim:
+                self._wipe_rejoin.add(victim)
+                #   the removed row itself restarts as an unconfigured
+                #   spare once its removal commits — future grows may
+                #   re-admit it
+
+    def pump_membership(self) -> None:
+        if not self._wipe_rejoin:
+            return
+        e = self.engine
+        for v in list(self._wipe_rejoin):
+            if e.alive[v]:
+                self._wipe_rejoin.discard(v)
+            elif not e.member[v]:
+                # the old voter identity has left the configuration:
+                # the row may now restart (fresh learner rejoin)
+                e.recover(v)
+                if e.alive[v]:
+                    self._wipe_rejoin.discard(v)
 
     def _crash_restart(self, storage: str) -> None:
         # resolve in-flight ops against the dying engine: writes may
@@ -599,8 +726,13 @@ class _SingleTorture(_TortureBase):
         self.chaos_t.clear_message_faults()
         e.heal_partition()
         self.partitioned = False
+        self.pump_membership()   # wiped rows that may legally restart, do
         for r in range(self.cfg.rows):
-            if e.member[r] and not e.alive[r]:
+            if (e.member[r] or e.learner[r]) and not e.alive[r]:
+                # recover() quietly refuses wiped still-configured voters
+                # (their replace ladder may not have committed); the
+                # quorum-liveness gating guarantees a live voter
+                # majority without them, so the probe below still lands
                 e.recover(r)
             e.set_slow(r, False)
         probe = None
@@ -1048,4 +1180,194 @@ def overload_run(
         op_counts=run.history.counts(),
         repro=(f"python -m raft_tpu.chaos --seed {seed} "
                f"--overload-recovery {rate_mult:g}"),
+    )
+
+
+# ------------------------------------------------- reconfiguration drill
+@dataclasses.dataclass
+class ReconfigReport:
+    """One seeded deterministic reconfiguration drill (``reconfig_run``):
+    grow twice through the learner phase, shrink, remove the leader,
+    then wipe-replace a voter — with closed-loop client traffic flowing
+    throughout. Two properties are asserted on top of the history
+    verdict:
+
+    - **availability**: after every configuration commit, a fresh write
+      commits within ``availability_window_s`` VIRTUAL seconds (the
+      documented resume window, docs/MEMBERSHIP.md) — ``events`` carries
+      each op's measured resume time and ``availability_ok`` the
+      conjunction;
+    - **learner catch-up**: ``promote_s`` (fresh join) and
+      ``replace_promote_s`` (rejoin-from-nothing after total durable
+      loss) measure attach -> voter on the virtual clock.
+    """
+
+    seed: int
+    check: CheckResult
+    ops: int
+    op_counts: Dict[str, int]
+    events: List[dict]              # {op, t, resume_s, ok}
+    promote_s: Optional[float]
+    replace_promote_s: Optional[float]
+    availability_window_s: float
+    availability_ok: bool
+    repro: str
+
+    @property
+    def verdict(self) -> str:
+        return self.check.verdict
+
+    def summary(self) -> str:
+        evs = ", ".join(
+            f"{ev['op']}:{ev['resume_s']:.0f}s" if ev["ok"]
+            else f"{ev['op']}:STALLED" for ev in self.events
+        )
+        line = (
+            f"seed {self.seed}: {self.verdict} over {self.ops} ops, "
+            f"resume [{evs}] (window {self.availability_window_s:g}s), "
+            f"promote {self.promote_s:.0f}s, "
+            f"wipe-replace promote {self.replace_promote_s:.0f}s"
+            if self.promote_s is not None
+            and self.replace_promote_s is not None
+            else f"seed {self.seed}: {self.verdict}, drill incomplete"
+        )
+        if self.verdict != LINEARIZABLE or not self.availability_ok:
+            line += f"\n  REPRO: {self.repro}"
+        return line
+
+
+def reconfig_run(
+    seed: int,
+    availability_window_s: float = 120.0,
+    catchup_limit_s: float = 900.0,
+    cfg: Optional[RaftConfig] = None,
+    step_budget: int = 500_000,
+) -> ReconfigReport:
+    """The deterministic reconfiguration scenario behind the acceptance
+    criteria (no random nemesis — ``torture_run(membership=True)``
+    composes; this run isolates the membership story so the
+    availability assertion is crisp):
+
+    1. *Grow 3 -> 4 -> 5*, learner-first: each ``add_server`` attaches a
+       non-voting learner, heals it, auto-promotes at the lag bound.
+    2. *Shrink 5 -> 4*: remove a non-leader voter.
+    3. *Remove the leader*: the removed leader keeps serving until the
+       entry commits, steps down, and the survivors elect (§4.2.2).
+    4. *Wipe-replace*: crash a voter, destroy its durable state
+       entirely (device row + mirrors + vote WAL), and ``replace`` it —
+       removal, learner re-admission of the wiped row under a fresh
+       identity, snapshot-install catch-up, promotion.
+
+    After every configuration commit a probe write must commit within
+    ``availability_window_s`` virtual seconds: reconfiguration is
+    supposed to be something the cluster serves traffic THROUGH, not
+    around.
+    """
+    run = _SingleTorture(
+        seed, 0, 2, 3, 30.0,
+        cfg or _membership_cfg(_default_cfg(seed)), None, None,
+        membership=True,
+    )
+    e = run.engine
+    slice_s = 2 * run.cfg.heartbeat_period
+    events: List[dict] = []
+
+    def drive(seconds: float) -> None:
+        t_end = run.now() + seconds
+        while run.now() < t_end:
+            run._invoke_idle()
+            run.drive(slice_s)
+            run.pump_membership()
+            run._poll_all()
+
+    def probe_resume(op: str) -> None:
+        """A config entry just committed: commit progress must resume
+        inside the window."""
+        t0 = run.now()
+        seq = e.submit(bytes(run.cfg.entry_bytes))
+        end = t0 + availability_window_s
+        while not e.is_durable(seq) and run.now() < end and e._q:
+            e.step_event()
+        ok = e.is_durable(seq)
+        events.append({
+            "op": op, "t": t0,
+            "resume_s": (run.now() - t0) if ok else None, "ok": ok,
+        })
+
+    def until_voter(r: int) -> Optional[float]:
+        """Drive with traffic until row ``r`` is a voter; returns the
+        virtual seconds it took, None on timeout."""
+        t0 = run.now()
+        end = t0 + catchup_limit_s
+        while not e.member[r] and run.now() < end:
+            drive(slice_s)
+        return (run.now() - t0) if e.member[r] else None
+
+    drive(30.0)                                      # baseline traffic
+
+    # 1. grow 3 -> 4 -> 5 through the learner phase
+    t0 = run.now()
+    e.add_server(3)
+    promote_s = until_voter(3)
+    if promote_s is not None:
+        promote_s = run.now() - t0
+    probe_resume("grow")
+    e.add_server(4)
+    until_voter(4)
+    probe_resume("grow")
+
+    # 2. shrink 5 -> 4 (an election gap can straddle any probe window —
+    # re-elect before each leader-required op instead of dying on
+    # leader_id=None with an unrelated traceback)
+    e.run_until_leader(limit=catchup_limit_s)
+    victim = next(
+        r for r in range(run.cfg.rows)
+        if e.member[r] and r != e.leader_id
+    )
+    s_rm = e.remove_server(victim)
+    e.run_until_committed(s_rm, limit=catchup_limit_s)
+    probe_resume("shrink")
+
+    # 3. remove the leader
+    e.run_until_leader(limit=catchup_limit_s)
+    lead = e.leader_id
+    e.remove_server(lead)
+    end = run.now() + catchup_limit_s
+    while e.member[lead] and run.now() < end:
+        drive(slice_s)
+    e.run_until_leader(limit=catchup_limit_s)
+    probe_resume("remove_leader")
+
+    # 4. wipe-replace a voter (rejoin-from-nothing as a learner)
+    e.run_until_leader(limit=catchup_limit_s)
+    victim = next(
+        r for r in range(run.cfg.rows)
+        if e.member[r] and r != e.leader_id
+    )
+    e.fail(victim)
+    e.wipe(victim)
+    run.store.wipe_node(victim)
+    t0 = run.now()
+    e.replace(victim, victim)
+    run._wipe_rejoin.add(victim)
+    end = run.now() + catchup_limit_s
+    while e.member[victim] and run.now() < end:
+        drive(slice_s)        # the removal half of the ladder commits
+    replace_promote_s = (
+        until_voter(victim) if not e.member[victim] else None
+    )
+    if replace_promote_s is not None:
+        replace_promote_s = run.now() - t0
+    probe_resume("wipe_replace")
+
+    run.quiesce()
+    run.history.close()
+    check = check_history(run.history, step_budget=step_budget)
+    return ReconfigReport(
+        seed=seed, check=check, ops=len(run.history),
+        op_counts=run.history.counts(), events=events,
+        promote_s=promote_s, replace_promote_s=replace_promote_s,
+        availability_window_s=availability_window_s,
+        availability_ok=bool(events) and all(ev["ok"] for ev in events),
+        repro=f"python -m raft_tpu.chaos --reconfig --seed {seed}",
     )

@@ -26,6 +26,14 @@ Fault vocabulary (applied between crash and restart):
   previous generation (a filesystem-level rollback / lost write): the
   stale mirror is internally VALID, so recovery must prefer the mirror
   with the higher committed watermark, not merely any valid one.
+- ``wipe_node``     — total disk loss of ONE node: its (term, votedFor)
+  slice is zeroed in every mirror generation (current and previous, so
+  a later ``rollback`` cannot resurrect it) and its records dropped
+  from the vote WAL. Unlike the corruptions above this is a *clean*
+  loss the recovery path is allowed to load — the protocol-level
+  defense is the engine's wiped-voter rule: a node whose durable
+  identity is gone must rejoin through removal + learner re-admission
+  (``RaftEngine.replace``), never resume as a voter.
 
 ``load_best`` is the recovery path under test: validate every mirror
 (sidecar CRC over the raw bytes, then a real ``EngineCheckpoint.load``),
@@ -187,3 +195,53 @@ class MirroredStore:
         os.replace(prev, p)
         os.replace(prev_crc, self._crc_path(p))
         return True
+
+    def wipe_node(self, r: int) -> None:
+        """Destroy node ``r``'s durable identity across the whole store:
+        zero its (term, votedFor) slice in EVERY mirror generation —
+        current and ``.prev``, so neither recovery nor a later
+        ``rollback`` fault can resurrect its votes — and drop its rows
+        from the vote WAL. The mirrors stay internally VALID (fresh CRC
+        sidecars, same generation rank): this is clean disk loss, not
+        corruption, and pairs with ``RaftEngine.wipe``'s in-memory half
+        during the chaos wipe-replace cycle."""
+        from raft_tpu.ckpt import EngineCheckpoint
+        from raft_tpu.ckpt.votelog import _MAGIC, _REC, VoteLog
+
+        for i in range(self.mirrors):
+            for path in (self.mirror_path(i),
+                         self._prev_path(self.mirror_path(i))):
+                crc_path = (
+                    self._crc_path(path) if not path.endswith(".prev")
+                    else self._prev_path(self._crc_path(self.mirror_path(i)))
+                )
+                if not (os.path.exists(path) and os.path.exists(crc_path)):
+                    continue
+                try:
+                    ck = EngineCheckpoint.load(path)
+                    with open(crc_path) as f:
+                        gen = int(f.read().split()[1])
+                except Exception:
+                    continue   # already-corrupt mirrors stay corrupt
+                if not (0 <= r < ck.terms.shape[0]):
+                    continue
+                ck.terms[r] = 0
+                ck.voted_for[r] = -1
+                ck.save(path)
+                with open(path, "rb") as f:
+                    blob = f.read()
+                with open(crc_path, "w") as f:
+                    f.write(f"{zlib.crc32(blob):08x} {gen}\n")
+        # vote WAL: rewrite without r's records (a torn trailing record,
+        # if any, is dropped with the rewrite — same as VoteLog's own
+        # open-path trim)
+        recs = VoteLog.replay(self.votelog_path)
+        if r in recs:
+            del recs[r]
+            with open(self.votelog_path, "wb") as f:
+                f.write(_MAGIC)
+                for q in sorted(recs):
+                    t, v = recs[q]
+                    f.write(_REC.pack(int(q), int(t), int(v)))
+                f.flush()
+                os.fsync(f.fileno())

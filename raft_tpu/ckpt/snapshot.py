@@ -130,11 +130,18 @@ class EngineCheckpoint:
     member: Optional[np.ndarray] = None  # bool[R] configuration at save
     #   time (membership-change clusters); None on older checkpoints or
     #   fixed-membership clusters (= all rows are members)
+    learner: Optional[np.ndarray] = None  # bool[R] non-voting learners at
+    #   save time (dissertation §4.2.1); None on older checkpoints (= no
+    #   learners, the only configuration they could express)
 
     def save(self, path: str) -> None:
         member = (
             self.member if self.member is not None
             else np.ones_like(self.terms, bool)
+        )
+        learner = (
+            self.learner if self.learner is not None
+            else np.zeros_like(self.terms, bool)
         )
         _atomic_savez(
             path,
@@ -145,6 +152,7 @@ class EngineCheckpoint:
             replica_terms=self.terms,
             voted_for=self.voted_for,
             member=np.asarray(member, bool),
+            learner=np.asarray(learner, bool),
         )
 
     @classmethod
@@ -162,6 +170,10 @@ class EngineCheckpoint:
                 voted_for=np.asarray(z["voted_for"], np.int32),
                 member=(
                     np.asarray(z["member"], bool) if "member" in z else None
+                ),
+                learner=(
+                    np.asarray(z["learner"], bool) if "learner" in z
+                    else None
                 ),
             )
 

@@ -34,6 +34,13 @@ class RaftConfig:
     # dissertation-§4 single-server change) needs rows allocated up front.
     # None = fixed membership at n_replicas (no spare rows, no change).
     max_replicas: Optional[int] = None
+    # Learner promotion lag (entries): ``promote`` commits the voter
+    # config entry only once the learner's current-term verified match is
+    # within this many entries of the leader's last index — the
+    # dissertation-§4.2.1 catch-up gate that keeps a far-behind joiner
+    # from ever counting against the commit quorum. None = 2 * batch_size
+    # (one in-flight window of slack). See docs/MEMBERSHIP.md.
+    promote_max_lag: Optional[int] = None
 
     # --- erasure coding (config 3); k = data shards, m = parity shards ---
     # None disables EC: every replica stores the full payload, like the
@@ -210,6 +217,8 @@ class RaftConfig:
             # shards per entry instead of n-k, paid at encode time and in
             # ring lanes — the TPU-native trade: static shapes, zero
             # re-encode on reconfiguration.
+        if self.promote_max_lag is not None and self.promote_max_lag < 1:
+            raise ValueError("promote_max_lag must be >= 1 (or None)")
         if self.steady_dispatch not in ("auto", "off"):
             raise ValueError('steady_dispatch must be "auto" or "off"')
         if self.pipeline_max_laps < 1:

@@ -58,6 +58,41 @@ from raft_tpu.config import RaftConfig
 # replication scan from one captured scalar).
 NO_VOTE = -1
 
+# Packed membership mask bits (learner phase, dissertation §4.2.1). A
+# configuration with learners travels to the device step as ONE int32[R]
+# mask — bit 0 marks a VOTER of the current configuration, bit 1 a
+# non-voting LEARNER. The step decomposes it at the kernel boundary
+# (``membership_voters``): quorum denominators, ack masks and the §5.4.2
+# commit gate all count voters only, while learners ride the step's
+# ``alive`` mask (they hear windows, append, adopt terms and advance
+# commit, contributing nothing to any quorum). A plain bool[R] mask keeps
+# its legacy meaning (every True row is a voter), so existing
+# fixed-and-voter-only configurations are bit-exact no-ops.
+VOTER_BIT = 1
+LEARNER_BIT = 2
+
+
+def pack_membership(member: np.ndarray, learner: np.ndarray) -> np.ndarray:
+    """Host masks (voters, learners) -> packed int32[R] membership mask
+    (``VOTER_BIT`` | ``LEARNER_BIT``). A row must not carry both bits —
+    promotion swaps learner for voter in one configuration entry."""
+    m = np.asarray(member, bool)
+    l = np.asarray(learner, bool)
+    if (m & l).any():
+        raise ValueError("a row cannot be both voter and learner")
+    return (
+        m.astype(np.int32) * VOTER_BIT + l.astype(np.int32) * LEARNER_BIT
+    )
+
+
+def membership_voters(mask: jax.Array) -> jax.Array:
+    """The bool voter mask of a membership mask: identity for bool masks
+    (legacy voter-only configs), the ``VOTER_BIT`` plane of a packed
+    int mask. Static on dtype, so jit traces exactly one branch."""
+    if mask.dtype == jnp.bool_ or mask.dtype == np.bool_:
+        return mask
+    return (mask & VOTER_BIT) != 0
+
 
 @struct.dataclass
 class ReplicaState:

@@ -118,13 +118,20 @@ def replicate_step(
     #   The repair window must never serve from that region (followers
     #   below it rejoin via snapshot install); the engine passes its
     #   host-tracked ring-validity floor for the current leader.
-    member: jax.Array | None = None,  # bool[R] current configuration
-    #   (membership change). None = every row is a member and the commit
-    #   quorum is the static ``commit_quorum``; an array makes the quorum
-    #   DYNAMIC: strict majority of members (dead members still count in
-    #   the denominator — Raft quorums are over the configuration). The
-    #   engine composes membership into the ``alive`` mask it passes, so
-    #   non-member rows also neither hear windows nor contribute acks.
+    member: jax.Array | None = None,  # bool[R] current configuration, or
+    #   a packed int32[R] membership mask (core.state.pack_membership)
+    #   when the configuration carries non-voting LEARNERS. None = every
+    #   row is a member and the commit quorum is the static
+    #   ``commit_quorum``; an array makes the quorum DYNAMIC: strict
+    #   majority of VOTERS (dead voters still count in the denominator —
+    #   Raft quorums are over the configuration). The engine composes
+    #   membership into the ``alive`` mask it passes, so non-member rows
+    #   neither hear windows nor contribute acks — and a LEARNER is
+    #   exactly a row the engine keeps in ``alive`` (it hears windows,
+    #   appends, adopts terms, advances commit) while the voter mask
+    #   decomposed here (``membership_voters``) excludes it from the
+    #   quorum denominator, the ack mask and the §5.4.2 gate. Bool masks
+    #   keep their legacy all-voter meaning bit-exactly.
     *,
     ec: bool = False,
     commit_quorum: int | None = None,
@@ -175,6 +182,15 @@ def replicate_step(
     cap = state.capacity
     B = client_payload.shape[0]
     M = client_payload.shape[1]                    # L * W folded lanes
+    if member is not None:
+        # decompose a packed membership mask (learner bit) into the bool
+        # voter mask EVERY downstream formulation counts quorums over —
+        # here, before dispatch, so the fused mesh/pallas programs and
+        # the XLA path all see the same bool mask (bit-exact for legacy
+        # bool masks: membership_voters is the identity on them)
+        from raft_tpu.core.state import membership_voters
+
+        member = membership_voters(member)
     from raft_tpu.core.comm import MeshComm, SingleDeviceComm
 
     if (
@@ -492,6 +508,13 @@ def scan_replicate(
     ``payloads``: i32[T, B, L*W] folded batches; ``counts``: i32[T];
     ``repair`` selects the repair-capable vs steady-state step program."""
     from raft_tpu.core.comm import MeshComm, SingleDeviceComm
+
+    if member is not None:
+        # same boundary decomposition as replicate_step: the scan-level
+        # fused dispatches below must see the bool voter mask
+        from raft_tpu.core.state import membership_voters
+
+        member = membership_voters(member)
 
     cap, B = state.capacity, payloads.shape[1]
     if (

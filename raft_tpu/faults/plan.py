@@ -108,11 +108,12 @@ class FaultPlan:
         n_replicas: int,
         alive=None,
         strict: bool = True,
+        membership=None,
     ) -> List[FaultEvent]:
         """Check the plan's kill events against the quorum-liveness rule:
         simulated in time order (same-``t`` ties in list order, matching
         ``merged``), no ``kill`` may leave fewer than a strict majority
-        of the ``n_replicas`` cluster alive — a plan that does cannot
+        of the CURRENT voter set alive — a plan that does cannot
         quiesce and proves nothing. ``alive`` optionally seeds the
         per-replica aliveness (default: all up). Returns the offending
         kill events (each treated as NOT executed for the rest of the
@@ -120,31 +121,94 @@ class FaultPlan:
         schedule); with ``strict=True`` (the default) raises
         ``ValueError`` on the first one instead.
 
+        ``membership`` makes the rule configuration-aware (live
+        reconfiguration — the round-9 membership plane): either a
+        time-ordered sequence of ``(t, member_rows)`` pairs (the voter
+        set from instant ``t`` on; the walk switches sets as its clock
+        passes each ``t``) or a callable ``t -> member_rows``. Kills of
+        NON-members never count against quorum (a dead spare or learner
+        keeps no one out of office), the majority denominator is the
+        current voter set's size — and a membership *transition* that
+        itself strands the new set below a live majority (a shrink
+        landing on mostly-dead voters) is an offense of its own,
+        reported as a synthetic ``kill``-less offense via ``ValueError``
+        under ``strict`` (non-strict walks skip to the next timeline
+        entry, mirroring the kill handling). ``membership=None`` keeps
+        the legacy fixed-membership rule bit-for-bit.
+
         The walk models only kill/recover (partitions and slow windows
-        do not change aliveness) and assumes fixed membership — plans
-        driving a live-membership engine should validate against the
-        smallest membership the schedule reaches."""
+        do not change aliveness)."""
         up = list(alive) if alive is not None else [True] * n_replicas
         if len(up) != n_replicas:
             raise ValueError(
                 f"alive has {len(up)} entries for {n_replicas} replicas"
             )
-        majority = n_replicas // 2 + 1
+        if callable(membership):
+            member_at = membership
+            timeline: List[Tuple[float, Tuple[int, ...]]] = []
+        elif membership is not None:
+            timeline = sorted(
+                (float(t), tuple(m)) for t, m in membership
+            )
+            member_at = None
+        else:
+            timeline, member_at = [], None
+
+        def members_for(t: float):
+            if member_at is not None:
+                return sorted(set(int(r) for r in member_at(t)))
+            # before the first timeline entry takes effect, the legacy
+            # rule governs (every row is a voter) — seeding with the
+            # first entry would judge pre-transition kills against a
+            # FUTURE configuration
+            cur = tuple(range(n_replicas))
+            for tt, m in timeline:
+                if tt <= t:
+                    cur = m
+                else:
+                    break
+            return sorted(set(int(r) for r in cur))
+
+        def check_transition(t: float, members) -> None:
+            live = sum(1 for r in members if 0 <= r < n_replicas and up[r])
+            if live < len(members) // 2 + 1:
+                raise ValueError(
+                    f"membership at t={t} leaves {live} of "
+                    f"{len(members)} voters alive (majority is "
+                    f"{len(members) // 2 + 1}); a post-shrink cluster "
+                    "below live quorum cannot quiesce"
+                )
+
         offending: List[FaultEvent] = []
+        pending = list(timeline)
         for ev in sorted(self.events, key=lambda e: e.t):
+            while pending and pending[0][0] <= ev.t:
+                tt, m = pending.pop(0)
+                if strict:
+                    check_transition(tt, list(m))
+            members = members_for(ev.t)
+            majority = len(members) // 2 + 1
             if ev.action == "recover":
                 if 0 <= ev.replica < n_replicas:
                     up[ev.replica] = True
             elif ev.action == "kill" and 0 <= ev.replica < n_replicas:
-                if up[ev.replica] and sum(up) - 1 < majority:
+                if ev.replica not in members:
+                    # spares and learners die for free: no quorum impact
+                    up[ev.replica] = False
+                    continue
+                live = sum(1 for r in members if up[r])
+                if up[ev.replica] and live - 1 < majority:
                     if strict:
                         raise ValueError(
                             f"kill of replica {ev.replica} at t={ev.t} "
-                            f"leaves {sum(up) - 1} of {n_replicas} alive "
-                            f"(majority is {majority}); a plan below "
-                            "majority cannot quiesce"
+                            f"leaves {live - 1} of {len(members)} voters "
+                            f"alive (majority is {majority}); a plan "
+                            "below majority cannot quiesce"
                         )
                     offending.append(ev)
                 else:
                     up[ev.replica] = False
+        if strict:
+            for tt, m in pending:   # transitions after the last event
+                check_transition(tt, list(m))
         return offending

@@ -2,7 +2,7 @@
 program (``MultiEngine``), behind a key-routed sharding front end
 (``Router``). See ``multi.engine`` for the design notes."""
 
-from raft_tpu.multi.engine import MultiEngine, NotLeader
+from raft_tpu.multi.engine import MultiEngine, NotLeader, UnsupportedMembership
 from raft_tpu.multi.router import Router
 
-__all__ = ["MultiEngine", "NotLeader", "Router"]
+__all__ = ["MultiEngine", "NotLeader", "Router", "UnsupportedMembership"]

@@ -80,6 +80,18 @@ class NotLeader(Exception):
         self.partial: Optional[list] = None
 
 
+class UnsupportedMembership(ValueError):
+    """MultiEngine runs FIXED membership only: live reconfiguration
+    (``max_replicas`` headroom, learners, ``add_server``/``replace``) is
+    a single-group ``RaftEngine`` capability — the group-batched device
+    program compiles one static row count for every group, and a
+    per-group dynamic voter set would fork the launch shapes the whole
+    design fuses. Typed (a ``ValueError`` subclass, so existing broad
+    handlers keep working) so callers and tests can assert the scope
+    refusal precisely instead of string-matching; see
+    docs/MEMBERSHIP.md for the single-group-only scope note."""
+
+
 _PROGRAMS: Dict[int, tuple] = {}
 
 
@@ -117,9 +129,10 @@ class MultiEngine:
                 "single-group RaftEngine for EC clusters"
             )
         if cfg.max_replicas is not None:
-            raise ValueError(
+            raise UnsupportedMembership(
                 "MultiEngine runs fixed membership; max_replicas must be "
-                "None"
+                "None (live reconfiguration — learners, add_server, "
+                "replace — is single-group RaftEngine scope)"
             )
         if cfg.transport != "single":
             # loud, like the other unsupported knobs: the group axis is

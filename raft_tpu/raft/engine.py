@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.admission import AdmissionGate
+from raft_tpu.admission import AdmissionGate, Overloaded
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import NO_VOTE, ReplicaState, fold_batch
 from raft_tpu.transport.base import Transport, make_transport
@@ -97,6 +97,16 @@ class TicketEvicted(LinearizableReadRefused):
     poll-once: a ticket already consumed by ``read_confirmed`` that is
     re-polled after the eviction floor passed it also reads as evicted,
     not ``KeyError`` — indistinguishable by design, identical action."""
+
+
+class LearnerLagging(RuntimeError):
+    """``promote`` refused: the learner's current-term verified match is
+    still more than ``cfg.promote_max_lag`` entries behind the leader's
+    last index. Promoting now would let a far-behind row count against
+    the commit quorum — the availability regression the learner phase
+    exists to prevent (dissertation §4.2.1). Retry once replication /
+    snapshot install has caught the learner up; the engine's own staged
+    promotion (``add_server`` / ``replace``) retries every leader tick."""
 
 
 class MirrorDesyncError(Exception):
@@ -175,6 +185,30 @@ class RaftEngine:
         #   add_server commits them in. Quorums are counted over members
         #   (the device step receives the mask for its denominator; the
         #   engine composes it into every reach mask).
+        self.learner = np.zeros(n, bool)
+        #   Non-voting learners (dissertation §4.2.1): rows that receive
+        #   replication, repair and snapshot install (they ride the
+        #   replication reach mask) but are excluded from vote reach,
+        #   commit counting and CheckQuorum. ``promote`` turns a
+        #   caught-up learner into a voter via an ordinary configuration
+        #   entry; ``add_server`` is learner-then-promote.
+        self._wiped = np.zeros(n, bool)
+        #   Rows whose durable identity was destroyed by ``wipe`` while
+        #   still a configured VOTER. Such a row must never run again
+        #   under its old identity (it may have voted or acked durably —
+        #   restarting it amnesiac is the classic double-vote /
+        #   lost-ack hazard); ``recover`` refuses until the row has been
+        #   removed from the configuration (``replace``), after which it
+        #   may rejoin as a fresh learner.
+        self._staged_config: List[Tuple[str, int]] = []
+        #   Deferred single-server steps ("add_learner" / "promote",
+        #   row): the learner-then-promote ladder of ``add_server`` and
+        #   the remove→add_learner→promote ladder of ``replace``. The
+        #   routed leader tick drives the head whenever no change is in
+        #   flight; a lagging learner's "promote" simply waits
+        #   (LearnerLagging) until catch-up. Host-only state: lost on a
+        #   whole-process restart like any other in-flight intent (the
+        #   operator re-issues; committed config state is durable).
         self.roles: List[str] = [FOLLOWER] * n
         self.terms = np.zeros(n, np.int64)     # host mirror for timer logic
         self.lead_terms = np.zeros(n, np.int64)
@@ -255,6 +289,11 @@ class RaftEngine:
         #   their range to the last log_capacity entries, so the store
         #   compacts beyond 2x that instead of growing without bound.
         self._lasts_snapshot = None   # see _pre_lasts
+        self._match_snapshot = None
+        #   cached (match_index, match_term) host pair for
+        #   _effective_match — same lifetime as _lasts_snapshot:
+        #   refreshed lazily, dropped whenever a step or host-side
+        #   mutation moves match state
         self._term_floor = 1   # first log index of the current leader's
         #   term (dissertation §5.4.2 gate for the fused steady program,
         #   core.step_pallas): set to last_index+1 on every election win,
@@ -576,7 +615,12 @@ class RaftEngine:
                 self.state, info = self.t.replicate_pipeline(
                     self.state, payload_stack, jnp.asarray(counts), r,
                     self.leader_term, jnp.asarray(eff),
-                    jnp.asarray(self.slow), member=self._member_arg(),
+                    jnp.asarray(self.slow),
+                    # the pipeline kernel takes the bool VOTER plane
+                    # directly (no packed-mask decomposition on this
+                    # entry point — unlike replicate/scan_replicate)
+                    member=(jnp.asarray(self.member)
+                            if self.cfg.max_replicas is not None else None),
                     repair_floor=floor, floor_prev_term=fpt,
                     term_floor=self._term_floor,
                     # write-only turnover only when the host's verified
@@ -777,6 +821,11 @@ class RaftEngine:
                 quorum = max(quorum, cfg.commit_quorum)
         else:
             quorum = cfg.commit_quorum
+        if cfg.max_replicas is not None:
+            # the kernel counts acks over VOTERS (alive & member on
+            # device); learner rows in the accept set replicate but must
+            # not be counted toward the host's quorum feasibility either
+            return int((accept & self.member).sum()) >= quorum
         return int(accept.sum()) >= quorum
 
     @property
@@ -839,10 +888,10 @@ class RaftEngine:
         if int(self.terms[r]) > int(self.lead_terms[r]):
             self._step_down_leader(r, int(self.terms[r]))
             raise LinearizableReadRefused("deposed (higher term seen)")
-        eff = self._reach(r)
-        if int(eff.sum()) <= int(self.member.sum()) // 2:
+        voters = self._voter_reach(r)
+        if int(voters.sum()) <= int(self.member.sum()) // 2:
             raise LinearizableReadRefused(
-                f"quorum unreachable ({int(eff.sum())} of "
+                f"quorum unreachable ({int(voters.sum())} of "
                 f"{int(self.member.sum())} members)"
             )
         tk = self._next_read_ticket
@@ -926,7 +975,11 @@ class RaftEngine:
         stays bounded by the FIFO eviction cap."""
         if not self._reads:
             return
-        if max_term > term or int(eff.sum()) <= int(self.member.sum()) // 2:
+        # quorum is counted over reachable VOTERS: the replication reach
+        # mask also carries learners, whose acks confirm nothing
+        if max_term > term or (
+            int((eff & self.member).sum()) <= int(self.member.sum()) // 2
+        ):
             return
         bucket = self._read_buckets.pop((r, term), None)
         if not bucket:
@@ -979,9 +1032,10 @@ class RaftEngine:
         read_index = self.commit_watermark
         eff = self._reach(r)
         # (b) first — it needs no device round and a minority-side leader
-        # must be refused even while its own side is quiet. _reach already
-        # intersects membership, so eff counts members only.
-        confirmed = int(eff.sum())
+        # must be refused even while its own side is quiet. The quorum is
+        # counted over reachable VOTERS (eff also carries learners, which
+        # hear the confirmation round but confirm nothing).
+        confirmed = int((eff & self.member).sum())
         if confirmed <= int(self.member.sum()) // 2:
             raise LinearizableReadRefused(
                 f"quorum unreachable ({confirmed} of "
@@ -1026,29 +1080,52 @@ class RaftEngine:
 
     # ------------------------------------------------------------- membership
     def _member_arg(self):
-        """The member mask for device steps — None on fixed-membership
-        clusters (their programs compile the static quorum)."""
+        """The membership mask for device steps — None on fixed-membership
+        clusters (their programs compile the static quorum), the bool
+        voter mask while no learner is attached (bit-exact legacy), the
+        packed voter|learner mask (core.state.pack_membership) otherwise
+        — the step decomposes it back to the voter plane at the kernel
+        boundary. The dtype flip (bool <-> int32) retraces the replicate
+        programs once per learner-attach/drain transition — a deliberate
+        cost: the packed mask is the device-visible record of the full
+        configuration, so the core/step learner support stays exercised
+        end to end rather than test-only. replicate_pipeline is the one
+        entry point that takes the bool voter plane directly (see the
+        submit_pipelined call site)."""
         if self.cfg.max_replicas is None:
             return None
+        if self.learner.any():
+            from raft_tpu.core.state import pack_membership
+
+            return jnp.asarray(pack_membership(self.member, self.learner))
         return jnp.asarray(self.member)
 
-    def _config_payload(self, new_mask: np.ndarray) -> bytes:
+    def _config_payload(self, member: np.ndarray, learner: np.ndarray) -> bytes:
         """Configuration entries ride the log like data (the §4 approach:
-        a config change IS a log entry): magic + the member bitmap,
-        padded to entry_bytes."""
-        bits = int(sum(1 << i for i in np.flatnonzero(new_mask)))
+        a config change IS a log entry): magic + the voter bitmap, plus a
+        learner bitmap when (and only when) the NEW configuration
+        carries learners — an omitted bitmap means an empty learner
+        set, so voter-only entries stay byte-identical to every
+        pre-learner configuration entry."""
+        bits = int(sum(1 << i for i in np.flatnonzero(member)))
         body = b"RCFG" + bits.to_bytes(8, "little")
+        if np.asarray(learner, bool).any():
+            lbits = int(sum(1 << i for i in np.flatnonzero(learner)))
+            body += lbits.to_bytes(8, "little")
         if len(body) > self.cfg.entry_bytes:
             raise ValueError(
                 "entry_bytes too small to carry a configuration entry"
             )
         return body + bytes(self.cfg.entry_bytes - len(body))
 
-    def _change_membership(self, new_mask: np.ndarray) -> int:
+    def _change_membership(self, new_member: np.ndarray,
+                           new_learner: np.ndarray) -> int:
         if self.cfg.max_replicas is None:
             raise ValueError(
                 "membership change needs max_replicas headroom in RaftConfig"
             )
+        if (np.asarray(new_member, bool) & np.asarray(new_learner, bool)).any():
+            raise ValueError("a row cannot be both voter and learner")
         if self._pending_config is not None or any(
             q in self._config_seqs for q, _ in self._queue
         ):
@@ -1061,31 +1138,123 @@ class RaftEngine:
             )
         if self.leader_id is None:
             raise RuntimeError("membership change needs a current leader")
-        seq = self.submit(self._config_payload(new_mask))
+        seq = self.submit(self._config_payload(new_member, new_learner))
         self._config_seqs[seq] = (
-            tuple(bool(x) for x in self.member),
-            tuple(bool(x) for x in new_mask),
+            (tuple(bool(x) for x in self.member),
+             tuple(bool(x) for x in self.learner)),
+            (tuple(bool(x) for x in new_member),
+             tuple(bool(x) for x in new_learner)),
         )
         return seq
 
+    def add_learner(self, r: int) -> int:
+        """Attach row ``r`` as a NON-VOTING learner (dissertation §4.2.1):
+        it receives replication, repair and snapshot install like any
+        member but is excluded from vote reach, commit counting and
+        CheckQuorum — so a fresh, far-behind row can never shrink the
+        effective quorum. Returns the config entry's seq. ``promote``
+        makes it a voter once caught up."""
+        if not (0 <= r < self.cfg.rows):
+            raise ValueError(f"replica {r} out of range (rows={self.cfg.rows})")
+        if self.member[r]:
+            raise ValueError(f"replica {r} is already a voter")
+        if self.learner[r]:
+            raise ValueError(f"replica {r} is already a learner")
+        new_l = self.learner.copy()
+        new_l[r] = True
+        return self._change_membership(self.member.copy(), new_l)
+
+    def _promote_lag_bound(self) -> int:
+        lag = self.cfg.promote_max_lag
+        return lag if lag is not None else 2 * self.cfg.batch_size
+
+    def promote(self, r: int) -> int:
+        """Promote learner ``r`` to a voter — one configuration entry
+        swapping its learner bit for the voter bit. Refuses with
+        ``LearnerLagging`` while the learner's current-term verified
+        match is more than ``cfg.promote_max_lag`` entries behind the
+        leader's last index (the §4.2.1 catch-up gate): the whole point
+        of the learner phase is that the voter set only ever grows by a
+        row that can immediately pull its quorum weight."""
+        if not self.learner[r]:
+            raise ValueError(f"replica {r} is not a learner")
+        lead = self.leader_id
+        if lead is None:
+            raise RuntimeError("promotion needs a current leader")
+        if not self.alive[r]:
+            # a dead learner trivially "satisfies" any lag bound on a
+            # short log (its match is 0 and so is everyone's gap), but
+            # promoting a row that cannot ack is exactly the quorum
+            # shrink this phase exists to prevent
+            raise LearnerLagging(
+                f"learner {r} is down; promotion requires a live, "
+                "caught-up learner"
+            )
+        lasts_matches = self._fetch(jnp.stack([
+            self.state.last_index, self.state.match_index,
+            self.state.match_term,
+        ]))
+        leader_last = int(lasts_matches[0, lead])
+        eff_match = (
+            int(lasts_matches[1, r])
+            if int(lasts_matches[2, r]) == int(self.lead_terms[lead]) else 0
+        )
+        lag = leader_last - eff_match
+        if lag > self._promote_lag_bound():
+            raise LearnerLagging(
+                f"learner {r} is {lag} entries behind the leader "
+                f"(bound {self._promote_lag_bound()}); promote once "
+                "replication / snapshot install has caught it up"
+            )
+        new_m = self.member.copy()
+        new_m[r] = True
+        new_l = self.learner.copy()
+        new_l[r] = False
+        return self._change_membership(new_m, new_l)
+
     def add_server(self, r: int) -> int:
-        """Grow the cluster by one server (dissertation §4: a log-committed
-        configuration entry; the new config takes effect when APPENDED,
-        commits under its own majority). Returns the config entry's seq —
-        durable via ``is_durable`` like any entry. The new row joins empty
-        and is healed by the repair window / snapshot install."""
+        """Grow the cluster by one server, learner-first (dissertation
+        §4.2.1): row ``r`` joins as a non-voting learner (this call's
+        returned seq is the learner config entry — durable via
+        ``is_durable``), is healed by the repair window / snapshot
+        install, and is promoted to voter AUTOMATICALLY by the leader
+        tick once its match is within ``cfg.promote_max_lag`` of the
+        leader's tail. The voter set therefore never gains a row that
+        would shrink the effective quorum; poll ``engine.member[r]`` (or
+        ``run_until_voter``) for full-join completion. The legacy
+        immediate-voter path remains as ``add_voter``."""
+        seq = self.add_learner(r)
+        self._staged_config.append(("promote", r))
+        return seq
+
+    def add_voter(self, r: int) -> int:
+        """Grow the cluster by one IMMEDIATE voter (dissertation §4: a
+        log-committed configuration entry; the new config takes effect
+        when APPENDED, commits under its own majority). Returns the
+        config entry's seq. The new row joins empty and is healed by the
+        repair window / snapshot install — and until it catches up it
+        counts against the commit quorum, which is exactly the
+        availability hazard ``add_server``'s learner-first flow avoids;
+        prefer that unless the joiner is known to be caught up."""
         if not (0 <= r < self.cfg.rows):
             raise ValueError(f"replica {r} out of range (rows={self.cfg.rows})")
         if self.member[r]:
             raise ValueError(f"replica {r} is already a member")
         new = self.member.copy()
         new[r] = True
-        return self._change_membership(new)
+        new_l = self.learner.copy()
+        new_l[r] = False   # promoting a learner directly is allowed
+        return self._change_membership(new, new_l)
 
     def remove_server(self, r: int) -> int:
-        """Shrink the cluster by one server. Removing the current leader
-        is allowed: it keeps leading until the entry commits, then steps
-        down (dissertation §4.2.2)."""
+        """Shrink the cluster by one server (voter or learner). Removing
+        the current leader is allowed: it keeps leading until the entry
+        commits, then steps down (dissertation §4.2.2). Removing a
+        learner never changes any quorum."""
+        if self.learner[r]:
+            new_l = self.learner.copy()
+            new_l[r] = False
+            return self._change_membership(self.member.copy(), new_l)
         if not self.member[r]:
             raise ValueError(f"replica {r} is not a member")
         new = self.member.copy()
@@ -1099,7 +1268,84 @@ class RaftEngine:
                 f"removing replica {r} leaves {int(new.sum())} members, "
                 f"below the EC commit quorum ({self.cfg.commit_quorum})"
             )
-        return self._change_membership(new)
+        return self._change_membership(new, self.learner.copy())
+
+    def replace(self, dead: int, spare: int) -> int:
+        """Replace a DEAD voter with ``spare`` (node replacement, the
+        wipe-rejoin runbook of docs/MEMBERSHIP.md): remove ``dead`` from
+        the configuration now (returns that entry's seq), then — staged,
+        one change at a time — admit ``spare`` as a learner, heal it
+        from nothing via repair / snapshot install, and promote it once
+        caught up. ``spare == dead`` re-admits the same row under a
+        FRESH identity, which is the only safe way back in for a row
+        whose durable state was lost (``wipe``): its old votes and acks
+        are gone, so it must not resume its old voter identity."""
+        if not self.member[dead]:
+            raise ValueError(f"replica {dead} is not a member")
+        if self.alive[dead]:
+            raise ValueError(
+                f"replica {dead} is alive; replace() is for dead servers "
+                "(fail() it first, or use remove_server/add_server)"
+            )
+        if not (0 <= spare < self.cfg.rows):
+            # range first: a mask read on an out-of-range (or negative)
+            # row would raise IndexError / probe the wrong row
+            raise ValueError(f"spare {spare} out of range")
+        if spare != dead and (self.member[spare] or self.learner[spare]):
+            raise ValueError(f"spare {spare} is already configured")
+        seq = self.remove_server(dead)
+        self._staged_config.extend(
+            [("add_learner", spare), ("promote", spare)]
+        )
+        return seq
+
+    def _drive_staged_config(self, r: int) -> None:
+        """Advance the head of the staged single-server ladder
+        (``add_server`` auto-promotion, ``replace``) when no change is
+        in flight. Runs on the routed leader's tick; a lagging learner's
+        promote just waits (retried next tick)."""
+        if not self._staged_config:
+            return
+        if self._pending_config is not None or any(
+            q in self._config_seqs for q, _ in self._queue
+        ):
+            return
+        kind, row = self._staged_config[0]
+        if kind == "add_learner":
+            if self.member[row] or self.learner[row]:
+                self._staged_config.pop(0)   # already in — ladder advances
+                return
+            try:
+                self.add_learner(row)
+            except (RuntimeError, ValueError, Overloaded):
+                return   # no leader yet / admission shedding: retry later
+            self._staged_config.pop(0)
+        elif kind == "promote":
+            if self.member[row] or not self.learner[row]:
+                # already a voter, or the learner was removed/rolled back
+                # out from under the ladder: the staged step is moot
+                self._staged_config.pop(0)
+                return
+            try:
+                self.promote(row)
+            except LearnerLagging:
+                return                       # still catching up: retry
+            except (RuntimeError, ValueError, Overloaded):
+                return
+            self._staged_config.pop(0)
+
+    def run_until_voter(self, r: int, limit: float = 600.0) -> None:
+        """Drive the event loop until row ``r`` is a VOTER — the
+        completion point of ``add_server``'s learner-then-promote flow
+        (and of a ``replace`` ladder's final step)."""
+        end = self.clock.now + limit
+        while not self.member[r] and self.clock.now < end and self._q:
+            self.step_event()
+        assert self.member[r], (
+            f"replica {r} not promoted to voter within {limit}s "
+            f"(learner={bool(self.learner[r])}, "
+            f"staged={self._staged_config})"
+        )
 
     def _note_config_ingest(self, idx: int, seq: int, term: int) -> None:
         """A configuration entry reached the leader's log: activate the
@@ -1110,38 +1356,62 @@ class RaftEngine:
             return
         old, new = ch
         self._pending_config = (idx, old, new, term)
-        #   (index, old mask, new mask, ingest term) — the term makes the
-        #   keep-if-held check self-contained across later elections
-        self._apply_membership(np.array(new, bool))
+        #   (index, old (member, learner), new (member, learner), ingest
+        #   term) — the term makes the keep-if-held check self-contained
+        #   across later elections
+        self._apply_membership(np.array(new[0], bool), np.array(new[1], bool))
 
     def _rollback_pending_config(self, r: int, reason: str) -> None:
         """Roll an in-flight (uncommitted) configuration change back to
-        its old mask — the entry no longer survives in the relevant log
+        its old masks — the entry no longer survives in the relevant log
         (election winner doesn't hold it / truncation removed it from
         every row). Its seq never reads durable; the operator retries."""
-        _, old_mask, _, _ = self._pending_config
+        _, old_masks, _, _ = self._pending_config
         self._pending_config = None
-        self._apply_membership(np.array(old_mask, bool))
+        self._apply_membership(
+            np.array(old_masks[0], bool), np.array(old_masks[1], bool)
+        )
         self.nodelog(r, reason)
 
-    def _apply_membership(self, new: np.ndarray) -> None:
+    def _apply_membership(self, new: np.ndarray,
+                          new_learner: np.ndarray) -> None:
         added = new & ~self.member
         removed = self.member & ~new
+        l_added = new_learner & ~self.learner
+        l_removed = self.learner & ~new_learner
         self.member = new
+        self.learner = new_learner
         self._steady = False
         for p in np.flatnonzero(added):
             p = int(p)
             self.roles[p] = FOLLOWER
-            self.nodelog(p, "added to configuration")
+            if l_removed[p]:
+                self.nodelog(p, "promoted from learner to voter")
+            else:
+                self.nodelog(p, "added to configuration")
             self._arm_follower(p)
         for p in np.flatnonzero(removed):
             p = int(p)
             self.nodelog(p, "removed from configuration")
+            # NOTE: _wiped is deliberately NOT cleared here — this runs
+            # at APPEND-time activation, which can still roll back. A
+            # wiped voter may only restart once the removal is DURABLE
+            # (_advance_commit clears the flag at config commit);
+            # clearing on an uncommitted removal would let a rollback
+            # resurrect a live amnesiac voter — the double-vote hazard.
             # a removed LEADER keeps serving until the entry commits
             # (the _advance_commit hook demotes it); everyone else's
             # timers simply stop firing (gated on member)
             if self.roles[p] != LEADER:
                 self.roles[p] = FOLLOWER
+        for p in np.flatnonzero(l_added):
+            p = int(p)
+            self.roles[p] = FOLLOWER
+            self.nodelog(p, "added to configuration as learner")
+            # learners arm no election timers: they never campaign
+        for p in np.flatnonzero(l_removed & ~added):
+            p = int(p)
+            self.nodelog(p, "learner removed from configuration")
 
     # ---------------------------------------------------------- fault toggles
     def fail(self, r: int) -> None:
@@ -1156,11 +1426,73 @@ class RaftEngine:
         self.nodelog(r, "killed")
 
     def recover(self, r: int) -> None:
+        if self._wiped[r]:
+            # A wiped row whose voter identity has not durably LEFT the
+            # configuration must not run again: its durable (term,
+            # votedFor) and acked entries are gone, so restarting it
+            # amnesiac could double-vote in a term it already voted in
+            # (two leaders, split-brain commits) or silently un-ack
+            # committed data. The flag clears only when a removal
+            # COMMITS (_advance_commit) — an append-time activation can
+            # still roll back, so `not member[r]` alone is not evidence
+            # the identity is gone. The only safe path back is
+            # replace(): remove the identity, let it commit, rejoin as a
+            # fresh learner. Refusal is a quiet no-op so seeded fault
+            # schedules stay executable.
+            self.nodelog(
+                r, "recover refused: wiped voter must rejoin via replace()"
+            )
+            return
         self._steady = False
         self.alive[r] = True
         self.roles[r] = FOLLOWER
         self.nodelog(r, "recovered")
         self._arm_follower(r)
+
+    def wipe(self, r: int) -> None:
+        """Destroy a DEAD row's entire durable and volatile state — log,
+        term, vote, match, commit — modeling total disk loss. The row's
+        bytes are zeroed on device and its host mirrors reset; if it was
+        a configured VOTER it is marked wiped and ``recover`` refuses to
+        restart it until ``replace`` has removed the old identity from
+        the configuration (the double-vote hazard — see ``recover``).
+        Rejoin is then from nothing: learner admission + snapshot
+        install. The chaos 'wipe' fault composes this with
+        ``MirroredStore.wipe_node`` so the loss covers the simulated
+        disk too."""
+        if self.alive[r]:
+            raise ValueError(
+                f"replica {r} is alive; wipe() models disk loss of a "
+                "crashed server (fail() it first)"
+            )
+        w = self.state.words_per_entry
+        self.state = self.state.replace(
+            term=self.state.term.at[r].set(0),
+            voted_for=self.state.voted_for.at[r].set(NO_VOTE),
+            last_index=self.state.last_index.at[r].set(0),
+            commit_index=self.state.commit_index.at[r].set(0),
+            match_index=self.state.match_index.at[r].set(0),
+            match_term=self.state.match_term.at[r].set(0),
+            log_term=self.state.log_term.at[r].set(0),
+            log_payload=self.state.log_payload.at[
+                :, r * w:(r + 1) * w
+            ].set(0),
+        )
+        self.terms[r] = 0
+        self.lead_terms[r] = 0
+        self.roles[r] = FOLLOWER
+        self._ring_floor[r] = 1
+        self._match_stall[r] = 0
+        self._last_heard[r] = -1e18
+        self._persisted_terms[r] = 0
+        self._persisted_vf[r] = NO_VOTE
+        self._quorum_contact_at.pop(r, None)
+        self._lasts_snapshot = None
+        self._match_snapshot = None
+        self._steady = False
+        if self.member[r]:
+            self._wiped[r] = True
+        self.nodelog(r, "wiped (durable state destroyed)")
 
     def set_slow(self, r: int, is_slow: bool) -> None:
         """Induced-slow follower: receives traffic, appends nothing (stale
@@ -1187,10 +1519,22 @@ class RaftEngine:
         self._campaign(r)  # every _campaign outcome re-arms the right timer
 
     def _reach(self, src: int) -> np.ndarray:
-        """Effective alive mask for a step sourced at ``src``: a member,
-        live, AND link-reachable from it (``src`` itself included — a
-        just-removed leader is the one non-member source; its row rides
-        ingest_row on device, not this mask)."""
+        """Effective alive mask for a REPLICATION step sourced at
+        ``src``: a voter or learner, live, AND link-reachable from it
+        (``src`` itself included — a just-removed leader is the one
+        non-member source; its row rides ingest_row on device, not this
+        mask). Learners hear windows and heal through this mask; every
+        QUORUM computation must intersect with ``self.member`` (or use
+        ``_voter_reach``) so they never count."""
+        return (
+            self.alive & self.connectivity[src]
+            & (self.member | self.learner)
+        )
+
+    def _voter_reach(self, src: int) -> np.ndarray:
+        """Reachable live VOTERS from ``src`` — the mask every vote
+        round, CheckQuorum lease and read-quorum check counts over
+        (learners are excluded: non-voting by definition)."""
         return self.alive & self.connectivity[src] & self.member
 
     def _pre_lasts(self):
@@ -1255,6 +1599,7 @@ class RaftEngine:
                 int(pre_lasts[q]) - self.state.capacity + 1,
             )
         self._lasts_snapshot = post
+        self._match_snapshot = None   # the step moved match state
 
     def partition(self, groups) -> None:
         """Install a link-level partition: replicas exchange messages only
@@ -1509,7 +1854,7 @@ class RaftEngine:
         partitioned node harmless). Nothing is persisted and no device
         state changes: a losing pre-vote leaves the cluster exactly as
         it was, which is the entire point."""
-        eff = self._reach(r)
+        eff = self._voter_reach(r)   # learners cannot grant (§4.2.1)
         if not hasattr(self, "_last_keys_jit"):
             cap = self.state.capacity
 
@@ -1544,7 +1889,9 @@ class RaftEngine:
         """One collective vote round (replaces the serial poll,
         main.go:253-284)."""
         cand_term = int(self.terms[r])
-        eff = self._reach(r)   # votes travel only inside the partition
+        eff = self._voter_reach(r)
+        #   votes travel only inside the partition, and only to VOTERS:
+        #   a learner neither grants nor counts (§4.2.1 non-voting)
         self.state, info = self.t.request_votes(
             self.state, r, cand_term, jnp.asarray(eff)
         )
@@ -1669,12 +2016,13 @@ class RaftEngine:
             return
         cfg = self.cfg
         if cfg.check_quorum:
-            # §9.6 CheckQuorum: renew the lease while a member majority
-            # is reachable; a leader cut off for a full minimum election
-            # timeout demotes ITSELF (same term — nothing was heard),
-            # silencing the minority side of a partition instead of
-            # heartbeating a stale leadership forever.
-            if int(self._reach(r).sum()) > int(self.member.sum()) // 2:
+            # §9.6 CheckQuorum: renew the lease while a VOTER majority
+            # is reachable (learners keep nobody in office); a leader cut
+            # off for a full minimum election timeout demotes ITSELF
+            # (same term — nothing was heard), silencing the minority
+            # side of a partition instead of heartbeating a stale
+            # leadership forever.
+            if int(self._voter_reach(r).sum()) > int(self.member.sum()) // 2:
                 self._quorum_contact_at[r] = self.clock.now
             elif (self.clock.now
                     - self._quorum_contact_at.setdefault(r, self.clock.now)
@@ -1712,6 +2060,11 @@ class RaftEngine:
                 self.nodelog(r, "admission shedding OFF (delay back "
                                 "under target)")
         if routed:
+            # staged single-server ladders (add_server auto-promotion,
+            # replace) advance first: they queue at most one config
+            # entry, which the batch clamp below then handles like any
+            # operator-submitted change
+            self._drive_staged_config(r)
             # must run BEFORE the batch is taken from the queue: it may
             # prepend re-queued entries, and the post-step bookkeeping
             # maps self._queue[:ingested] to the appended indices
@@ -1737,7 +2090,12 @@ class RaftEngine:
                     room = self.state.capacity - (last0 - commit0)
                     if room >= qi + 1:
                         take = qi + 1
-                        step_member = np.array(ch[1], bool)
+                        # the NEW configuration's VOTER mask — the only
+                        # plane the device step counts quorums over (a
+                        # learner change leaves it equal to the old one,
+                        # so the quorum provably never moves on a
+                        # learner add/remove)
+                        step_member = np.array(ch[1][0], bool)
                     else:
                         take = qi    # everything before the entry only
                     break
@@ -1869,6 +2227,7 @@ class RaftEngine:
             match_index=jnp.minimum(self.state.match_index, cut_arr),
         )
         self._lasts_snapshot = None
+        self._match_snapshot = None
         self._steady = False
         # re-appends land at cut+1 under the current term: the §5.4.2
         # floor must never sit above the first current-term index
@@ -1914,16 +2273,40 @@ class RaftEngine:
             return True
         return not self._steady
 
+    def _effective_match(self, term: int, match) -> np.ndarray:
+        """Host view of the step's verified match vector with LEARNER
+        rows filled in from device state. ``RepInfo.match`` is masked by
+        the device ack mask (voters only — the §4.2.2 guarantee that a
+        non-voter ack never counts toward commit), so a learner's
+        progress reads 0 there; the heal and steady consumers need the
+        real value or they would snapshot-install a caught-up learner
+        forever. No extra fetch on learner-free clusters, and at most
+        ONE per step otherwise: the (match_index, match_term) fetch is
+        cached like ``_lasts_snapshot`` (same invalidation points), so
+        the heal pass and the steady update of one tick share it."""
+        match = np.asarray(match).copy()
+        if self.learner.any():
+            if self._match_snapshot is None:
+                self._match_snapshot = np.asarray(self._fetch(jnp.stack(
+                    [self.state.match_index, self.state.match_term]
+                )))
+            mi_mt = self._match_snapshot
+            lr = self.learner
+            match[lr] = np.where(mi_mt[1][lr] == term, mi_mt[0][lr], 0)
+        return match
+
     def _update_steady(self, r: int, match, eff=None) -> None:
         """After a replicate step: every live non-slow follower verified up
         to the leader's tail -> the next step may run the steady-state
         (repair-free) program. ``match`` arrives as the un-materialized
         device array so the "off" mode really skips the host sync.
         ``eff`` is the step's effective reach (partition-aware); rows the
-        leader cannot reach are not the repair window's business."""
+        leader cannot reach are not the repair window's business.
+        Learners count: a lagging learner keeps the repair program
+        dispatched (its catch-up IS repair traffic)."""
         if self.cfg.steady_dispatch == "off":
             return  # _repair_program never reads _steady
-        match = np.asarray(match)
+        match = self._effective_match(int(self.lead_terms[r]), match)
         others = (self.alive if eff is None else eff) & ~self.slow
         others[r] = False
         leader_last = int(self._fetch(self.state.last_index)[r])
@@ -1945,6 +2328,11 @@ class RaftEngine:
             idx = self._pending_config[0]
             self._pending_config = None
             self.nodelog(r, f"configuration committed at {idx}")
+            # A wiped voter's old identity is gone for good only now
+            # that its removal is DURABLE: clear the wiped flag for rows
+            # the committed configuration no longer counts as voters, so
+            # they may restart (as fresh learners via replace's ladder).
+            self._wiped &= self.member
             lead = self.leader_id
             if lead is not None and not self.member[lead]:
                 # the leader managed itself out of the cluster; now that
@@ -1968,9 +2356,11 @@ class RaftEngine:
         #   against its own leadership (§9.6 stickiness)
         for p in range(self.cfg.rows):
             if p == r or not self.alive[p] or not self.connectivity[r, p]\
-                    or not self.member[p]:
+                    or not (self.member[p] or self.learner[p]):
                 continue   # unreachable replicas hear nothing
             self._last_heard[p] = self.clock.now   # §9.6 stickiness clock
+            if not self.member[p]:
+                continue   # learners run no election timers: non-voting
             if self.roles[p] == FOLLOWER:
                 self._arm_follower(p)
             elif self.roles[p] == CANDIDATE:
@@ -2067,6 +2457,7 @@ class RaftEngine:
         # Only [lo, hi] was written; slots below keep whatever they held.
         self._ring_floor[replica] = max(self._ring_floor[replica], lo)
         self._lasts_snapshot = None   # last_index changed outside a step
+        self._match_snapshot = None   # ...and so did match_index
         self.nodelog(replica, f"snapshot installed to {hi}")
         return True
 
@@ -2082,7 +2473,7 @@ class RaftEngine:
         snapshot of the committed prefix from the checkpoint store, then
         let the repair window cover (snapshot, leader_last]."""
         cap = self.state.capacity
-        match = np.asarray(info.match)
+        match = self._effective_match(int(self.lead_terms[leader]), info.match)
         leader_last = int(self._fetch(self.state.last_index)[leader])
         # the repair window cannot serve below the leader's ring-validity
         # floor either (truncated-after-wrap slots hold junk): such
@@ -2090,8 +2481,10 @@ class RaftEngine:
         horizon = max(leader_last - cap + 1, int(self._ring_floor[leader]))
         for p in range(self.cfg.rows):
             if (p == leader or not self.alive[p] or self.slow[p]
-                    or not self.member[p]
+                    or not (self.member[p] or self.learner[p])
                     or not self.connectivity[leader, p]):
+                # learners heal exactly like members: snapshot install is
+                # how a wiped/fresh learner rejoins from nothing
                 self._match_stall[p] = 0
                 continue
             if int(match[p]) + 1 >= horizon:
@@ -2125,17 +2518,19 @@ class RaftEngine:
           installed."""
         from raft_tpu.ec.reconstruct import heal_replica, install_entries
 
-        match = np.asarray(info.match)
+        match = self._effective_match(int(self.lead_terms[leader]), info.match)
         n, k = self.cfg.rows, self.cfg.rs_k
         leader_last = int(self._fetch(self.state.last_index)[leader])
         hi_rec = self.commit_watermark
         for p in range(n):
             if (p == leader or not self.alive[p] or self.slow[p]
                     or not self.connectivity[leader, p]
-                    or not self.member[p]):
+                    or not (self.member[p] or self.learner[p])):
                 # spare (non-member) rows idle unhealed until added; a
                 # REMOVED row's committed shards still serve as donor
-                # material below (donor criteria are data-based)
+                # material below (donor criteria are data-based).
+                # Learners heal like members — catch-up is the learner
+                # phase's whole job.
                 continue
             if match[p] >= leader_last:
                 continue
@@ -2162,6 +2557,7 @@ class RaftEngine:
                         self.leader_term, hi_rec, self.cfg.batch_size,
                     )
                     self._lasts_snapshot = None
+                    self._match_snapshot = None
                     self.nodelog(p, f"healed by reconstruction to {hi_rec}")
                 except ValueError:
                     # Below every donor's ring horizon: reconstruction would
@@ -2211,6 +2607,7 @@ class RaftEngine:
                     self.cfg.batch_size,
                 )
                 self._lasts_snapshot = None
+                self._match_snapshot = None
                 self.nodelog(p, f"suffix re-served to {leader_last}")
 
     def _ec_abandon_lost_suffix(self, leader: int, missing) -> bool:
@@ -2556,6 +2953,7 @@ class RaftEngine:
             terms=self._fetch(self.state.term).astype(np.int32),
             voted_for=self._fetch(self.state.voted_for).astype(np.int32),
             member=self.member.copy(),
+            learner=self.learner.copy(),
         ).save(path)
         if self._votelog is not None:
             # WAL rotation: the checkpoint just captured (term, votedFor),
@@ -2641,6 +3039,10 @@ class RaftEngine:
                 # rows that joined after the initial config need timers
                 if eng.member[r] and r >= cfg.n_replicas:
                     eng._arm_follower(r)
+        if ck.learner is not None and ck.learner.shape == (cfg.rows,):
+            # learners resume as learners (non-voting, no timers): their
+            # catch-up restarts from the restored snapshot like any row
+            eng.learner = ck.learner.copy() & ~eng.member
         for r in range(cfg.rows):
             if eng.member[r]:
                 eng.nodelog(r, f"restored from checkpoint to {eng.commit_watermark}")

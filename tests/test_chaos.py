@@ -79,7 +79,7 @@ def run_chaos(e, rng, phases=10, phase_s=40.0):
             if (spares and e._pending_config is None and not partitioned
                     and e.leader_id is not None and dead_members == 0):
                 try:
-                    e.add_server(spares[0])
+                    e.add_voter(spares[0])
                 except RuntimeError:
                     pass                      # change already queued
         elif action == "remove":
@@ -381,7 +381,7 @@ def test_exactly_once_counter_under_full_chaos(seed):
             if (spares and e._pending_config is None and not partitioned
                     and e.leader_id is not None and dead_members == 0):
                 try:
-                    e.add_server(spares[0])
+                    e.add_voter(spares[0])
                 except RuntimeError:
                     pass
         elif action == "remove":
@@ -491,7 +491,7 @@ def run_ec_member_chaos(e, rng, phases=10, phase_s=40.0):
             if (spares and e._pending_config is None and not partitioned
                     and e.leader_id is not None and dead_members == 0):
                 try:
-                    e.add_server(spares[0])
+                    e.add_voter(spares[0])
                 except RuntimeError:
                     pass
         elif action == "remove":

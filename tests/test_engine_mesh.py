@@ -205,7 +205,7 @@ class TestMembershipOverMesh:
         seqs = [e.submit(p) for p in ps]
         e.run_until_committed(seqs[-1])
 
-        s_add = e.add_server(3)
+        s_add = e.add_voter(3)
         e.run_until_committed(s_add)
         assert e.member[3] and int(e.member.sum()) == 4
         mid = [e.submit(p) for p in payloads(4, seed=13)]

@@ -203,3 +203,75 @@ class TestMetrics:
 
         s = LatencySummary.of(np.array([]))
         assert s.count == 0 and np.isnan(s.p50)
+
+
+class TestValidateMembershipTimeline:
+    """Round 9 satellite: kill gating counts the CURRENT voter set, not
+    the initial ``n`` — and a membership transition that itself strands
+    the new set below a live majority is rejected too."""
+
+    def test_non_members_die_for_free(self):
+        # 5 rows, but only {0, 1, 2} are voters: legacy validation (2
+        # rows already dead) rejects this kill; configuration-aware
+        # validation accepts it — rows 3/4 keep nobody out of office
+        plan = FaultPlan([FaultEvent(5.0, "kill", 0)])
+        alive = [True, True, True, False, False]
+        with pytest.raises(ValueError, match="below majority"):
+            plan.validate(5, alive=alive)
+        assert plan.validate(
+            5, alive=alive, membership=[(0.0, [0, 1, 2])]
+        ) == []
+
+    def test_post_shrink_majority_governs_kills(self):
+        # legal under 5 voters, illegal once the set shrinks to {1, 2}
+        plan = FaultPlan([FaultEvent(5.0, "kill", 0),
+                          FaultEvent(15.0, "kill", 1)])
+        assert plan.validate(5) == []
+        timeline = [(0.0, [0, 1, 2, 3, 4]), (10.0, [1, 2])]
+        with pytest.raises(ValueError, match="of 2 voters"):
+            plan.validate(5, membership=timeline)
+        bad = plan.validate(5, membership=timeline, strict=False)
+        assert [e.replica for e in bad] == [1]
+
+    def test_stranding_transition_rejected(self):
+        # the shrink itself lands on a mostly-dead voter set: reject the
+        # PLAN even though no kill event is at fault
+        plan = FaultPlan([FaultEvent(1.0, "kill", 3),
+                          FaultEvent(2.0, "kill", 4),
+                          FaultEvent(20.0, "recover", 3)])
+        timeline = [(0.0, [0, 1, 2, 3, 4]), (10.0, [2, 3, 4])]
+        with pytest.raises(ValueError, match="post-shrink"):
+            plan.validate(5, membership=timeline)
+
+    def test_callable_membership(self):
+        plan = FaultPlan([FaultEvent(5.0, "kill", 0),
+                          FaultEvent(15.0, "kill", 1)])
+        def member_at(t):
+            return [0, 1, 2, 3, 4] if t < 10.0 else [1, 2]
+        with pytest.raises(ValueError, match="of 2 voters"):
+            plan.validate(5, membership=member_at)
+
+    def test_none_membership_is_bit_identical_legacy(self):
+        plan = FaultPlan([FaultEvent(1.0, "kill", 0),
+                          FaultEvent(2.0, "kill", 1),
+                          FaultEvent(3.0, "kill", 2)])
+        bad_legacy = plan.validate(5, strict=False)
+        bad_full = plan.validate(
+            5, strict=False, membership=[(0.0, [0, 1, 2, 3, 4])]
+        )
+        assert [(e.t, e.replica) for e in bad_legacy] \
+            == [(e.t, e.replica) for e in bad_full] == [(3.0, 2)]
+
+    def test_pre_timeline_events_use_legacy_full_membership(self):
+        """code-review r9: the first timeline entry must not apply
+        retroactively — kills BEFORE it are judged against the legacy
+        all-rows voter set, not a future shrunken one (under which they
+        would all be 'free' non-member kills)."""
+        plan = FaultPlan([FaultEvent(5.0, "kill", 0),
+                          FaultEvent(6.0, "kill", 1),
+                          FaultEvent(7.0, "kill", 2)])
+        timeline = [(10.0, [3, 4])]
+        with pytest.raises(ValueError, match="below majority"):
+            plan.validate(5, membership=timeline)
+        bad = plan.validate(5, membership=timeline, strict=False)
+        assert [e.replica for e in bad] == [2]

@@ -64,7 +64,7 @@ def run_lap_chaos(seed):
             if (spares and e._pending_config is None and not partitioned
                     and dead == 0 and e.leader_id is not None):
                 try:
-                    e.add_server(spares[0])
+                    e.add_voter(spares[0])
                 except RuntimeError:
                     pass
         elif action == "remove":

@@ -453,3 +453,21 @@ class TestGoldenDifferential:
         # sibling groups were untouched by the fault and kept committing
         for g in (0, 2):
             assert me.is_durable(g, bg_last[g])
+
+
+def test_fixed_membership_refusal_is_typed():
+    """Round 9 satellite: MultiEngine's single-group-only membership
+    scope stays loud AND typed — ``UnsupportedMembership`` (a
+    ``ValueError`` subclass, so pre-existing broad handlers still work)
+    rather than a string-matched bare ValueError."""
+    from raft_tpu.multi import MultiEngine, UnsupportedMembership
+
+    cfg = RaftConfig(
+        n_replicas=3, max_replicas=5, entry_bytes=16, batch_size=4,
+        log_capacity=64, transport="single",
+    )
+    with pytest.raises(UnsupportedMembership, match="fixed membership"):
+        MultiEngine(cfg, 2)
+    assert issubclass(UnsupportedMembership, ValueError)
+    with pytest.raises(ValueError):       # the compat contract
+        MultiEngine(cfg, 2)
