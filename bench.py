@@ -62,7 +62,8 @@ from raft_tpu.config import RaftConfig
 from raft_tpu.core.comm import SingleDeviceComm
 from raft_tpu.core.state import init_state
 from raft_tpu.core.step import replicate_step
-from raft_tpu.obs.profiling import device_seconds
+from raft_tpu.obs.profiling import device_seconds, op_breakdown
+from raft_tpu.obs.registry import MetricsRegistry
 
 REFERENCE_TICK_US = 2_000_000.0  # main.go:394 — 2 s replication tick
 T_STEPS = 512                    # steps per traced scan
@@ -263,7 +264,29 @@ def bench_scan(cfg: RaftConfig, fn, reps: int = REPS) -> dict:
         for _ in range(reps)
     ]
     method = "device"
-    if not any(np.isfinite(per_step)):
+    breakdown = None
+    if any(np.isfinite(per_step)):
+        # one extra traced rep into a KEPT trace dir so the row carries
+        # per-kernel device-time attribution (obs.profiling.op_breakdown)
+        # — device time per op, not just the whole-module wall/device
+        # headline. Best-effort: a platform that times fine but traces
+        # oddly just omits the field.
+        import shutil
+        import tempfile
+
+        tdir = tempfile.mkdtemp(prefix="raft_tpu_bench_trace_")
+        try:
+            if np.isfinite(
+                device_seconds(fn, lambda: (init_state(cfg),),
+                               warmups=0, trace_dir=tdir)
+            ):
+                breakdown = [
+                    {"op": nm, "calls": c, "total_ms": round(ms, 3)}
+                    for nm, c, ms in op_breakdown(tdir, top=8)
+                ] or None
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+    else:
         # no device trace on this platform: wall-clock whole-scan fallback
         method = "wall"
         per_step = []
@@ -272,12 +295,15 @@ def bench_scan(cfg: RaftConfig, fn, reps: int = REPS) -> dict:
             _ = np.asarray(st.term)
             per_step.append(_timed_wall_call(fn, st) * 1e6 / T_STEPS)
     p50, p99 = _percentiles(per_step)
-    return {
+    row = {
         "p50_us": round(p50, 3),
         "p99_us": round(p99, 3),
         "entries_per_sec": round(cfg.batch_size / p50 * 1e6, 1),
         "method": method,
     }
+    if breakdown is not None:
+        row["op_breakdown"] = breakdown
+    return row
 
 
 def _best_program(steady: dict, repair_capable: dict) -> dict:
@@ -423,6 +449,7 @@ def bench_client_latency() -> dict:
 
     cfg = RaftConfig()                   # the c2 shape
     e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e.metrics = MetricsRegistry()
     e.run_until_leader()
     rng = np.random.default_rng(7)
     n = cfg.log_capacity                 # one full-ring chunk
@@ -493,6 +520,7 @@ def bench_client_latency() -> dict:
         "wall_us_per_entry": round(wall * 1e6 / n, 3),
         "entries_per_sec_wall": round(n / wall, 1),
         "lapped_chunk": lapped,
+        "metrics": e.metrics.to_json(),
         "note": ("submit->durable-ack through the axon tunnel (20-80 ms "
                  "dispatch RTT) incl. host durability bookkeeping; the "
                  "device-time rows measure the kernel only"),
@@ -515,6 +543,7 @@ def bench_read_index() -> dict:
         transport="single", seed=4,
     )
     e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e.metrics = MetricsRegistry()
     e.run_until_leader()
     rng = np.random.default_rng(0)
 
@@ -559,6 +588,7 @@ def bench_read_index() -> dict:
         "serial_reads_per_sec": round(K / serial_s, 1),
         "batched_reads_per_sec": round(KB / batched_s, 1),
         "batched_extra_rounds": 0,
+        "metrics": e.metrics.to_json(),
         "note": ("batched reads confirm on the write ticks' rounds; "
                  "batched wall time includes the write traffic itself"),
     }
@@ -595,6 +625,10 @@ def bench_overload() -> dict:
     rows = {}
     for mult in (1, 2, 5):
         e = RaftEngine(cfg, t)
+        e.metrics = MetricsRegistry()
+        #   per-row registry: the emitted row carries the structured
+        #   protocol counters (elections, heartbeats, sheds by reason,
+        #   commit-latency buckets) alongside the headline numbers
         e.run_until_leader()
         rng = _random.Random(f"bench-overload:{mult}")
         slice_s = cfg.heartbeat_period
@@ -623,6 +657,7 @@ def bench_overload() -> dict:
             "depth_bound": rep.max_writes,
             "shed_by_reason": rep.shed,
             "virtual_window_s": window_s,
+            "metrics": e.metrics.to_json(),
         })
     return rows
 

@@ -118,7 +118,8 @@ class CircuitBreaker:
     with a fresh cooldown. Single-threaded by design (the engines are
     event loops)."""
 
-    def __init__(self, failure_threshold: int = 8, cooldown_s: float = 30.0):
+    def __init__(self, failure_threshold: int = 8, cooldown_s: float = 30.0,
+                 on_transition=None):
         if failure_threshold < 1 or cooldown_s <= 0:
             raise ValueError("failure_threshold >= 1 and cooldown_s > 0")
         self.failure_threshold = failure_threshold
@@ -126,6 +127,20 @@ class CircuitBreaker:
         self._consecutive_failures = 0
         self._opened_at: Optional[float] = None
         self.opened_count = 0
+        self.on_transition = on_transition
+        #   optional ``fn(state, now)`` observability hook, fired on
+        #   open / half_open / close transitions (the flight recorder's
+        #   previously-silent breaker plane — multi.router wires it).
+        #   ``state()`` derives half-open from elapsed time, so the
+        #   half_open notification fires from the first post-cooldown
+        #   ``allow`` probe, deduped by _half_open_seen. ``now`` is
+        #   None on a close whose ``on_success`` caller supplied no
+        #   clock reading (the breaker holds no clock of its own).
+        self._half_open_seen = False
+
+    def _notify(self, state: str, now: Optional[float]) -> None:
+        if self.on_transition is not None:
+            self.on_transition(state, now)
 
     def state(self, now: float) -> str:
         if self._opened_at is None:
@@ -135,16 +150,24 @@ class CircuitBreaker:
         return "open"
 
     def allow(self, now: float) -> bool:
-        return self.state(now) != "open"
+        st = self.state(now)
+        if st == "half_open" and not self._half_open_seen:
+            self._half_open_seen = True
+            self._notify("half_open", now)
+        return st != "open"
 
     def retry_after(self, now: float) -> float:
         if self._opened_at is None:
             return 0.0
         return max(0.0, self.cooldown_s - (now - self._opened_at))
 
-    def on_success(self) -> None:
+    def on_success(self, now: Optional[float] = None) -> None:
+        was_open = self._opened_at is not None
         self._consecutive_failures = 0
         self._opened_at = None
+        self._half_open_seen = False
+        if was_open:
+            self._notify("close", now)
 
     def on_failure(self, now: float) -> None:
         self._consecutive_failures += 1
@@ -153,7 +176,10 @@ class CircuitBreaker:
                 # the half-open probe failed: re-arm a fresh cooldown
                 self._opened_at = now
                 self.opened_count += 1
+                self._half_open_seen = False
+                self._notify("open", now)
             return
         if self._consecutive_failures >= self.failure_threshold:
             self._opened_at = now
             self.opened_count += 1
+            self._notify("open", now)

@@ -70,6 +70,16 @@ def main(argv=None) -> int:
                          "SUCCEEDS (exit 0) only if the checker rejects "
                          "it — a passing broken run means the harness "
                          "lost its teeth")
+    ap.add_argument("--observe", action="store_true",
+                    help="attach the observability plane (flight "
+                         "recorder, per-op spans, metrics registry) — "
+                         "determinism-neutral; makes forensics bundles "
+                         "carry the full event ring + span table")
+    ap.add_argument("--bundle-dir", default=None, metavar="DIR",
+                    help="write a repro bundle to DIR whenever a run "
+                         "ends in anything but its expected verdict "
+                         "(also honored via RAFT_TPU_BUNDLE_DIR); "
+                         "inspect with python -m raft_tpu.obs --explain")
     args = ap.parse_args(argv)
     if args.multi and args.broken:
         ap.error("--broken applies to the single-engine runner only")
@@ -85,7 +95,10 @@ def main(argv=None) -> int:
     ok = True
     if args.reconfig:
         for seed in range(args.seed, args.seed + args.sweep):
-            rep = reconfig_run(seed, step_budget=args.step_budget)
+            rep = reconfig_run(
+                seed, step_budget=args.step_budget,
+                observe=args.observe, bundle_dir=args.bundle_dir,
+            )
             print(rep.summary())
             print(json.dumps({
                 "seed": seed,
@@ -106,6 +119,7 @@ def main(argv=None) -> int:
             rep = overload_run(
                 seed, rate_mult=args.overload_recovery,
                 step_budget=args.step_budget,
+                observe=args.observe, bundle_dir=args.bundle_dir,
             )
             print(rep.summary())
             print(json.dumps({
@@ -135,6 +149,7 @@ def main(argv=None) -> int:
                 clients=args.clients, keys=args.keys,
                 phase_s=args.phase_s, overload=args.overload,
                 step_budget=args.step_budget,
+                observe=args.observe, bundle_dir=args.bundle_dir,
             )
         else:
             rep = torture_run(
@@ -144,6 +159,7 @@ def main(argv=None) -> int:
                 storage_faults=not args.no_storage, broken=args.broken,
                 overload=args.overload, membership=args.membership,
                 step_budget=args.step_budget,
+                observe=args.observe, bundle_dir=args.bundle_dir,
             )
         print(rep.summary())
         print(json.dumps({

@@ -93,6 +93,12 @@ class History:
 
     EPS = 1e-6
 
+    #: record class instantiated by ``invoke`` — subclasses substitute a
+    #: richer record (e.g. the chaos runner's span-closing _SpannedOp)
+    #: without re-implementing the stamp/append logic, so the timestamp
+    #: discipline cannot drift between observed and plain runs
+    REC_CLS = OpRecord
+
     def __init__(self) -> None:
         self.ops: List[OpRecord] = []
         self._last = 0.0
@@ -111,9 +117,14 @@ class History:
         value: Optional[bytes],
         t: float,
     ) -> OpRecord:
-        rec = OpRecord(client, op, key, value, invoke_t=self.stamp(t))
+        rec = self.REC_CLS(client, op, key, value, invoke_t=self.stamp(t))
+        self._on_invoke(rec)
         self.ops.append(rec)
         return rec
+
+    def _on_invoke(self, rec: OpRecord) -> None:
+        """Subclass hook, called after the record is stamped and before
+        it is appended (e.g. to open an obs span for it)."""
 
     def close(self) -> None:
         """End of run: any op still pending resolves to ``info`` —

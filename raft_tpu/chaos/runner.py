@@ -53,10 +53,12 @@ open-loop state never exceeds the configured queue depth.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 import random
 import tempfile
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from raft_tpu.admission import Overloaded
@@ -70,6 +72,11 @@ from raft_tpu.chaos.nemesis import MembershipView, Nemesis, NemesisAction
 from raft_tpu.chaos.storage import MirroredStore
 from raft_tpu.chaos.transport import ChaosTransport
 from raft_tpu.config import RaftConfig
+from raft_tpu.obs.forensics import (
+    ObsStack,
+    resolve_bundle_dir,
+    write_bundle,
+)
 
 
 def poisson(rng: random.Random, lam: float) -> int:
@@ -105,6 +112,17 @@ class TortureReport:
     #   reconfiguration ops the membership plane actually started
     #   (grow/shrink/remove_leader/replace) — coverage evidence for the
     #   pinned seeds
+    commit_digest: str = ""
+    #   CRC over the committed log (indices, terms, payload bytes) at
+    #   run end — the byte-identity witness for the observability
+    #   determinism pin (recorder on == recorder off).
+    bundle_path: Optional[str] = None
+    #   forensics repro bundle, written iff the verdict was unexpected
+    #   AND a bundle destination was configured (obs.forensics).
+    obs: Optional[ObsStack] = None
+    #   the run's observability plane when ``observe=True`` (flight
+    #   recorder ring + span table + metrics registry), for callers
+    #   that inspect signals beyond the bundle.
 
     @property
     def verdict(self) -> str:
@@ -152,6 +170,60 @@ def _membership_cfg(base: RaftConfig) -> RaftConfig:
     return dataclasses.replace(base, max_replicas=5)
 
 
+#: admission-flavored refusal reasons: a span whose refusal trail hit
+#: one of these closes as ``shed`` (typed load shedding), anything else
+#: refused closes as plain ``failed``
+_SHED_REASONS = {"depth", "delay", "fair_share", "read_depth",
+                 "circuit_open"}
+
+
+class _SpannedOp(OpRecord):
+    """An OpRecord that closes its obs span on its own terminal event —
+    every resolution path in the harness (poll, give-up, crash resolve,
+    quiesce, ``History.close``) already funnels through ``ok``/``fail``/
+    ``info``, so hooking here guarantees the span-completeness invariant
+    (exactly one terminal span state per invoked op) by construction."""
+
+    _span = None
+
+    def ok(self, t, value=None):
+        super().ok(t, value)
+        if self._span is not None and not self._span.terminal:
+            self._span.finish("ok", t)
+        return self
+
+    def fail(self, t):
+        super().fail(t)
+        if self._span is not None and not self._span.terminal:
+            shed = bool(_SHED_REASONS & set(self._span.refusal_reasons))
+            self._span.finish("shed" if shed else "failed", t)
+        return self
+
+    def info(self):
+        super().info()
+        if self._span is not None and not self._span.terminal:
+            self._span.finish("info", None)
+        return self
+
+
+class _ObsHistory(History):
+    """A History that opens one span per invoked op (closed by the
+    record's terminal event — see _SpannedOp). The stamp/append logic
+    stays in History.invoke (REC_CLS + _on_invoke hooks), so observed
+    and plain runs share one timestamp discipline by construction."""
+
+    REC_CLS = _SpannedOp
+
+    def __init__(self, spans):
+        super().__init__()
+        self._spans = spans
+
+    def _on_invoke(self, rec):
+        rec._span = self._spans.begin(
+            rec.op, rec.invoke_t, client=rec.client, key=rec.key
+        )
+
+
 class _Client:
     """One serial client: at most one op outstanding, its own rng."""
 
@@ -192,11 +264,20 @@ class _TortureBase:
     #: effect); the client then moves on.
     OP_TIMEOUT_S = 90.0
 
-    def __init__(self, seed, phases, clients, keys, phase_s):
+    def __init__(self, seed, phases, clients, keys, phase_s,
+                 observe: bool = False):
         self.seed = seed
         self.phases = phases
         self.phase_s = phase_s
-        self.history = History()
+        self.obs: Optional[ObsStack] = ObsStack.build() if observe else None
+        #   the observability plane (flight recorder + spans + metrics;
+        #   docs/OBSERVABILITY.md). Recording is determinism-neutral:
+        #   every seeded run replays byte-identically with it on or off
+        #   (pinned by tests/test_obs_plane.py).
+        self.history = (
+            _ObsHistory(self.obs.spans) if self.obs is not None
+            else History()
+        )
         self.keys = [f"k{i}".encode() for i in range(keys)]
         self.clients = [_Client(c, seed, self.keys) for c in range(clients)]
         self.crashes = 0
@@ -211,6 +292,28 @@ class _TortureBase:
         #   open-loop writes awaiting durability — bounded by the
         #   admission depth bound, which is what keeps the harness's own
         #   memory bounded under any offered load
+
+    def _ambient_span(self, rec):
+        """Context manager installing ``rec``'s span as the tracker's
+        ambient trace context for the duration of a client call — the
+        engine's submit/submit_read hooks bind seqs and refusal reasons
+        to whatever span is ambient (obs.spans)."""
+        if self.obs is None or rec is None:
+            return contextlib.nullcontext()
+        return self._set_current(getattr(rec, "_span", None))
+
+    @contextlib.contextmanager
+    def _set_current(self, span):
+        self.obs.spans.current = span
+        try:
+            yield
+        finally:
+            self.obs.spans.current = None
+
+    def commit_digest(self) -> str:
+        """CRC over the committed log at run end (engine-specific) —
+        the determinism witness the observability pin compares."""
+        raise NotImplementedError
 
     def _give_up(self, cl: _Client) -> bool:
         """Client-side op timeout (see OP_TIMEOUT_S); True if resolved."""
@@ -326,6 +429,8 @@ def torture_run(
     overload: bool = False,
     membership: bool = False,
     step_budget: int = 500_000,
+    observe: bool = False,
+    bundle_dir: Optional[str] = None,
 ) -> TortureReport:
     """One full single-engine torture run; see module docstring.
     ``overload=True`` arms admission (``_overload_cfg`` unless ``cfg``
@@ -335,13 +440,20 @@ def torture_run(
     membership-headroom config (``_membership_cfg`` unless ``cfg`` is
     given) and nemesis grow/shrink/remove-the-leader/wipe-replace
     cycles, composed with every other plane — client-visible
-    linearizability under reconfiguration is the property under test."""
+    linearizability under reconfiguration is the property under test.
+    ``observe=True`` attaches the observability plane (flight recorder,
+    per-op spans, metrics registry — determinism-neutral, pinned);
+    ``bundle_dir`` (or ``RAFT_TPU_BUNDLE_DIR``) arms forensics: a
+    verdict other than LINEARIZABLE auto-writes a repro bundle that
+    ``python -m raft_tpu.obs --explain`` reconstructs without
+    re-running the seed."""
     base = _overload_cfg(seed) if overload else _default_cfg(seed)
     if membership and cfg is None:
         base = _membership_cfg(base)
     run = _SingleTorture(
         seed, phases, clients, keys, phase_s,
         cfg or base, workdir, broken, membership=membership,
+        observe=observe,
     )
     nemesis = Nemesis(
         seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
@@ -368,19 +480,60 @@ def torture_run(
         f"--clients {clients} --keys {keys} --phase-s {phase_s:g}"
         + ("".join(" " + f for f in flags))
     )
+    bundle_path = _maybe_bundle(
+        "torture", run, check, LINEARIZABLE, repro, nemesis.log, bundle_dir,
+        extra={"crashes": run.crashes, "shed_ops": run.shed_ops,
+               "open_loop_ops": run.ol_submitted,
+               "membership_ops": run.membership_ops},
+    )
     return TortureReport(
         seed=seed, check=check, ops=len(run.history),
         op_counts=run.history.counts(), crashes=run.crashes,
         msg_stats=run.chaos_t.stats, nemesis_log=nemesis.log, repro=repro,
         shed_ops=run.shed_ops, open_loop_ops=run.ol_submitted,
         membership_ops=run.membership_ops,
+        commit_digest=run.commit_digest(), bundle_path=bundle_path,
+        obs=run.obs,
     )
+
+
+def _maybe_bundle(
+    kind: str, run: "_TortureBase", check: CheckResult, expected: str,
+    repro: str, nemesis_log: List[str], bundle_dir: Optional[str],
+    extra: Optional[dict] = None, force_unexpected: bool = False,
+) -> Optional[str]:
+    """Forensics hook shared by every chaos entry point: when the run
+    ended in anything but its expected verdict (or the runner flags the
+    outcome unexpected for a non-verdict reason, e.g. a missed recovery
+    window) and a bundle destination is configured, dump the repro
+    bundle. Never raises into the run's own reporting path — a bundle
+    that cannot be written (unwritable RAFT_TPU_BUNDLE_DIR, full disk)
+    must not destroy the report it was meant to preserve."""
+    bdir = resolve_bundle_dir(bundle_dir)
+    if bdir is None or (check.verdict == expected and not force_unexpected):
+        return None
+    try:
+        return write_bundle(
+            bdir, kind=kind, seed=run.seed, expected=expected,
+            verdict=check.verdict, detail=check.detail,
+            violation_key=check.key, repro=repro, config=run.cfg,
+            nemesis_log=nemesis_log, history=run.history, obs=run.obs,
+            extra=extra,
+        )
+    except OSError as ex:
+        import sys
+
+        print(f"raft_tpu.obs: repro bundle not written to {bdir!r}: {ex}",
+              file=sys.stderr)
+        return None
 
 
 class _SingleTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg,
-                 workdir, broken, membership: bool = False):
-        super().__init__(seed, phases, clients, keys, phase_s)
+                 workdir, broken, membership: bool = False,
+                 observe: bool = False):
+        super().__init__(seed, phases, clients, keys, phase_s,
+                         observe=observe)
         from raft_tpu.transport.device import SingleDeviceTransport
 
         self.cfg = cfg
@@ -413,8 +566,11 @@ class _SingleTorture(_TortureBase):
         from raft_tpu.raft.engine import RaftEngine
 
         self.engine = RaftEngine(
-            self.cfg, self.chaos_t, vote_log=self.store.votelog_path
+            self.cfg, self.chaos_t, vote_log=self.store.votelog_path,
+            recorder=self.obs.recorder if self.obs is not None else None,
         )
+        if self.obs is not None:
+            self.obs.attach(self.engine)
         self.kv = ReplicatedKV(self.engine)
         self.engine.run_until_leader()
 
@@ -433,7 +589,13 @@ class _SingleTorture(_TortureBase):
         self.engine = RaftEngine.restore(
             self.cfg, path, self.chaos_t,
             vote_log=self.store.votelog_path,
+            recorder=self.obs.recorder if self.obs is not None else None,
         )
+        if self.obs is not None:
+            self.obs.attach(self.engine)
+            #   one recorder/span/metric plane spans crash-restore
+            #   cycles: the ring keeps pre-crash events, the restored
+            #   engine keeps appending
         # carry virtual time forward: a restart must not rewind the
         # history clock (heap entries armed below t0 simply fire "now")
         self.engine.clock.now = t0
@@ -489,7 +651,8 @@ class _SingleTorture(_TortureBase):
             value = f"ol{self._ol_counter}".encode()
             rec = self.history.invoke(cid, WRITE, key, value, self.now())
             try:
-                seq = self.kv.set(key, value, client=cid)
+                with self._ambient_span(rec):
+                    seq = self.kv.set(key, value, client=cid)
             except Overloaded:
                 self.shed_ops += 1
                 rec.fail(self.history.stamp(self.now()))
@@ -498,6 +661,19 @@ class _SingleTorture(_TortureBase):
 
     def _ol_durable(self, handle) -> bool:
         return self.engine.is_durable(handle)
+
+    def commit_digest(self) -> str:
+        e = self.engine
+        wm = int(e.commit_watermark)
+        crc = zlib.crc32(f"wm:{wm}".encode())
+        if wm:
+            for idx in range(e.store.covered_lo(wm), wm + 1):
+                ent = e.store.get(idx)
+                if ent is not None:
+                    crc = zlib.crc32(
+                        ent[0], zlib.crc32(f"{idx}:{ent[1]}".encode(), crc)
+                    )
+        return f"{crc:08x}"
 
     def invoke(self, cl: _Client) -> None:
         from raft_tpu.raft.engine import LinearizableReadRefused
@@ -521,7 +697,8 @@ class _SingleTorture(_TortureBase):
                 cl.rec = None
                 return
             try:
-                cl.ticket = self.engine.submit_read()
+                with self._ambient_span(cl.rec):
+                    cl.ticket = self.engine.submit_read()
             except (LinearizableReadRefused, Overloaded):
                 # refused before any effect (read-lane admission refuses
                 # before minting a ticket)
@@ -530,10 +707,11 @@ class _SingleTorture(_TortureBase):
             return
         cl.rec = self.history.invoke(cl.cid, op, key, value, self.now())
         try:
-            cl.seq = (
-                self.kv.set(key, value, client=cl.cid) if op == WRITE
-                else self.kv.delete(key, client=cl.cid)
-            )
+            with self._ambient_span(cl.rec):
+                cl.seq = (
+                    self.kv.set(key, value, client=cl.cid) if op == WRITE
+                    else self.kv.delete(key, client=cl.cid)
+                )
         except Overloaded:
             # shed before queueing: provably no effect
             self.shed_ops += 1
@@ -770,6 +948,8 @@ def torture_run_multi(
     cfg: Optional[RaftConfig] = None,
     overload: bool = False,
     step_budget: int = 500_000,
+    observe: bool = False,
+    bundle_dir: Optional[str] = None,
 ) -> TortureReport:
     """Multi-Raft torture: the sharded Router/ShardedKV client surface
     under per-group process faults. No crash cycles or message faults —
@@ -783,7 +963,7 @@ def torture_run_multi(
     engine)."""
     run = _MultiTorture(
         seed, phases, clients, keys, phase_s, cfg, n_groups,
-        overload=overload,
+        overload=overload, observe=observe,
     )
     nemesis = Nemesis(
         seed, run.cfg.n_replicas, allow_crash=False, allow_msg=False,
@@ -797,18 +977,27 @@ def torture_run_multi(
         f"--keys {keys} --phase-s {phase_s:g}"
         + (" --overload" if overload else "")
     )
+    bundle_path = _maybe_bundle(
+        "torture_multi", run, check, LINEARIZABLE, repro, nemesis.log,
+        bundle_dir,
+        extra={"n_groups": n_groups, "shed_ops": run.shed_ops,
+               "open_loop_ops": run.ol_submitted},
+    )
     return TortureReport(
         seed=seed, check=check, ops=len(run.history),
         op_counts=run.history.counts(), crashes=0,
         msg_stats={}, nemesis_log=nemesis.log, repro=repro,
         shed_ops=run.shed_ops, open_loop_ops=run.ol_submitted,
+        commit_digest=run.commit_digest(), bundle_path=bundle_path,
+        obs=run.obs,
     )
 
 
 class _MultiTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg, n_groups,
-                 overload: bool = False):
-        super().__init__(seed, phases, clients, keys, phase_s)
+                 overload: bool = False, observe: bool = False):
+        super().__init__(seed, phases, clients, keys, phase_s,
+                         observe=observe)
         from raft_tpu.examples.kv_sharded import ShardedKV
         from raft_tpu.multi.engine import MultiEngine
         from raft_tpu.multi.router import Router
@@ -818,10 +1007,17 @@ class _MultiTorture(_TortureBase):
             transport="single", seed=seed,
             admission_max_writes=(16 if overload else None),
         )
-        self.engine = MultiEngine(self.cfg, n_groups)
+        obs = self.obs
+        self.engine = MultiEngine(
+            self.cfg, n_groups,
+            recorder=obs.recorder if obs is not None else None,
+        )
+        if obs is not None:
+            self.engine.metrics = obs.registry
         self.engine.seed_leaders()
-        self.router = Router(self.engine)
-        self._ol_router = Router(self.engine, max_retries=0)
+        spans = obs.spans if obs is not None else None
+        self.router = Router(self.engine, spans=spans)
+        self._ol_router = Router(self.engine, max_retries=0, spans=spans)
         #   open-loop arrivals do not retry: a refused one-shot writer
         #   is SHED (fail, no effect) — retrying it would re-close the
         #   loop the overload model exists to open
@@ -874,9 +1070,11 @@ class _MultiTorture(_TortureBase):
             value = f"ol{self._ol_counter}".encode()
             rec = self.history.invoke(cid, WRITE, key, value, self.now())
             try:
-                handle = self._ol_router.submit(
-                    key, encode_op(self.cfg.entry_bytes, _SET, key, value)
-                )
+                with self._ambient_span(rec):
+                    handle = self._ol_router.submit(
+                        key,
+                        encode_op(self.cfg.entry_bytes, _SET, key, value),
+                    )
             except Overloaded:
                 self.shed_ops += 1
                 rec.fail(self.history.stamp(self.now()))
@@ -892,6 +1090,18 @@ class _MultiTorture(_TortureBase):
         g, seq = handle
         return self.engine.is_durable(g, seq)
 
+    def commit_digest(self) -> str:
+        crc = 0
+        for g in range(self.engine.G):
+            wm = int(self.engine.commit_watermark[g])
+            crc = zlib.crc32(f"g{g}:wm:{wm}".encode(), crc)
+            arch = self.engine._archive[g]
+            for idx in sorted(i for i in arch if i <= wm):
+                crc = zlib.crc32(
+                    arch[idx], zlib.crc32(f"{idx}".encode(), crc)
+                )
+        return f"{crc:08x}"
+
     def invoke(self, cl: _Client) -> None:
         from raft_tpu.multi.engine import NotLeader
 
@@ -899,7 +1109,8 @@ class _MultiTorture(_TortureBase):
         cl.rec = self.history.invoke(cl.cid, op, key, value, self.now())
         try:
             if op == READ:
-                g, idx = self.router.read_index(key)
+                with self._ambient_span(cl.rec):
+                    g, idx = self.router.read_index(key)
                 if self.kv.last_applied[g] < idx:
                     self.drive(2 * self.cfg.heartbeat_period)
                 if self.kv.last_applied[g] < idx:
@@ -908,10 +1119,11 @@ class _MultiTorture(_TortureBase):
                     cl.rec.ok(self.history.stamp(self.now()), self.kv.get(key))
                 cl.rec = None
                 return
-            cl.seq = (
-                self.kv.set(key, value) if op == WRITE
-                else self.kv.delete(key)
-            )
+            with self._ambient_span(cl.rec):
+                cl.seq = (
+                    self.kv.set(key, value) if op == WRITE
+                    else self.kv.delete(key)
+                )
         except (NotLeader, Overloaded) as ex:
             # nothing was queued (submit_to_leader refuses before
             # queueing; read_index confirms nothing; admission and the
@@ -1024,6 +1236,8 @@ class OverloadReport:
     ops: int
     op_counts: Dict[str, int]
     repro: str
+    bundle_path: Optional[str] = None   # forensics (obs.forensics)
+    obs: Optional[ObsStack] = None
 
     @property
     def verdict(self) -> str:
@@ -1051,6 +1265,8 @@ def overload_run(
     recover_frac: float = 0.9,
     cfg: Optional[RaftConfig] = None,
     step_budget: int = 500_000,
+    observe: bool = False,
+    bundle_dir: Optional[str] = None,
 ) -> OverloadReport:
     """The deterministic overload scenario behind the acceptance
     criterion (no composed process faults — ``torture_run(overload=
@@ -1076,7 +1292,7 @@ def overload_run(
     """
     run = _SingleTorture(
         seed, 0, 2, 3, 30.0,
-        cfg or _overload_cfg(seed), None, None,
+        cfg or _overload_cfg(seed), None, None, observe=observe,
     )
     e = run.engine
     gate = e.admission
@@ -1161,6 +1377,17 @@ def overload_run(
     run.history.close()
     check = check_history(run.history, step_budget=step_budget)
     report = gate.report(queue_depth=len(e._queue))
+    repro = (f"python -m raft_tpu.chaos --seed {seed} "
+             f"--overload-recovery {rate_mult:g}")
+    bundle_path = _maybe_bundle(
+        "overload", run, check, LINEARIZABLE, repro, [], bundle_dir,
+        extra={"rate_mult": rate_mult, "recovered_in_s": recovered_in,
+               "recovery_window_s": recovery_window_s,
+               "shed": report.shed},
+        force_unexpected=recovered_in is None,
+        #   a missed recovery window is an unexpected outcome even when
+        #   the history itself checks LINEARIZABLE — bundle it too
+    )
     return OverloadReport(
         seed=seed, rate_mult=rate_mult, capacity_eps=run.capacity_eps,
         baseline_goodput=baseline_goodput,
@@ -1178,8 +1405,7 @@ def overload_run(
         recovery_ok=recovered_in is not None,
         check=check, ops=len(run.history),
         op_counts=run.history.counts(),
-        repro=(f"python -m raft_tpu.chaos --seed {seed} "
-               f"--overload-recovery {rate_mult:g}"),
+        repro=repro, bundle_path=bundle_path, obs=run.obs,
     )
 
 
@@ -1212,6 +1438,8 @@ class ReconfigReport:
     availability_window_s: float
     availability_ok: bool
     repro: str
+    bundle_path: Optional[str] = None   # forensics (obs.forensics)
+    obs: Optional[ObsStack] = None
 
     @property
     def verdict(self) -> str:
@@ -1242,6 +1470,8 @@ def reconfig_run(
     catchup_limit_s: float = 900.0,
     cfg: Optional[RaftConfig] = None,
     step_budget: int = 500_000,
+    observe: bool = False,
+    bundle_dir: Optional[str] = None,
 ) -> ReconfigReport:
     """The deterministic reconfiguration scenario behind the acceptance
     criteria (no random nemesis — ``torture_run(membership=True)``
@@ -1266,7 +1496,7 @@ def reconfig_run(
     run = _SingleTorture(
         seed, 0, 2, 3, 30.0,
         cfg or _membership_cfg(_default_cfg(seed)), None, None,
-        membership=True,
+        membership=True, observe=observe,
     )
     e = run.engine
     slice_s = 2 * run.cfg.heartbeat_period
@@ -1363,11 +1593,19 @@ def reconfig_run(
     run.quiesce()
     run.history.close()
     check = check_history(run.history, step_budget=step_budget)
+    availability_ok = bool(events) and all(ev["ok"] for ev in events)
+    repro = f"python -m raft_tpu.chaos --reconfig --seed {seed}"
+    bundle_path = _maybe_bundle(
+        "reconfig", run, check, LINEARIZABLE, repro, [], bundle_dir,
+        extra={"events": events, "promote_s": promote_s,
+               "replace_promote_s": replace_promote_s},
+        force_unexpected=not availability_ok,
+    )
     return ReconfigReport(
         seed=seed, check=check, ops=len(run.history),
         op_counts=run.history.counts(), events=events,
         promote_s=promote_s, replace_promote_s=replace_promote_s,
         availability_window_s=availability_window_s,
-        availability_ok=bool(events) and all(ev["ok"] for ev in events),
-        repro=f"python -m raft_tpu.chaos --reconfig --seed {seed}",
+        availability_ok=availability_ok,
+        repro=repro, bundle_path=bundle_path, obs=run.obs,
     )

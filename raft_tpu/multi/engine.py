@@ -122,6 +122,7 @@ class MultiEngine:
         cfg: RaftConfig,
         n_groups: int,
         trace: Optional[Callable[[str], None]] = None,
+        recorder=None,
     ):
         if cfg.ec_enabled:
             raise ValueError(
@@ -159,6 +160,13 @@ class MultiEngine:
 
         self.clock = VirtualClock()
         self._trace = trace
+        self.recorder = recorder
+        #   obs.events.FlightRecorder (None = off): nodelog sites record
+        #   typed per-group events (node "g3/Server0", ``group`` field
+        #   set), same contract as the single engine.
+        self.metrics = None
+        #   obs.registry.MetricsRegistry (None = off): the per-group
+        #   labeled counters (elections/commits/sheds by group).
         # Per-group rng streams: group g's election draws are its own
         # deterministic sequence (a lone engine with the same stream
         # makes the same draws), so adding groups never perturbs an
@@ -214,12 +222,17 @@ class MultiEngine:
                 self._arm_follower(g, r)
 
     # ------------------------------------------------------------------ util
-    def nodelog(self, g: int, r: int, msg: str) -> str:
+    def nodelog(self, g: int, r: int, msg: str,
+                kind: Optional[str] = None, **fields) -> str:
         """The reference nodelog schema with a group tag in the id field:
         ``[g{G}/Server{r}:Term:Commit:Last][role]msg``. The tag survives
         ``obs.trace.TraceRecord`` parsing (id = everything before the
-        first colon), and ``TraceRecord.group`` recovers the scope."""
-        if self._trace is None:
+        first colon), and ``TraceRecord.group`` recovers the scope.
+        With a flight recorder attached the same emission records a
+        typed ``obs.events.Event`` carrying ``group=g``; with neither
+        sink, the device fetch is skipped (no syncs when disabled)."""
+        rec = self.recorder
+        if self._trace is None and rec is None:
             return ""
         ci_li = np.asarray(
             jnp.stack(
@@ -230,8 +243,24 @@ class MultiEngine:
             f"[g{g}/Server{r}:{self.terms[g, r]}:{int(ci_li[0])}:"
             f"{int(ci_li[1])}][{self.roles[g][r]}]{msg}"
         )
-        self._trace(line)
+        if rec is not None:
+            rec.record(
+                node=f"g{g}/Server{r}", group=g, term=int(self.terms[g, r]),
+                kind=kind, t_virtual=self.clock.now,
+                state=self.roles[g][r], commit_index=int(ci_li[0]),
+                last_index=int(ci_li[1]), msg=msg, **fields,
+            )
+        if self._trace is not None:
+            self._trace(line)
         return line
+
+    def _metric_inc(self, g: int, name: str, help_: str = "",
+                    **labels) -> None:
+        """Guarded per-group counter bump (no-op without a registry)."""
+        if self.metrics is None:
+            return
+        labels.setdefault("group", str(g))
+        self.metrics.counter(name, help_, tuple(labels)).inc(**labels)
 
     def _push(self, t: float, kind: str, g: int, r: int) -> None:
         heapq.heappush(self._q, (t, self._seq_events, kind, g, r))
@@ -274,6 +303,7 @@ class MultiEngine:
         if self._admit_cap is not None and depth >= self._admit_cap:
             shed = self.shed_by_group[g]
             shed["depth"] = shed.get("depth", 0) + 1
+            self._metric_inc(g, "raft_sheds_total", reason="depth")
             raise Overloaded(
                 "depth", self.cfg.heartbeat_period,
                 f"group {g} write queue at bound {self._admit_cap}",
@@ -596,6 +626,7 @@ class MultiEngine:
                         self.roles[g][p] = FOLLOWER
                         self._arm_follower(g, p)
                 self.nodelog(g, r, "state changed to leader")
+                self._metric_inc(g, "raft_elections_total")
                 self._push(self.clock.now, "l", g, r)
             else:
                 self._arm_candidate(g, r)
@@ -735,6 +766,17 @@ class MultiEngine:
             seq = self._seq_at_index[g].get(idx)
             if seq is not None and seq not in self.commit_time[g]:
                 self.commit_time[g][seq] = self.clock.now
+                self._metric_inc(g, "raft_commits_total")
+                if self.metrics is not None:
+                    self.metrics.histogram(
+                        "raft_commit_latency_seconds",
+                        "submit -> durable, virtual seconds", ("group",),
+                    ).observe(
+                        self.clock.now - self.submit_time[g].get(
+                            seq, self.clock.now
+                        ),
+                        group=str(g),
+                    )
         self._archive_committed(g, leader, wm + 1, commit)
         self.commit_watermark[g] = commit
         self.nodelog(g, leader, f"commit index changed to {commit}")

@@ -64,11 +64,17 @@ class Router:
         elect_limit: float = 600.0,
         retry_budget: float = 32.0, retry_refill: float = 0.5,
         breaker_threshold: int = 8, breaker_cooldown_s: Optional[float] = None,
+        spans=None,
     ):
         self.engine = engine
         self.max_retries = max_retries
         self.drive = drive
         self.elect_limit = elect_limit
+        self.spans = spans
+        #   obs.spans.SpanTracker (None = off): _with_leader annotates
+        #   the ambient span with every retry / redial / breaker
+        #   fast-fail, so a client op's span shows the full refusal
+        #   discipline it rode through (docs/OBSERVABILITY.md).
         cfg = engine.cfg
         self.backoff = Backoff(
             base_s=cfg.heartbeat_period, max_s=cfg.follower_timeout[1],
@@ -82,9 +88,30 @@ class Router:
         self.breakers = [
             CircuitBreaker(
                 failure_threshold=breaker_threshold, cooldown_s=cooldown,
+                on_transition=self._breaker_transition(g),
             )
-            for _ in range(engine.G)
+            for g in range(engine.G)
         ]
+
+    def _breaker_transition(self, g: int):
+        """Breaker open/half_open/close transitions into the engine's
+        flight recorder (a previously-silent client-side plane). Bound
+        lazily so a recorder attached after construction still sees
+        them; the engine clock stamps the event (breaker success paths
+        carry no timestamp of their own)."""
+        def _note(state: str, _now: float, g=g) -> None:
+            rec = getattr(self.engine, "recorder", None)
+            if rec is not None:
+                rec.record(
+                    node=f"g{g}/client", group=g, term=-1,
+                    kind=f"breaker_{state}",
+                    t_virtual=self.engine.clock.now, state="client",
+                )
+            sp = self.spans.current if self.spans is not None else None
+            if sp is not None:
+                sp.annotate(f"breaker_{state}", self.engine.clock.now,
+                            group=g)
+        return _note
 
     # ------------------------------------------------------------- routing
     def group_of(self, key: bytes) -> int:
@@ -97,15 +124,31 @@ class Router:
         """Run ``fn`` under group ``g``'s refusal/retry discipline:
         breaker gate, jittered backoff, retry budget, redial."""
         breaker = self.breakers[g]
+        sp = self.spans.current if self.spans is not None else None
         if self.drive and not breaker.allow(self.engine.clock.now):
             # fast-fail without touching the engine: the group refused
             # repeatedly and its cooldown has not elapsed (the next
             # allowed call after cooldown is the half-open probe)
+            if sp is not None:
+                sp.refusal_reasons.append("circuit_open")
+                sp.annotate("circuit_open", self.engine.clock.now, group=g)
             raise CircuitOpen(breaker.retry_after(self.engine.clock.now), g)
         for attempt in range(self.max_retries + 1):
             try:
                 out = fn()
             except (NotLeader, Overloaded) as ex:
+                if sp is not None:
+                    reason = getattr(ex, "reason", "not_leader")
+                    sp.refusal_reasons.append(reason)
+                    #   MultiEngine's depth refusal has no engine-side
+                    #   span hook (unlike RaftEngine's note_refusal), so
+                    #   the router records the reason — an admission
+                    #   shed must close its span as "shed", not "failed"
+                    sp.annotate(
+                        "refusal", self.engine.clock.now, group=g,
+                        attempt=attempt, kind=type(ex).__name__,
+                        reason=reason,
+                    )
                 if not self.drive:
                     # without driving, nothing changes engine state
                     # between attempts (single-threaded host) — a retry
@@ -119,7 +162,14 @@ class Router:
                     # retry budget exhausted: retries are capped at a
                     # fraction of goodput — surface the refusal instead
                     # of feeding the overload
+                    if sp is not None:
+                        sp.annotate(
+                            "retry_budget_exhausted",
+                            self.engine.clock.now, group=g,
+                        )
                     raise
+                if sp is not None:
+                    sp.retries += 1
                 delay = self.backoff.delay(
                     attempt, getattr(ex, "retry_after_s", None)
                 )
@@ -138,14 +188,22 @@ class Router:
                     # leaderless: drive the event loop until the group
                     # re-elects (the redial); a group that cannot elect
                     # lets run_until_leader's own NotLeader propagate
+                    if sp is not None:
+                        sp.redials += 1
+                        sp.annotate("redial", self.engine.clock.now,
+                                    group=g)
                     self.engine.run_until_leader(g, limit=self.elect_limit)
                 if not breaker.allow(self.engine.clock.now):
+                    if sp is not None:
+                        sp.refusal_reasons.append("circuit_open")
+                        sp.annotate("circuit_open", self.engine.clock.now,
+                                    group=g)
                     raise CircuitOpen(
                         breaker.retry_after(self.engine.clock.now), g
                     )
             else:
                 if self.drive:
-                    breaker.on_success()
+                    breaker.on_success(self.engine.clock.now)
                     self.budget.on_success()
                 return out
         raise AssertionError("unreachable")

@@ -1,14 +1,52 @@
-"""Observability: trace capture + protocol metrics.
+"""Observability: flight recorder, op spans, metrics, forensics.
 
 The reference's only observability is ``nodelog`` printing
-``[Id:Term:CommitIndex:LastApplied][state]message`` to stdout from 19 call
-sites (main.go:399-401). That schema is kept verbatim — it is the
-differential-test join key between the golden model, the engine, and (by
-eye) the original Go binary — and extended with structured capture and the
-BASELINE metric set (entries/sec, p50/p99 commit latency).
+``[Id:Term:CommitIndex:LastApplied][state]message`` to stdout from 19
+call sites (main.go:399-401). That schema is kept verbatim — it is the
+differential-test join key between the golden model, the engine, and
+(by eye) the original Go binary — and grown into a real plane:
+
+- ``events``    — the flight recorder: a typed, bounded ring of
+  structured events; the legacy nodelog string is now a *rendering*
+  (``Event.nodelog()``, byte-identical).
+- ``spans``     — causal per-op tracing through router → admission →
+  engine → commit → apply, exportable as Chrome/Perfetto trace JSON.
+- ``registry``  — counters/gauges/histograms with per-group labels,
+  Prometheus text exposition + JSON snapshot.
+- ``forensics`` — repro bundles on unexpected chaos verdicts and the
+  ``python -m raft_tpu.obs --explain`` timeline reconstruction.
+- ``trace``     — the legacy string-capture ``TraceRecorder`` (kept:
+  the golden differential tests join on raw lines).
+- ``metrics``   — the BASELINE report (entries/s, p50/p99 commit
+  latency), now carrying the registry snapshot too.
 """
 
-from raft_tpu.obs.trace import TraceRecord, TraceRecorder
+from raft_tpu.obs.events import Event, FlightRecorder, kind_of
+from raft_tpu.obs.forensics import (
+    ObsStack,
+    explain,
+    load_bundle,
+    write_bundle,
+)
 from raft_tpu.obs.metrics import LatencySummary, summarize_engine
+from raft_tpu.obs.registry import MetricsRegistry, parse_prometheus
+from raft_tpu.obs.spans import Span, SpanTracker
+from raft_tpu.obs.trace import TraceRecord, TraceRecorder
 
-__all__ = ["TraceRecord", "TraceRecorder", "LatencySummary", "summarize_engine"]
+__all__ = [
+    "Event",
+    "FlightRecorder",
+    "LatencySummary",
+    "MetricsRegistry",
+    "ObsStack",
+    "Span",
+    "SpanTracker",
+    "TraceRecord",
+    "TraceRecorder",
+    "explain",
+    "kind_of",
+    "load_bundle",
+    "parse_prometheus",
+    "summarize_engine",
+    "write_bundle",
+]

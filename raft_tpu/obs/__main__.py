@@ -1,0 +1,114 @@
+"""Observability CLI: ``python -m raft_tpu.obs``.
+
+Post-mortem tooling over repro bundles (``obs.forensics``) — nothing
+here re-runs a seed:
+
+- ``--explain BUNDLE``          — reconstruct the minimal failure
+  timeline (last leader per term, faults in flight, the violating op).
+- ``--render-perfetto BUNDLE``  — convert the bundle's span table to
+  Chrome/Perfetto trace JSON (load at ui.perfetto.dev); ``-o`` writes
+  to a file, default stdout.
+- ``--metrics-dump BUNDLE``     — print the bundle's metrics snapshot
+  as Prometheus text exposition (``--json`` for the raw snapshot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from raft_tpu.obs.forensics import explain, load_bundle
+
+
+def _render_perfetto(bundle: dict) -> dict:
+    from raft_tpu.obs.spans import SpanTracker, spans_from_jsonable
+
+    if not bundle.get("spans"):
+        raise SystemExit(
+            "bundle carries no span table (run with observe=True)"
+        )
+    tracker = SpanTracker()
+    tracker.spans = spans_from_jsonable(bundle["spans"])
+    return tracker.to_perfetto()
+
+
+def _metrics_prometheus(snapshot: dict) -> str:
+    """Re-expose a bundle's JSON metrics snapshot as Prometheus text (a
+    snapshot is values, not live metric objects, so rebuild a registry)."""
+    from raft_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    for name, m in snapshot.items():
+        labels = tuple(m["labels"])
+        if m["type"] == "counter":
+            c = reg.counter(name, m["help"], labels)
+            for s in m["series"]:
+                c.inc(s["value"], **s["labels"])
+        elif m["type"] == "gauge":
+            g = reg.gauge(name, m["help"], labels)
+            for s in m["series"]:
+                g.set(s["value"], **s["labels"])
+        elif m["type"] == "histogram":
+            buckets = None
+            for s in m["series"]:
+                bs = [float(b) for b in s["buckets"] if b != "+Inf"]
+                buckets = tuple(bs)
+                break
+            h = reg.histogram(
+                name, m["help"], labels,
+                buckets=buckets if buckets else (1.0,),
+            )
+            for s in m["series"]:
+                h._counts[tuple(str(s["labels"][n]) for n in labels)] = \
+                    list(s["buckets"].values())
+                k = tuple(str(s["labels"][n]) for n in labels)
+                h._sum[k] = s["sum"]
+                h._n[k] = s["count"]
+    return reg.to_prometheus()
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m raft_tpu.obs",
+        description="raft_tpu observability tooling (repro bundles)",
+    )
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--explain", metavar="BUNDLE",
+                   help="reconstruct the failure timeline from a bundle")
+    g.add_argument("--render-perfetto", metavar="BUNDLE",
+                   help="bundle span table -> Chrome/Perfetto trace JSON")
+    g.add_argument("--metrics-dump", metavar="BUNDLE",
+                   help="bundle metrics snapshot -> Prometheus text")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output file (default stdout)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --metrics-dump: raw JSON snapshot instead "
+                         "of Prometheus text")
+    args = ap.parse_args(argv)
+
+    if args.explain:
+        text = explain(load_bundle(args.explain))
+    elif args.render_perfetto:
+        text = json.dumps(_render_perfetto(load_bundle(args.render_perfetto)))
+    else:
+        bundle = load_bundle(args.metrics_dump)
+        snap = bundle.get("metrics")
+        if not snap:
+            raise SystemExit(
+                "bundle carries no metrics snapshot (run with observe=True)"
+            )
+        text = (json.dumps(snap) if args.json
+                else _metrics_prometheus(snap))
+
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    else:
+        sys.stdout.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

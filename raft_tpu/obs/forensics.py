@@ -1,0 +1,291 @@
+"""Chaos forensics: repro bundles and failure-timeline reconstruction.
+
+Before this module, a failing torture run left behind exactly one
+artifact: a seed number to re-run. A repro bundle captures what the run
+already knew at the moment the verdict came back wrong — the flight
+recorder's event ring, the realized fault schedule, the client op
+history, span table, metrics snapshot, seed and config — as one JSON
+file, and ``explain()`` (exposed as ``python -m raft_tpu.obs --explain``)
+reconstructs the minimal failure timeline from it WITHOUT re-running the
+seed: the last leader of each term, the faults in flight around the
+violation, and the op that broke linearizability.
+
+The chaos runners write bundles automatically whenever a run ends in
+anything but its expected verdict and a destination is configured
+(``bundle_dir=`` argument, or the ``RAFT_TPU_BUNDLE_DIR`` environment
+variable); with neither set, nothing is written (CI trees stay clean —
+the pinned broken-variant tests opt in with a tmp dir).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+BUNDLE_FORMAT = "raft_tpu.obs/bundle.v1"
+
+
+@dataclasses.dataclass
+class ObsStack:
+    """The per-run observability plane the chaos runners attach when
+    ``observe=True``: one flight recorder + span tracker + metrics
+    registry, shared by every engine the run boots (including across
+    crash-restore cycles)."""
+
+    recorder: Any
+    spans: Any
+    registry: Any
+
+    @classmethod
+    def build(cls, capacity: int = 65536) -> "ObsStack":
+        from raft_tpu.obs.events import FlightRecorder
+        from raft_tpu.obs.registry import MetricsRegistry
+        from raft_tpu.obs.spans import SpanTracker
+
+        return cls(
+            recorder=FlightRecorder(capacity=capacity),
+            spans=SpanTracker(),
+            registry=MetricsRegistry(),
+        )
+
+    def attach(self, engine) -> None:
+        """Point an engine's observability hooks at this stack."""
+        engine.recorder = self.recorder
+        engine.spans = self.spans
+        engine.metrics = self.registry
+
+
+def resolve_bundle_dir(bundle_dir: Optional[str]) -> Optional[str]:
+    """The runner's destination policy: explicit argument, else the
+    ``RAFT_TPU_BUNDLE_DIR`` environment variable, else disabled."""
+    if bundle_dir is not None:
+        return bundle_dir
+    return os.environ.get("RAFT_TPU_BUNDLE_DIR") or None
+
+
+def _b2s(b: Optional[bytes]) -> Optional[str]:
+    return None if b is None else b.decode("latin1")
+
+
+def history_jsonable(history) -> List[dict]:
+    return [
+        {
+            "client": rec.client, "op": rec.op, "key": _b2s(rec.key),
+            "value": _b2s(rec.value), "invoke_t": rec.invoke_t,
+            "complete_t": rec.complete_t, "status": rec.status,
+        }
+        for rec in history.ops
+    ]
+
+
+def write_bundle(
+    bundle_dir: str,
+    *,
+    kind: str,
+    seed: int,
+    expected: str,
+    verdict: str,
+    detail: str = "",
+    violation_key: Optional[bytes] = None,
+    repro: str = "",
+    config: Optional[object] = None,
+    nemesis_log: Optional[List[str]] = None,
+    history=None,
+    obs: Optional[ObsStack] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write one repro bundle; returns the bundle file path."""
+    Path(bundle_dir).mkdir(parents=True, exist_ok=True)
+    bundle = {
+        "format": BUNDLE_FORMAT,
+        "kind": kind,
+        "seed": seed,
+        "expected": expected,
+        "verdict": verdict,
+        "detail": detail,
+        "violation_key": _b2s(violation_key),
+        "repro": repro,
+        "config": (
+            dataclasses.asdict(config) if dataclasses.is_dataclass(config)
+            else config
+        ),
+        "faults": list(nemesis_log or []),
+        "history": history_jsonable(history) if history is not None else [],
+        "events": obs.recorder.to_jsonable() if obs is not None else None,
+        "spans": obs.spans.to_jsonable() if obs is not None else None,
+        "metrics": obs.registry.to_json() if obs is not None else None,
+        "extra": extra or {},
+    }
+    path = Path(bundle_dir) / f"bundle_{kind}_seed{seed}.json"
+    path.write_text(json.dumps(bundle))
+    return str(path)
+
+
+def load_bundle(path: str) -> dict:
+    bundle = json.loads(Path(path).read_text())
+    if bundle.get("format") != BUNDLE_FORMAT:
+        raise ValueError(
+            f"{path}: not a raft_tpu repro bundle "
+            f"(format={bundle.get('format')!r})"
+        )
+    return bundle
+
+
+# --------------------------------------------------------------- explain
+_FAULT_T = re.compile(r"^t=(?P<t>[0-9.]+)\s+(?P<desc>.*)$")
+
+
+def _suspect_op(bundle: dict) -> Optional[dict]:
+    """Name the op that broke linearizability, from the recorded history
+    alone (no checker re-run): on the checker's offending key, the first
+    OK read whose returned value either was never written, was written
+    by an op that provably failed, or was invoked only AFTER the read
+    completed. Falls back to None when the heuristic finds nothing —
+    the per-key timeline is still printed either way."""
+    key = bundle.get("violation_key")
+    if key is None:
+        return None
+    kops = [op for op in bundle["history"] if op["key"] == key]
+    writers: Dict[Optional[str], dict] = {}
+    for op in kops:
+        if op["op"] in ("write", "delete"):
+            val = op["value"] if op["op"] == "write" else None
+            writers.setdefault(val, op)
+    for op in kops:
+        if op["op"] != "read" or op["status"] != "ok":
+            continue
+        w = writers.get(op["value"])
+        if op["value"] is not None and w is None:
+            return dict(op, why="read a value no client ever wrote")
+        if w is None:
+            continue
+        if w["status"] == "fail" and op["value"] is not None:
+            # None is also the key's INITIAL state, so a read of None
+            # after a failed delete is perfectly linearizable — only a
+            # concrete value proves the reader saw the failed writer
+            return dict(
+                op, why="read a value whose write provably took no effect"
+            )
+        if (op["complete_t"] is not None
+                and w["invoke_t"] > op["complete_t"]):
+            return dict(op, why="read a value written only later")
+    # new-then-old inversion (the dirty-read signature): a read returns
+    # value v_new, and a LATER read returns v_old whose write began
+    # before v_new's write — no linearization can order both.
+    ok_reads = [op for op in kops
+                if op["op"] == "read" and op["status"] == "ok"]
+    for i, r1 in enumerate(ok_reads):
+        w1 = writers.get(r1["value"])
+        if w1 is None or r1["complete_t"] is None:
+            continue
+        for r2 in ok_reads[i + 1:]:
+            if r2["invoke_t"] < r1["complete_t"]:
+                continue            # concurrent reads constrain nothing
+            w2 = writers.get(r2["value"])
+            if w2 is not None and w2["invoke_t"] < w1["invoke_t"]:
+                return dict(
+                    r2, why=(
+                        f"stale read: returned {r2['value']!r} after an "
+                        f"earlier read already returned the newer "
+                        f"{r1['value']!r}"
+                    ),
+                )
+    return None
+
+
+def explain(bundle: dict) -> str:
+    """The minimal failure timeline, reconstructed from a bundle."""
+    out: List[str] = []
+    out.append(
+        f"{bundle['kind']} seed {bundle['seed']}: verdict "
+        f"{bundle['verdict']} (expected {bundle['expected']})"
+    )
+    if bundle.get("detail"):
+        out.append(f"  checker: {bundle['detail']}")
+    if bundle.get("repro"):
+        out.append(f"  repro:   {bundle['repro']}")
+
+    # -- last leader per term (flight recorder) -------------------------
+    events = bundle.get("events")
+    if events and events.get("events"):
+        from raft_tpu.obs.events import Event
+
+        evs = [Event.from_jsonable(d) for d in events["events"]]
+        last_leader: Dict[tuple, Any] = {}
+        for e in evs:
+            if e.kind == "elect":
+                last_leader[(e.group, e.term)] = e
+        if last_leader:
+            out.append("last leader per term:")
+            for (g, term), e in sorted(
+                last_leader.items(), key=lambda kv: (kv[0][0] or 0, kv[0][1])
+            ):
+                scope = f"g{g} " if g is not None else ""
+                out.append(
+                    f"  {scope}term {term}: {e.node} "
+                    f"(elected t={e.t_virtual:.1f})"
+                )
+        if events.get("dropped"):
+            out.append(
+                f"  (ring overflowed: {events['dropped']} oldest events "
+                "dropped)"
+            )
+    else:
+        out.append("last leader per term: no flight recorder data "
+                   "(run with observe=True for the full ring)")
+
+    # -- the violating op ----------------------------------------------
+    suspect = _suspect_op(bundle)
+    key = bundle.get("violation_key")
+    t_focus = None
+    if suspect is not None:
+        t_focus = suspect.get("complete_t") or suspect.get("invoke_t")
+        out.append(
+            f"violating op: client {suspect['client']} read "
+            f"{suspect['key']!r} -> {suspect['value']!r} "
+            f"[{suspect['invoke_t']:.2f}, {suspect['complete_t']:.2f}] "
+            f"— {suspect['why']}"
+        )
+    elif key is not None:
+        out.append(
+            f"violating op: not isolated by heuristic; offending key "
+            f"{key!r} timeline below"
+        )
+
+    # -- faults in flight ----------------------------------------------
+    faults = []
+    for line in bundle.get("faults", []):
+        m = _FAULT_T.match(line)
+        if m:
+            faults.append((float(m["t"]), m["desc"]))
+    if faults:
+        if t_focus is not None:
+            window = [f for f in faults if f[0] <= t_focus]
+            window = window[-6:]
+            label = f"faults in flight before t={t_focus:.1f}:"
+        else:
+            window = faults[-8:]
+            label = "final fault schedule:"
+        out.append(label)
+        out.extend(f"  t={t:>8.1f}  {d}" for t, d in window)
+
+    # -- the offending key's op timeline -------------------------------
+    if key is not None:
+        kops = [op for op in bundle["history"] if op["key"] == key]
+        out.append(f"key {key!r} history ({len(kops)} ops):")
+        for op in kops:
+            end = ("inf" if op["complete_t"] is None
+                   else f"{op['complete_t']:.2f}")
+            mark = (" <== violation" if suspect is not None
+                    and op["invoke_t"] == suspect["invoke_t"]
+                    and op["client"] == suspect["client"] else "")
+            out.append(
+                f"  c{op['client']:<4} {op['op']:<6} "
+                f"{(op['value'] or ''):<12} [{op['invoke_t']:.2f}, {end}] "
+                f"{op['status']}{mark}"
+            )
+    return "\n".join(out)

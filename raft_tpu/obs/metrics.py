@@ -52,16 +52,26 @@ class EngineReport:
     # goodput is ``entries_per_sec`` above (committed work only; shed
     # arrivals never count).
     admission: Optional[AdmissionReport] = None
+    # obs.registry.MetricsRegistry snapshot (None when no registry is
+    # attached to the engine): the full labeled counter/gauge/histogram
+    # dump — elections, heartbeats, repair rounds, sheds by reason,
+    # commit-latency buckets (docs/OBSERVABILITY.md).
+    metrics: Optional[dict] = None
 
 
 def summarize_engine(engine, trace=None) -> EngineReport:
     """Metrics from a finished (or paused) engine run; ``trace`` is an
-    optional TraceRecorder for leadership-change counting."""
+    optional TraceRecorder for leadership-change counting (the engine's
+    attached ``recorder`` — structured ``elect`` events — is preferred
+    when present)."""
     lat = engine.commit_latencies()
     elapsed = engine.clock.now
     committed = len(engine.commit_time)
     leader_changes = 0
-    if trace is not None:
+    recorder = getattr(engine, "recorder", None)
+    if recorder is not None:
+        leader_changes = len(recorder.events(kind="elect"))
+    elif trace is not None:
         leader_changes = len(trace.matching("state changed to leader"))
     in_flight = engine.in_flight_count
     return EngineReport(
@@ -77,5 +87,9 @@ def summarize_engine(engine, trace=None) -> EngineReport:
         admission=(
             engine.admission.report(queue_depth=len(engine._queue))
             if getattr(engine, "admission", None) is not None else None
+        ),
+        metrics=(
+            engine.metrics.snapshot()
+            if getattr(engine, "metrics", None) is not None else None
         ),
     )

@@ -1,0 +1,264 @@
+"""Causal op tracing: one span per client operation, Dapper-style.
+
+A :class:`Span` is the lifetime of ONE client op — submit / linearizable
+read — carrying a trace id that propagates through every layer it
+crosses: ``Router._with_leader`` (retries, redials, breaker fast-fails),
+the admission gate (refusal reasons), ``RaftEngine.submit`` /
+``submit_read`` (queueing), ingest (queue delay), commit (replication
+rounds) and apply. Each layer *annotates* the span; whoever observes the
+op's outcome records exactly one terminal state.
+
+Propagation model: the engines are single-threaded event loops, so the
+ambient ``SpanTracker.current`` slot is the trace context — the caller
+sets it around the client call (the in-process analogue of a trace-id
+header) and the engine binds the span to its sequence number / read
+ticket from there. After that the causal chain is keyed by seq → log
+index → apply, no ambient state needed.
+
+Terminal states:
+
+- ``ok``      — outcome observed (write durable, read served).
+- ``failed``  — refused with provably no effect (NotLeader, refused
+  read, circuit open).
+- ``shed``    — refused by admission (a ``failed`` specialized by cause).
+- ``info``    — outcome unknown (crash window, client gave up).
+
+Export: ``to_perfetto()`` emits Chrome/Perfetto trace JSON on the
+VIRTUAL clock (virtual seconds scaled into the microsecond ``ts`` field
+1:1), so a whole torture run loads into ``ui.perfetto.dev`` as a
+timeline — spans as slices per client track, annotations as instants.
+
+Determinism contract: same as the flight recorder — pure host
+bookkeeping, no rng, no device traffic; a seeded run replays
+byte-identically with the tracker attached or absent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+TERMINAL_STATES = ("ok", "failed", "shed", "info")
+
+
+@dataclasses.dataclass
+class Span:
+    trace_id: int
+    op: str                          # "write" | "delete" | "read" | ...
+    t_start: float
+    client: Optional[object] = None
+    key: Optional[bytes] = None
+    group: Optional[int] = None
+    state: str = "open"              # "open" -> one of TERMINAL_STATES
+    t_end: Optional[float] = None
+    seq: Optional[int] = None        # engine sequence number, once bound
+    ticket: Optional[int] = None     # read ticket, once bound
+    retries: int = 0                 # refusals retried (router/client)
+    redials: int = 0                 # leadership redials (router)
+    queue_delay_s: Optional[float] = None     # submit -> ingest
+    replication_rounds: Optional[int] = None  # ingest -> commit, in ticks
+    refusal_reasons: List[str] = dataclasses.field(default_factory=list)
+    annotations: List[Tuple[float, str, Dict[str, Any]]] = \
+        dataclasses.field(default_factory=list)
+
+    @property
+    def terminal(self) -> bool:
+        return self.state != "open"
+
+    def annotate(self, name: str, t: float, **fields: Any) -> None:
+        self.annotations.append((t, name, fields))
+
+    def finish(self, state: str, t: Optional[float], **fields: Any) -> None:
+        """Record the span's single terminal state. A second terminal
+        transition is a harness bug (an op resolved twice) and raises."""
+        if state not in TERMINAL_STATES:
+            raise ValueError(f"not a terminal span state: {state!r}")
+        if self.terminal:
+            raise RuntimeError(
+                f"span {self.trace_id} already terminal "
+                f"({self.state!r}); second terminal {state!r}"
+            )
+        self.state = state
+        self.t_end = t                # None = unbounded (info at give-up)
+        if fields:
+            self.annotate(f"end:{state}", t if t is not None else
+                          self.t_start, **fields)
+
+    def to_jsonable(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.key is not None:
+            d["key"] = self.key.decode("latin1")
+        d["annotations"] = [
+            [t, name, fields] for t, name, fields in self.annotations
+        ]
+        return d
+
+
+class SpanTracker:
+    """Mints, binds and collects spans for one engine stack.
+
+    ``current`` is the ambient trace context (see module docstring); the
+    ``note_*`` hooks are what the engine calls at each causal step — all
+    tolerant of unbound ids, so instrumented engines keep working for
+    callers that never open spans."""
+
+    def __init__(self) -> None:
+        self.spans: List[Span] = []
+        self.current: Optional[Span] = None
+        self._next_id = 1
+        self._by_seq: Dict[int, Span] = {}
+        self._by_idx: Dict[int, Span] = {}
+        self._by_ticket: Dict[int, Span] = {}
+
+    def begin(
+        self,
+        op: str,
+        t: float,
+        client: Optional[object] = None,
+        key: Optional[bytes] = None,
+        group: Optional[int] = None,
+    ) -> Span:
+        sp = Span(
+            trace_id=self._next_id, op=op, t_start=t,
+            client=client, key=key, group=group,
+        )
+        self._next_id += 1
+        self.spans.append(sp)
+        return sp
+
+    # ------------------------------------------------ engine-side hooks
+    def note_submit(self, seq: int, t: float) -> None:
+        """``RaftEngine.submit`` minted ``seq`` for the current span."""
+        sp = self.current
+        if sp is None:
+            return
+        sp.seq = seq
+        sp.annotate("queued", t, seq=seq)
+        self._by_seq[seq] = sp
+
+    def note_ingest(self, seq: int, idx: int, t: float, tick: int) -> None:
+        """The leader tick moved ``seq`` from the host queue into the
+        replicated log at ``idx``."""
+        sp = self._by_seq.get(seq)
+        if sp is None:
+            return
+        sp.queue_delay_s = t - sp.t_start
+        sp.annotate("ingested", t, index=idx, tick=tick,
+                    queue_delay_s=sp.queue_delay_s)
+        sp._ingest_tick = tick          # type: ignore[attr-defined]
+        self._by_idx[idx] = sp
+
+    def note_commit(self, seq: int, t: float, tick: int) -> None:
+        sp = self._by_seq.pop(seq, None)
+        if sp is None:
+            return
+        t0 = getattr(sp, "_ingest_tick", None)
+        sp.replication_rounds = (tick - t0) if t0 is not None else None
+        sp.annotate("committed", t, rounds=sp.replication_rounds)
+
+    def note_apply(self, idx: int, t: float) -> None:
+        sp = self._by_idx.pop(idx, None)
+        if sp is not None:
+            sp.annotate("applied", t)
+
+    def note_refusal(self, reason: str, t: float) -> None:
+        """An admission gate / engine refusal hit the current span."""
+        sp = self.current
+        if sp is not None:
+            sp.refusal_reasons.append(reason)
+            sp.annotate("refused", t, reason=reason)
+
+    def note_read_ticket(self, ticket: int, t: float) -> None:
+        sp = self.current
+        if sp is None:
+            return
+        sp.ticket = ticket
+        sp.annotate("ticket", t, ticket=ticket)
+        self._by_ticket[ticket] = sp
+
+    def note_read_confirmed(self, ticket: int, idx: int, t: float) -> None:
+        sp = self._by_ticket.pop(ticket, None)
+        if sp is not None:
+            sp.annotate("confirmed", t, read_index=idx)
+
+    def note_read_refused(self, ticket: Optional[int], reason: str,
+                          t: float) -> None:
+        sp = (self._by_ticket.pop(ticket, None) if ticket is not None
+              else self.current)
+        if sp is not None:
+            sp.refusal_reasons.append(reason)
+            sp.annotate("refused", t, reason=reason)
+
+    # -------------------------------------------------------- inspection
+    def __len__(self) -> int:
+        return len(self.spans)
+
+    def open_spans(self) -> List[Span]:
+        return [sp for sp in self.spans if not sp.terminal]
+
+    def by_state(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for sp in self.spans:
+            out[sp.state] = out.get(sp.state, 0) + 1
+        return out
+
+    # ------------------------------------------------------------ export
+    def to_jsonable(self) -> dict:
+        return {"spans": [sp.to_jsonable() for sp in self.spans]}
+
+    def to_perfetto(self) -> dict:
+        """Chrome/Perfetto trace JSON on the virtual clock: pid = raft
+        group (0 for single-group), tid = client id; spans are ``X``
+        slices, annotations ``i`` instants. ``ts`` is microseconds, so
+        virtual seconds are scaled 1e6 and a 300-virtual-second run
+        spans a readable 5-minute timeline."""
+        evs: List[dict] = []
+        pids = set()
+        for sp in self.spans:
+            pid = sp.group if sp.group is not None else 0
+            tid = sp.client if isinstance(sp.client, int) else 0
+            pids.add(pid)
+            t_end = sp.t_end if sp.t_end is not None else sp.t_start
+            name = sp.op
+            if sp.key is not None:
+                name = f"{sp.op} {sp.key.decode('latin1')}"
+            evs.append({
+                "name": name, "cat": "op", "ph": "X",
+                "ts": sp.t_start * 1e6,
+                "dur": max((t_end - sp.t_start) * 1e6, 1.0),
+                "pid": pid, "tid": tid,
+                "args": {
+                    "trace_id": sp.trace_id, "state": sp.state,
+                    "seq": sp.seq, "retries": sp.retries,
+                    "redials": sp.redials,
+                    "queue_delay_s": sp.queue_delay_s,
+                    "replication_rounds": sp.replication_rounds,
+                    "refusals": sp.refusal_reasons,
+                },
+            })
+            for t, aname, fields in sp.annotations:
+                evs.append({
+                    "name": aname, "cat": "annotation", "ph": "i",
+                    "ts": t * 1e6, "pid": pid, "tid": tid, "s": "t",
+                    "args": dict(fields, trace_id=sp.trace_id),
+                })
+        for pid in sorted(pids):
+            evs.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"raft group {pid}"},
+            })
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def spans_from_jsonable(d: dict) -> List[Span]:
+    """Rehydrate spans from a forensics bundle (keys back to bytes)."""
+    out = []
+    for sd in d.get("spans", []):
+        sd = dict(sd)
+        if sd.get("key") is not None:
+            sd["key"] = sd["key"].encode("latin1")
+        sd["annotations"] = [
+            (t, name, fields) for t, name, fields in sd["annotations"]
+        ]
+        out.append(Span(**sd))
+    return out

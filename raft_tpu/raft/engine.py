@@ -163,6 +163,7 @@ class RaftEngine:
         transport: Optional[Transport] = None,
         trace: Optional[Callable[[str], None]] = None,
         vote_log: Optional[str] = None,
+        recorder=None,
     ):
         self.cfg = cfg
         self.t: Transport = transport if transport is not None else make_transport(cfg)
@@ -176,6 +177,25 @@ class RaftEngine:
         self.rng = random.Random(cfg.seed)
         self.clock = VirtualClock()
         self._trace = trace
+        self.recorder = recorder
+        #   obs.events.FlightRecorder (None = off): every nodelog call
+        #   site records a typed Event whose ``.nodelog()`` rendering is
+        #   byte-identical to the legacy trace line, plus the
+        #   previously-silent transitions (_record_event). With neither
+        #   a recorder nor a trace callback attached, nodelog skips its
+        #   device fetch entirely — the disabled path costs no syncs.
+        self.spans = None
+        #   obs.spans.SpanTracker (None = off): causal per-op tracing —
+        #   submit/submit_read bind the ambient span to their seq or
+        #   ticket; ingest/commit/apply annotate it (docs/OBSERVABILITY).
+        self.metrics = None
+        #   obs.registry.MetricsRegistry (None = off): protocol counters
+        #   (elections, heartbeats, repair rounds, sheds, commit-latency
+        #   histogram), labeled group="0" for the single-group engine.
+        self._tick_count = 0
+        #   Leader ticks fired so far — the replication-round clock the
+        #   span tracker diffs for rounds-to-commit (always maintained:
+        #   one int increment, determinism-neutral either way).
 
         n = cfg.rows
         self.member = np.zeros(n, bool)
@@ -306,6 +326,10 @@ class RaftEngine:
         #   from the snapshot tail's start: slots below it still hold init
         #   zeros (or pre-install leftovers), and a committed-range read
         #   from them would return garbage labeled as committed data.
+        self._floor_event_hwm: Dict[int, int] = {}
+        #   Highest repair floor already reported to the flight recorder
+        #   per leader row (the floor is recomputed every tick; the
+        #   EVENT fires only when it rises).
         self._match_stall = [0] * n
         #   Consecutive leader ticks each replica has sat below the ring
         #   horizon without match progress. After a leadership change every
@@ -381,9 +405,21 @@ class RaftEngine:
                 self._arm_follower(r)
 
     # ------------------------------------------------------------------ util
-    def nodelog(self, r: int, msg: str) -> str:
+    def nodelog(self, r: int, msg: str, kind: Optional[str] = None,
+                **fields) -> str:
         """The reference's trace schema (main.go:399-401) — the differential
-        join key: [Id:Term:CommitIndex:LastApplied][state]msg."""
+        join key: [Id:Term:CommitIndex:LastApplied][state]msg.
+
+        With a flight recorder attached the same emission records a typed
+        ``obs.events.Event`` (``kind`` explicit or classified from the
+        message; the legacy line is exactly ``Event.nodelog()``). With
+        NEITHER sink attached the device fetch is skipped — observability
+        off costs no device syncs (on a multihost transport the fetch is
+        a collective, so sinks must be attached symmetrically across
+        processes, as the mirrored event loop already requires)."""
+        rec = self.recorder
+        if rec is None and self._trace is None:
+            return ""
         ci_li = self._fetch(
             jnp.stack([self.state.commit_index, self.state.last_index])
         )   # one fetch (a collective on multihost) for both fields
@@ -391,9 +427,37 @@ class RaftEngine:
             f"[Server{r}:{self.terms[r]}:{int(ci_li[0, r])}:"
             f"{int(ci_li[1, r])}][{self.roles[r]}]{msg}"
         )
+        if rec is not None:
+            rec.record(
+                node=f"Server{r}", term=int(self.terms[r]), kind=kind,
+                t_virtual=self.clock.now, state=self.roles[r],
+                commit_index=int(ci_li[0, r]), last_index=int(ci_li[1, r]),
+                msg=msg, **fields,
+            )
         if self._trace is not None:  # not truthiness: empty sinks are falsy
             self._trace(line)
         return line
+
+    def _record_event(self, r: int, kind: str, **fields) -> None:
+        """Record a structured event that has NO legacy nodelog line (the
+        previously-silent transitions: repair floor raises, span-free
+        internals). Never enters the trace stream — the nodelog line set
+        is the differential join key and must not drift — and reads only
+        host mirrors, so it costs no device fetch."""
+        if self.recorder is not None:
+            self.recorder.record(
+                node=f"Server{r}", term=int(self.terms[r]), kind=kind,
+                t_virtual=self.clock.now, state=self.roles[r], **fields,
+            )
+
+    def _metric_inc(self, name: str, help_: str = "", **labels) -> None:
+        """Guarded counter bump (no-op without a registry). The single
+        engine is group "0"; extra labels (e.g. shed ``reason``) ride
+        along. Pure host arithmetic — determinism-neutral."""
+        if self.metrics is None:
+            return
+        labels.setdefault("group", "0")
+        self.metrics.counter(name, help_, tuple(labels)).inc(**labels)
 
     def _attach_votelog(self, path: str) -> None:
         from raft_tpu.ckpt import VoteLog
@@ -466,11 +530,26 @@ class RaftEngine:
                 f"payload must be exactly {self.cfg.entry_bytes} bytes"
             )
         if self.admission is not None:
-            self.admission.admit_write(len(self._queue), client)
+            try:
+                self.admission.admit_write(len(self._queue), client)
+            except Overloaded as ex:
+                # the gate refused before anything queued; the span (if
+                # one is ambient) and shed counter record the reason
+                if self.spans is not None:
+                    self.spans.note_refusal(ex.reason, self.clock.now)
+                self._metric_inc("raft_sheds_total", reason=ex.reason)
+                raise
         seq = self._next_seq
         self._next_seq += 1
         self._queue.append((seq, payload))
         self.submit_time[seq] = self.clock.now
+        if self.spans is not None:
+            self.spans.note_submit(seq, self.clock.now)
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "raft_queue_depth_high_water",
+                "max host write-queue depth observed", ("group",),
+            ).set_max(len(self._queue), group="0")
         return seq
 
     def is_durable(self, seq: int) -> bool:
@@ -501,6 +580,7 @@ class RaftEngine:
         if self.leader_id == r:
             self.leader_id = None
         self.nodelog(r, "step down to follower")
+        self._metric_inc("raft_term_adoptions_total")
         self._arm_follower(r)
 
     def submit_pipelined(self, payloads: List[bytes]) -> List[int]:
@@ -880,20 +960,31 @@ class RaftEngine:
                     break
                 self._drop_read_ticket(tk)
                 self._read_evict_floor = max(self._read_evict_floor, tk + 1)
-            self.admission.admit_read(len(self._reads))
+            try:
+                self.admission.admit_read(len(self._reads))
+            except Overloaded as ex:
+                if self.spans is not None:
+                    self.spans.note_refusal(ex.reason, self.clock.now)
+                self._metric_inc("raft_sheds_total", reason=ex.reason)
+                raise
         if r is None:
             r = self.leader_id
-        if r is None or self.roles[r] != LEADER or not self.alive[r]:
-            raise LinearizableReadRefused("not a live leader")
-        if int(self.terms[r]) > int(self.lead_terms[r]):
-            self._step_down_leader(r, int(self.terms[r]))
-            raise LinearizableReadRefused("deposed (higher term seen)")
-        voters = self._voter_reach(r)
-        if int(voters.sum()) <= int(self.member.sum()) // 2:
-            raise LinearizableReadRefused(
-                f"quorum unreachable ({int(voters.sum())} of "
-                f"{int(self.member.sum())} members)"
-            )
+        try:
+            if r is None or self.roles[r] != LEADER or not self.alive[r]:
+                raise LinearizableReadRefused("not a live leader")
+            if int(self.terms[r]) > int(self.lead_terms[r]):
+                self._step_down_leader(r, int(self.terms[r]))
+                raise LinearizableReadRefused("deposed (higher term seen)")
+            voters = self._voter_reach(r)
+            if int(voters.sum()) <= int(self.member.sum()) // 2:
+                raise LinearizableReadRefused(
+                    f"quorum unreachable ({int(voters.sum())} of "
+                    f"{int(self.member.sum())} members)"
+                )
+        except LinearizableReadRefused as ex:
+            if self.spans is not None:
+                self.spans.note_read_refused(None, str(ex), self.clock.now)
+            raise
         tk = self._next_read_ticket
         self._next_read_ticket += 1
         bind = (r, int(self.lead_terms[r]))
@@ -916,6 +1007,8 @@ class RaftEngine:
             for old in list(islice(iter(self._reads), n_evict)):
                 self._drop_read_ticket(old)
                 self._read_evict_floor = max(self._read_evict_floor, old + 1)
+        if self.spans is not None:
+            self.spans.note_read_ticket(tk, self.clock.now)
         return tk
 
     def _drop_read_ticket(self, ticket: int) -> None:
@@ -950,11 +1043,18 @@ class RaftEngine:
         row, idx, tterm, st = rec[:4]
         if st == "ready":
             self._drop_read_ticket(ticket)
+            if self.spans is not None:
+                self.spans.note_read_confirmed(ticket, idx, self.clock.now)
             return idx
         if (self.roles[row] != LEADER or not self.alive[row]
                 or int(self.lead_terms[row]) != tterm
                 or int(self.terms[row]) > tterm):
             self._drop_read_ticket(ticket)
+            if self.spans is not None:
+                self.spans.note_read_refused(
+                    ticket, "leadership lost before confirmation",
+                    self.clock.now,
+                )
             raise LinearizableReadRefused(
                 "leadership lost before confirmation"
             )
@@ -1568,10 +1668,18 @@ class RaftEngine:
         ring at full uncommitted depth, parking followers at the
         horizon across elections)."""
         cap = self.state.capacity
-        floor = max(
-            int(self._ring_floor[r]),
-            int(self._pre_lasts()[r]) - cap + 1,
-        )
+        lap = int(self._pre_lasts()[r]) - cap + 1
+        floor = max(int(self._ring_floor[r]), lap)
+        if (self.recorder is not None and floor > 1
+                and floor > self._floor_event_hwm.get(r, 0)):
+            # previously-silent transition: the repair floor rose (ring
+            # lap horizon or truncation) — recorder-only, no nodelog
+            # line (the legacy stream must not drift)
+            self._floor_event_hwm[r] = floor
+            self._record_event(
+                r, "repair_floor_raise", floor=floor, lap_horizon=lap,
+                ring_floor=int(self._ring_floor[r]),
+            )
         if floor <= 1:
             return floor, 0
         ent = self.store.get(floor - 1)
@@ -1990,6 +2098,11 @@ class RaftEngine:
                     self.roles[p] = FOLLOWER
                     self._arm_follower(p)
             self.nodelog(r, "state changed to leader")
+            self._metric_inc("raft_elections_total")
+            if self.metrics is not None:
+                self.metrics.gauge(
+                    "raft_term", "highest term seen", ("group",),
+                ).set_max(int(self.terms.max()), group="0")
             self._push(self.clock.now, f"l:{self._timer_gen[r]}", r)
         else:
             self._arm_candidate(r)
@@ -2015,6 +2128,8 @@ class RaftEngine:
             self._step_down_leader(r, int(self.terms[r]))
             return
         cfg = self.cfg
+        self._tick_count += 1
+        self._metric_inc("raft_heartbeat_ticks_total")
         if cfg.check_quorum:
             # §9.6 CheckQuorum: renew the lease while a VOTER majority
             # is reachable (learners keep nobody in office); a leader cut
@@ -2124,6 +2239,9 @@ class RaftEngine:
             )
         pre_lasts = self._pre_lasts()
         floor, fpt = self._floor_attest(r)
+        repair = self._repair_program()
+        if repair:
+            self._metric_inc("raft_repair_rounds_total")
         self.state, info = self.t.replicate(
             self.state,
             payload,
@@ -2132,7 +2250,7 @@ class RaftEngine:
             term,
             jnp.asarray(eff),
             jnp.asarray(self.slow),
-            repair=self._repair_program(),
+            repair=repair,
             member=(jnp.asarray(step_member) if step_member is not None
                     else self._member_arg()),
             repair_floor=floor,
@@ -2162,6 +2280,10 @@ class RaftEngine:
                 self._seq_at_index[idx] = seq
                 self._uncommitted[idx] = (p, term)
                 self._note_config_ingest(idx, seq, term)
+                if self.spans is not None:
+                    self.spans.note_ingest(
+                        seq, idx, self.clock.now, self._tick_count
+                    )
             self._queue = self._queue[ingested:]
         self._advance_commit(r, int(info.commit_index))
         self._confirm_reads(r, term, eff, max_term)
@@ -2321,6 +2443,21 @@ class RaftEngine:
             seq = self._seq_at_index.get(idx)
             if seq is not None and seq not in self.commit_time:
                 self.commit_time[seq] = self.clock.now
+                if self.spans is not None:
+                    self.spans.note_commit(
+                        seq, self.clock.now, self._tick_count
+                    )
+                if self.metrics is not None:
+                    self._metric_inc("raft_commits_total")
+                    self.metrics.histogram(
+                        "raft_commit_latency_seconds",
+                        "submit -> durable, virtual seconds", ("group",),
+                    ).observe(
+                        self.clock.now - self.submit_time.get(
+                            seq, self.clock.now
+                        ),
+                        group="0",
+                    )
         self._archive_committed(r, self.commit_watermark + 1, commit)
         self.commit_watermark = commit
         self.nodelog(r, f"commit index changed to {commit}")
@@ -2459,6 +2596,7 @@ class RaftEngine:
         self._lasts_snapshot = None   # last_index changed outside a step
         self._match_snapshot = None   # ...and so did match_index
         self.nodelog(replica, f"snapshot installed to {hi}")
+        self._metric_inc("raft_snapshot_installs_total")
         return True
 
     def _snapshot_heal(self, leader: int, info) -> None:
@@ -2782,6 +2920,8 @@ class RaftEngine:
             # not make OTHER registrants miss this index, and must not
             # cause re-delivery to them on the next drain.
             self.applied_index += 1
+            if self.spans is not None:
+                self.spans.note_apply(self.applied_index, self.clock.now)
             err: Optional[BaseException] = None
             for fn, fn_start in self._apply_fns:
                 if self.applied_index >= fn_start:
@@ -2968,6 +3108,7 @@ class RaftEngine:
         transport: Optional[Transport] = None,
         trace: Optional[Callable[[str], None]] = None,
         vote_log: Optional[str] = None,
+        recorder=None,
     ) -> "RaftEngine":
         """Rebuild an engine from ``save_checkpoint`` output: every replica
         restarts as a follower holding the archived committed tail (RS
@@ -2988,7 +3129,7 @@ class RaftEngine:
                 f"checkpoint entry size {ck.snap.entries.shape[1]} != "
                 f"config entry_bytes {cfg.entry_bytes}"
             )
-        eng = cls(cfg, transport, trace=trace)
+        eng = cls(cfg, transport, trace=trace, recorder=recorder)
         snap = ck.snap
         if snap.last_index >= snap.base_index:
             # History below the snapshot base was compacted before the

@@ -17,7 +17,7 @@ import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import committed_payloads
-from raft_tpu.obs import TraceRecorder
+from raft_tpu.obs import FlightRecorder
 from raft_tpu.raft import RaftEngine
 from raft_tpu.transport import SingleDeviceTransport
 
@@ -29,8 +29,8 @@ def mk(seed):
         n_replicas=3, max_replicas=5, entry_bytes=ENTRY, batch_size=4,
         log_capacity=256, transport="single", seed=seed,
     )
-    tr = TraceRecorder()
-    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr), tr
+    tr = FlightRecorder()
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), recorder=tr), tr
 
 
 def run_chaos(e, rng, phases=10, phase_s=40.0):
@@ -119,6 +119,8 @@ def check_invariants(cfg, e, tr, snapshots):
     """The post-chaos assertions shared by every transport variant:
     Election Safety, State-Machine Safety over current members, Leader
     Completeness over majority-side snapshots, membership coherence."""
+    assert tr.dropped == 0, \
+        "flight-recorder ring overflowed: election evidence incomplete"
     for term, leaders in tr.leaders_by_term().items():
         assert len(leaders) <= 1, f"two leaders in term {term}: {leaders}"
     members = [r for r in range(cfg.rows) if e.member[r]]
@@ -151,8 +153,8 @@ def mk_ec(seed):
         n_replicas=5, rs_k=3, rs_m=2, entry_bytes=12, batch_size=4,
         log_capacity=256, transport="single", seed=seed,
     )
-    tr = TraceRecorder()
-    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr), tr
+    tr = FlightRecorder()
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), recorder=tr), tr
 
 
 def run_ec_chaos(e, rng, phases=8, phase_s=40.0):
@@ -223,6 +225,8 @@ def check_ec_invariants(cfg, e, tr, snaps):
     from raft_tpu.ec.reconstruct import reconstruct
     from raft_tpu.ec.rs import RSCode
 
+    assert tr.dropped == 0, \
+        "flight-recorder ring overflowed: election evidence incomplete"
     for term, leaders in tr.leaders_by_term().items():
         assert len(leaders) <= 1, f"two leaders in term {term}"
     hi = e.commit_watermark
@@ -267,8 +271,8 @@ def test_chaos_over_mesh_transport():
         log_capacity=256, transport="tpu_mesh", seed=0,
     )
     t = TpuMeshTransport(cfg, jax.devices()[: cfg.rows])
-    tr = TraceRecorder()
-    e = RaftEngine(cfg, t, trace=tr)
+    tr = FlightRecorder()
+    e = RaftEngine(cfg, t, recorder=tr)
     snapshots = run_chaos(e, rng, phases=7, phase_s=35.0)
     check_invariants(cfg, e, tr, snapshots)
 
@@ -291,8 +295,8 @@ def mk_sessions(seed):
         n_replicas=3, max_replicas=5, entry_bytes=24, batch_size=4,
         log_capacity=64, transport="single", seed=seed,
     )
-    tr = TraceRecorder()
-    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr), tr
+    tr = FlightRecorder()
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), recorder=tr), tr
 
 
 @pytest.mark.parametrize("seed", [101, 202, 303])
@@ -435,8 +439,8 @@ def mk_ec_member(seed):
         n_replicas=5, max_replicas=7, rs_k=3, rs_m=2, entry_bytes=12,
         batch_size=4, log_capacity=256, transport="single", seed=seed,
     )
-    tr = TraceRecorder()
-    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr), tr
+    tr = FlightRecorder()
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), recorder=tr), tr
 
 
 def run_ec_member_chaos(e, rng, phases=10, phase_s=40.0):
@@ -531,6 +535,8 @@ def check_ec_member_invariants(cfg, e, tr, snaps):
     from raft_tpu.ec.reconstruct import reconstruct
     from raft_tpu.ec.rs import RSCode
 
+    assert tr.dropped == 0, \
+        "flight-recorder ring overflowed: election evidence incomplete"
     for term, leaders in tr.leaders_by_term().items():
         assert len(leaders) <= 1, f"two leaders in term {term}"
     assert e._pending_config is None

@@ -235,14 +235,14 @@ class TestElectionStormDifferential:
         )
 
         # --- engine, same schedule ---------------------------------------
-        from raft_tpu.obs import TraceRecorder
+        from raft_tpu.obs import FlightRecorder
 
-        tr = TraceRecorder()
+        tr = FlightRecorder()
         cfg = RaftConfig(
             n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=128,
             transport="single", seed=seed,
         )
-        e = RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr)
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg), recorder=tr)
         e.run_until_leader()
         seqs = [e.submit(p) for p in pre]
         e.run_until_committed(seqs[-1])
@@ -259,6 +259,8 @@ class TestElectionStormDifferential:
         # differential join: golden committed is a byte-prefix of engine's
         assert eng[: len(golden_committed)] == golden_committed
         # Election Safety held on the engine through the storm
+        assert tr.dropped == 0, \
+            "flight-recorder ring overflowed: election evidence incomplete"
         for term, leaders in tr.leaders_by_term().items():
             assert len(leaders) <= 1, f"two leaders in term {term}"
 

@@ -10,21 +10,27 @@ import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.faults import FaultEvent, FaultPlan
-from raft_tpu.obs import TraceRecord, TraceRecorder, summarize_engine
+from raft_tpu.obs import (
+    FlightRecorder,
+    TraceRecord,
+    TraceRecorder,
+    summarize_engine,
+)
 from raft_tpu.raft import RaftEngine
 from raft_tpu.transport import SingleDeviceTransport
 
 ENTRY = 16
 
 
-def mk_engine(seed=0, trace=None, **kw):
+def mk_engine(seed=0, trace=None, recorder=None, **kw):
     defaults = dict(
         n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=256,
         transport="single", seed=seed,
     )
     defaults.update(kw)
     cfg = RaftConfig(**defaults)
-    return RaftEngine(cfg, SingleDeviceTransport(cfg), trace=trace)
+    return RaftEngine(cfg, SingleDeviceTransport(cfg), trace=trace,
+                      recorder=recorder)
 
 
 def payloads(n, seed=0):
@@ -123,8 +129,8 @@ class TestElectionStorm:
 
     @pytest.mark.parametrize("seed", [0, 1])
     def test_safety_and_progress_under_storm(self, seed):
-        tr = TraceRecorder()
-        e = mk_engine(seed, trace=tr)
+        tr = FlightRecorder()
+        e = mk_engine(seed, recorder=tr)
         e.run_until_leader()
         t0 = e.clock.now
         e.schedule_faults(
@@ -142,15 +148,15 @@ class TestElectionStorm:
         assert e.leader_term > 1
 
     def test_storm_churns_leadership(self):
-        tr = TraceRecorder()
-        e = mk_engine(3, trace=tr)
+        tr = FlightRecorder()
+        e = mk_engine(3, recorder=tr)
         e.run_until_leader()
         t0 = e.clock.now
         e.schedule_faults(
             FaultPlan.election_storm(3, t0, t0 + 200.0, 15.0, seed=7)
         )
         e.run_for(220.0)
-        assert len(tr.matching("state changed to leader")) >= 2
+        assert len(tr.events(kind="elect")) >= 2
 
 
 class TestTrace:

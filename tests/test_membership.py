@@ -13,7 +13,7 @@ import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import committed_payloads
-from raft_tpu.obs import TraceRecorder
+from raft_tpu.obs import FlightRecorder
 from raft_tpu.raft import RaftEngine
 from raft_tpu.transport import SingleDeviceTransport
 
@@ -26,14 +26,15 @@ def payloads(n, seed=0):
             for _ in range(n)]
 
 
-def mk(seed=0, n=3, rows=5, trace=None, **kw):
+def mk(seed=0, n=3, rows=5, trace=None, recorder=None, **kw):
     defaults = dict(
         n_replicas=n, max_replicas=rows, entry_bytes=ENTRY, batch_size=4,
         log_capacity=256, transport="single", seed=seed,
     )
     defaults.update(kw)
     cfg = RaftConfig(**defaults)
-    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=trace)
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=trace,
+                           recorder=recorder)
 
 
 def committed(e, r):
@@ -113,8 +114,8 @@ class TestLifecycle:
     def test_grow_3_to_5_then_shrink_to_4(self):
         """The VERDICT's named lifecycle: 3 -> 5 -> 4 with client traffic
         flowing throughout and safety properties asserted."""
-        tr = TraceRecorder()
-        cfg, e = mk(seed=4, trace=tr)
+        tr = FlightRecorder()
+        cfg, e = mk(seed=4, recorder=tr)
         e.run_until_leader()
         drain(e, payloads(6, 40))
 
@@ -160,6 +161,8 @@ class TestLifecycle:
         assert e.roles[victim] == "follower"
 
         # safety: one leader per term; members agree on committed prefix
+        assert tr.dropped == 0, \
+            "flight-recorder ring overflowed: election evidence incomplete"
         for term, leaders in tr.leaders_by_term().items():
             assert len(leaders) <= 1, f"two leaders in term {term}"
         final = committed(e, e.leader_id)
@@ -436,8 +439,10 @@ class TestECLifecycle:
             n_replicas=5, max_replicas=6, rs_k=3, rs_m=2, entry_bytes=24,
             batch_size=4, log_capacity=64, transport="single", seed=seed,
         )
-        tr = TraceRecorder()
-        return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=tr), tr
+        tr = FlightRecorder()
+        return cfg, RaftEngine(
+            cfg, SingleDeviceTransport(cfg), recorder=tr,
+        ), tr
 
     def ps(self, n, seed):
         rng = np.random.default_rng(seed)
@@ -511,6 +516,8 @@ class TestECLifecycle:
             e.remove_server(last)
 
         # safety held throughout
+        assert tr.dropped == 0, \
+            "flight-recorder ring overflowed: election evidence incomplete"
         for term, leaders in tr.leaders_by_term().items():
             assert len(leaders) <= 1, f"two leaders in term {term}"
         probe = e.submit(self.ps(1, 314)[0])

@@ -1,0 +1,148 @@
+"""Observability under chaos: determinism pins, span completeness,
+repro bundles and the ``python -m raft_tpu.obs`` CLI (round 10).
+
+The determinism pin is the acceptance backbone: the observability plane
+must be a pure read-side — attaching the flight recorder / spans /
+metrics to a seeded torture run must not perturb a single committed
+byte or verdict. Pinned over the membership seeds 11/14/22/27 (the
+richest composition in the tier-1 pin set: reconfiguration + crash
+cycles + message faults), at reduced phase count to stay inside the
+tier-1 budget — the nemesis decision stream is identical at any phase
+count prefix."""
+
+import json
+
+import pytest
+
+from raft_tpu.chaos.checker import LINEARIZABLE, VIOLATION
+from raft_tpu.chaos.runner import torture_run, torture_run_multi
+from raft_tpu.obs import explain, load_bundle
+from raft_tpu.obs.__main__ import main as obs_main
+
+# the PR-9 membership pins (tests/test_torture.MEMBERSHIP_SEEDS): the
+# observability determinism pin replays the same seeds with the plane
+# on vs off
+OBS_DETERMINISM_SEEDS = [11, 14, 22, 27]
+
+
+def _fingerprint(rep):
+    return (rep.verdict, rep.commit_digest, rep.ops, rep.op_counts,
+            rep.crashes, rep.shed_ops, rep.membership_ops)
+
+
+def test_flight_recorder_is_determinism_neutral_on_pinned_seeds():
+    """ACCEPTANCE: seeds 11/14/22/27 with the full observability plane
+    attached vs absent — committed bytes (log CRC) and verdicts are
+    byte-identical, as are op counts and crash cycles."""
+    for seed in OBS_DETERMINISM_SEEDS:
+        plain = torture_run(seed, phases=4, membership=True)
+        observed = torture_run(seed, phases=4, membership=True,
+                               observe=True)
+        assert _fingerprint(plain) == _fingerprint(observed), (
+            f"seed {seed}: observability perturbed the run: "
+            f"{_fingerprint(plain)} != {_fingerprint(observed)}"
+        )
+        assert plain.verdict == LINEARIZABLE
+        assert observed.obs is not None and len(observed.obs.recorder) > 0
+
+
+def test_span_completeness_under_crash_and_shed():
+    """Every invoked op ends in exactly one terminal span state —
+    across crash cycles (info resolutions), admission shedding and
+    refused reads. Seed 9 is the overload pin (ring-full stalls +
+    sheds); membership seed 11 adds crash cycles."""
+    for seed, kw in ((9, dict(overload=True)), (11, dict(membership=True))):
+        rep = torture_run(seed, phases=5, observe=True, **kw)
+        spans = rep.obs.spans
+        assert len(spans.spans) == rep.ops, \
+            "one span per invoked op (history and span table must agree)"
+        assert spans.open_spans() == [], \
+            f"seed {seed}: non-terminal spans leaked"
+        states = spans.by_state()
+        assert set(states) <= {"ok", "failed", "shed", "info"}
+        assert states.get("ok", 0) > 0
+        if rep.shed_ops:
+            assert states.get("shed", 0) > 0, \
+                f"seed {seed}: sheds happened but no span closed as shed"
+
+
+def test_span_completeness_multi_router_redials():
+    """The NotLeader-redial leg: multi-Raft torture routes through
+    Router._with_leader; spans still all terminate, and router retries
+    are recorded on the spans that experienced them."""
+    rep = torture_run_multi(0, n_groups=4, phases=5, observe=True)
+    spans = rep.obs.spans
+    assert len(spans.spans) == rep.ops
+    assert spans.open_spans() == []
+    assert rep.verdict == LINEARIZABLE
+
+
+def test_forensics_bundle_on_pinned_rejected_seed(tmp_path):
+    """ACCEPTANCE: the pinned broken variant (dirty_reads, seed 0 —
+    REJECTED since round 7) auto-writes a repro bundle, and --explain
+    reconstructs a timeline naming the violating op WITHOUT re-running
+    the seed."""
+    rep = torture_run(0, phases=8, keys=2, broken="dirty_reads",
+                      observe=True, bundle_dir=str(tmp_path))
+    assert rep.verdict == VIOLATION
+    assert rep.bundle_path is not None
+    bundle = load_bundle(rep.bundle_path)
+    assert bundle["expected"] == LINEARIZABLE
+    assert bundle["verdict"] == VIOLATION
+    assert bundle["events"]["events"], "observe=True must dump the ring"
+    assert bundle["spans"]["spans"]
+    assert bundle["history"]
+    text = explain(bundle)
+    assert "violating op:" in text
+    assert "stale read" in text or "read a value" in text
+    assert "last leader per term:" in text
+    assert rep.repro in text
+
+    # the CLI paths over the same bundle (in-process: module import cost
+    # only, no re-run)
+    out = tmp_path / "explain.txt"
+    assert obs_main(["--explain", rep.bundle_path,
+                     "-o", str(out)]) == 0
+    assert "violating op:" in out.read_text()
+
+    perfetto = tmp_path / "trace.json"
+    assert obs_main(["--render-perfetto", rep.bundle_path,
+                     "-o", str(perfetto)]) == 0
+    doc = json.loads(perfetto.read_text())
+    assert any(ev.get("ph") == "X" for ev in doc["traceEvents"])
+
+    prom = tmp_path / "metrics.prom"
+    assert obs_main(["--metrics-dump", rep.bundle_path,
+                     "-o", str(prom)]) == 0
+    assert "raft_commits_total" in prom.read_text()
+
+
+def test_no_bundle_on_expected_verdict(tmp_path):
+    """A LINEARIZABLE run writes nothing even with a destination
+    configured — bundles mark unexpected outcomes only."""
+    rep = torture_run(3, phases=3, observe=True, bundle_dir=str(tmp_path))
+    assert rep.verdict == LINEARIZABLE
+    assert rep.bundle_path is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_explain_without_observability_still_works(tmp_path):
+    """A bundle from an observe=False run (history + faults only) still
+    explains — with an explicit pointer at the missing ring."""
+    rep = torture_run(0, phases=6, keys=2, broken="dirty_reads",
+                      bundle_dir=str(tmp_path))
+    assert rep.verdict == VIOLATION and rep.bundle_path
+    text = explain(load_bundle(rep.bundle_path))
+    assert "no flight recorder data" in text
+    assert "key" in text
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(8))
+def test_observed_torture_sweep_matches_plain(seed):
+    """Sweep-sized determinism evidence beyond the pinned four: the
+    full default composition, plane on vs off."""
+    plain = torture_run(seed, phases=10)
+    observed = torture_run(seed, phases=10, observe=True)
+    assert _fingerprint(plain) == _fingerprint(observed)
+    assert observed.obs.spans.open_spans() == []

@@ -1,0 +1,355 @@
+"""The observability plane (round 10): flight recorder, spans, metrics,
+forensics.
+
+Four contracts under test:
+
+1. **Nodelog byte-compatibility** — ``Event.nodelog()`` renders the
+   exact legacy trace line for every event emitted from a nodelog call
+   site, across a faulted differential-style run with BOTH sinks
+   attached. The line format is the differential-test join key with the
+   golden model and must not drift.
+2. **Determinism neutrality** — chaos seeds 11/14/22/27 (the membership
+   pins) replay byte-identically (committed-log CRC, verdict, op
+   counts, crash count) with the flight recorder enabled vs disabled;
+   and the disabled path performs no device fetch from nodelog.
+3. **Span completeness** — every invoked op ends in exactly one
+   terminal span state, under crash cycles, NotLeader redials and
+   admission shedding alike.
+4. **Forensics** — a pinned REJECTED seed (the ``dirty_reads`` broken
+   variant) auto-writes a repro bundle, and ``python -m raft_tpu.obs
+   --explain`` turns it into a timeline naming the violating op without
+   re-running the seed.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs import (
+    Event,
+    FlightRecorder,
+    MetricsRegistry,
+    SpanTracker,
+    TraceRecorder,
+    parse_prometheus,
+    summarize_engine,
+)
+from raft_tpu.raft.engine import RaftEngine
+from raft_tpu.transport.device import SingleDeviceTransport
+
+ENTRY = 16
+
+
+def mk_engine(seed=0, trace=None, recorder=None, **kw):
+    defaults = dict(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single", seed=seed,
+    )
+    defaults.update(kw)
+    cfg = RaftConfig(**defaults)
+    return RaftEngine(
+        cfg, SingleDeviceTransport(cfg), trace=trace, recorder=recorder
+    )
+
+
+def payloads(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, ENTRY, np.uint8).tobytes()
+            for _ in range(n)]
+
+
+# ------------------------------------------------------- 1. byte compat
+class TestNodelogByteCompat:
+    def test_nodelog_rendering_byte_identical(self):
+        """ACCEPTANCE: a faulted run with BOTH sinks attached — every
+        legacy trace line is exactly the recorder's rendering, in
+        order. Covers elections, step-downs, kills/recovers,
+        partitions, commits — the kinds the legacy assertions grepped."""
+        tr = TraceRecorder()
+        rec = FlightRecorder()
+        e = mk_engine(7, trace=tr, recorder=rec)
+        e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(6, seed=1)]
+        e.run_until_committed(seqs[-1])
+        victim = next(r for r in range(3) if r != e.leader_id)
+        e.fail(victim)
+        e.run_for(40.0)
+        e.recover(victim)
+        e.partition([[0, 1], [2]])
+        e.run_for(80.0)
+        e.heal_partition()
+        more = [e.submit(p) for p in payloads(4, seed=2)]
+        e.run_until_committed(more[-1], limit=600.0)
+        assert len(tr.lines) > 10
+        assert rec.nodelog_lines() == tr.lines
+
+    def test_multi_engine_rendering_byte_identical(self):
+        """The group-tagged schema (``g3/Server0``) renders identically
+        too, and events carry the group scope for filtered queries."""
+        from raft_tpu.multi.engine import MultiEngine
+
+        tr = TraceRecorder()
+        rec = FlightRecorder()
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=4,
+            log_capacity=64, transport="single", seed=2,
+        )
+        e = MultiEngine(cfg, 2, trace=tr, recorder=rec)
+        e.seed_leaders()
+        seqs = [e.submit_to_leader(g, payloads(1, seed=g)[0])
+                for g in range(2)]
+        for g, seq in enumerate(seqs):
+            e.run_until_committed(g, seq)
+        assert len(tr.lines) > 0
+        assert rec.nodelog_lines() == tr.lines
+        assert all(ev.group in (0, 1) for ev in rec.events())
+
+    def test_event_nodelog_requires_legacy_message(self):
+        ev = Event(seq=0, t_virtual=0.0, node="Server0", group=None,
+                   term=1, kind="repair_floor_raise")
+        with pytest.raises(ValueError):
+            ev.nodelog()
+
+    def test_structured_leaders_match_string_leaders(self):
+        tr = TraceRecorder()
+        rec = FlightRecorder()
+        e = mk_engine(3, trace=tr, recorder=rec)
+        e.run_until_leader()
+        e.fail(e.leader_id)
+        e.run_for(120.0)
+        want = {}
+        for r in tr.matching("state changed to leader"):
+            want.setdefault(r.term, set()).add(r.node)
+        assert rec.leaders_by_term() == want
+
+    def test_disabled_path_skips_device_fetch(self):
+        """No sink attached -> nodelog performs no device fetch (the
+        no-syncs-when-off half of the overhead contract)."""
+        e = mk_engine(1)
+        calls = [0]
+        orig = e._fetch
+
+        def counting(x):
+            calls[0] += 1
+            return orig(x)
+
+        e._fetch = counting
+        assert e.nodelog(0, "hello") == ""
+        assert calls[0] == 0
+        e._fetch = orig
+
+
+# ---------------------------------------------------- 2. ring semantics
+class TestFlightRecorderRing:
+    def test_ring_bound_and_overflow(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record(node="Server0", term=i, kind="elect",
+                       t_virtual=float(i))
+        assert len(rec) == 8
+        assert rec.dropped == 12
+        assert rec.total_recorded == 20
+        seqs = [e.seq for e in rec.events()]
+        assert seqs == list(range(12, 20))      # newest kept, seq monotone
+
+    def test_queries_filter_kind_node_group(self):
+        rec = FlightRecorder()
+        rec.record(node="g0/Server1", group=0, term=1, kind="elect",
+                   t_virtual=1.0)
+        rec.record(node="g1/Server2", group=1, term=1, kind="elect",
+                   t_virtual=2.0)
+        rec.record(node="g1/Server2", group=1, term=1, kind="kill",
+                   t_virtual=3.0)
+        assert len(rec.events(kind="elect")) == 2
+        assert len(rec.events(group=1)) == 2
+        assert len(rec.events(kind="elect", group=1)) == 1
+        assert rec.leaders_by_term(group=0) == {1: {"g0/Server1"}}
+
+    def test_dump_roundtrip(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(6):
+            rec.record(node="Server0", term=i, kind="commit",
+                       t_virtual=float(i), msg=f"commit index changed to {i}",
+                       state="leader", commit_index=i, last_index=i)
+        back = FlightRecorder.from_jsonable(
+            json.loads(json.dumps(rec.to_jsonable()))
+        )
+        assert back.dropped == rec.dropped
+        assert [e.nodelog() for e in back.events()] == \
+            [e.nodelog() for e in rec.events()]
+
+
+# -------------------------------------------------------------- 3. spans
+class TestSpans:
+    def test_engine_causal_chain(self):
+        rec = FlightRecorder()
+        e = mk_engine(5, recorder=rec)
+        e.spans = sp = SpanTracker()
+        e.register_apply(lambda idx, b: None)
+        e.run_until_leader()
+        span = sp.begin("write", e.clock.now, client=1, key=b"k")
+        sp.current = span
+        seq = e.submit(payloads(1, seed=9)[0])
+        sp.current = None
+        e.run_until_committed(seq)
+        span.finish("ok", e.clock.now)
+        names = [a[1] for a in span.annotations]
+        assert names[:3] == ["queued", "ingested", "committed"]
+        assert "applied" in names
+        assert span.queue_delay_s is not None
+        assert span.replication_rounds is not None
+        assert span.seq == seq
+
+    def test_double_terminal_raises(self):
+        sp = SpanTracker()
+        span = sp.begin("write", 0.0)
+        span.finish("ok", 1.0)
+        with pytest.raises(RuntimeError):
+            span.finish("failed", 2.0)
+
+    def test_shed_refusal_annotates_span(self):
+        e = mk_engine(2, admission_max_writes=1)
+        e.spans = sp = SpanTracker()
+        e.run_until_leader()
+        from raft_tpu.admission import Overloaded
+
+        ok = sp.begin("write", e.clock.now, client=0)
+        sp.current = ok
+        e.submit(payloads(1)[0])
+        sp.current = None
+        shed = sp.begin("write", e.clock.now, client=0)
+        sp.current = shed
+        with pytest.raises(Overloaded):
+            e.submit(payloads(1, seed=1)[0])
+        sp.current = None
+        assert shed.refusal_reasons == ["depth"]
+
+    def test_multi_router_shed_records_reason_on_span(self):
+        """A MultiEngine depth refusal has no engine-side span hook, so
+        the Router must record the reason — the span-state mapping
+        (shed, not failed) depends on it."""
+        from raft_tpu.admission import Overloaded
+        from raft_tpu.multi.engine import MultiEngine
+        from raft_tpu.multi.router import Router
+
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=4,
+            log_capacity=64, transport="single", seed=1,
+            admission_max_writes=1,
+        )
+        me = MultiEngine(cfg, 1)
+        me.seed_leaders()
+        sp = SpanTracker()
+        router = Router(me, max_retries=0, spans=sp)
+        me.submit(0, payloads(1)[0])          # queue at its bound of 1
+        span = sp.begin("write", me.clock.now, client=1, key=b"k")
+        sp.current = span
+        with pytest.raises(Overloaded):
+            router.submit(b"k", payloads(1, seed=2)[0])
+        sp.current = None
+        assert "depth" in span.refusal_reasons
+
+    def test_perfetto_export_shape(self):
+        sp = SpanTracker()
+        span = sp.begin("write", 1.0, client=3, key=b"k0", group=2)
+        span.annotate("queued", 1.5, seq=4)
+        span.finish("ok", 2.0)
+        doc = sp.to_perfetto()
+        slices = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+        assert slices[0]["pid"] == 2 and slices[0]["tid"] == 3
+        assert slices[0]["ts"] == 1.0e6 and slices[0]["dur"] == 1.0e6
+        assert any(ev["ph"] == "i" for ev in doc["traceEvents"])
+        json.dumps(doc)   # must be JSON-serializable as-is
+
+
+# ------------------------------------------------------------ 4. metrics
+class TestMetricsRegistry:
+    def test_counters_gauges_histograms(self):
+        reg = MetricsRegistry()
+        c = reg.counter("raft_elections_total", "wins", ("group",))
+        c.inc(group="0")
+        c.inc(2, group="1")
+        g = reg.gauge("raft_term", "", ("group",))
+        g.set_max(3, group="0")
+        g.set_max(1, group="0")
+        h = reg.histogram("lat", "", ("group",), buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v, group="0")
+        snap = reg.snapshot()
+        assert snap["raft_elections_total"]["series"][1]["value"] == 2
+        assert snap["raft_term"]["series"][0]["value"] == 3
+        hs = snap["lat"]["series"][0]
+        assert hs["count"] == 3 and hs["buckets"]["+Inf"] == 1
+
+    def test_prometheus_round_trip(self):
+        """ACCEPTANCE: exposition text parses back to the exact values
+        the registry holds (counters, gauges, histogram buckets/sums)."""
+        reg = MetricsRegistry()
+        c = reg.counter("raft_sheds_total", "refusals", ("reason", "group"))
+        c.inc(4, reason="depth", group="0")
+        c.inc(1, reason="fair_share", group="0")
+        reg.gauge("raft_term", "highest", ("group",)).set(7, group="0")
+        h = reg.histogram(
+            "raft_commit_latency_seconds", "", ("group",), buckets=(1.0, 4.0)
+        )
+        for v in (0.5, 2.0, 2.5, 9.0):
+            h.observe(v, group="0")
+        parsed = parse_prometheus(reg.to_prometheus())
+        assert parsed["raft_sheds_total"][
+            (("group", "0"), ("reason", "depth"))] == 4
+        assert parsed["raft_term"][(("group", "0"),)] == 7
+        b = parsed["raft_commit_latency_seconds_bucket"]
+        assert b[(("group", "0"), ("le", "1.0"))] == 1
+        assert b[(("group", "0"), ("le", "4.0"))] == 3
+        assert b[(("group", "0"), ("le", "+Inf"))] == 4
+        assert parsed["raft_commit_latency_seconds_count"][
+            (("group", "0"),)] == 4
+        assert parsed["raft_commit_latency_seconds_sum"][
+            (("group", "0"),)] == pytest.approx(14.0)
+
+    def test_prometheus_label_escaping_round_trip(self):
+        """Awkward label values — literal backslash+n, quotes, real
+        newlines — survive expose -> parse intact."""
+        reg = MetricsRegistry()
+        c = reg.counter("x_total", "", ("k",))
+        for v in ("a\\nb", 'with "quotes"', "two\nlines", "trail\\"):
+            c.inc(k=v)
+        parsed = parse_prometheus(reg.to_prometheus())
+        for v in ("a\\nb", 'with "quotes"', "two\nlines", "trail\\"):
+            assert parsed["x_total"][(("k", v),)] == 1, repr(v)
+
+    def test_engine_report_carries_snapshot(self):
+        rec = FlightRecorder()
+        e = mk_engine(6, recorder=rec)
+        e.metrics = MetricsRegistry()
+        e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(5, seed=3)]
+        e.run_until_committed(seqs[-1])
+        rep = summarize_engine(e)
+        assert rep.leader_changes >= 1           # counted from elect events
+        snap = rep.metrics
+        commits = snap["raft_commits_total"]["series"][0]["value"]
+        assert commits == 5
+        assert snap["raft_elections_total"]["series"][0]["value"] >= 1
+        lat = snap["raft_commit_latency_seconds"]["series"][0]
+        assert lat["count"] == 5
+
+
+# ---------------------------------------------------------- 5. breakers
+class TestBreakerEvents:
+    def test_open_half_open_close_transitions(self):
+        from raft_tpu.admission import CircuitBreaker
+
+        seen = []
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                            on_transition=lambda st, t: seen.append(st))
+        br.on_failure(0.0)
+        br.on_failure(1.0)               # opens
+        assert not br.allow(5.0)
+        assert br.allow(11.0)            # half-open probe allowed
+        br.on_failure(12.0)              # probe failed -> re-open
+        assert br.allow(23.0)
+        br.on_success()                  # probe succeeded -> close
+        assert seen == ["open", "half_open", "open", "half_open", "close"]

@@ -21,7 +21,7 @@ import pytest
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import committed_payloads
 from raft_tpu.faults import FaultPlan
-from raft_tpu.obs import TraceRecorder
+from raft_tpu.obs import FlightRecorder
 from raft_tpu.raft import RaftEngine
 from raft_tpu.transport import SingleDeviceTransport
 
@@ -34,14 +34,15 @@ def payloads(n, seed=0):
             for _ in range(n)]
 
 
-def mk(seed=0, n=3, trace=None, **kw):
+def mk(seed=0, n=3, trace=None, recorder=None, **kw):
     defaults = dict(
         n_replicas=n, entry_bytes=ENTRY, batch_size=4, log_capacity=256,
         transport="single", seed=seed,
     )
     defaults.update(kw)
     cfg = RaftConfig(**defaults)
-    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=trace)
+    return cfg, RaftEngine(cfg, SingleDeviceTransport(cfg), trace=trace,
+                           recorder=recorder)
 
 
 def committed(e, r):
@@ -207,8 +208,8 @@ def test_safety_properties_under_partition_schedule(seed, n):
     from tests.test_properties import replica_log
 
     rng = random.Random(7000 * n + seed)
-    tr = TraceRecorder()
-    cfg, e = mk(seed=seed, n=n, trace=tr)
+    tr = FlightRecorder()
+    cfg, e = mk(seed=seed, n=n, recorder=tr)
 
     snapshots = []
     e.run_until_leader()
@@ -240,6 +241,8 @@ def test_safety_properties_under_partition_schedule(seed, n):
     e.run_for(6 * cfg.heartbeat_period)
 
     # Election Safety: at most one leader per term, across the whole run
+    assert tr.dropped == 0, \
+        "flight-recorder ring overflowed: election evidence incomplete"
     for term, leaders in tr.leaders_by_term().items():
         assert len(leaders) <= 1, f"two leaders in term {term}: {leaders}"
     # Log Matching

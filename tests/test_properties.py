@@ -29,19 +29,19 @@ import pytest
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import committed_payloads, log_entries
-from raft_tpu.obs import TraceRecorder
+from raft_tpu.obs import FlightRecorder
 from raft_tpu.raft import RaftEngine
 from raft_tpu.transport import SingleDeviceTransport
 
 ENTRY = 16
 
 
-def mk_engine(seed, n, trace=None):
+def mk_engine(seed, n, recorder=None):
     cfg = RaftConfig(
         n_replicas=n, entry_bytes=ENTRY, batch_size=4, log_capacity=256,
         transport="single", seed=seed,
     )
-    return RaftEngine(cfg, SingleDeviceTransport(cfg), trace=trace)
+    return RaftEngine(cfg, SingleDeviceTransport(cfg), recorder=recorder)
 
 
 def replica_log(e, r):
@@ -219,11 +219,13 @@ def test_safety_across_whole_process_restart(seed, tmp_path):
 @pytest.mark.parametrize("n", [3, 5])
 def test_safety_properties_under_random_schedule(seed, n):
     rng = random.Random(1000 * n + seed)
-    tr = TraceRecorder()
-    e = mk_engine(seed, n, trace=tr)
+    tr = FlightRecorder()
+    e = mk_engine(seed, n, recorder=tr)
     snapshots = run_random_schedule(e, rng)
 
     # --- Election Safety ---------------------------------------------------
+    assert tr.dropped == 0, \
+        "flight-recorder ring overflowed: election evidence incomplete"
     for term, leaders in tr.leaders_by_term().items():
         assert len(leaders) <= 1, f"two leaders in term {term}: {leaders}"
 
