@@ -434,6 +434,105 @@ def bench_rs53() -> dict:
     return out
 
 
+# ------------------------------------------------- host/device attribution
+def bench_attribution() -> dict:
+    """WHERE the engine's per-tick wall time goes (ROADMAP item 2's
+    measurement layer): the headline rows prove the device step is ~µs
+    while the engine's wall cost per tick is orders of magnitude higher,
+    and until now "host-bound" was asserted, not measured. This leg
+    drives the real engine tick loop at the headline shape with
+    ``obs.hostprof.HostProfiler`` attached and decomposes each tick into
+    contiguous host phases (heap_pop / host_pre / pack / dispatch /
+    device_wait / host_post — docs/PERF.md has the table).
+
+    The phases are boundary-marked, so they tile the tick: the emitted
+    ``columns_us`` MUST sum to within 10% of the measured wall µs/tick
+    (``attribution_coverage`` reports the ratio). The observe-off wall
+    is measured first and reported too — both the profiler's own
+    overhead and the before/after baseline the future K-tick
+    ``lax.scan`` fusion will be judged against."""
+    from raft_tpu.obs.hostprof import HostProfiler
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    cfg = RaftConfig()                   # the c2 headline shape
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e.metrics = MetricsRegistry()
+    e.run_until_leader()
+    rng = np.random.default_rng(3)
+
+    def mk_batch():
+        return [rng.integers(0, 256, cfg.entry_bytes, np.uint8).tobytes()
+                for _ in range(cfg.batch_size)]
+
+    def drive_rounds(rounds: int) -> tuple:
+        """(wall_s, events, leader_ticks) over `rounds` one-batch commit
+        rounds; the wall window covers exactly the step_event loop — the
+        same span the profiler phases tile — so columns vs wall is a
+        like-for-like comparison. ``events`` counts step_event calls
+        (the profiler's denominator: leader ticks PLUS the stale timer
+        pops each tick's re-arms leave in the heap); ``leader_ticks``
+        counts real replication rounds, the headline's denominator.
+        Submit cost rides outside both on purpose: it is client-side
+        work, not tick work."""
+        wall, events, n0 = 0.0, 0, e._tick_count
+        for _ in range(rounds):
+            seqs = [e.submit(p) for p in mk_batch()]
+            t0 = time.perf_counter()
+            while not e.is_durable(seqs[-1]):
+                e.step_event()
+                events += 1
+            wall += time.perf_counter() - t0
+        return wall, events, e._tick_count - n0
+
+    # warm past compiles AND the first ring lap + archive compaction
+    # (log_capacity/batch rounds fill the ring; 2x that hits the store's
+    # compaction threshold) — the steady regime both windows must share
+    drive_rounds(2 * cfg.log_capacity // cfg.batch_size + 2)
+    ROUNDS = 24
+    wall_off1, ev_off1, _ = drive_rounds(ROUNDS)        # observe-off base
+    e.hostprof = hp = HostProfiler(registry=e.metrics)
+    wall_on, ev_on, lt_on = drive_rounds(ROUNDS)
+    assert ev_on == hp.ticks
+    e.hostprof = None
+    wall_off2, ev_off2, _ = drive_rounds(ROUNDS)        # off, re-measured
+    #   bracketing the on-window between two off-windows keeps a slow
+    #   drift (allocator state, dict growth) from being misread as
+    #   profiler overhead in either direction
+
+    per = hp.us_per_tick()
+    host_us, dev_us = hp.split()
+    wall_us = wall_on / max(ev_on, 1) * 1e6
+    wall_us_off = min(
+        wall_off1 / max(ev_off1, 1), wall_off2 / max(ev_off2, 1)
+    ) * 1e6
+    return {
+        "ticks": ev_on,
+        "leader_ticks": lt_on,
+        "entries_per_tick": cfg.batch_size,
+        "wall_us_per_leader_tick": round(
+            wall_on / max(lt_on, 1) * 1e6, 3
+        ),
+        "wall_us_per_tick": round(wall_us, 3),
+        "wall_us_per_tick_observe_off": round(wall_us_off, 3),
+        "observe_overhead_us": round(wall_us - wall_us_off, 3),
+        "columns_us": {k: round(v, 3) for k, v in per.items()},
+        "host_us_per_tick": round(host_us, 3),
+        "device_us_per_tick": round(dev_us, 3),
+        "attribution_coverage": round(
+            sum(per.values()) / wall_us if wall_us else float("nan"), 4
+        ),
+        "metrics": e.metrics.to_json(),
+        "note": ("columns_us are boundary-marked phases tiling each "
+                 "step_event; their sum must land within 10% of "
+                 "wall_us_per_tick (attribution_coverage ~ 1.0). "
+                 "device_wait is the post-dispatch block_until_ready; "
+                 "host fetches inside bookkeeping phases charge to those "
+                 "phases — they are the per-tick host round-trip the "
+                 "K-tick scan fusion (ROADMAP item 2) will remove"),
+    }
+
+
 # ------------------------------------------------ client-observed latency
 def bench_client_latency() -> dict:
     """What a CLIENT of ``submit_pipelined`` experiences, wall-clock:
@@ -1376,6 +1475,7 @@ def main(argv=None) -> None:
         ("mesh1_per_device", lambda: bench_mesh1(rng)),
         ("read_index", bench_read_index),
         ("client_chunk", bench_client_latency),
+        ("attribution", bench_attribution),
         ("overload", bench_overload),
         ("reconfig", bench_reconfig),
     ):

@@ -80,6 +80,14 @@ def main(argv=None) -> int:
                          "ends in anything but its expected verdict "
                          "(also honored via RAFT_TPU_BUNDLE_DIR); "
                          "inspect with python -m raft_tpu.obs --explain")
+    ap.add_argument("--blackbox-dir", default=None, metavar="DIR",
+                    help="write a black-box progress journal (one "
+                         "append-only line-flushed .jsonl per run: "
+                         "nemesis phases, crash-restore cycles, checker "
+                         "milestones) to DIR — it survives an external "
+                         "kill of the harness itself (also honored via "
+                         "RAFT_TPU_BLACKBOX_DIR); inspect with "
+                         "python -m raft_tpu.obs --explain")
     args = ap.parse_args(argv)
     if args.multi and args.broken:
         ap.error("--broken applies to the single-engine runner only")
@@ -98,6 +106,7 @@ def main(argv=None) -> int:
             rep = reconfig_run(
                 seed, step_budget=args.step_budget,
                 observe=args.observe, bundle_dir=args.bundle_dir,
+                blackbox_dir=args.blackbox_dir,
             )
             print(rep.summary())
             print(json.dumps({
@@ -120,6 +129,7 @@ def main(argv=None) -> int:
                 seed, rate_mult=args.overload_recovery,
                 step_budget=args.step_budget,
                 observe=args.observe, bundle_dir=args.bundle_dir,
+                blackbox_dir=args.blackbox_dir,
             )
             print(rep.summary())
             print(json.dumps({
@@ -150,6 +160,7 @@ def main(argv=None) -> int:
                 phase_s=args.phase_s, overload=args.overload,
                 step_budget=args.step_budget,
                 observe=args.observe, bundle_dir=args.bundle_dir,
+                blackbox_dir=args.blackbox_dir,
             )
         else:
             rep = torture_run(
@@ -160,6 +171,7 @@ def main(argv=None) -> int:
                 overload=args.overload, membership=args.membership,
                 step_budget=args.step_budget,
                 observe=args.observe, bundle_dir=args.bundle_dir,
+                blackbox_dir=args.blackbox_dir,
             )
         print(rep.summary())
         print(json.dumps({

@@ -72,6 +72,7 @@ from raft_tpu.chaos.nemesis import MembershipView, Nemesis, NemesisAction
 from raft_tpu.chaos.storage import MirroredStore
 from raft_tpu.chaos.transport import ChaosTransport
 from raft_tpu.config import RaftConfig
+from raft_tpu.obs import blackbox
 from raft_tpu.obs.forensics import (
     ObsStack,
     resolve_bundle_dir,
@@ -395,11 +396,18 @@ class _TortureBase:
         return None
 
     def run_phases(self, nemesis: Nemesis) -> None:
-        for _ in range(self.phases):
+        for phase_no in range(self.phases):
             self._invoke_idle()
             act = nemesis.next_action(
                 self.members(), self.alive_map(), self.partitioned,
                 self.now(), membership=self.membership_view(),
+            )
+            # blackbox progress mark (no-op without a journal): a run
+            # killed externally mid-phase leaves WHICH phase and which
+            # nemesis action it was executing in the journal tail
+            blackbox.mark(
+                "torture_phase", phase_no=phase_no, action=act.describe(),
+                t_virtual=round(self.now(), 3), ops=len(self.history),
             )
             self.apply_nemesis(act)
             # drive in slices so completions are stamped near the event
@@ -410,6 +418,8 @@ class _TortureBase:
                 self.pump_membership()
                 self._poll_all()
                 self._invoke_idle()
+        blackbox.mark("quiesce", t_virtual=round(self.now(), 3),
+                      ops=len(self.history), crashes=self.crashes)
         self.quiesce()
         self.history.close()
 
@@ -431,6 +441,7 @@ def torture_run(
     step_budget: int = 500_000,
     observe: bool = False,
     bundle_dir: Optional[str] = None,
+    blackbox_dir: Optional[str] = None,
 ) -> TortureReport:
     """One full single-engine torture run; see module docstring.
     ``overload=True`` arms admission (``_overload_cfg`` unless ``cfg``
@@ -446,22 +457,32 @@ def torture_run(
     ``bundle_dir`` (or ``RAFT_TPU_BUNDLE_DIR``) arms forensics: a
     verdict other than LINEARIZABLE auto-writes a repro bundle that
     ``python -m raft_tpu.obs --explain`` reconstructs without
-    re-running the seed."""
+    re-running the seed. ``blackbox_dir`` (or ``RAFT_TPU_BLACKBOX_DIR``)
+    arms the black-box progress journal (obs.blackbox): a per-process
+    append-only file of phase marks — nemesis actions, crash-restore
+    cycles, quiesce, the checker — that SURVIVES both engine crash
+    cycles and an external kill of the harness itself."""
     base = _overload_cfg(seed) if overload else _default_cfg(seed)
     if membership and cfg is None:
         base = _membership_cfg(base)
-    run = _SingleTorture(
-        seed, phases, clients, keys, phase_s,
-        cfg or base, workdir, broken, membership=membership,
-        observe=observe,
-    )
-    nemesis = Nemesis(
-        seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
-        allow_storage=storage_faults, allow_overload=overload,
-        allow_membership=membership,
-    )
-    run.run_phases(nemesis)
-    check = check_history(run.history, step_budget=step_budget)
+    with blackbox.journal_for(f"torture_seed{seed}", blackbox_dir):
+        blackbox.mark("torture_run", seed=seed, phases=phases,
+                      clients=clients, keys=keys)
+        run = _SingleTorture(
+            seed, phases, clients, keys, phase_s,
+            cfg or base, workdir, broken, membership=membership,
+            observe=observe,
+        )
+        nemesis = Nemesis(
+            seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
+            allow_storage=storage_faults, allow_overload=overload,
+            allow_membership=membership,
+        )
+        run.run_phases(nemesis)
+        blackbox.mark("check_history", ops=len(run.history),
+                      step_budget=step_budget)
+        check = check_history(run.history, step_budget=step_budget)
+        blackbox.mark("check_done", verdict=check.verdict)
     flags = []
     if not crash:
         flags.append("--no-crash")
@@ -579,6 +600,12 @@ class _SingleTorture(_TortureBase):
         from raft_tpu.raft.engine import RaftEngine
 
         t0 = self.now()
+        # write-before-block: the restore path replays checkpoints and
+        # re-elects — if the process dies or wedges inside it, the
+        # journal (which, being a per-process append-only file, SURVIVES
+        # the engine's crash-restore cycle by construction) says so
+        blackbox.mark("crash_restore", crashes=self.crashes,
+                      t_virtual=round(t0, 3))
         path, _, _rejected = self.store.load_best()
         old_stats = self.chaos_t.stats
         self.chaos_t = ChaosTransport(
@@ -950,6 +977,7 @@ def torture_run_multi(
     step_budget: int = 500_000,
     observe: bool = False,
     bundle_dir: Optional[str] = None,
+    blackbox_dir: Optional[str] = None,
 ) -> TortureReport:
     """Multi-Raft torture: the sharded Router/ShardedKV client surface
     under per-group process faults. No crash cycles or message faults —
@@ -961,16 +989,21 @@ def torture_run_multi(
     nemesis open open-loop arrival windows routed through a no-retry
     Router (shed = ``fail``, same soundness argument as the single
     engine)."""
-    run = _MultiTorture(
-        seed, phases, clients, keys, phase_s, cfg, n_groups,
-        overload=overload, observe=observe,
-    )
-    nemesis = Nemesis(
-        seed, run.cfg.n_replicas, allow_crash=False, allow_msg=False,
-        allow_storage=False, allow_overload=overload,
-    )
-    run.run_phases(nemesis)
-    check = check_history(run.history, step_budget=step_budget)
+    with blackbox.journal_for(f"torture_multi_seed{seed}", blackbox_dir):
+        blackbox.mark("torture_run_multi", seed=seed, n_groups=n_groups,
+                      phases=phases)
+        run = _MultiTorture(
+            seed, phases, clients, keys, phase_s, cfg, n_groups,
+            overload=overload, observe=observe,
+        )
+        nemesis = Nemesis(
+            seed, run.cfg.n_replicas, allow_crash=False, allow_msg=False,
+            allow_storage=False, allow_overload=overload,
+        )
+        run.run_phases(nemesis)
+        blackbox.mark("check_history", ops=len(run.history))
+        check = check_history(run.history, step_budget=step_budget)
+        blackbox.mark("check_done", verdict=check.verdict)
     repro = (
         f"python -m raft_tpu.chaos --seed {seed} --multi "
         f"--groups {n_groups} --phases {phases} --clients {clients} "
@@ -1257,6 +1290,19 @@ class OverloadReport:
 
 
 def overload_run(
+    seed: int, *args, blackbox_dir: Optional[str] = None, **kwargs,
+) -> OverloadReport:
+    """Journaled front door for :func:`_overload_run_impl` — the impl's
+    signature and defaults are the single source of truth (everything
+    but ``blackbox_dir`` forwards verbatim; see its docstring for the
+    scenario). ``blackbox_dir`` / ``RAFT_TPU_BLACKBOX_DIR`` arms the
+    progress journal like the other chaos entry points."""
+    with blackbox.journal_for(f"overload_seed{seed}", blackbox_dir):
+        blackbox.mark("overload_run", seed=seed)
+        return _overload_run_impl(seed, *args, **kwargs)
+
+
+def _overload_run_impl(
     seed: int,
     rate_mult: float = 5.0,
     baseline_s: float = 120.0,
@@ -1465,6 +1511,19 @@ class ReconfigReport:
 
 
 def reconfig_run(
+    seed: int, *args, blackbox_dir: Optional[str] = None, **kwargs,
+) -> ReconfigReport:
+    """Journaled front door for :func:`_reconfig_run_impl` — the impl's
+    signature and defaults are the single source of truth (everything
+    but ``blackbox_dir`` forwards verbatim; see its docstring for the
+    drill). ``blackbox_dir`` / ``RAFT_TPU_BLACKBOX_DIR`` arms the
+    progress journal like the other chaos entry points."""
+    with blackbox.journal_for(f"reconfig_seed{seed}", blackbox_dir):
+        blackbox.mark("reconfig_run", seed=seed)
+        return _reconfig_run_impl(seed, *args, **kwargs)
+
+
+def _reconfig_run_impl(
     seed: int,
     availability_window_s: float = 120.0,
     catchup_limit_s: float = 900.0,

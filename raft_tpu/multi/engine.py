@@ -167,6 +167,14 @@ class MultiEngine:
         self.metrics = None
         #   obs.registry.MetricsRegistry (None = off): the per-group
         #   labeled counters (elections/commits/sheds by group).
+        self.hostprof = None
+        #   obs.hostprof.HostProfiler (None = off): per-tick host-time
+        #   attribution, same contract as the single engine. A shared
+        #   batched launch serves several groups at once, so each phase
+        #   observation is recorded once per participating group label
+        #   (the launch is shared; the group axis is what amortizes it).
+        self._hp_groups: set = set()
+        #   groups the current tick's launches served (tick_end labels)
         # Per-group rng streams: group g's election draws are its own
         # deterministic sequence (a lone engine with the same stream
         # makes the same draws), so adding groups never perturbs an
@@ -514,6 +522,9 @@ class MultiEngine:
         shared-launch batching the group axis exists for."""
         if not self._q:
             return False
+        hp = self.hostprof
+        if hp is not None:
+            hp.tick_begin()
         t, _, kind, g, r = heapq.heappop(self._q)
         self.clock.now = max(self.clock.now, t)
         tag, _, gen = kind.partition(":")
@@ -522,9 +533,21 @@ class MultiEngine:
             while self._q and self._q[0][0] == t and self._q[0][2] == "l":
                 _, _, _, g2, r2 = heapq.heappop(self._q)
                 ticks.append((g2, r2))
+            if hp is not None:
+                hp.mark("heap_pop")
+                self._hp_groups = set()
             self._fire_leader_ticks(ticks)
+            if hp is not None:
+                hp.tick_end(
+                    groups=sorted(str(gg) for gg in self._hp_groups)
+                    or [str(gg) for gg, _ in ticks[:1]]
+                )
             return True
+        if hp is not None:
+            hp.mark("heap_pop")
         if tag in ("e", "c") and int(gen) != self._timer_gen[g, r]:
+            if hp is not None:
+                hp.tick_end(groups=(str(g),))
             return True  # stale timer generation
         if tag == "e":
             self._fire_follower(g, r)
@@ -532,6 +555,11 @@ class MultiEngine:
             self._fire_candidate(g, r)
         elif tag == "f":
             self._fire_fault(int(gen))
+        if hp is not None:
+            # fault events carry g=-1 (no owning group): flush the tick
+            # into the totals but emit no histogram series — a phantom
+            # group="-1" label must never reach the registry
+            hp.tick_end(groups=(str(g),) if tag != "f" else ())
         return True
 
     def run_for(self, seconds: float, max_events: int = 100_000) -> None:
@@ -645,6 +673,12 @@ class MultiEngine:
         as host arrays; ingest bookkeeping is the caller's."""
         cfg = self.cfg
         G, R, B = self.G, cfg.n_replicas, cfg.batch_size
+        hp = self.hostprof
+        if hp is not None:
+            # tick prep up to here (role checks, queue slicing) is
+            # host_pre; the fold below is the pack phase
+            hp.mark("host_pre")
+            self._hp_groups.update(active)
         counts = np.zeros(G, np.int32)
         leaders = np.zeros(G, np.int32)
         lterms = np.zeros(G, np.int32)
@@ -664,16 +698,23 @@ class MultiEngine:
                     (G, B, R * cfg.shard_words), jnp.int32
                 )
             payloads_dev = self._hb_payloads
+        if hp is not None:
+            hp.mark("pack")
         for g, (r, term, take, _) in active.items():
             leaders[g] = r
             lterms[g] = term
             eff[g] = self._reach(g, r)
             counts[g] = take
+        if hp is not None:
+            hp.mark("host_pre")
         self.state, info = self._replicate(
             self.state, payloads_dev, jnp.asarray(counts),
             jnp.asarray(leaders), jnp.asarray(lterms), jnp.asarray(eff),
             jnp.asarray(self.slow), self._member,
         )
+        if hp is not None:
+            hp.mark("dispatch")
+            hp.sync(self.state, info)
         self._last_info = info
         return np.asarray(info.max_term), np.asarray(info.commit_index)
 

@@ -19,8 +19,24 @@ differential-test join key between the golden model, the engine, and
   the golden differential tests join on raw lines).
 - ``metrics``   — the BASELINE report (entries/s, p50/p99 commit
   latency), now carrying the registry snapshot too.
+- ``hostprof``  — per-tick host-time attribution: phase timers tiling
+  the engine step (heap_pop / host_pre / pack / dispatch / device_wait
+  / host_post), feeding the ``raft_host_phase_seconds`` histogram and
+  the bench ``attribution`` leg.
+- ``blackbox``  — the hang-proof half: per-process append-only progress
+  journals (phase marks written BEFORE every blocking operation) and
+  the stall watchdog that dumps all-thread stacks + the journal tail
+  into a stall bundle when progress stops.
 """
 
+from raft_tpu.obs import blackbox
+from raft_tpu.obs.blackbox import (
+    BlackboxJournal,
+    StallWatchdog,
+    explain_journal,
+    explain_stall,
+    read_journal,
+)
 from raft_tpu.obs.events import Event, FlightRecorder, kind_of
 from raft_tpu.obs.forensics import (
     ObsStack,
@@ -28,25 +44,33 @@ from raft_tpu.obs.forensics import (
     load_bundle,
     write_bundle,
 )
+from raft_tpu.obs.hostprof import HostProfiler
 from raft_tpu.obs.metrics import LatencySummary, summarize_engine
 from raft_tpu.obs.registry import MetricsRegistry, parse_prometheus
 from raft_tpu.obs.spans import Span, SpanTracker
 from raft_tpu.obs.trace import TraceRecord, TraceRecorder
 
 __all__ = [
+    "BlackboxJournal",
     "Event",
     "FlightRecorder",
+    "HostProfiler",
     "LatencySummary",
     "MetricsRegistry",
     "ObsStack",
     "Span",
     "SpanTracker",
+    "StallWatchdog",
     "TraceRecord",
     "TraceRecorder",
+    "blackbox",
     "explain",
+    "explain_journal",
+    "explain_stall",
     "kind_of",
     "load_bundle",
     "parse_prometheus",
+    "read_journal",
     "summarize_engine",
     "write_bundle",
 ]

@@ -1,10 +1,16 @@
 """Observability CLI: ``python -m raft_tpu.obs``.
 
-Post-mortem tooling over repro bundles (``obs.forensics``) — nothing
-here re-runs a seed:
+Post-mortem tooling over repro bundles (``obs.forensics``) and black-box
+artifacts (``obs.blackbox``) — nothing here re-runs a seed:
 
-- ``--explain BUNDLE``          — reconstruct the minimal failure
-  timeline (last leader per term, faults in flight, the violating op).
+- ``--explain PATH``            — reconstruct the failure story from
+  whatever PATH is: a repro bundle (minimal failure timeline: last
+  leader per term, faults in flight, the violating op), a **stall
+  bundle** (who stalled, the blocked phase, journal tail, all-thread
+  stacks), a **blackbox journal** ``.jsonl`` (per-process phase
+  timeline with durations; the final in-flight phase flagged), or a
+  directory of journals (one timeline per process — the multihost
+  post-mortem view).
 - ``--render-perfetto BUNDLE``  — convert the bundle's span table to
   Chrome/Perfetto trace JSON (load at ui.perfetto.dev); ``-o`` writes
   to a file, default stdout.
@@ -16,10 +22,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Optional
 
-from raft_tpu.obs.forensics import explain, load_bundle
+from raft_tpu.obs.blackbox import STALL_FORMAT, explain_journal, explain_stall
+from raft_tpu.obs.forensics import BUNDLE_FORMAT, explain, load_bundle
 
 
 def _render_perfetto(bundle: dict) -> dict:
@@ -32,6 +40,55 @@ def _render_perfetto(bundle: dict) -> dict:
     tracker = SpanTracker()
     tracker.spans = spans_from_jsonable(bundle["spans"])
     return tracker.to_perfetto()
+
+
+def _explain_any(path: str) -> str:
+    """Dispatch --explain on what the artifact actually is: a directory
+    of journals, a journal file, a stall bundle, or a repro bundle."""
+    if os.path.isdir(path):
+        names = sorted(os.listdir(path))
+        journals = [
+            os.path.join(path, f) for f in names if f.endswith(".jsonl")
+        ]
+        # the watchdog writes stall bundles into the SAME blackbox dir —
+        # the directory post-mortem must surface them (they carry the
+        # all-thread stacks), not just the journal timelines
+        stalls = []
+        for f in names:
+            if f.startswith("stall_") and f.endswith(".json"):
+                try:
+                    with open(os.path.join(path, f)) as fh:
+                        doc = json.load(fh)
+                except (OSError, json.JSONDecodeError):
+                    continue
+                if doc.get("format") == STALL_FORMAT:
+                    stalls.append(explain_stall(doc))
+        if not journals and not stalls:
+            raise SystemExit(
+                f"{path}: no .jsonl journals or stall bundles in directory"
+            )
+        parts = [explain_journal(journals)] if journals else []
+        return "\n\n".join(parts + stalls)
+    if not os.path.exists(path):
+        # read_journal forgives unreadable files (it must not choke on
+        # the artifact of a crash), but a CLI typo must fail loudly, not
+        # exit 0 with an "empty journal" shrug
+        raise SystemExit(f"{path}: no such file")
+    if path.endswith(".jsonl"):
+        return explain_journal([path])
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as ex:
+        raise SystemExit(f"{path}: not a readable JSON artifact ({ex})")
+    if doc.get("format") == STALL_FORMAT:
+        return explain_stall(doc)
+    if doc.get("format") != BUNDLE_FORMAT:
+        raise SystemExit(
+            f"{path}: not a raft_tpu artifact "
+            f"(format={doc.get('format')!r})"
+        )
+    return explain(doc)
 
 
 def _metrics_prometheus(snapshot: dict) -> str:
@@ -75,8 +132,10 @@ def main(argv: Optional[list] = None) -> int:
         description="raft_tpu observability tooling (repro bundles)",
     )
     g = ap.add_mutually_exclusive_group(required=True)
-    g.add_argument("--explain", metavar="BUNDLE",
-                   help="reconstruct the failure timeline from a bundle")
+    g.add_argument("--explain", metavar="PATH",
+                   help="reconstruct the failure timeline from a repro "
+                        "bundle, a stall bundle, a blackbox journal "
+                        "(.jsonl), or a directory of journals")
     g.add_argument("--render-perfetto", metavar="BUNDLE",
                    help="bundle span table -> Chrome/Perfetto trace JSON")
     g.add_argument("--metrics-dump", metavar="BUNDLE",
@@ -89,7 +148,7 @@ def main(argv: Optional[list] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.explain:
-        text = explain(load_bundle(args.explain))
+        text = _explain_any(args.explain)
     elif args.render_perfetto:
         text = json.dumps(_render_perfetto(load_bundle(args.render_perfetto)))
     else:

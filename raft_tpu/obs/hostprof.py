@@ -1,0 +1,161 @@
+"""Host-time attribution: per-tick phase timers around the engine step.
+
+The performance question the ROADMAP leaves open (item 2): device time
+per step is ~2 µs while the engine's wall cost per tick is two orders
+of magnitude higher — and nothing measured WHERE the other 99% goes.
+This module decomposes one engine tick into contiguous host phases on
+``time.perf_counter``:
+
+==============  ========================================================
+phase           what it covers
+==============  ========================================================
+``heap_pop``    event-heap pop, virtual-clock advance, stale-timer check
+``host_pre``    pre-dispatch bookkeeping: CheckQuorum, admission delay
+                observation, staged-config drive, batch clamp, repair
+                floor attest and the cached last/match fetches
+``pack``        ingest batching: entry bytes -> the folded device batch
+                (``_pack_entries`` / ``fold_batch`` / EC encode)
+``dispatch``    the transport ``replicate`` call itself — on an async
+                backend this returns after launch, not completion
+``device_wait`` explicit ``jax.block_until_ready`` on the step's
+                outputs — device execution + queue time not already
+                hidden under dispatch
+``host_post``   post-step bookkeeping: truncation notes, seq->index
+                mapping, commit/apply/archive, read confirmation, span
+                hooks, heartbeat re-arm, mirror digest
+==============  ========================================================
+
+The phases are *boundary-marked* — each ``mark(phase)`` attributes the
+time since the previous boundary — so they tile the tick interval with
+no gaps by construction: their sum equals the measured tick wall time
+up to the marking overhead itself. That is what lets the bench
+``attribution`` leg promise host+device columns that sum to the wall
+slope (docs/PERF.md).
+
+Overhead contract (the flight-recorder contract, extended): the
+profiler is pure host bookkeeping, and the ``device_wait`` sync — the
+ONE deliberate device interaction — exists only behind
+:meth:`HostProfiler.sync`, which no engine path calls unless a profiler
+is attached. Observe-off therefore costs zero extra device syncs
+(pinned by the sync-counting test, like ``test_obs_plane``'s no-fetch
+pin).
+
+Per-tick phase seconds feed the PR-5 metrics registry as the
+``raft_host_phase_seconds`` histogram, labeled ``(group, phase)`` —
+under ``MultiEngine`` a shared batched launch observes once per
+participating group (the launch is shared; attribution is per group by
+construction of the group axis).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+#: µs-to-100ms log-spaced buckets: host phases live in the 1 µs - 1 ms
+#: band on a local backend and the 10-100 ms band through a dispatch
+#: tunnel; the default registry buckets (0.5s+) would flatten both.
+HOST_PHASE_BUCKETS = (
+    1e-6, 3e-6, 1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1,
+)
+
+PHASES = (
+    "heap_pop", "host_pre", "pack", "dispatch", "device_wait", "host_post",
+)
+
+
+class HostProfiler:
+    """Boundary-marking per-tick phase accumulator (see module doc).
+
+    Attach with ``engine.hostprof = HostProfiler(registry=engine.metrics)``
+    (registry optional — totals work standalone). The engine calls
+    ``tick_begin`` / ``mark`` / ``sync`` / ``tick_end`` only when a
+    profiler is attached; detached costs one ``is None`` check per site.
+    """
+
+    def __init__(self, registry=None, buckets=HOST_PHASE_BUCKETS):
+        self.registry = registry
+        self._hist = (
+            registry.histogram(
+                "raft_host_phase_seconds",
+                "host wall seconds per engine tick by phase",
+                ("group", "phase"), buckets=buckets,
+            )
+            if registry is not None else None
+        )
+        self.ticks = 0
+        self.phase_s: Dict[str, float] = {}
+        self.phase_marks: Dict[str, int] = {}
+        self._cur: Dict[str, float] = {}
+        self._last: Optional[float] = None
+
+    # ----------------------------------------------------------- marking
+    def tick_begin(self) -> None:
+        self._cur = {}
+        self._last = time.perf_counter()
+
+    def mark(self, phase: str) -> None:
+        """Attribute the time since the previous boundary to ``phase``.
+        Marking the same phase twice in one tick accumulates (the engine
+        marks ``host_pre`` both before and after the pack). Outside an
+        open ``tick_begin``/``tick_end`` bracket this is a no-op: call
+        paths that reach the marked engine internals directly (e.g. a
+        ``read_index`` driving ``_replicate_round`` without a tick)
+        must neither leak partial samples into the next tick nor inflate
+        the mark counters with samples no tick_end will ever flush."""
+        if self._last is None:
+            return
+        now = time.perf_counter()
+        self._cur[phase] = self._cur.get(phase, 0.0) + (now - self._last)
+        self.phase_marks[phase] = self.phase_marks.get(phase, 0) + 1
+        self._last = now
+
+    def sync(self, *values) -> None:
+        """Block until the step's device outputs are ready and attribute
+        the wait to ``device_wait`` — the ONE profiler operation that
+        touches the device, deliberately absent from every detached
+        engine path (the observe-off zero-extra-syncs contract). Like
+        :meth:`mark`, a no-op outside an open tick bracket (an
+        unattributable block would be pure added latency)."""
+        if self._last is None:
+            return
+        import jax
+
+        jax.block_until_ready(values)
+        self.mark("device_wait")
+
+    def tick_end(self, groups: Sequence[str] = ("0",)) -> None:
+        """Close the tick: the residue since the last boundary is
+        ``host_post`` (post-step bookkeeping runs from the final
+        explicit mark to here), then the per-tick phase seconds flush
+        into the totals and — when a registry is attached — into the
+        ``raft_host_phase_seconds`` histogram once per group label."""
+        self.mark("host_post")
+        self.ticks += 1
+        for phase, s in self._cur.items():
+            self.phase_s[phase] = self.phase_s.get(phase, 0.0) + s
+            if self._hist is not None:
+                for g in groups:
+                    self._hist.observe(s, group=str(g), phase=phase)
+        self._cur = {}
+        self._last = None
+
+    # ----------------------------------------------------------- results
+    def totals(self) -> Dict[str, float]:
+        """phase -> accumulated seconds over all ticks."""
+        return dict(self.phase_s)
+
+    def us_per_tick(self) -> Dict[str, float]:
+        """phase -> mean µs per tick (0 ticks -> empty)."""
+        if not self.ticks:
+            return {}
+        return {
+            p: s / self.ticks * 1e6 for p, s in sorted(self.phase_s.items())
+        }
+
+    def split(self) -> Tuple[float, float]:
+        """(host_us_per_tick, device_us_per_tick): ``device_wait`` is
+        the device column, every other phase is host control plane."""
+        per = self.us_per_tick()
+        dev = per.get("device_wait", 0.0)
+        return sum(per.values()) - dev, dev

@@ -55,6 +55,7 @@ import numpy as np
 from raft_tpu.admission import AdmissionGate, Overloaded
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import NO_VOTE, ReplicaState, fold_batch
+from raft_tpu.obs import blackbox
 from raft_tpu.transport.base import Transport, make_transport
 
 FOLLOWER = "follower"
@@ -192,6 +193,14 @@ class RaftEngine:
         #   obs.registry.MetricsRegistry (None = off): protocol counters
         #   (elections, heartbeats, repair rounds, sheds, commit-latency
         #   histogram), labeled group="0" for the single-group engine.
+        self.hostprof = None
+        #   obs.hostprof.HostProfiler (None = off): per-tick host-time
+        #   attribution — phase timers tiling step_event (heap_pop,
+        #   host_pre, pack, dispatch, device_wait, host_post). Detached
+        #   costs one None check per site and performs ZERO extra device
+        #   syncs: the profiler's block_until_ready lives only behind
+        #   HostProfiler.sync, which no detached path calls (pinned by
+        #   tests/test_perf_obs.py, like the nodelog no-fetch pin).
         self._tick_count = 0
         #   Leader ticks fired so far — the replication-round clock the
         #   span tracker diffs for rounds-to-commit (always maintained:
@@ -1758,6 +1767,9 @@ class RaftEngine:
         """Advance the clock to the next timer and handle it."""
         if not self._q:
             return False
+        hp = self.hostprof
+        if hp is not None:
+            hp.tick_begin()
         t, _, kind, r = heapq.heappop(self._q)
         self.clock.now = max(self.clock.now, t)
         tag, _, gen = kind.partition(":")
@@ -1766,6 +1778,8 @@ class RaftEngine:
         #   the pop still counts toward the mirror digest below, or a
         #   generation divergence would desynchronize the decision COUNT
         #   and cross-pair the digest exchange itself
+        if hp is not None:
+            hp.mark("heap_pop")
         if not stale:
             if tag == "e":
                 self._fire_follower(r)
@@ -1788,6 +1802,8 @@ class RaftEngine:
             self._mirror_digest_step(
                 t, kind + ("|stale" if stale else ""), r
             )
+        if hp is not None:
+            hp.tick_end()
         return True
 
     # ------------------------------------------------ mirror desync guard
@@ -1845,6 +1861,15 @@ class RaftEngine:
 
         from jax.experimental import multihost_utils
 
+        # write-before-block (obs.blackbox): if this exchange wedges —
+        # a peer died, diverged in count, or the fabric hung — the
+        # journal's last line names this barrier, its decision count and
+        # tick count, which is exactly what the stall bundle needs
+        blackbox.mark(
+            "barrier_enter", barrier="mirror_digest",
+            decisions=self._mirror_decisions, tick=self._tick_count,
+            digest=int(self._mirror_digest),
+        )
         box: dict = {}
 
         def _exchange() -> None:
@@ -1876,6 +1901,10 @@ class RaftEngine:
                 "planes can no longer be trusted to issue matching "
                 "collectives — failing stop instead of hanging."
             )
+        blackbox.mark(
+            "barrier_exit", barrier="mirror_digest",
+            decisions=self._mirror_decisions,
+        )
         digests = box["digests"]
         if not (digests == digests[0]).all():
             raise MirrorDesyncError(
@@ -2214,6 +2243,11 @@ class RaftEngine:
                     else:
                         take = qi    # everything before the entry only
                     break
+        hp = self.hostprof
+        if hp is not None:
+            # pre-dispatch bookkeeping up to here is host_pre; the
+            # payload build below is the ingest-batching (pack) phase
+            hp.mark("host_pre")
         if take == 0:
             if self._hb_payload is None:
                 self._hb_payload = jnp.zeros(
@@ -2237,11 +2271,18 @@ class RaftEngine:
                 self._pack_entries(self._queue[:take], take),
                 cfg.rows, B,
             )
+        if hp is not None:
+            hp.mark("pack")
         pre_lasts = self._pre_lasts()
         floor, fpt = self._floor_attest(r)
         repair = self._repair_program()
         if repair:
             self._metric_inc("raft_repair_rounds_total")
+        if hp is not None:
+            # the floor-attest / cached-lasts fetches above are part of
+            # the per-tick host round-trip the attribution exists to
+            # expose — charged to host_pre, not device_wait
+            hp.mark("host_pre")
         self.state, info = self.t.replicate(
             self.state,
             payload,
@@ -2257,6 +2298,9 @@ class RaftEngine:
             floor_prev_term=fpt,
             term_floor=self._term_floor,
         )
+        if hp is not None:
+            hp.mark("dispatch")
+            hp.sync(self.state, info)
         self._note_truncations(pre_lasts)
         max_term = int(info.max_term)
         if max_term > term:

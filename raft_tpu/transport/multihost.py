@@ -90,6 +90,7 @@ from typing import Optional, Sequence
 import jax
 
 from raft_tpu.config import RaftConfig
+from raft_tpu.obs import blackbox
 from raft_tpu.transport.tpu_mesh import TpuMeshTransport
 
 
@@ -104,11 +105,19 @@ def initialize_multihost(
     process — the raw material for ``replica_devices_across_hosts``."""
     if num_processes <= 1:
         return
+    # write-before-block (obs.blackbox): the distributed runtime dial is
+    # the first cross-process rendezvous — a dead coordinator or a
+    # firewalled port hangs exactly here, and only the journal says so
+    blackbox.mark(
+        "distributed_init", coordinator=str(coordinator_address),
+        num_processes=num_processes, process_id=process_id,
+    )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+    blackbox.mark("distributed_init_done", process_id=process_id)
 
 
 def replica_devices_across_hosts(
@@ -134,6 +143,15 @@ def replica_devices_across_hosts(
     host's ICI — a byte-sliced log row spanning DCN would put the hot
     window path on the slow fabric).
     """
+    if devices is None:
+        # write-before-block: with no live backend, jax.devices()
+        # INITIALIZES one — on a real-chip platform that dials the TPU
+        # tunnel and can hang indefinitely (the round-5 failure mode
+        # __graft_entry__._backend_initialized documents)
+        blackbox.mark(
+            "device_enum", n_replicas=n_replicas,
+            payload_shards=payload_shards,
+        )
     devs = list(devices) if devices is not None else list(jax.devices())
     by_proc: dict = {}
     for d in devs:

@@ -79,6 +79,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from raft_tpu.obs import blackbox
+
 
 def _atomic_write(path: str, payload: dict) -> bool:
     """Write-once atomic JSON publish: False if ``path`` already exists
@@ -310,6 +312,11 @@ class Rendezvous:
         placeholders could silently drop the max-watermark checkpoint
         from the next epoch's restore choice)."""
         hb = hb or {}
+        # write-before-block (obs.blackbox): this wait can legitimately
+        # run to its full timeout — the journal says which epoch the
+        # process was waiting past when an external kill arrives
+        blackbox.mark("await_epoch", rv_pid=self.pid, after=after,
+                      timeout_s=timeout_s)
         # monotonic deadline (ADVICE r5 #1): a wall-clock step must not
         # expire the wait early or extend it indefinitely
         deadline = time.monotonic() + timeout_s
@@ -317,6 +324,7 @@ class Rendezvous:
             ep = self.latest_epoch()
             if ep is not None and ep.n > after and self.pid in ep.members:
                 self.clear_join(self.pid)
+                blackbox.mark("await_epoch_done", rv_pid=self.pid, epoch=ep.n)
                 return ep
             self.heartbeat(after, hb.get("round", -1), hb.get("wm", -1),
                            hb.get("ckpt"))
@@ -337,6 +345,8 @@ class Rendezvous:
         would-be coordinator is itself dead (its heartbeat goes stale
         and the next-lowest survivor takes over)."""
         hb = hb or {}
+        blackbox.mark("reform_enter", rv_pid=self.pid, epoch=cur.n,
+                      stall_s=stall_s, timeout_s=timeout_s)
         deadline = time.monotonic() + timeout_s
         seen, seen_at = None, time.monotonic()
         settle_s = 6.0
@@ -344,6 +354,7 @@ class Rendezvous:
             ep = self.latest_epoch()
             if ep is not None and ep.n > cur.n:
                 if self.pid in ep.members:
+                    blackbox.mark("reform_done", rv_pid=self.pid, epoch=ep.n)
                     return ep
                 # A newer epoch EXCLUDED this survivor: its heartbeat went
                 # stale past the detector window while it was wedged (GC
@@ -354,6 +365,8 @@ class Rendezvous:
                 # the rejoin path instead: announce the join and wait to
                 # be folded into a following epoch (the coordinator sees
                 # the fresh join on its next round).
+                blackbox.mark("reform_rejoin", rv_pid=self.pid,
+                              excluded_by=ep.n)
                 self.request_join()
                 return self.await_epoch_including_me(
                     after=ep.n,
@@ -374,6 +387,9 @@ class Rendezvous:
                 self.is_coordinator(fresh, cur.members)
                 and time.monotonic() - seen_at >= settle_s
             ):
+                blackbox.mark("reform_propose", rv_pid=self.pid,
+                              next_epoch=cur.n + 1,
+                              survivors=sorted(fresh))
                 self.propose_next_epoch(cur, fresh, list(joiners))
             time.sleep(0.5)
         raise TimeoutError(f"pid {self.pid}: re-formation stalled")

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.comm import MeshComm
+from raft_tpu.obs import blackbox
 from raft_tpu.core.state import ReplicaState, init_state
 from raft_tpu.core.step import (
     RepInfo,
@@ -70,6 +71,13 @@ class TpuMeshTransport:
                 f"evenly over {payload_shards} payload shards"
             )
         self.payload_shards = payload_shards
+        # write-before-block (obs.blackbox): mesh construction and the
+        # shard_map program builds below are where a wedged backend or
+        # an incompatible JAX stalls/dies — the journal names this phase
+        blackbox.mark(
+            "mesh_build", rows=cfg.rows, payload_shards=payload_shards,
+            devices=len(devices),
+        )
         grid = np.array(devices[:need]).reshape(cfg.rows, payload_shards)
         self.mesh = Mesh(grid, (AXIS, PAYLOAD_AXIS))
         # The folded payload's lane axis is [R x P x W_local] flattened in
@@ -157,6 +165,10 @@ class TpuMeshTransport:
         self._lanes = lanes
         self._mem_spec = mem_spec
         self._fused = {}
+        self._fetch_seq = 0
+        #   allgather id for blackbox marks: every cross-process fetch is
+        #   a collective that can stall; the journal carries which one
+        blackbox.mark("mesh_ready", rows=cfg.rows)
 
     def init(self) -> ReplicaState:
         state = init_state(self.cfg)
@@ -181,6 +193,12 @@ class TpuMeshTransport:
         if not hasattr(self, "_fetch_jit"):
             rep = NamedSharding(self.mesh, P())
             self._fetch_jit = jax.jit(lambda a: a, out_shardings=rep)
+        # write-before-block: a cross-process fetch is a collective every
+        # process must reach in lockstep; a mirrored-loop divergence or a
+        # dead peer stalls exactly here, and the journal's allgather id
+        # tells WHICH fetch each process was in when it wedged
+        self._fetch_seq += 1
+        blackbox.mark("allgather", id=self._fetch_seq, op="fetch")
         return np.asarray(self._fetch_jit(x))
 
     def shard_rows(self, payload):

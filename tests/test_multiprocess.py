@@ -499,18 +499,28 @@ if os.environ.get("RAFT_SUPERVISED") != "1":
     # next collective).
     import subprocess, time
     restarts = 0
+    fast_fails = 0
     while True:
         env = dict(os.environ)
         env["RAFT_SUPERVISED"] = "1"
         if restarts:
             env["RAFT_REFORM"] = "1"
+        t0 = time.monotonic()
         p = subprocess.run([sys.executable] + sys.argv, env=env)
         if p.returncode == 0:
             raise SystemExit(0)
         restarts += 1
+        # crash-loop fast-fail (the k8s CrashLoopBackOff analogue): a
+        # worker that dies within seconds of start never joined an epoch
+        # — a legitimate death (leader loss, reform) comes after real
+        # progress. Three consecutive instant deaths mean the
+        # environment can never work (e.g. no usable mesh backend);
+        # burning 10 more jax imports just delays the same exit and, on
+        # a broken env, costs the tier-1 suite ~100 s of its wall budget.
+        fast_fails = fast_fails + 1 if time.monotonic() - t0 < 15.0 else 0
         print(f"SUPERVISOR pid={PID} worker exit {p.returncode}; "
               f"restart {restarts}", flush=True)
-        if restarts > 10:
+        if restarts > 10 or fast_fails >= 3:
             raise SystemExit(1)
         time.sleep(1.0)
 

@@ -1,0 +1,489 @@
+"""The performance/liveness observability plane (round 11): host-time
+attribution, blackbox journals, stall watchdog, bench liveness.
+
+Contracts under test:
+
+1. **Attribution overhead** — the observe-off engine step performs ZERO
+   extra device syncs (sync-counting pin, the hostprof analogue of
+   ``test_obs_plane``'s nodelog no-fetch pin), and with the profiler on,
+   the boundary-marked phases tile the tick (their sum tracks the
+   measured step_event wall).
+2. **Journal semantics** — write-before-block ordering (a mark is
+   durable even when the process dies immediately after, with no close),
+   round-trip parse, torn-tail tolerance, and survival across a chaos
+   crash-restore cycle.
+3. **Watchdog** — fires on an induced stall (including the acceptance
+   scenario: two processes blocked inside the engine's mirror-digest
+   barrier, each producing a stall bundle with faulthandler stacks and
+   journal tail naming the barrier) and stays silent on clean runs.
+4. **Bench liveness** — ``dryrun_multichip`` under an exhausted deadline
+   self-truncates with explicit per-phase skip rows and a final summary
+   row instead of dying silently (the rc=124/parsed-null fix).
+5. **Tooling** — ``python -m raft_tpu.obs --explain`` reads journals and
+   stall bundles; the multi-engine host-phase histogram series carry
+   per-group labels and survive the Prometheus round-trip.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs import (
+    BlackboxJournal,
+    HostProfiler,
+    MetricsRegistry,
+    StallWatchdog,
+    parse_prometheus,
+    read_journal,
+    summarize_engine,
+)
+from raft_tpu.obs import blackbox
+from raft_tpu.raft.engine import RaftEngine
+from raft_tpu.transport.device import SingleDeviceTransport
+
+ENTRY = 16
+
+
+def mk_engine(seed=0, **kw):
+    defaults = dict(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single", seed=seed,
+    )
+    defaults.update(kw)
+    cfg = RaftConfig(**defaults)
+    return RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+
+def payloads(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, ENTRY, np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def drive_batches(e, batches, seed=7):
+    for b in range(batches):
+        seqs = [e.submit(p) for p in payloads(4, seed=seed + b)]
+        e.run_until_committed(seqs[-1])
+
+
+# ----------------------------------------------------- 1. attribution
+class TestHostAttribution:
+    def test_observe_off_zero_extra_device_syncs(self, monkeypatch):
+        """ACCEPTANCE pin: the same traffic driven with the profiler
+        detached vs attached performs IDENTICAL fetch/replicate counts —
+        the only added device interaction is HostProfiler.sync, which no
+        detached path can reach."""
+        syncs = [0]
+        orig_sync = HostProfiler.sync
+
+        def counting_sync(self, *values):
+            syncs[0] += 1
+            return orig_sync(self, *values)
+
+        monkeypatch.setattr(HostProfiler, "sync", counting_sync)
+
+        def run(attach_profiler):
+            e = mk_engine(3)
+            if attach_profiler:
+                e.hostprof = HostProfiler()
+            fetches = [0]
+            orig_fetch = e._fetch
+            e._fetch = lambda x: (fetches.__setitem__(0, fetches[0] + 1),
+                                  orig_fetch(x))[1]
+            replicates = [0]
+            orig_rep = e.t.replicate
+
+            def counting_rep(*a, **k):
+                replicates[0] += 1
+                return orig_rep(*a, **k)
+
+            e.t.replicate = counting_rep
+            e.run_until_leader()
+            drive_batches(e, 3)
+            committed = bytes(
+                b for _, payload in sorted(
+                    (i, e.store.get(i)[0])
+                    for i in range(1, e.commit_watermark + 1)
+                ) for b in payload
+            )
+            return fetches[0], replicates[0], committed
+
+        syncs[0] = 0
+        f_off, r_off, log_off = run(attach_profiler=False)
+        assert syncs[0] == 0          # detached: not one profiler sync
+        f_on, r_on, log_on = run(attach_profiler=True)
+        assert syncs[0] > 0           # attached: syncs exist, and ONLY there
+        assert f_on == f_off          # no hidden fetches either way
+        assert r_on == r_off
+        assert log_on == log_off      # determinism-neutral
+
+    def test_phases_tile_the_tick(self):
+        """Boundary marking means the phase columns sum to the measured
+        step_event wall (the bench attribution leg's 10% contract; the
+        unit pin allows wider slack for CI timing noise)."""
+        e = mk_engine(5)
+        e.hostprof = hp = HostProfiler()
+        e.run_until_leader()
+        wall, t0n = 0.0, hp.ticks
+        for b in range(8):
+            seqs = [e.submit(p) for p in payloads(4, seed=20 + b)]
+            t0 = time.perf_counter()
+            while not e.is_durable(seqs[-1]):
+                e.step_event()
+            wall += time.perf_counter() - t0
+        ticks = hp.ticks - t0n
+        assert ticks > 0
+        col_sum = sum(hp.totals().values()) / hp.ticks * ticks
+        coverage = col_sum / wall
+        assert 0.75 < coverage < 1.25, (coverage, hp.us_per_tick())
+        host_us, dev_us = hp.split()
+        assert dev_us > 0             # the sync really waited on device
+        assert host_us > 0
+
+    def test_engine_report_carries_host_phase_series(self):
+        e = mk_engine(6)
+        e.metrics = MetricsRegistry()
+        e.hostprof = HostProfiler(registry=e.metrics)
+        e.run_until_leader()
+        drive_batches(e, 2)
+        snap = summarize_engine(e).metrics
+        series = snap["raft_host_phase_seconds"]["series"]
+        phases = {s["labels"]["phase"] for s in series}
+        assert {"heap_pop", "dispatch", "device_wait",
+                "host_post"} <= phases
+        assert all(s["labels"]["group"] == "0" for s in series)
+
+    def test_multi_engine_per_group_series_round_trip(self):
+        """The MultiEngine host-phase histogram carries per-group labels
+        and the exposition round-trips (the small-fix satellite)."""
+        from raft_tpu.multi.engine import MultiEngine
+
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=4,
+            log_capacity=64, transport="single", seed=2,
+        )
+        me = MultiEngine(cfg, 2)
+        me.metrics = MetricsRegistry()
+        me.hostprof = HostProfiler(registry=me.metrics)
+        me.seed_leaders()
+        seqs = [me.submit_to_leader(g, payloads(1, seed=g)[0])
+                for g in range(2)]
+        for g, seq in enumerate(seqs):
+            me.run_until_committed(g, seq)
+        snap = me.metrics.snapshot()
+        series = snap["raft_host_phase_seconds"]["series"]
+        groups = {s["labels"]["group"] for s in series}
+        assert groups == {"0", "1"}
+        parsed = parse_prometheus(me.metrics.to_prometheus())
+        counts = parsed["raft_host_phase_seconds_count"]
+        # every (group, phase) series survives the text round trip
+        for s in series:
+            key = tuple(sorted(
+                (k, v) for k, v in s["labels"].items()
+            ))
+            assert counts[key] == s["count"]
+
+
+# -------------------------------------------------------- 2. journals
+class TestBlackboxJournal:
+    def test_roundtrip_order_and_fields(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        j = BlackboxJournal(str(p), proc="t0")
+        j.mark("mesh_build", rows=4)
+        j.mark("barrier_enter", barrier="mirror_digest", id=1)
+        j.close()
+        recs = read_journal(str(p))
+        assert [r["phase"] for r in recs] == [
+            "journal_open", "mesh_build", "barrier_enter", "journal_close",
+        ]
+        assert [r["seq"] for r in recs] == list(range(4))
+        monos = [r["mono"] for r in recs]
+        assert monos == sorted(monos)
+        assert recs[1]["rows"] == 4
+        assert recs[2]["barrier"] == "mirror_digest"
+        assert all(r["proc"] == "t0" for r in recs)
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        j = BlackboxJournal(str(p), proc="t1")
+        j.mark("phase_a")
+        j.close()
+        with open(p, "a") as f:
+            f.write('{"seq": 99, "phase": "torn')   # crash mid-write
+        recs = read_journal(str(p))
+        assert [r["phase"] for r in recs][-1] == "journal_close"
+
+    def test_write_before_block_survives_sigkill(self, tmp_path):
+        """The whole point of the journal: a mark is durable BEFORE the
+        next (possibly fatal) operation — even an immediate hard exit
+        with no close leaves it on disk."""
+        p = tmp_path / "j.jsonl"
+        code = (
+            "import sys, os\n"
+            "from raft_tpu.obs.blackbox import BlackboxJournal\n"
+            f"j = BlackboxJournal({str(p)!r}, proc='victim')\n"
+            "j.mark('barrier_enter', barrier='allgather', id=7)\n"
+            "os._exit(137)   # the block that never returns\n"
+        )
+        r = subprocess.run([sys.executable, "-c", code],
+                           env=_cpu_env(), timeout=120)
+        assert r.returncode == 137
+        recs = read_journal(str(p))
+        assert recs[-1]["phase"] == "barrier_enter"
+        assert recs[-1]["id"] == 7
+
+    def test_active_journal_module_marks(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        blackbox.mark("ignored_without_journal")       # no-op, no raise
+        j = BlackboxJournal(str(p), proc="t2")
+        prev = blackbox.set_journal(j)
+        try:
+            blackbox.mark("visible", k=1)
+        finally:
+            blackbox.set_journal(prev)
+            j.close()
+        assert [r["phase"] for r in read_journal(str(p))] == [
+            "journal_open", "visible", "journal_close",
+        ]
+
+    def test_chaos_journal_survives_crash_restore(self, tmp_path):
+        """One torture run with crash cycles: the journal (a per-process
+        append-only file) spans every engine crash-restore cycle — one
+        crash_restore mark per cycle, with the run's phase timeline
+        around them."""
+        from raft_tpu.chaos.runner import torture_run
+
+        rep = torture_run(3, phases=6, blackbox_dir=str(tmp_path))
+        assert rep.verdict == "LINEARIZABLE"
+        path = tmp_path / "journal_torture_seed3.jsonl"
+        recs = read_journal(str(path))
+        phases = [r["phase"] for r in recs]
+        assert phases[0] == "journal_open"
+        assert "torture_run" in phases
+        assert phases.count("crash_restore") == rep.crashes
+        assert rep.crashes >= 1   # seed 3 @ 6 phases runs 3 crash cycles
+        assert "check_done" in phases
+        assert phases[-1] == "journal_close"
+        # the journal is parseable mid-run too: every mark before a
+        # crash survived it (seq strictly rises across the whole file)
+        seqs = [r["seq"] for r in recs]
+        assert seqs == sorted(seqs)
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", "")
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = here + os.pathsep + env["PYTHONPATH"]
+    return env
+
+
+# -------------------------------------------------------- 3. watchdog
+class TestStallWatchdog:
+    def test_fires_on_induced_stall_with_stacks_and_tail(self, tmp_path):
+        j = BlackboxJournal(str(tmp_path / "j.jsonl"), proc="stall0")
+        fired = []
+        wd = StallWatchdog(
+            0.3, tag="unit", journal=j, bundle_dir=str(tmp_path),
+            on_fire=fired.append, poll_s=0.05,
+        ).arm()
+        j.mark("barrier_enter", barrier="test_barrier", id=3)
+        deadline = time.monotonic() + 30.0
+        while not wd.fired and time.monotonic() < deadline:
+            time.sleep(0.05)       # the "blocked" main thread
+        wd.disarm()
+        j.close()
+        assert wd.fired and fired
+        bundle = json.loads(open(wd.bundle_path).read())
+        assert bundle["format"] == "raft_tpu.obs/stall.v1"
+        assert bundle["phase"] == "barrier_enter"
+        tail_phases = [r["phase"] for r in bundle["journal_tail"]]
+        assert "barrier_enter" in tail_phases
+        # faulthandler stacks name this very test frame
+        assert "test_fires_on_induced_stall" in bundle["stacks"]
+
+    def test_silent_on_clean_run(self, tmp_path):
+        j = BlackboxJournal(str(tmp_path / "j.jsonl"), proc="clean")
+        with StallWatchdog(5.0, tag="clean", journal=j,
+                           bundle_dir=str(tmp_path), poll_s=0.05) as wd:
+            for i in range(3):
+                j.mark("work", step=i)
+        j.close()
+        assert not wd.fired
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.startswith("stall_")]
+
+    def test_pet_resets_deadline(self, tmp_path):
+        wd = StallWatchdog(0.4, tag="pet", poll_s=0.05).arm()
+        for _ in range(4):
+            time.sleep(0.15)
+            wd.pet()
+        assert not wd.fired
+        wd.disarm()
+
+    @pytest.mark.parametrize("n_procs", [2])
+    def test_multiprocess_barrier_stall_produces_bundles(
+        self, tmp_path, n_procs
+    ):
+        """ACCEPTANCE: an induced multihost stall — mirrored engine
+        processes blocked inside the mirror-digest barrier (the real
+        seam, reached by faking a 2-process world whose peer never
+        answers the allgather) — produces one stall bundle PER PROCESS
+        containing faulthandler stacks and the journal tail naming the
+        barrier."""
+        code = (
+            "import sys, os, threading\n"
+            "d, tag = sys.argv[1], sys.argv[2]\n"
+            "from raft_tpu.obs.blackbox import (BlackboxJournal,\n"
+            "    StallWatchdog, set_journal)\n"
+            "j = BlackboxJournal(os.path.join(d, f'journal_{tag}.jsonl'),\n"
+            "                    proc=tag)\n"
+            "set_journal(j)\n"
+            "import raft_tpu.raft.engine as eng\n"
+            "from raft_tpu.config import RaftConfig\n"
+            "from raft_tpu.transport.device import SingleDeviceTransport\n"
+            "cfg = RaftConfig(n_replicas=3, entry_bytes=16, batch_size=4,\n"
+            "                 log_capacity=64, transport='single',\n"
+            "                 mirror_check_every=1,\n"
+            "                 mirror_exchange_timeout_s=600.0)\n"
+            "e = eng.RaftEngine(cfg, SingleDeviceTransport(cfg))\n"
+            "wd = StallWatchdog(1.0, tag=f'barrier_{tag}', journal=j,\n"
+            "                   bundle_dir=d, hard_exit_code=9,\n"
+            "                   poll_s=0.1).arm()\n"
+            "# a 2-process mirrored world whose peer never answers\n"
+            "eng.jax.process_count = lambda: 2\n"
+            "from jax.experimental import multihost_utils\n"
+            "multihost_utils.process_allgather = (\n"
+            "    lambda x: threading.Event().wait(600))\n"
+            "e._verify_mirror_digest()\n"
+            "print('unreachable: barrier returned')\n"
+        )
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", code, str(tmp_path), f"p{i}"],
+                env=_cpu_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+            for i in range(n_procs)
+        ]
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 9, (out, err)
+            assert b"STALL" in err
+        bundles = sorted(f for f in os.listdir(tmp_path)
+                         if f.startswith("stall_"))
+        assert len(bundles) == n_procs
+        for i, name in enumerate(bundles):
+            b = json.loads(open(tmp_path / name).read())
+            assert b["phase"] == "barrier_enter"
+            tail = b["journal_tail"]
+            barrier_marks = [r for r in tail
+                             if r["phase"] == "barrier_enter"]
+            assert barrier_marks
+            assert barrier_marks[-1]["barrier"] == "mirror_digest"
+            assert "_verify_mirror_digest" in b["stacks"]
+
+
+# --------------------------------------------------- 4. bench liveness
+class TestMultichipLiveness:
+    def test_exhausted_deadline_self_truncates_with_rows(
+        self, tmp_path, capsys
+    ):
+        """The BENCH_r05 kill-mode fix, applied to the multichip runner:
+        with the budget already spent, every phase emits an explicit
+        {"skipped": "deadline"} row, the summary row still prints, the
+        journal exists — and the run raises instead of being silently
+        killed from outside."""
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if here not in sys.path:
+            sys.path.insert(0, here)
+        import __graft_entry__
+
+        with pytest.raises(RuntimeError, match="deadline"):
+            __graft_entry__.dryrun_multichip(
+                1, deadline_s=1e-6, blackbox_dir=str(tmp_path)
+            )
+        rows = [json.loads(ln) for ln in
+                capsys.readouterr().out.strip().splitlines()]
+        legs = {r["leg"]: r for r in rows}
+        assert legs["multichip_mesh_build"] == {
+            "leg": "multichip_mesh_build", "skipped": "deadline",
+        }
+        assert legs["multichip_pipeline_flight"]["skipped"] == "deadline"
+        summary = legs["multichip"]
+        assert summary["ok"] is False
+        assert summary["deadline_skipped"] == [
+            "mesh_build", "vote_round", "replicate_round", "fused_step",
+            "pipeline_flight", "final_sync",
+        ]
+        assert os.path.exists(summary["journal"])
+
+
+# ------------------------------------------------------ 5. explain CLI
+class TestExplainTooling:
+    def test_explain_journal_names_in_flight_phase(self, tmp_path, capsys):
+        from raft_tpu.obs.__main__ import main as obs_main
+
+        p = tmp_path / "journal_x.jsonl"
+        j = BlackboxJournal(str(p), proc="px")
+        j.mark("mesh_build", rows=4)
+        j.mark("barrier_enter", barrier="mirror_digest", id=2)
+        # no close: the process "hung" here
+        j._f.close()
+        assert obs_main(["--explain", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "barrier_enter" in out
+        assert "in flight at journal end" in out
+        assert "px" in out
+
+    def test_explain_directory_of_journals(self, tmp_path, capsys):
+        from raft_tpu.obs.__main__ import main as obs_main
+
+        for tag in ("p0", "p1"):
+            j = BlackboxJournal(str(tmp_path / f"journal_{tag}.jsonl"),
+                                proc=tag)
+            j.mark("phase_a")
+            j._f.close()
+        assert obs_main(["--explain", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "p0" in out and "p1" in out
+
+    def test_explain_stall_bundle(self, tmp_path, capsys):
+        from raft_tpu.obs.__main__ import main as obs_main
+
+        j = BlackboxJournal(str(tmp_path / "j.jsonl"), proc="s0")
+        wd = StallWatchdog(0.2, tag="exp", journal=j,
+                           bundle_dir=str(tmp_path), poll_s=0.05).arm()
+        j.mark("allgather", id=5)
+        while not wd.fired:
+            time.sleep(0.05)
+        wd.disarm()
+        j.close()
+        assert obs_main(["--explain", wd.bundle_path]) == 0
+        out = capsys.readouterr().out
+        assert "STALL" in out
+        assert "allgather" in out
+        assert "thread stacks" in out
+
+    def test_explain_still_reads_repro_bundles(self, tmp_path, capsys):
+        """The dispatch must not break the PR-5 contract: a bundle.v1
+        repro bundle still explains."""
+        from raft_tpu.obs.__main__ import main as obs_main
+        from raft_tpu.obs.forensics import write_bundle
+        from raft_tpu.chaos.history import History
+
+        h = History()
+        path = write_bundle(
+            str(tmp_path), kind="torture", seed=1, expected="LINEARIZABLE",
+            verdict="VIOLATION", detail="d", repro="r", history=h,
+        )
+        assert obs_main(["--explain", path]) == 0
+        assert "VIOLATION" in capsys.readouterr().out

@@ -517,6 +517,8 @@ class TestPipelineKernel:
                 err_msg=f"state.{f}",
             )
 
+    @pytest.mark.slow   # EC/multi-lap COMPOSITION variant: the non-EC / single-lap
+    #   equivalence pins stay tier-1; this rides the slow lane for wall budget
     def test_ec_pipeline_matches_scan(self):
         from raft_tpu.ec.kernels import fold_data_lanes, parity_consts
 
@@ -536,6 +538,8 @@ class TestPipelineKernel:
         assert int(info.commit_index) == T * B
 
 
+@pytest.mark.slow   # EC/multi-lap COMPOSITION variant: the non-EC / single-lap
+#   equivalence pins stay tier-1; this rides the slow lane for wall budget
 def test_engine_pipeline_chunk_gate_and_bookkeeping(monkeypatch):
     """The engine's submit_pipelined fast path: full-ring chunks on a
     verified-steady cluster go through transport.replicate_pipeline as
@@ -642,6 +646,8 @@ def test_engine_pipeline_gate_negative_cases(monkeypatch):
     assert not e._pipeline_eligible(r, T * B, T, 0, eff)
 
 
+@pytest.mark.slow   # EC/multi-lap COMPOSITION variant: the non-EC / single-lap
+#   equivalence pins stay tier-1; this rides the slow lane for wall budget
 def test_engine_multi_lap_chunk(monkeypatch):
     """cfg.pipeline_max_laps > 1: a backlog covering several ring
     turnovers rides ONE replicate_pipeline launch (the write-only
@@ -908,6 +914,8 @@ class TestTurnoverKernel:
         # row 2's ring must be PRESERVED zeros (slow: nothing appended)
         assert int(np.asarray(st_p.last_index)[2]) == 0
 
+    @pytest.mark.slow   # EC/multi-lap COMPOSITION variant: the non-EC / single-lap
+    #   equivalence pins stay tier-1; this rides the slow lane for wall budget
     def test_ec_turnover_matches_scan(self):
         from raft_tpu.core.step_pallas import (
             steady_pipeline_tpu, steady_scan_replicate_tpu,
