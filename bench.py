@@ -506,6 +506,46 @@ def bench_attribution() -> dict:
     wall_us_off = min(
         wall_off1 / max(ev_off1, 1), wall_off2 / max(ev_off2, 1)
     ) * 1e6
+
+    # -- device-resident observability (obs.device): ring on/off -------
+    # same drive loop with the in-kernel event ring attached: the added
+    # µs/tick is the recorded step program + the one packed flush fetch
+    # per launch boundary — the price of keeping the trace inside the
+    # compiled program (what the K-tick scan fusion will amortise by
+    # flushing once per K ticks instead of once per tick)
+    dev_obs = e.attach_device_obs(capacity=4096)
+    drive_rounds(2)                           # warm the recorded programs
+    rec0 = dev_obs.total_recorded
+    wall_dev, ev_dev, _ = drive_rounds(ROUNDS)
+    dev_records = dev_obs.total_recorded - rec0
+    # flush cost alone (one packed fetch + decode), measured directly —
+    # amortised over launch size K because the contract is one flush
+    # per LAUNCH boundary, not per tick
+    t0 = time.perf_counter()
+    FLUSHES = 200
+    for _ in range(FLUSHES):
+        e._flush_device_obs()
+    flush_us = (time.perf_counter() - t0) / FLUSHES * 1e6
+    e.detach_device_obs()
+    wall_dev_us = wall_dev / max(ev_dev, 1) * 1e6
+    device_ring = {
+        "wall_us_per_tick_ring_on": round(wall_dev_us, 3),
+        "wall_us_per_tick_ring_off": round(wall_us_off, 3),
+        "added_us_per_tick": round(wall_dev_us - wall_us_off, 3),
+    }
+    device_obs_row = {
+        "records": int(dev_records),
+        "records_per_s": round(dev_records / max(wall_dev, 1e-9), 1),
+        "dropped": dev_obs.dropped,
+        "flush_us": round(flush_us, 3),
+        "flush_us_per_tick_amortised": {
+            f"K{k}": round(flush_us / k, 3) for k in (1, 8, 64)
+        },
+        "note": ("flush = one packed ring+counters fetch per launch "
+                 "boundary; a K-tick fused launch pays it once per K "
+                 "ticks (ROADMAP item 2)"),
+    }
+
     return {
         "ticks": ev_on,
         "leader_ticks": lt_on,
@@ -522,6 +562,8 @@ def bench_attribution() -> dict:
         "attribution_coverage": round(
             sum(per.values()) / wall_us if wall_us else float("nan"), 4
         ),
+        "device_ring": device_ring,
+        "device_obs": device_obs_row,
         "metrics": e.metrics.to_json(),
         "note": ("columns_us are boundary-marked phases tiling each "
                  "step_event; their sum must land within 10% of "

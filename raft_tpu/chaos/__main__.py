@@ -75,6 +75,12 @@ def main(argv=None) -> int:
                          "recorder, per-op spans, metrics registry) — "
                          "determinism-neutral; makes forensics bundles "
                          "carry the full event ring + span table")
+    ap.add_argument("--observe-device", action="store_true",
+                    help="additionally attach the DEVICE observability "
+                         "plane (obs.device in-kernel event rings + "
+                         "on-device counters; implies --observe on the "
+                         "torture runners) — determinism-neutral, and "
+                         "bundles gain a device_ring section")
     ap.add_argument("--bundle-dir", default=None, metavar="DIR",
                     help="write a repro bundle to DIR whenever a run "
                          "ends in anything but its expected verdict "
@@ -159,7 +165,9 @@ def main(argv=None) -> int:
                 clients=args.clients, keys=args.keys,
                 phase_s=args.phase_s, overload=args.overload,
                 step_budget=args.step_budget,
-                observe=args.observe, bundle_dir=args.bundle_dir,
+                observe=args.observe,
+                observe_device=args.observe_device,
+                bundle_dir=args.bundle_dir,
                 blackbox_dir=args.blackbox_dir,
             )
         else:
@@ -170,7 +178,9 @@ def main(argv=None) -> int:
                 storage_faults=not args.no_storage, broken=args.broken,
                 overload=args.overload, membership=args.membership,
                 step_budget=args.step_budget,
-                observe=args.observe, bundle_dir=args.bundle_dir,
+                observe=args.observe,
+                observe_device=args.observe_device,
+                bundle_dir=args.bundle_dir,
                 blackbox_dir=args.blackbox_dir,
             )
         print(rep.summary())

@@ -266,11 +266,16 @@ class _TortureBase:
     OP_TIMEOUT_S = 90.0
 
     def __init__(self, seed, phases, clients, keys, phase_s,
-                 observe: bool = False):
+                 observe: bool = False, observe_device: bool = False):
         self.seed = seed
         self.phases = phases
         self.phase_s = phase_s
-        self.obs: Optional[ObsStack] = ObsStack.build() if observe else None
+        self.obs: Optional[ObsStack] = (
+            ObsStack.build(device=observe_device)
+            if (observe or observe_device) else None
+        )
+        #   observe_device additionally attaches the device-resident
+        #   plane (obs.device in-kernel rings); it implies observe.
         #   the observability plane (flight recorder + spans + metrics;
         #   docs/OBSERVABILITY.md). Recording is determinism-neutral:
         #   every seeded run replays byte-identically with it on or off
@@ -440,6 +445,7 @@ def torture_run(
     membership: bool = False,
     step_budget: int = 500_000,
     observe: bool = False,
+    observe_device: bool = False,
     bundle_dir: Optional[str] = None,
     blackbox_dir: Optional[str] = None,
 ) -> TortureReport:
@@ -471,7 +477,7 @@ def torture_run(
         run = _SingleTorture(
             seed, phases, clients, keys, phase_s,
             cfg or base, workdir, broken, membership=membership,
-            observe=observe,
+            observe=observe, observe_device=observe_device,
         )
         nemesis = Nemesis(
             seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
@@ -552,9 +558,9 @@ def _maybe_bundle(
 class _SingleTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg,
                  workdir, broken, membership: bool = False,
-                 observe: bool = False):
+                 observe: bool = False, observe_device: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s,
-                         observe=observe)
+                         observe=observe, observe_device=observe_device)
         from raft_tpu.transport.device import SingleDeviceTransport
 
         self.cfg = cfg
@@ -976,6 +982,7 @@ def torture_run_multi(
     overload: bool = False,
     step_budget: int = 500_000,
     observe: bool = False,
+    observe_device: bool = False,
     bundle_dir: Optional[str] = None,
     blackbox_dir: Optional[str] = None,
 ) -> TortureReport:
@@ -995,6 +1002,7 @@ def torture_run_multi(
         run = _MultiTorture(
             seed, phases, clients, keys, phase_s, cfg, n_groups,
             overload=overload, observe=observe,
+            observe_device=observe_device,
         )
         nemesis = Nemesis(
             seed, run.cfg.n_replicas, allow_crash=False, allow_msg=False,
@@ -1028,9 +1036,10 @@ def torture_run_multi(
 
 class _MultiTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg, n_groups,
-                 overload: bool = False, observe: bool = False):
+                 overload: bool = False, observe: bool = False,
+                 observe_device: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s,
-                         observe=observe)
+                         observe=observe, observe_device=observe_device)
         from raft_tpu.examples.kv_sharded import ShardedKV
         from raft_tpu.multi.engine import MultiEngine
         from raft_tpu.multi.router import Router
@@ -1047,6 +1056,8 @@ class _MultiTorture(_TortureBase):
         )
         if obs is not None:
             self.engine.metrics = obs.registry
+            if obs.device is not None:
+                self.engine.attach_device_obs(obs.device)
         self.engine.seed_leaders()
         spans = obs.spans if obs is not None else None
         self.router = Router(self.engine, spans=spans)

@@ -146,6 +146,12 @@ class ChaosTransport:
         self, state, client_payload, client_count, leader, leader_term,
         alive, slow, **kw,
     ):
+        # the device-observability ring (obs.device) rides only the
+        # PRIMARY delivery: echoes (dup / delayed re-delivery) replay a
+        # message the engine already observed, so recording them would
+        # double-count transitions — and the deferred-echo kw snapshot
+        # must never capture a stale ring
+        ring = kw.pop("ring", None)
         self._round += 1
         state = self._run_due(state, alive)
         alive_np = np.asarray(alive).astype(bool)
@@ -156,10 +162,16 @@ class ChaosTransport:
         self.stats["drop"] += int(dropped.sum())
         self.stats["delay"] += int(delayed.sum())
         slow_round = slow_np | dropped | delayed
-        state, info = self.t.replicate(
-            state, client_payload, client_count, leader, leader_term,
-            alive, jnp.asarray(slow_round), **kw,
-        )
+        if ring is not None:
+            state, info, ring = self.t.replicate(
+                state, client_payload, client_count, leader, leader_term,
+                alive, jnp.asarray(slow_round), ring=ring, **kw,
+            )
+        else:
+            state, info = self.t.replicate(
+                state, client_payload, client_count, leader, leader_term,
+                alive, jnp.asarray(slow_round), **kw,
+            )
         if delayed.any():
             due = self._round + self.rng.randint(*self.delay_rounds)
             self._deferred.append(
@@ -171,6 +183,8 @@ class ChaosTransport:
             state = self._echo(
                 state, leader_i, int(leader_term), alive_np, slow_np, kw
             )
+        if ring is not None:
+            return state, info, ring
         return state, info
 
     def replicate_many(
@@ -191,14 +205,21 @@ class ChaosTransport:
             jnp.asarray(slow_round), **kw,
         )
 
-    def request_votes(self, state, candidate, cand_term, alive):
+    def request_votes(self, state, candidate, cand_term, alive,
+                      ring=None, quorum=0):
         self._round += 1
         alive_np = np.asarray(alive).astype(bool)
         dropped = self._victims(self.p_drop, alive_np, int(candidate))
         self.stats["drop"] += int(dropped.sum())
-        state, info = self.t.request_votes(
-            state, candidate, cand_term, jnp.asarray(alive_np & ~dropped)
-        )
+        if ring is not None:
+            state, info, ring = self.t.request_votes(
+                state, candidate, cand_term,
+                jnp.asarray(alive_np & ~dropped), ring=ring, quorum=quorum,
+            )
+        else:
+            state, info = self.t.request_votes(
+                state, candidate, cand_term, jnp.asarray(alive_np & ~dropped)
+            )
         if self.p_dup > 0.0 and self.rng.random() < self.p_dup:
             # repeat RequestVote delivery: re-grants to the same
             # candidate in the same term (idempotent by §5.2's
@@ -207,4 +228,6 @@ class ChaosTransport:
             state, _ = self.t.request_votes(
                 state, candidate, cand_term, jnp.asarray(alive_np & ~dropped)
             )
+        if ring is not None:
+            return state, info, ring
         return state, info

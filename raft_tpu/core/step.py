@@ -149,6 +149,16 @@ def replicate_step(
     #   in-place aliased pallas_call are not certified, and the XLA ops
     #   vmap exactly — byte-equivalence per group is preserved because
     #   the two formulations are equivalence-gated (bench._ring_kernel_gate).
+    ring=None,            # obs.device.EventRing threaded when record=True
+    record: bool = False,  # STATIC device-observability flag. False (the
+    #   default) is byte-for-byte the pre-instrumentation function — the
+    #   record branch below is the FIRST statement, so the off-path
+    #   traces the identical program (HLO-identity pinned by
+    #   tests/test_device_obs.py). True wraps the step — whichever
+    #   formulation dispatch picks — with obs.device event recording
+    #   derived from the (old, new, info) triple, and returns
+    #   ``(state, info, ring)``.
+    group_id: int = -1,   # group tag recorded events carry (-1 = single)
 ) -> tuple[ReplicaState, RepInfo]:
     """One leader tick: ingest + repair + replicate + quorum commit, on device.
 
@@ -179,6 +189,27 @@ def replicate_step(
     the host engine, which watches the match vector, dispatches the
     repair-capable program on the next tick.
     """
+    if record:
+        # run the step unrecorded (identical math through whichever
+        # formulation the dispatch below picks), then derive the event
+        # records from the state transition alone — the device ring
+        # rides OUTSIDE the protocol kernels, so recorded state outputs
+        # are bit-identical to unrecorded ones by construction
+        from raft_tpu.obs.device import record_replicate_events
+
+        if ring is None:
+            raise ValueError("record=True requires an EventRing")
+        new_state, info = replicate_step(
+            comm, state, client_payload, client_count, leader,
+            leader_term, alive, slow, floor_prev_term, repair_floor,
+            member, ec=ec, commit_quorum=commit_quorum, repair=repair,
+            term_floor=term_floor, use_pallas=use_pallas,
+        )
+        ring = record_replicate_events(
+            ring, comm, state, new_state, info, leader, leader_term,
+            group_id, repair=bool(repair and not ec),
+        )
+        return new_state, info, ring
     cap = state.capacity
     B = client_payload.shape[0]
     M = client_payload.shape[1]                    # L * W folded lanes
@@ -501,13 +532,44 @@ def replicate_step(
 def scan_replicate(
     comm, ec, commit_quorum, repair, state, payloads, counts, leader,
     leader_term, alive, slow, floor_prev_term=0, repair_floor=0,
-    member=None, term_floor=None,
+    member=None, term_floor=None, ring=None, record=False, group_id=-1,
 ):
     """T replication steps as one compiled ``lax.scan`` — no host round-trip
     per batch (SURVEY.md §7 hard part 1). Shared by both device transports.
     ``payloads``: i32[T, B, L*W] folded batches; ``counts``: i32[T];
-    ``repair`` selects the repair-capable vs steady-state step program."""
+    ``repair`` selects the repair-capable vs steady-state step program.
+
+    ``record=True`` threads an ``obs.device.EventRing`` through the scan
+    carry and returns ``(state, infos, ring, interesting)`` where
+    ``interesting`` is i32[T]: 1 for every step that recorded at least
+    one event (commit advance, term adoption, election evidence,
+    repair motion). That scalar-per-step mask is exactly the
+    host-escape predicate the K-tick fusion of ROADMAP item 2 needs —
+    "run K ticks on device, come back only if something interesting
+    happened" — proven here before the fusion lands."""
     from raft_tpu.core.comm import MeshComm, SingleDeviceComm
+
+    if record:
+        if ring is None:
+            raise ValueError("record=True requires an EventRing")
+
+        def rec_body(carry, xs):
+            st, rg = carry
+            payload, count = xs
+            c0 = rg.count
+            st, info, rg = replicate_step(
+                comm, st, payload, count, leader, leader_term, alive,
+                slow, floor_prev_term, repair_floor, member, ec=ec,
+                commit_quorum=commit_quorum, repair=repair,
+                term_floor=None, ring=rg, record=True, group_id=group_id,
+            )
+            interesting = (rg.count > c0).astype(jnp.int32)
+            return (st, rg), (info, interesting)
+
+        (state, ring), (infos, interesting) = jax.lax.scan(
+            rec_body, (state, ring), (payloads, counts)
+        )
+        return state, infos, ring, interesting
 
     if member is not None:
         # same boundary decomposition as replicate_step: the scan-level
@@ -568,7 +630,8 @@ def scan_replicate(
     return jax.lax.scan(body, state, (payloads, counts))
 
 
-def group_replicate_step(n_replicas: int, *, repair: bool = True):
+def group_replicate_step(n_replicas: int, *, repair: bool = True,
+                         record: bool = False):
     """G independent Raft groups' replication ticks as ONE batched device
     program: ``jax.vmap`` of ``replicate_step`` over a leading group axis
     on every operand (state from ``core.state.init_group_state``).
@@ -599,6 +662,22 @@ def group_replicate_step(n_replicas: int, *, repair: bool = True):
 
     comm = SingleDeviceComm(n_replicas)
 
+    if record:
+        # device-observability variant: per-group EventRing slices and
+        # group ids ride two extra mapped operands; everything else is
+        # the same vmapped program (recording derives from the state
+        # transition, so per-group byte-equivalence is preserved)
+        def one_rec(state, payload, count, leader, term, alive, slow,
+                    member, ring, gid):
+            return replicate_step(
+                comm, state, payload, count, leader, term, alive, slow,
+                member=member, ec=False, commit_quorum=None,
+                repair=repair, use_pallas=False, ring=ring, record=True,
+                group_id=gid,
+            )
+
+        return jax.vmap(one_rec)
+
     def one(state, payload, count, leader, term, alive, slow, member):
         return replicate_step(
             comm, state, payload, count, leader, term, alive, slow,
@@ -609,7 +688,7 @@ def group_replicate_step(n_replicas: int, *, repair: bool = True):
     return jax.vmap(one)
 
 
-def group_vote_step(n_replicas: int):
+def group_vote_step(n_replicas: int, *, record: bool = False):
     """G groups' election rounds as one batched launch: ``jax.vmap`` of
     ``vote_step`` over the leading group axis. Masking: a group with no
     campaign this round passes an all-False ``alive`` row — no grants,
@@ -618,6 +697,17 @@ def group_vote_step(n_replicas: int):
     from raft_tpu.core.comm import SingleDeviceComm
 
     comm = SingleDeviceComm(n_replicas)
+
+    if record:
+        # fixed membership in the group engine: the win threshold is the
+        # static strict majority of the R-row cluster
+        def one_rec(state, candidate, cand_term, alive, ring, gid):
+            return vote_step(
+                comm, state, candidate, cand_term, alive, ring=ring,
+                record=True, quorum=n_replicas // 2, group_id=gid,
+            )
+
+        return jax.vmap(one_rec)
 
     def one(state, candidate, cand_term, alive):
         return vote_step(comm, state, candidate, cand_term, alive)
@@ -631,6 +721,15 @@ def vote_step(
     candidate: jax.Array,   # i32[] global replica id of the candidate
     cand_term: jax.Array,   # i32[] term the candidate is campaigning in
     alive: jax.Array,       # bool[R]
+    *,
+    ring=None,              # obs.device.EventRing threaded when record=True
+    record: bool = False,   # STATIC flag; off-path HLO-identical (see
+    #   replicate_step). True returns (state, info, ring); the win
+    #   condition recorded is exactly the engine's promotion rule, so
+    #   ``quorum`` (votes needed minus one — i.e. members // 2) must be
+    #   supplied by the caller.
+    quorum=0,               # i32[] or int: win iff votes > quorum
+    group_id: int = -1,
 ) -> tuple[ReplicaState, VoteInfo]:
     """One election round: every replica votes simultaneously.
 
@@ -643,6 +742,17 @@ def vote_step(
     main.go:185-186, 264). The candidate's self-vote (main.go:255) falls out
     naturally: its own row grants.
     """
+    if record:
+        from raft_tpu.obs.device import record_vote_events
+
+        if ring is None:
+            raise ValueError("record=True requires an EventRing")
+        new_state, info = vote_step(comm, state, candidate, cand_term, alive)
+        ring = record_vote_events(
+            ring, comm, state, new_state, info, candidate, cand_term,
+            quorum, group_id,
+        )
+        return new_state, info, ring
     ids = comm.replica_ids()
     alive_l = comm.local(alive)
 

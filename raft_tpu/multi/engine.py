@@ -92,20 +92,29 @@ class UnsupportedMembership(ValueError):
     docs/MEMBERSHIP.md for the single-group-only scope note."""
 
 
-_PROGRAMS: Dict[int, tuple] = {}
+_PROGRAMS: Dict[tuple, tuple] = {}
 
 
-def _programs(n_replicas: int) -> tuple:
+def _programs(n_replicas: int, record: bool = False) -> tuple:
     """Process-wide (replicate, vote) jitted group programs per cluster
     size: every MultiEngine over the same R shares ONE compiled program
     per distinct G (jit caches per input shape), instead of retracing
-    per engine instance."""
-    if n_replicas not in _PROGRAMS:
-        _PROGRAMS[n_replicas] = (
-            jax.jit(group_replicate_step(n_replicas), donate_argnums=(0,)),
-            jax.jit(group_vote_step(n_replicas), donate_argnums=(0,)),
+    per engine instance. ``record=True`` yields the device-observability
+    variants (obs.device: per-group EventRing + group-id operands;
+    per-group state outputs bit-identical to the unrecorded programs)."""
+    key = (n_replicas, record)
+    if key not in _PROGRAMS:
+        _PROGRAMS[key] = (
+            jax.jit(
+                group_replicate_step(n_replicas, record=record),
+                donate_argnums=(0, 8) if record else (0,),
+            ),
+            jax.jit(
+                group_vote_step(n_replicas, record=record),
+                donate_argnums=(0, 4) if record else (0,),
+            ),
         )
-    return _PROGRAMS[n_replicas]
+    return _PROGRAMS[key]
 
 
 class MultiEngine:
@@ -173,6 +182,16 @@ class MultiEngine:
         #   batched launch serves several groups at once, so each phase
         #   observation is recorded once per participating group label
         #   (the launch is shared; the group axis is what amortizes it).
+        self.device_obs = None
+        #   obs.device.DeviceObs (None = off): device-resident event
+        #   rings, one per group (vmapped alongside the state), flushed
+        #   as ONE packed fetch per batched launch — same contract as
+        #   the single engine, with per-group decode and counter labels.
+        self._dev_rings = None
+        self._dev_gids = None
+        self._dev_flushed = None
+        self._dev_counters_folded = None
+        self._replicate_rec = self._vote_rec = None
         self._hp_groups: set = set()
         #   groups the current tick's launches served (tick_end labels)
         # Per-group rng streams: group g's election draws are its own
@@ -269,6 +288,66 @@ class MultiEngine:
             return
         labels.setdefault("group", str(g))
         self.metrics.counter(name, help_, tuple(labels)).inc(**labels)
+
+    # ------------------------------------------- device observability plane
+    def attach_device_obs(self, obs=None, capacity: int = 4096):
+        """Attach the device-resident observability plane: G per-group
+        EventRings batched as one pytree ride every replicate/vote
+        launch (recorded group programs; per-group state outputs
+        bit-identical), flushed as one packed fetch per launch. Same
+        contract as ``RaftEngine.attach_device_obs``."""
+        from raft_tpu.obs.device import (
+            N_COUNTERS,
+            DeviceObs,
+            init_group_rings,
+        )
+
+        self.device_obs = obs if obs is not None else DeviceObs(capacity)
+        self.device_obs.new_epoch()   # see RaftEngine.attach_device_obs
+        self._dev_rings = init_group_rings(self.device_obs.capacity, self.G)
+        self._dev_gids = jnp.arange(self.G, dtype=jnp.int32)
+        self._dev_flushed = np.zeros(self.G, np.int64)
+        self._dev_counters_folded = np.zeros((self.G, N_COUNTERS), np.int64)
+        self._replicate_rec, self._vote_rec = _programs(
+            self.cfg.n_replicas, record=True
+        )
+        return self.device_obs
+
+    def _flush_device_obs(self) -> None:
+        """Decode every group's new records from ONE packed fetch; fold
+        per-group counter deltas into the registry (raft_device_*)."""
+        if self.device_obs is None or self._dev_rings is None:
+            return
+        from raft_tpu.obs.device import (
+            COUNTER_METRICS,
+            decode_records,
+            packed_flush,
+        )
+
+        packed = np.asarray(packed_flush(self._dev_rings))   # [G, cap+1, W]
+        for g in range(self.G):
+            events, count, lost, counters, _tick = decode_records(
+                packed[g], int(self._dev_flushed[g]),
+                t_virtual=self.clock.now,
+            )
+            if count == self._dev_flushed[g] and not np.any(
+                counters - self._dev_counters_folded[g]
+            ):
+                continue
+            self.device_obs.ingest(
+                events, total=count, lost=lost, counters=counters, group=g,
+            )
+            self._dev_flushed[g] = count
+            if self.metrics is not None:
+                for i, name in enumerate(COUNTER_METRICS):
+                    delta = int(
+                        counters[i] - self._dev_counters_folded[g][i]
+                    )
+                    if delta:
+                        self.metrics.counter(
+                            name, "on-device protocol counter", ("group",)
+                        ).inc(delta, group=str(g))
+            self._dev_counters_folded[g] = counters
 
     def _push(self, t: float, kind: str, g: int, r: int) -> None:
         heapq.heappush(self._q, (t, self._seq_events, kind, g, r))
@@ -615,10 +694,17 @@ class MultiEngine:
             candidates[g] = r
             cterms[g] = int(self.terms[g, r])
             eff[g] = self._reach(g, r)
-        self.state, info = self._vote(
-            self.state, jnp.asarray(candidates), jnp.asarray(cterms),
-            jnp.asarray(eff),
-        )
+        if self._dev_rings is not None:
+            self.state, info, self._dev_rings = self._vote_rec(
+                self.state, jnp.asarray(candidates), jnp.asarray(cterms),
+                jnp.asarray(eff), self._dev_rings, self._dev_gids,
+            )
+            self._flush_device_obs()
+        else:
+            self.state, info = self._vote(
+                self.state, jnp.asarray(candidates), jnp.asarray(cterms),
+                jnp.asarray(eff),
+            )
         votes = np.asarray(info.votes)
         max_terms = np.asarray(info.max_term)
         for g, r in cands:
@@ -707,14 +793,25 @@ class MultiEngine:
             counts[g] = take
         if hp is not None:
             hp.mark("host_pre")
-        self.state, info = self._replicate(
-            self.state, payloads_dev, jnp.asarray(counts),
-            jnp.asarray(leaders), jnp.asarray(lterms), jnp.asarray(eff),
-            jnp.asarray(self.slow), self._member,
-        )
+        if self._dev_rings is not None:
+            self.state, info, self._dev_rings = self._replicate_rec(
+                self.state, payloads_dev, jnp.asarray(counts),
+                jnp.asarray(leaders), jnp.asarray(lterms),
+                jnp.asarray(eff), jnp.asarray(self.slow), self._member,
+                self._dev_rings, self._dev_gids,
+            )
+        else:
+            self.state, info = self._replicate(
+                self.state, payloads_dev, jnp.asarray(counts),
+                jnp.asarray(leaders), jnp.asarray(lterms),
+                jnp.asarray(eff), jnp.asarray(self.slow), self._member,
+            )
         if hp is not None:
             hp.mark("dispatch")
             hp.sync(self.state, info)
+        # device-obs flush after the profiler marks (its packed fetch
+        # syncs; inside the dispatch window it would misattribute)
+        self._flush_device_obs()
         self._last_info = info
         return np.asarray(info.max_term), np.asarray(info.commit_index)
 

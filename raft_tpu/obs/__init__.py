@@ -27,9 +27,23 @@ differential-test join key between the golden model, the engine, and
   journals (phase marks written BEFORE every blocking operation) and
   the stall watchdog that dumps all-thread stacks + the journal tail
   into a stall bundle when progress stops.
+- ``device``    — the device-resident plane: an in-kernel event ring
+  (``dev_record`` — legal inside jit/vmap/scan/shard_map) + on-device
+  metrics vector written by the recorded step programs, decoded at
+  launch boundaries into byte-compatible ``Event`` objects. The trace
+  rides inside the compiled program, so the coming K-tick scan fusion
+  (ROADMAP item 2) keeps full visibility.
 """
 
 from raft_tpu.obs import blackbox
+from raft_tpu.obs.device import (
+    DeviceObs,
+    EventRing,
+    decode_records,
+    dev_record,
+    init_ring,
+    merged_timeline,
+)
 from raft_tpu.obs.blackbox import (
     BlackboxJournal,
     StallWatchdog,
@@ -52,7 +66,9 @@ from raft_tpu.obs.trace import TraceRecord, TraceRecorder
 
 __all__ = [
     "BlackboxJournal",
+    "DeviceObs",
     "Event",
+    "EventRing",
     "FlightRecorder",
     "HostProfiler",
     "LatencySummary",
@@ -64,11 +80,15 @@ __all__ = [
     "TraceRecord",
     "TraceRecorder",
     "blackbox",
+    "decode_records",
+    "dev_record",
     "explain",
     "explain_journal",
     "explain_stall",
+    "init_ring",
     "kind_of",
     "load_bundle",
+    "merged_timeline",
     "parse_prometheus",
     "read_journal",
     "summarize_engine",

@@ -5,11 +5,13 @@ artifacts (``obs.blackbox``) — nothing here re-runs a seed:
 
 - ``--explain PATH``            — reconstruct the failure story from
   whatever PATH is: a repro bundle (minimal failure timeline: last
-  leader per term, faults in flight, the violating op), a **stall
-  bundle** (who stalled, the blocked phase, journal tail, all-thread
-  stacks), a **blackbox journal** ``.jsonl`` (per-process phase
-  timeline with durations; the final in-flight phase flagged), or a
-  directory of journals (one timeline per process — the multihost
+  leader per term, faults in flight, the violating op — and, when the
+  run carried the device plane, the decoded device ring: kind summary,
+  overflow laps flagged, device events interleaved into the timeline),
+  a **stall bundle** (who stalled, the blocked phase, journal tail,
+  all-thread stacks), a **blackbox journal** ``.jsonl`` (per-process
+  phase timeline with durations; the final in-flight phase flagged),
+  or a directory of journals (one timeline per process — the multihost
   post-mortem view).
 - ``--render-perfetto BUNDLE``  — convert the bundle's span table to
   Chrome/Perfetto trace JSON (load at ui.perfetto.dev); ``-o`` writes

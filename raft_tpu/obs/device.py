@@ -1,0 +1,530 @@
+"""Device-resident observability: an in-kernel event ring + metrics.
+
+The PR-5 flight recorder and PR-6 host profiler observe every protocol
+transition only because every tick currently returns to the host. The
+moment steady-state ticks fuse into one compiled ``lax.scan`` (ROADMAP
+item 2) or the control plane rides the ``shard_map`` mesh (item 5),
+host-side nodelog call sites see nothing. This module moves the trace
+INTO the compiled program, the way the consensus already is:
+
+- :class:`EventRing` — a fixed-capacity ring of fixed-width int32
+  records living in device memory, carried through ``jit`` like any
+  other state. Each record is ``REC_W`` lanes: (seq, tick, node, group,
+  kind-code, term, role, commit, last, aux).
+- :func:`dev_record` — the masked write primitive: one
+  ``dynamic_update_slice`` + a counter bump, predicated on a traced
+  bool, legal inside ``jit`` / ``vmap`` / ``lax.scan`` / ``shard_map``.
+  ``seq`` is stamped from the ring's monotone counter, so overflow
+  (laps) never reorders or renumbers surviving records.
+- :func:`record_replicate_events` / :func:`record_vote_events` — the
+  instrumentation bodies ``core.step`` runs behind its static
+  ``record`` flag: they derive role change, term adoption, election
+  win, commit advance and repair-floor motion purely from the
+  (old state, new state, info) triple, so they compose with EVERY
+  step formulation (XLA, fused Pallas, mesh) without touching the
+  protocol math — the recorded program's state outputs are
+  bit-identical to the unrecorded program's by construction.
+- an on-device **metrics vector** (``EventRing.counters``): elections,
+  term adoptions, commits, heartbeat ticks, repair rounds — per group
+  under ``vmap`` — folded into the PR-5 registry at flush.
+- :func:`decode_records` — the host-side decoder materialising PR-5
+  ``Event`` objects. For kinds that overlap the host recorder's
+  nodelog stream (``elect``, ``commit``), the decoded event's
+  ``.nodelog()`` rendering is BYTE-IDENTICAL to the line the host
+  recorder emits for the same transition — the golden-differential
+  join key extends on-device (pinned by tests/test_device_obs.py).
+- :class:`DeviceObs` — the host-side accumulation plane an engine
+  flushes into once per launch boundary: decoded events (merged with
+  host events by :func:`merged_timeline`), cumulative counters,
+  overflow accounting.
+
+Determinism contract: recording changes WHICH compiled program runs,
+never what it computes — the extra ops read protocol state and write
+only the ring. A seeded chaos run replays byte-identically (commit CRC,
+verdict, op counts) with device recording on or off, and the
+``record=False`` path is HLO-identical to the pre-instrumentation
+program (both pinned). Detached costs zero device syncs: no ring is
+allocated, no flush ever runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax import lax
+
+from raft_tpu.obs.events import Event
+
+# ------------------------------------------------------------ record layout
+#: int32 lanes per record.
+REC_W = 10
+#: field offsets inside a record (the order the module docstring names)
+F_SEQ, F_TICK, F_NODE, F_GROUP, F_KIND, F_TERM, F_ROLE, F_COMMIT, \
+    F_LAST, F_AUX = range(REC_W)
+
+#: kind codes (0 is reserved = "empty slot"; decode rejects it)
+K_ELECT = 1          # election win          (host twin: "state changed to leader")
+K_COMMIT = 2         # commit advance        (host twin: "commit index changed to N")
+K_TERM_ADOPT = 3     # a row adopted a higher term (silent on the host)
+K_STEP_DOWN = 4      # step saw a term above the leader's (host acts next tick)
+K_REPAIR = 5         # repair window moved (aux = window start index)
+
+KIND_NAMES = {
+    K_ELECT: "elect",
+    K_COMMIT: "commit",
+    K_TERM_ADOPT: "term_adopt",
+    K_STEP_DOWN: "step_down",
+    K_REPAIR: "repair_floor",
+}
+
+#: role codes (record field F_ROLE) -> engine role strings
+ROLE_FOLLOWER, ROLE_CANDIDATE, ROLE_LEADER = 0, 1, 2
+ROLE_NAMES = {ROLE_FOLLOWER: "follower", ROLE_CANDIDATE: "candidate",
+              ROLE_LEADER: "leader"}
+
+# ------------------------------------------------------- on-device counters
+#: offsets into ``EventRing.counters`` (the on-device metrics vector)
+C_ELECTIONS, C_TERM_ADOPTIONS, C_COMMITS, C_TICKS, C_REPAIRS = range(5)
+N_COUNTERS = 5
+COUNTER_NAMES = (
+    "elections", "term_adoptions", "commits", "heartbeat_ticks",
+    "repair_rounds",
+)
+#: registry metric name for counter i at flush (PR-5 MetricsRegistry)
+COUNTER_METRICS = tuple(f"raft_device_{n}_total" for n in COUNTER_NAMES)
+
+# the flush trailer packs (count, tick, counters...) into one REC_W row
+assert N_COUNTERS + 2 <= REC_W
+
+
+@struct.dataclass
+class EventRing:
+    """The device-resident ring: a pytree carried through jit/scan.
+
+    ``count`` is the monotone seq counter (total records ever written —
+    the next record's seq); slot of seq ``s`` is ``s % capacity``, so
+    ``max(0, count - capacity)`` oldest records have been lapped.
+    ``tick`` counts recorded launches (the device tick stamp records
+    carry); ``counters`` is the on-device metrics vector."""
+
+    buf: jax.Array       # i32[capacity, REC_W]
+    count: jax.Array     # i32[]
+    tick: jax.Array      # i32[]
+    counters: jax.Array  # i32[N_COUNTERS]
+
+    @property
+    def capacity(self) -> int:
+        return self.buf.shape[-2]
+
+
+def init_ring(capacity: int = 4096) -> EventRing:
+    """A fresh empty ring (host-side constant arrays; jit moves them)."""
+    if capacity < 1:
+        raise ValueError("capacity must be >= 1")
+    return EventRing(
+        buf=jnp.zeros((capacity, REC_W), jnp.int32),
+        count=jnp.zeros((), jnp.int32),
+        tick=jnp.zeros((), jnp.int32),
+        counters=jnp.zeros((N_COUNTERS,), jnp.int32),
+    )
+
+
+def init_group_rings(capacity: int, n_groups: int) -> EventRing:
+    """G independent rings as one batched pytree (leading group axis on
+    every leaf) — the shape ``vmap``-ed recorded group steps carry."""
+    one = init_ring(capacity)
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (n_groups,) + a.shape), one
+    )
+
+
+def make_rec(kind: int, node, term, role: int, commit, last, aux,
+             group) -> jax.Array:
+    """Assemble one i32[REC_W] record. ``seq`` and ``tick`` are stamped
+    by :func:`dev_record`; ``kind``/``role`` are static codes, the rest
+    may be traced scalars."""
+    z = jnp.int32
+    return jnp.stack([
+        z(0), z(0), z(node), z(group), z(kind), z(term), z(role),
+        z(commit), z(last), z(aux),
+    ])
+
+
+def dev_record(ring: EventRing, cond, rec: jax.Array) -> EventRing:
+    """Masked ring append: write ``rec`` at slot ``count % capacity`` and
+    bump the seq counter iff ``cond`` — otherwise the ring passes
+    through bit-unchanged. One dynamic slice read + one
+    ``dynamic_update_slice`` + scalar arithmetic: legal (and cheap)
+    inside ``jit``, ``vmap``, ``lax.scan`` and ``shard_map``."""
+    cap = ring.buf.shape[-2]
+    cond = jnp.asarray(cond, bool)
+    slot = lax.rem(ring.count, jnp.int32(cap))
+    rec = rec.at[F_SEQ].set(ring.count).at[F_TICK].set(ring.tick)
+    cur = lax.dynamic_slice(ring.buf, (slot, jnp.int32(0)), (1, REC_W))
+    new = jnp.where(cond, rec[None, :], cur)
+    buf = lax.dynamic_update_slice(ring.buf, new, (slot, jnp.int32(0)))
+    return ring.replace(buf=buf, count=ring.count + cond.astype(jnp.int32))
+
+
+def dev_count(ring: EventRing, idx: int, amount) -> EventRing:
+    """Bump on-device metrics counter ``idx`` (static) by ``amount``
+    (traced i32)."""
+    return ring.replace(
+        counters=ring.counters.at[idx].add(jnp.int32(amount))
+    )
+
+
+# ------------------------------------------------- kernel instrumentation
+def record_replicate_events(
+    ring: EventRing, comm, old, new, info, leader, leader_term,
+    group_id, *, repair: bool = True, ticks=1,
+) -> EventRing:
+    """Record one replicate step's interesting transitions, derived
+    purely from the (old, new, info) triple — never from the step's
+    internals, so every formulation (XLA / fused Pallas / mesh) shares
+    this body unchanged. Events: commit advance (the host nodelog
+    twin), per-row term adoptions, a step-down signal (``max_term``
+    above the leader's), and repair-window motion; counters: ticks,
+    commits (entry delta), term adoptions, repair rounds."""
+    R = comm.n_replicas
+    leader = jnp.int32(leader)
+    leader_term = jnp.int32(leader_term)
+    old_term = comm.all_gather(old.term)
+    new_term = comm.all_gather(new.term)
+    new_commit = comm.all_gather(new.commit_index)
+    new_last = comm.all_gather(new.last_index)
+    old_commit_l = comm.all_gather(old.commit_index)[leader]
+    old_last_l = comm.all_gather(old.last_index)[leader]
+    legit = leader_term >= 1
+
+    ring = ring.replace(tick=ring.tick + 1)
+    # a masked group lane (leader_term 0 under vmap) ran a bit-exact
+    # no-op, not a tick — count only legitimate steps. ``ticks`` lets
+    # a chunk-granularity caller (the engine's pipelined paths) charge
+    # the whole flight's step count to one recorded transition.
+    ring = dev_count(ring, C_TICKS, legit.astype(jnp.int32) * jnp.int32(ticks))
+
+    # commit advance, leader-attributed: the decoded twin of the host's
+    # "commit index changed to N" line (byte-identical within a stable
+    # leadership — the leader row's commit IS the global commit there)
+    commit_adv = legit & (info.commit_index > old_commit_l)
+    ring = dev_record(ring, commit_adv, make_rec(
+        K_COMMIT, leader, leader_term, ROLE_LEADER, info.commit_index,
+        new_last[leader], 0, group_id,
+    ))
+    ring = dev_count(ring, C_COMMITS, jnp.where(
+        commit_adv, info.commit_index - old_commit_l, 0
+    ))
+
+    # per-row term adoption (static unroll over the replica axis: R
+    # conditional single-row writes — in a steady window all no-ops)
+    adopt = new_term > old_term
+    for p in range(R):
+        ring = dev_record(ring, adopt[p], make_rec(
+            K_TERM_ADOPT, p, new_term[p], ROLE_FOLLOWER, new_commit[p],
+            new_last[p], old_term[p], group_id,
+        ))
+    ring = dev_count(
+        ring, C_TERM_ADOPTIONS, jnp.sum(adopt.astype(jnp.int32))
+    )
+
+    # the step saw a term above the leader's: the engine will step the
+    # leader down when it reads info.max_term — record the device-side
+    # evidence (aux = the leader term that just died)
+    step_down = legit & (info.max_term > leader_term)
+    ring = dev_record(ring, step_down, make_rec(
+        K_STEP_DOWN, leader, info.max_term, ROLE_FOLLOWER,
+        new_commit[leader], new_last[leader], leader_term, group_id,
+    ))
+
+    if repair:
+        # the repair window actually moved entries this step (the
+        # compiled-out steady/EC program skips this block statically):
+        # repair_count > 0 <=> legit & window start <= leader's old last
+        moved = legit & (info.repair_start >= 1) & (
+            old_last_l >= info.repair_start
+        )
+        ring = dev_record(ring, moved, make_rec(
+            K_REPAIR, leader, leader_term, ROLE_LEADER,
+            info.commit_index, new_last[leader], info.repair_start,
+            group_id,
+        ))
+        ring = dev_count(ring, C_REPAIRS, moved.astype(jnp.int32))
+    return ring
+
+
+def record_vote_events(
+    ring: EventRing, comm, old, new, info, candidate, cand_term,
+    quorum, group_id,
+) -> EventRing:
+    """Record one vote round: the election win (the decoded twin of the
+    host's "state changed to leader" line — same win rule the engine
+    applies: a vote majority AND no higher term heard) plus per-row
+    term adoptions."""
+    R = comm.n_replicas
+    candidate = jnp.int32(candidate)
+    cand_term = jnp.int32(cand_term)
+    old_term = comm.all_gather(old.term)
+    new_term = comm.all_gather(new.term)
+    new_commit = comm.all_gather(new.commit_index)
+    new_last = comm.all_gather(new.last_index)
+
+    ring = ring.replace(tick=ring.tick + 1)
+    win = (info.votes > jnp.int32(quorum)) & (info.max_term <= cand_term)
+    ring = dev_record(ring, win, make_rec(
+        K_ELECT, candidate, cand_term, ROLE_LEADER,
+        new_commit[candidate], new_last[candidate], info.votes, group_id,
+    ))
+    ring = dev_count(ring, C_ELECTIONS, win.astype(jnp.int32))
+
+    adopt = new_term > old_term
+    for p in range(R):
+        ring = dev_record(ring, adopt[p], make_rec(
+            K_TERM_ADOPT, p, new_term[p], ROLE_FOLLOWER, new_commit[p],
+            new_last[p], old_term[p], group_id,
+        ))
+    ring = dev_count(
+        ring, C_TERM_ADOPTIONS, jnp.sum(adopt.astype(jnp.int32))
+    )
+    return ring
+
+
+# --------------------------------------------------------------- flushing
+def flush_pack(ring: EventRing) -> jax.Array:
+    """Pack the whole ring into ONE i32[capacity+1, REC_W] array for a
+    single amortised device fetch per launch boundary: the buffer plus a
+    trailer row carrying (count, tick, counters...)."""
+    trailer = jnp.zeros((REC_W,), jnp.int32)
+    trailer = trailer.at[0].set(ring.count).at[1].set(ring.tick)
+    trailer = lax.dynamic_update_slice(trailer, ring.counters, (2,))
+    return jnp.concatenate([ring.buf, trailer[None, :]], axis=0)
+
+
+_flush_pack_jit = None
+_flush_pack_group_jit = None
+
+
+def packed_flush(ring: EventRing) -> jax.Array:
+    """Jitted :func:`flush_pack` — single ring (i32[cap+1, REC_W]) or
+    group-batched rings (i32[G, cap+1, REC_W]); one launch either way."""
+    global _flush_pack_jit, _flush_pack_group_jit
+    if ring.count.ndim == 0:
+        if _flush_pack_jit is None:
+            _flush_pack_jit = jax.jit(flush_pack)
+        return _flush_pack_jit(ring)
+    if _flush_pack_group_jit is None:
+        _flush_pack_group_jit = jax.jit(jax.vmap(flush_pack))
+    return _flush_pack_group_jit(ring)
+
+
+def _node_name(node: int, group: int) -> str:
+    return f"Server{node}" if group < 0 else f"g{group}/Server{node}"
+
+
+def _msg_of(kind_code: int, commit: int) -> Optional[str]:
+    if kind_code == K_ELECT:
+        return "state changed to leader"
+    if kind_code == K_COMMIT:
+        return f"commit index changed to {commit}"
+    return None            # recorder-only: never entered the trace stream
+
+
+def decode_records(
+    packed: np.ndarray,
+    start_seq: int = 0,
+    t_virtual: float = 0.0,
+) -> Tuple[List[Event], int, int, np.ndarray, int]:
+    """Decode one :func:`packed_flush` fetch into PR-5 ``Event`` objects.
+
+    Returns ``(events, count, lost, counters, tick)`` where ``events``
+    are the decoded records with seq >= ``start_seq`` still resident in
+    the ring (seq order), and ``lost`` counts records that lapped out
+    between flushes (seq < the oldest resident record but >=
+    ``start_seq``). ``Event.seq`` carries the DEVICE seq; ``t_virtual``
+    stamps the flush-time virtual clock (the engine flushes once per
+    launch, so decoded events carry the tick they surfaced at)."""
+    packed = np.asarray(packed)
+    cap = packed.shape[0] - 1
+    trailer = packed[-1]
+    count, tick = int(trailer[0]), int(trailer[1])
+    counters = trailer[2 : 2 + N_COUNTERS].astype(np.int64)
+    oldest = max(0, count - cap)
+    lost = max(0, oldest - start_seq)
+    events: List[Event] = []
+    for s in range(max(start_seq, oldest), count):
+        row = packed[s % cap]
+        if int(row[F_SEQ]) != s or int(row[F_KIND]) == 0:
+            continue       # torn slot (cannot happen post-flush; belt)
+        kind_code = int(row[F_KIND])
+        group = int(row[F_GROUP])
+        commit = int(row[F_COMMIT])
+        events.append(Event(
+            seq=s,
+            t_virtual=t_virtual,
+            node=_node_name(int(row[F_NODE]), group),
+            group=None if group < 0 else group,
+            term=int(row[F_TERM]),
+            kind=KIND_NAMES.get(kind_code, f"dev_kind_{kind_code}"),
+            state=ROLE_NAMES.get(int(row[F_ROLE]), ""),
+            commit_index=commit,
+            last_index=int(row[F_LAST]),
+            msg=_msg_of(kind_code, commit),
+            fields={
+                "device": True, "tick": int(row[F_TICK]),
+                "aux": int(row[F_AUX]),
+            },
+        ))
+    return events, count, lost, counters, tick
+
+
+class DeviceObs:
+    """Host-side accumulation plane for device-recorded observability.
+
+    One instance can span several engines / crash-restore cycles (the
+    chaos ObsStack holds one per run, like the flight recorder): each
+    engine keeps its own ring + flush cursor and ``ingest``s decoded
+    events here. ``counters`` accumulates the on-device metrics vector
+    per group label; ``dropped`` counts records lapped out before any
+    flush saw them (the overflow contract: seq stays monotone, losses
+    are reported, never silent)."""
+
+    def __init__(self, capacity: int = 4096,
+                 host_capacity: int = 1 << 16):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        from collections import deque
+
+        self.capacity = capacity
+        self.events = deque(maxlen=host_capacity)
+        #   decoded events, host-side bounded like the FlightRecorder's
+        #   ring; host evictions are counted separately from device
+        #   laps (``dropped`` = records lost BEFORE any flush saw them)
+        self.host_evicted = 0
+        self.dropped = 0
+        # epoch accounting: each engine attachment is one EPOCH whose
+        # device-side readings (seq counter, metrics vector) restart at
+        # zero; completed epochs fold into the ``_base_*`` accumulators
+        # (new_epoch) so a crash-restored engine ADDS to the plane
+        # instead of regressing it, and its seqs re-offset past
+        # everything already ingested.
+        self._cur_totals: Dict[Optional[int], int] = {}
+        self._cur_laps: Dict[Optional[int], int] = {}
+        self._cur_counters: Dict[Tuple[str, str], int] = {}
+        self._base_totals: Dict[Optional[int], int] = {}
+        self._base_laps: Dict[Optional[int], int] = {}
+        self._base_counters: Dict[Tuple[str, str], int] = {}
+
+    # ------------------------------------------------------------ epochs
+    def new_epoch(self) -> None:
+        """Fold the current engine's cumulative device readings into the
+        base accumulators — called by ``attach_device_obs`` whenever an
+        engine (fresh boot, crash-restore) adopts this plane. Idempotent
+        on an empty current epoch."""
+        for g, tot in self._cur_totals.items():
+            self._base_totals[g] = self._base_totals.get(g, 0) + tot
+        for g, laps in self._cur_laps.items():
+            self._base_laps[g] = self._base_laps.get(g, 0) + laps
+        for key, v in self._cur_counters.items():
+            self._base_counters[key] = self._base_counters.get(key, 0) + v
+        self._cur_totals = {}
+        self._cur_laps = {}
+        self._cur_counters = {}
+
+    # ------------------------------------------------------------ ingest
+    def ingest(self, events: List[Event], *, total: int, lost: int,
+               counters: np.ndarray, group: Optional[int] = None) -> None:
+        base = self._base_totals.get(group, 0)
+        if base:
+            # keep the accumulated stream's seqs monotone across engine
+            # generations (each fresh ring restarts at 0)
+            import dataclasses
+
+            events = [dataclasses.replace(e, seq=e.seq + base)
+                      for e in events]
+        room = self.events.maxlen - len(self.events)
+        if len(events) > room:
+            self.host_evicted += len(events) - room
+        self.events.extend(events)
+        self.dropped += lost
+        self._cur_totals[group] = total
+        self._cur_laps[group] = total // self.capacity
+        label = "0" if group is None else str(group)
+        for i, name in enumerate(COUNTER_METRICS):
+            self._cur_counters[(name, label)] = int(counters[i])
+
+    # ----------------------------------------------------------- queries
+    @property
+    def counters(self) -> Dict[str, Dict[str, int]]:
+        """name -> {group label -> value}, summed across epochs."""
+        out: Dict[str, Dict[str, int]] = {}
+        for src in (self._base_counters, self._cur_counters):
+            for (name, label), v in src.items():
+                out.setdefault(name, {})
+                out[name][label] = out[name].get(label, 0) + v
+        return out
+
+    @property
+    def total_recorded(self) -> int:
+        return (sum(self._base_totals.values())
+                + sum(self._cur_totals.values()))
+
+    @property
+    def laps(self) -> int:
+        groups = set(self._base_laps) | set(self._cur_laps)
+        return max(
+            (self._base_laps.get(g, 0) + self._cur_laps.get(g, 0)
+             for g in groups),
+            default=0,
+        )
+
+    def of_kind(self, *kinds: str, group: Optional[int] = None):
+        want = set(kinds)
+        return [
+            e for e in self.events
+            if e.kind in want and (group is None or e.group == group)
+        ]
+
+    def nodelog_lines(self) -> List[str]:
+        """The decoded device stream's nodelog renderings (events whose
+        kind overlaps the host trace stream — elect / commit)."""
+        return [e.nodelog() for e in self.events if e.msg is not None]
+
+    # --------------------------------------------------------- (de)serial
+    def to_jsonable(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "laps": self.laps,
+            "total_recorded": self.total_recorded,
+            "counters": self.counters,
+            "events": [e.to_jsonable() for e in self.events],
+        }
+
+    @classmethod
+    def from_jsonable(cls, d: dict) -> "DeviceObs":
+        obs = cls(capacity=d.get("capacity", 4096))
+        obs.dropped = d.get("dropped", 0)
+        for name, series in d.get("counters", {}).items():
+            for label, v in series.items():
+                obs._base_counters[(name, label)] = int(v)
+        obs._base_totals = {None: d.get("total_recorded", len(d["events"]))}
+        obs._base_laps = {None: d.get("laps", 0)}
+        obs.events.extend(Event.from_jsonable(ed) for ed in d["events"])
+        return obs
+
+
+def merged_timeline(recorder, device_obs) -> List[Event]:
+    """Host flight-recorder events and decoded device events as ONE
+    stream, ordered by virtual time with device events first inside a
+    tie (the device step ran before the host bookkeeping that observed
+    it) — the forensics view ``--explain`` interleaves."""
+    host = list(recorder._ring) if recorder is not None else []
+    dev = list(device_obs.events) if device_obs is not None else []
+    tagged = [(e.t_virtual, 0, i, e) for i, e in enumerate(dev)]
+    tagged += [(e.t_virtual, 1, i, e) for i, e in enumerate(host)]
+    tagged.sort(key=lambda t: t[:3])
+    return [e for _, _, _, e in tagged]

@@ -33,23 +33,34 @@ BUNDLE_FORMAT = "raft_tpu.obs/bundle.v1"
 class ObsStack:
     """The per-run observability plane the chaos runners attach when
     ``observe=True``: one flight recorder + span tracker + metrics
-    registry, shared by every engine the run boots (including across
-    crash-restore cycles)."""
+    registry — plus, when ``device=True``, the device-resident plane
+    (``obs.device.DeviceObs``: in-kernel event rings decoded at every
+    launch boundary) — shared by every engine the run boots (including
+    across crash-restore cycles; each fresh engine gets a fresh ring,
+    the DeviceObs accumulates)."""
 
     recorder: Any
     spans: Any
     registry: Any
+    device: Any = None
 
     @classmethod
-    def build(cls, capacity: int = 65536) -> "ObsStack":
+    def build(cls, capacity: int = 65536,
+              device: bool = False) -> "ObsStack":
         from raft_tpu.obs.events import FlightRecorder
         from raft_tpu.obs.registry import MetricsRegistry
         from raft_tpu.obs.spans import SpanTracker
 
+        dev = None
+        if device:
+            from raft_tpu.obs.device import DeviceObs
+
+            dev = DeviceObs()
         return cls(
             recorder=FlightRecorder(capacity=capacity),
             spans=SpanTracker(),
             registry=MetricsRegistry(),
+            device=dev,
         )
 
     def attach(self, engine) -> None:
@@ -57,6 +68,8 @@ class ObsStack:
         engine.recorder = self.recorder
         engine.spans = self.spans
         engine.metrics = self.registry
+        if self.device is not None and hasattr(engine, "attach_device_obs"):
+            engine.attach_device_obs(self.device)
 
 
 def resolve_bundle_dir(bundle_dir: Optional[str]) -> Optional[str]:
@@ -118,6 +131,11 @@ def write_bundle(
         "events": obs.recorder.to_jsonable() if obs is not None else None,
         "spans": obs.spans.to_jsonable() if obs is not None else None,
         "metrics": obs.registry.to_json() if obs is not None else None,
+        "device_ring": (
+            obs.device.to_jsonable()
+            if obs is not None and getattr(obs, "device", None) is not None
+            else None
+        ),
         "extra": extra or {},
     }
     path = Path(bundle_dir) / f"bundle_{kind}_seed{seed}.json"
@@ -256,20 +274,53 @@ def explain(bundle: dict) -> str:
             f"{key!r} timeline below"
         )
 
-    # -- faults in flight ----------------------------------------------
+    # -- device plane (obs.device: in-kernel event ring) ---------------
+    dev_entries = []
+    dr = bundle.get("device_ring")
+    if dr is not None:
+        from raft_tpu.obs.events import Event
+
+        dev_evs = [Event.from_jsonable(d) for d in dr.get("events", [])]
+        by_kind: Dict[str, int] = {}
+        for e in dev_evs:
+            by_kind[e.kind] = by_kind.get(e.kind, 0) + 1
+        kinds = ", ".join(f"{k}={n}" for k, n in sorted(by_kind.items()))
+        out.append(
+            f"device ring: {dr.get('total_recorded', len(dev_evs))} "
+            f"records ({kinds or 'none'})"
+        )
+        if dr.get("dropped"):
+            out.append(
+                f"  (ring lapped {dr.get('laps', 0)}x: "
+                f"{dr['dropped']} oldest device records dropped)"
+            )
+        dev_entries = [
+            (
+                e.t_virtual,
+                f"[device] {e.kind} {e.node} term={e.term}"
+                + (f" commit={e.commit_index}"
+                   if e.kind == "commit" else "")
+                + (f" aux={e.fields.get('aux')}"
+                   if e.kind in ("repair_floor", "step_down") else ""),
+            )
+            for e in dev_evs
+        ]
+
+    # -- faults in flight (device events interleaved) ------------------
     faults = []
     for line in bundle.get("faults", []):
         m = _FAULT_T.match(line)
         if m:
             faults.append((float(m["t"]), m["desc"]))
-    if faults:
+    timeline = sorted(faults + dev_entries, key=lambda f: f[0])
+    if timeline:
         if t_focus is not None:
-            window = [f for f in faults if f[0] <= t_focus]
-            window = window[-6:]
-            label = f"faults in flight before t={t_focus:.1f}:"
+            window = [f for f in timeline if f[0] <= t_focus]
+            window = window[-(6 + min(len(dev_entries), 6)):]
+            label = f"timeline before t={t_focus:.1f}:"
         else:
-            window = faults[-8:]
-            label = "final fault schedule:"
+            window = timeline[-12:]
+            label = "final fault/device timeline:"
         out.append(label)
         out.extend(f"  t={t:>8.1f}  {d}" for t, d in window)
 

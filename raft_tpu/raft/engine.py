@@ -201,6 +201,18 @@ class RaftEngine:
         #   syncs: the profiler's block_until_ready lives only behind
         #   HostProfiler.sync, which no detached path calls (pinned by
         #   tests/test_perf_obs.py, like the nodelog no-fetch pin).
+        self.device_obs = None
+        #   obs.device.DeviceObs (None = off): the device-resident
+        #   observability plane — attach_device_obs allocates an
+        #   in-kernel EventRing the replicate/vote launches thread
+        #   through (record=True step programs), and every launch
+        #   boundary flushes ONE packed fetch of ring + counters into
+        #   this host accumulator. Detached costs zero extra device
+        #   syncs and dispatches the exact pre-instrumentation programs
+        #   (HLO-identity pinned by tests/test_device_obs.py).
+        self._dev_ring = None
+        self._dev_flushed = 0
+        self._dev_counters_folded = None
         self._tick_count = 0
         #   Leader ticks fired so far — the replication-round clock the
         #   span tracker diffs for rounds-to-commit (always maintained:
@@ -468,6 +480,127 @@ class RaftEngine:
         labels.setdefault("group", "0")
         self.metrics.counter(name, help_, tuple(labels)).inc(**labels)
 
+    # ------------------------------------------- device observability plane
+    def attach_device_obs(self, obs=None, capacity: int = 4096):
+        """Attach the device-resident observability plane (obs.device):
+        subsequent replicate/vote launches run the recorded step
+        programs (state outputs bit-identical — recording derives from
+        the transition, outside the protocol math) and each launch
+        boundary flushes the ring + on-device counters into ``obs``
+        (a DeviceObs; one is created when omitted). Passing an existing
+        DeviceObs lets one plane span crash-restore cycles, like the
+        flight recorder (each attachment opens a new accumulation
+        epoch). The pipelined chunk launches (``submit_pipelined``)
+        record at CHUNK granularity (``_dev_record_chunk``) — the same
+        granularity the host nodelog observes them at. Returns the
+        DeviceObs."""
+        from raft_tpu.obs.device import N_COUNTERS, DeviceObs, init_ring
+
+        self.device_obs = obs if obs is not None else DeviceObs(capacity)
+        self.device_obs.new_epoch()
+        #   each attachment is an epoch: a crash-restored engine's fresh
+        #   ring (seqs and counters restarting at 0) ADDS to the plane's
+        #   accumulators instead of regressing them
+        self._dev_ring = init_ring(self.device_obs.capacity)
+        self._dev_flushed = 0
+        self._dev_counters_folded = np.zeros(N_COUNTERS, np.int64)
+        return self.device_obs
+
+    def detach_device_obs(self) -> None:
+        """Back to the pre-instrumentation programs; the DeviceObs keeps
+        everything already flushed."""
+        self._flush_device_obs()
+        self.device_obs = None
+        self._dev_ring = None
+
+    def _flush_device_obs(self) -> None:
+        """One amortised fetch per launch boundary: pack the ring buffer
+        + seq counter + metrics vector into a single array, decode new
+        records into PR-5 Events, fold counter deltas into the
+        registry. Pure read — no engine decision depends on it, so
+        recording stays determinism-neutral."""
+        if self.device_obs is None or self._dev_ring is None:
+            return
+        from raft_tpu.obs.device import (
+            COUNTER_METRICS,
+            decode_records,
+            packed_flush,
+        )
+
+        packed = np.asarray(self._fetch(packed_flush(self._dev_ring)))
+        events, count, lost, counters, _tick = decode_records(
+            packed, self._dev_flushed, t_virtual=self.clock.now,
+        )
+        if count == self._dev_flushed and not np.any(
+            counters - self._dev_counters_folded
+        ):
+            return
+        self.device_obs.ingest(
+            events, total=count, lost=lost, counters=counters, group=None,
+        )
+        self._dev_flushed = count
+        if self.metrics is not None:
+            for i, name in enumerate(COUNTER_METRICS):
+                delta = int(counters[i] - self._dev_counters_folded[i])
+                if delta:
+                    self.metrics.counter(
+                        name, "on-device protocol counter", ("group",)
+                    ).inc(delta, group="0")
+        self._dev_counters_folded = counters
+
+    def _dev_pre_chunk(self):
+        """Pre-capture the scalars chunk recording needs (term / commit
+        / last vectors) BEFORE a pipelined launch: ``replicate_pipeline``
+        donates the state buffers, so the old values must be copied out
+        first. None when the device plane is detached."""
+        if self._dev_ring is None:
+            return None
+        if not hasattr(self, "_dev_pre_jit"):
+            self._dev_pre_jit = jax.jit(
+                lambda s: (s.term, s.commit_index, s.last_index)
+            )
+        return self._dev_pre_jit(self.state)
+
+    def _dev_record_chunk(self, pre, info, r: int, term: int,
+                          ticks: int) -> None:
+        """Chunk-granularity device recording for the pipelined launches
+        (``submit_pipelined``): the fused pipeline kernel cannot carry
+        the per-step ring, so the chunk records its AGGREGATE transition
+        — one commit-advance event (exactly mirroring the ONE host
+        nodelog commit line each chunk produces via ``_advance_commit``)
+        plus term adoptions, step-down evidence and counter deltas. The
+        device plane is therefore never silently dark on a path the
+        host observes; ``heartbeat_ticks`` is charged the chunk's step
+        count."""
+        if self._dev_ring is None or pre is None:
+            return
+        if not hasattr(self, "_dev_chunk_jit"):
+            from raft_tpu.core.comm import SingleDeviceComm
+            from raft_tpu.obs.device import record_replicate_events
+
+            comm = SingleDeviceComm(self.cfg.rows)
+
+            def _rec(ring, pre_term, pre_commit, pre_last, state, info,
+                     leader, lterm, ticks):
+                # a view of the pre-launch state: only the three small
+                # vectors recording reads are swapped in; the other
+                # leaves alias the post-launch buffers untouched
+                old_view = state.replace(
+                    term=pre_term, commit_index=pre_commit,
+                    last_index=pre_last,
+                )
+                return record_replicate_events(
+                    ring, comm, old_view, state, info, leader, lterm,
+                    -1, repair=False, ticks=ticks,
+                )
+
+            self._dev_chunk_jit = jax.jit(_rec)
+        self._dev_ring = self._dev_chunk_jit(
+            self._dev_ring, *pre, self.state, info, jnp.int32(r),
+            jnp.int32(term), jnp.int32(ticks),
+        )
+        self._flush_device_obs()
+
     def _attach_votelog(self, path: str) -> None:
         from raft_tpu.ckpt import VoteLog
 
@@ -694,6 +827,7 @@ class RaftEngine:
                 )
             pre_lasts = self._pre_lasts()
             floor, fpt = self._floor_attest(r)
+            dev_pre = self._dev_pre_chunk()
             if eligible:
                 # The saturated fast path: the whole full-ring chunk as
                 # ONE kernel launch (core.step_pallas.steady_pipeline_tpu
@@ -719,6 +853,7 @@ class RaftEngine:
                     allow_turnover=all_accept,
                 )
                 self._note_truncations(pre_lasts)
+                self._dev_record_chunk(dev_pre, info, r, self.leader_term, T)
                 final_commit = int(info.commit_index)
                 if final_commit != leader_last + take:
                     # The host gate and the kernel's feasibility predicate
@@ -774,6 +909,13 @@ class RaftEngine:
                 term_floor=self._term_floor,
             )
             self._note_truncations(pre_lasts)
+            if dev_pre is not None:
+                # the scanned path stacks per-step infos; the chunk
+                # transition is judged against the final step's
+                self._dev_record_chunk(
+                    dev_pre, jax.tree.map(lambda a: a[-1], infos),
+                    r, self.leader_term, T,
+                )
             # ---- one host sync for the whole chunk ----
             frontier = np.asarray(infos.frontier_len)
             max_term = int(np.max(np.asarray(infos.max_term)))
@@ -1177,13 +1319,23 @@ class RaftEngine:
             )
         pre_lasts = self._pre_lasts()
         floor, fpt = self._floor_attest(r)
-        self.state, info = self.t.replicate(
-            self.state, self._hb_payload, 0, r, term,
-            jnp.asarray(eff), jnp.asarray(self.slow),
-            repair=self._repair_program(), member=self._member_arg(),
-            repair_floor=floor, floor_prev_term=fpt,
-            term_floor=self._term_floor,
-        )
+        if self._dev_ring is not None:
+            self.state, info, self._dev_ring = self.t.replicate(
+                self.state, self._hb_payload, 0, r, term,
+                jnp.asarray(eff), jnp.asarray(self.slow),
+                repair=self._repair_program(), member=self._member_arg(),
+                repair_floor=floor, floor_prev_term=fpt,
+                term_floor=self._term_floor, ring=self._dev_ring,
+            )
+            self._flush_device_obs()
+        else:
+            self.state, info = self.t.replicate(
+                self.state, self._hb_payload, 0, r, term,
+                jnp.asarray(eff), jnp.asarray(self.slow),
+                repair=self._repair_program(), member=self._member_arg(),
+                repair_floor=floor, floor_prev_term=fpt,
+                term_floor=self._term_floor,
+            )
         self._note_truncations(pre_lasts)
         return info
 
@@ -2029,9 +2181,16 @@ class RaftEngine:
         eff = self._voter_reach(r)
         #   votes travel only inside the partition, and only to VOTERS:
         #   a learner neither grants nor counts (§4.2.1 non-voting)
-        self.state, info = self.t.request_votes(
-            self.state, r, cand_term, jnp.asarray(eff)
-        )
+        if self._dev_ring is not None:
+            self.state, info, self._dev_ring = self.t.request_votes(
+                self.state, r, cand_term, jnp.asarray(eff),
+                ring=self._dev_ring, quorum=int(self.member.sum()) // 2,
+            )
+            self._flush_device_obs()
+        else:
+            self.state, info = self.t.request_votes(
+                self.state, r, cand_term, jnp.asarray(eff)
+            )
         votes = int(info.votes)
         max_term = int(info.max_term)
         self.terms[eff] = np.maximum(self.terms[eff], cand_term)
@@ -2283,24 +2442,29 @@ class RaftEngine:
             # the per-tick host round-trip the attribution exists to
             # expose — charged to host_pre, not device_wait
             hp.mark("host_pre")
-        self.state, info = self.t.replicate(
-            self.state,
-            payload,
-            take,
-            r,
-            term,
-            jnp.asarray(eff),
-            jnp.asarray(self.slow),
-            repair=repair,
-            member=(jnp.asarray(step_member) if step_member is not None
-                    else self._member_arg()),
-            repair_floor=floor,
-            floor_prev_term=fpt,
-            term_floor=self._term_floor,
-        )
+        member_arg = (jnp.asarray(step_member) if step_member is not None
+                      else self._member_arg())
+        if self._dev_ring is not None:
+            self.state, info, self._dev_ring = self.t.replicate(
+                self.state, payload, take, r, term, jnp.asarray(eff),
+                jnp.asarray(self.slow), repair=repair, member=member_arg,
+                repair_floor=floor, floor_prev_term=fpt,
+                term_floor=self._term_floor, ring=self._dev_ring,
+            )
+        else:
+            self.state, info = self.t.replicate(
+                self.state, payload, take, r, term, jnp.asarray(eff),
+                jnp.asarray(self.slow), repair=repair, member=member_arg,
+                repair_floor=floor, floor_prev_term=fpt,
+                term_floor=self._term_floor,
+            )
         if hp is not None:
             hp.mark("dispatch")
             hp.sync(self.state, info)
+        # device-obs flush AFTER the profiler's dispatch/device_wait
+        # marks: its packed fetch forces a sync, and running it inside
+        # the dispatch window would misattribute flush cost to the step
+        self._flush_device_obs()
         self._note_truncations(pre_lasts)
         max_term = int(info.max_term)
         if max_term > term:

@@ -49,6 +49,12 @@ class SingleDeviceTransport:
             for rep in reps
         }
         self._vote = jax.jit(partial(vote_step, comm))
+        # device-observability (obs.device) variants, built lazily on
+        # first recorded call: same protocol programs wrapped with the
+        # in-kernel event ring (record=True). Keyed like _replicate.
+        self._comm = comm
+        self._replicate_rec: dict = {}
+        self._vote_rec = None
         self._replicate_many = {
             rep: jax.jit(
                 partial(
@@ -75,14 +81,40 @@ class SingleDeviceTransport:
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
         alive, slow, repair=True, member=None, repair_floor=0,
-        floor_prev_term=0, term_floor=None,
+        floor_prev_term=0, term_floor=None, ring=None,
     ) -> Tuple[ReplicaState, RepInfo]:
+        """``ring`` (obs.device.EventRing) selects the recorded program
+        and makes the return a ``(state, info, ring)`` triple; ``None``
+        (the default) runs the exact pre-instrumentation program."""
         fpt = jnp.int32(floor_prev_term)
         rf = jnp.int32(repair_floor)
         tf = None if term_floor is None else jnp.int32(term_floor)
+        if member is None and self._member_mode:
+            member = jnp.ones(self.cfg.rows, bool)
+        if ring is not None:
+            # EC has no repair window: both dispatch keys are one
+            # program — alias like the unrecorded caches do
+            key = True if self.cfg.ec_enabled else bool(repair)
+            if key not in self._replicate_rec:
+                self._replicate_rec[key] = jax.jit(
+                    partial(
+                        replicate_step, self._comm,
+                        ec=self.cfg.ec_enabled,
+                        commit_quorum=self.cfg.commit_quorum,
+                        repair=key, record=True,
+                    )
+                )
+            args = (
+                state, client_payload, jnp.int32(client_count),
+                jnp.int32(leader), jnp.int32(leader_term), alive, slow,
+                fpt, rf,
+            )
+            if self._member_mode:
+                args = args + (member,)
+            return self._replicate_rec[key](
+                *args, term_floor=tf, ring=ring,
+            )
         if self._member_mode:
-            if member is None:
-                member = jnp.ones(self.cfg.rows, bool)
             return self._replicate[bool(repair)](
                 state, client_payload, jnp.int32(client_count),
                 jnp.int32(leader), jnp.int32(leader_term), alive, slow,
@@ -119,8 +151,20 @@ class SingleDeviceTransport:
         )
 
     def request_votes(
-        self, state, candidate, cand_term, alive
+        self, state, candidate, cand_term, alive, ring=None, quorum=0,
     ) -> Tuple[ReplicaState, VoteInfo]:
+        """``ring`` selects the recorded vote program (returns a triple);
+        ``quorum`` is the engine's win threshold (members // 2) the
+        recorded election-win condition uses."""
+        if ring is not None:
+            if self._vote_rec is None:
+                self._vote_rec = jax.jit(
+                    partial(vote_step, self._comm, record=True)
+                )
+            return self._vote_rec(
+                state, jnp.int32(candidate), jnp.int32(cand_term), alive,
+                ring=ring, quorum=jnp.int32(quorum),
+            )
         return self._vote(state, jnp.int32(candidate), jnp.int32(cand_term), alive)
 
     def replicate_pipeline(

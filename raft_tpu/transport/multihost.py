@@ -90,8 +90,33 @@ from typing import Optional, Sequence
 import jax
 
 from raft_tpu.config import RaftConfig
+from raft_tpu.core.comm import shard_map  # noqa: F401 — the version-
+#   portable shim every mesh program build (TpuMeshTransport, and via
+#   it this module's pod transports) goes through; re-exported here so
+#   multihost deployments import the portability seam from the
+#   transport they configure. Before the shim, jax.shard_map's absence
+#   on this JAX line killed every mesh/multiprocess path at build time.
 from raft_tpu.obs import blackbox
 from raft_tpu.transport.tpu_mesh import TpuMeshTransport
+
+
+def _enable_cpu_collectives() -> None:
+    """On the CPU backend, multi-process XLA computations need a
+    cross-process collectives implementation — without one every
+    sharded computation dies with ``INVALID_ARGUMENT: Multiprocess
+    computations aren't implemented on the CPU backend``. Select Gloo
+    (the CI stand-in for DCN) when the knob exists and is unset; a
+    TPU/GPU backend ignores it. Must run BEFORE the backend
+    initializes, which is why the distributed dial calls it first."""
+    try:
+        if jax.config._read("jax_cpu_collectives_implementation") in (
+            None, "none",
+        ):
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+    except Exception:
+        pass   # a jax line without the knob: nothing to select
 
 
 def initialize_multihost(
@@ -105,6 +130,7 @@ def initialize_multihost(
     process — the raw material for ``replica_devices_across_hosts``."""
     if num_processes <= 1:
         return
+    _enable_cpu_collectives()
     # write-before-block (obs.blackbox): the distributed runtime dial is
     # the first cross-process rendezvous — a dead coordinator or a
     # firewalled port hangs exactly here, and only the journal says so

@@ -31,7 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.config import RaftConfig
-from raft_tpu.core.comm import MeshComm
+from raft_tpu.core.comm import MeshComm, shard_map
 from raft_tpu.obs import blackbox
 from raft_tpu.core.state import ReplicaState, init_state
 from raft_tpu.core.step import (
@@ -107,7 +107,7 @@ class TpuMeshTransport:
         mem_spec = (P(),) if self._member_mode else ()
         self._replicate = {
             rep: jax.jit(
-                jax.shard_map(
+                shard_map(
                     partial(
                         replicate_step, comm,
                         ec=cfg.ec_enabled, commit_quorum=cfg.commit_quorum,
@@ -125,7 +125,7 @@ class TpuMeshTransport:
             for rep in reps
         }
         self._vote = jax.jit(
-            jax.shard_map(
+            shard_map(
                 partial(vote_step, comm),
                 mesh=self.mesh,
                 in_specs=(state_specs, P(), P(), P()),
@@ -135,7 +135,7 @@ class TpuMeshTransport:
         )
         self._replicate_many = {
             rep: jax.jit(
-                jax.shard_map(
+                shard_map(
                     partial(
                         scan_replicate, comm, cfg.ec_enabled,
                         cfg.commit_quorum, rep,
@@ -165,6 +165,11 @@ class TpuMeshTransport:
         self._lanes = lanes
         self._mem_spec = mem_spec
         self._fused = {}
+        self._recorded = {}
+        #   device-observability (obs.device) program cache: recorded
+        #   variants threading the replicated EventRing through the
+        #   shard_map body (every device computes the identical ring
+        #   from gathered values, so P() specs are exact)
         self._fetch_seq = 0
         #   allgather id for blackbox marks: every cross-process fetch is
         #   a collective that can stall; the journal carries which one
@@ -264,7 +269,7 @@ class TpuMeshTransport:
             win_spec = P(None, None, lanes)
 
         prog = jax.jit(
-            jax.shard_map(
+            shard_map(
                 fn,
                 mesh=self.mesh,
                 in_specs=(
@@ -278,12 +283,78 @@ class TpuMeshTransport:
         self._fused[key] = prog
         return prog
 
+    def _recorded_program(self, kind: str, rep: bool, has_tf: bool):
+        """Device-observability variants (obs.device): the same protocol
+        programs with record=True and the EventRing threaded through as
+        a fully-replicated operand — recording derives from gathered
+        (hence replicated) values, so every device writes the identical
+        ring. Built lazily per (kind, repair, term_floor?) and cached."""
+        if kind == "replicate" and self.cfg.ec_enabled:
+            rep = True   # EC has no repair window: both keys are one
+            #   program — alias like the unrecorded caches do
+        key = (kind, rep, has_tf)
+        if key in self._recorded:
+            return self._recorded[key]
+        from raft_tpu.obs.device import EventRing
+
+        cfg = self.cfg
+        comm = self._comm
+        mm = self._member_mode
+        ring_specs = EventRing(buf=P(), count=P(), tick=P(), counters=P())
+
+        if kind == "replicate":
+            def fn(state, payload, cnt, leader, lterm, alive, slow, fpt,
+                   rf, *rest):
+                member = rest[0] if mm else None
+                tf = rest[-2] if has_tf else None
+                return replicate_step(
+                    comm, state, payload, cnt, leader, lterm, alive,
+                    slow, fpt, rf, member, ec=cfg.ec_enabled,
+                    commit_quorum=cfg.commit_quorum, repair=rep,
+                    term_floor=tf, ring=rest[-1], record=True,
+                )
+
+            in_specs = (
+                self._state_specs, P(None, self._lanes),
+                P(), P(), P(), P(), P(), P(), P(),
+            ) + self._mem_spec + ((P(),) if has_tf else ()) + (ring_specs,)
+            out_specs = (self._state_specs, self._info_specs, ring_specs)
+        else:                                    # "vote"
+            vote_specs = VoteInfo(votes=P(), max_term=P(), grants=P())
+
+            def fn(state, candidate, cand_term, alive, quorum, ring):
+                return vote_step(
+                    comm, state, candidate, cand_term, alive, ring=ring,
+                    record=True, quorum=quorum,
+                )
+
+            in_specs = (self._state_specs, P(), P(), P(), P(), ring_specs)
+            out_specs = (self._state_specs, vote_specs, ring_specs)
+
+        prog = jax.jit(
+            shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=False,
+            )
+        )
+        self._recorded[key] = prog
+        return prog
+
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
         alive, slow, repair=True, member=None, repair_floor=0,
-        floor_prev_term=0, term_floor=None,
+        floor_prev_term=0, term_floor=None, ring=None,
     ) -> Tuple[ReplicaState, RepInfo]:
         extra = (self._member_or_ones(member),) if self._member_mode else ()
+        if ring is not None:
+            has_tf = term_floor is not None
+            tf = (jnp.int32(term_floor),) if has_tf else ()
+            return self._recorded_program("replicate", bool(repair), has_tf)(
+                state, client_payload, jnp.int32(client_count),
+                jnp.int32(leader), jnp.int32(leader_term), alive, slow,
+                jnp.int32(floor_prev_term), jnp.int32(repair_floor),
+                *extra, *tf, ring,
+            )
         if term_floor is not None:
             return self._fused_program("replicate", bool(repair))(
                 state, client_payload, jnp.int32(client_count),
@@ -339,6 +410,11 @@ class TpuMeshTransport:
         )
 
     def request_votes(
-        self, state, candidate, cand_term, alive
+        self, state, candidate, cand_term, alive, ring=None, quorum=0,
     ) -> Tuple[ReplicaState, VoteInfo]:
+        if ring is not None:
+            return self._recorded_program("vote", True, False)(
+                state, jnp.int32(candidate), jnp.int32(cand_term), alive,
+                jnp.int32(quorum), ring,
+            )
         return self._vote(state, jnp.int32(candidate), jnp.int32(cand_term), alive)

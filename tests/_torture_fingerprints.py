@@ -1,0 +1,31 @@
+"""Session-shared plain-run fingerprints for the determinism pins.
+
+Two acceptance pins replay the SAME plain baselines — the PR-10
+flight-recorder pin (tests/test_obs_forensics.py) and the PR-12
+device-recording pin (tests/test_device_obs.py) both compare an
+observed run of membership seeds 11/14/22/27 at phases=4 against the
+unobserved run of the same seed. The plain run is a pure function of
+(seed, phases), so one execution per session serves both pins — the
+wall-budget rule (README "Testing strategy") is why this lives here
+instead of each file paying for its own baselines.
+
+Not a test module (leading underscore: pytest does not collect it).
+"""
+
+from functools import lru_cache
+
+
+def fingerprint(rep):
+    """THE determinism fingerprint both pins compare: (verdict, commit
+    CRC, op count, op counts, crashes, shed ops, membership ops). One
+    definition — extending the contract means editing exactly here."""
+    return (rep.verdict, rep.commit_digest, rep.ops, rep.op_counts,
+            rep.crashes, rep.shed_ops, rep.membership_ops)
+
+
+@lru_cache(maxsize=None)
+def plain_membership_run(seed: int, phases: int = 4):
+    """The unobserved membership torture run's fingerprint."""
+    from raft_tpu.chaos.runner import torture_run
+
+    return fingerprint(torture_run(seed, phases=phases, membership=True))

@@ -1,0 +1,520 @@
+"""Device-resident observability (obs.device): the in-kernel event
+ring, on-device metrics, and scan-safe tracing (round 12).
+
+Four contracts pinned here:
+
+1. **HLO identity** — the ``record=False`` path of ``replicate_step`` /
+   ``vote_step`` lowers to the byte-identical HLO of the
+   pre-instrumentation call (device observability off costs literally
+   nothing), and the recorded program is a genuinely different program.
+2. **Byte-compatible decode** — device-recorded events for a stable
+   leader window decode to the exact nodelog lines the host flight
+   recorder produces for the same transitions (elect / commit), single
+   AND multi engine: the golden-differential join key extends on-device.
+3. **Determinism** — the pinned chaos seeds (11/14/22/27, the richest
+   tier-1 composition: membership + crash + message faults) replay
+   byte-identical commit CRC, verdict and op counts with device
+   recording on vs off.
+4. **Overflow honesty** — a lapped ring keeps seq monotone and reports
+   ``dropped``; nothing is silently renumbered.
+
+The per-step ``interesting`` mask of the recorded scan is the
+host-escape predicate ROADMAP item 2's K-tick fusion will reuse —
+proven here before the fusion lands.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.comm import SingleDeviceComm
+from raft_tpu.core.state import fold_batch, init_state
+from raft_tpu.core.step import replicate_step, scan_replicate, vote_step
+from raft_tpu.obs.device import (
+    F_SEQ,
+    REC_W,
+    DeviceObs,
+    decode_records,
+    dev_record,
+    init_ring,
+    make_rec,
+    merged_timeline,
+    packed_flush,
+)
+from raft_tpu.obs.events import FlightRecorder
+from raft_tpu.obs.registry import MetricsRegistry
+
+
+def _small_cfg(**kw):
+    kw.setdefault("n_replicas", 3)
+    kw.setdefault("entry_bytes", 16)
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("log_capacity", 256)
+    return RaftConfig(**kw)
+
+
+# --------------------------------------------------------- ring semantics
+def test_dev_record_masked_append_and_seq():
+    ring = init_ring(8)
+    rec = make_rec(1, 2, 3, 2, 4, 5, 6, -1)
+    ring = dev_record(ring, jnp.asarray(True), rec)
+    ring = dev_record(ring, jnp.asarray(False), rec)   # masked: no write
+    ring = dev_record(ring, jnp.asarray(True), rec)
+    assert int(ring.count) == 2
+    buf = np.asarray(ring.buf)
+    assert buf[0, F_SEQ] == 0 and buf[1, F_SEQ] == 1
+    assert (buf[2:] == 0).all()                        # masked slot untouched
+
+
+def test_ring_overflow_keeps_seq_monotone_and_reports_dropped():
+    cap = 4
+    ring = init_ring(cap)
+    for i in range(11):
+        ring = dev_record(
+            ring, jnp.asarray(True), make_rec(1, i, 1, 0, 0, 0, i, -1)
+        )
+    events, count, lost, _, _ = decode_records(
+        np.asarray(packed_flush(ring)), 0
+    )
+    assert count == 11
+    assert lost == 11 - cap                 # lapped-out records reported
+    assert [e.seq for e in events] == [7, 8, 9, 10]    # monotone survivors
+    assert [e.fields["aux"] for e in events] == [7, 8, 9, 10]
+    obs = DeviceObs(capacity=cap)
+    obs.ingest(events, total=count, lost=lost,
+               counters=np.zeros(5, np.int64))
+    assert obs.dropped == 7 and obs.laps == 2
+
+
+def test_dev_record_legal_in_jit_vmap_scan():
+    """The primitive composes with every transform the step programs
+    live under (shard_map legality is exercised end-to-end by the mesh
+    engines in tests/test_engine_mesh.py and the recorded mesh smoke
+    below the slow marker)."""
+    def write_n(ring, n):
+        def body(i, rg):
+            return dev_record(
+                rg, i % 2 == 0, make_rec(1, i, 1, 0, 0, 0, i, -1)
+            )
+        return jax.lax.fori_loop(0, n, body, ring)
+
+    ring = jax.jit(write_n, static_argnums=1)(init_ring(16), 6)
+    assert int(ring.count) == 3             # even i only
+
+    rings = jax.vmap(lambda r, g: dev_record(
+        r, g > 0, make_rec(1, 0, 1, 0, 0, 0, 0, g)
+    ))(
+        jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (4,) + a.shape), init_ring(8)
+        ),
+        jnp.arange(4, dtype=jnp.int32),
+    )
+    assert np.asarray(rings.count).tolist() == [0, 1, 1, 1]
+
+
+# ------------------------------------------------------------ HLO identity
+def _step_args(cfg):
+    state = init_state(cfg, rows=cfg.n_replicas)
+    payload = jnp.zeros(
+        (cfg.batch_size, cfg.n_replicas * cfg.shard_words), jnp.int32
+    )
+    alive = jnp.ones(cfg.n_replicas, bool)
+    slow = jnp.zeros(cfg.n_replicas, bool)
+    return state, payload, alive, slow
+
+
+def test_record_false_is_hlo_identical_to_pre_instrumentation():
+    """ACCEPTANCE: the off-path IS today's program. The pre-PR call
+    shape (no observability kwargs at all) and the explicit
+    ``ring=None, record=False`` call lower to byte-identical HLO text;
+    the recorded program lowers to something else (sanity that the
+    static flag actually switches programs)."""
+    cfg = _small_cfg()
+    comm = SingleDeviceComm(cfg.n_replicas)
+    state, payload, alive, slow = _step_args(cfg)
+    args = (state, payload, jnp.int32(0), jnp.int32(0), jnp.int32(1),
+            alive, slow)
+
+    def _mk(kwargs):
+        # identical wrapper NAME for every variant, so the lowered
+        # module name cannot mask (or fake) an HLO difference
+        def step(*a):
+            return replicate_step(comm, *a, ec=False, commit_quorum=2,
+                                  repair=True, **kwargs)
+        return step
+
+    legacy_txt = jax.jit(_mk({})).lower(*args).as_text()
+    off_txt = jax.jit(
+        _mk(dict(ring=None, record=False))
+    ).lower(*args).as_text()
+    assert legacy_txt == off_txt
+
+    ring = init_ring(64)
+    on_txt = jax.jit(
+        _mk(dict(ring=ring, record=True))
+    ).lower(*args).as_text()
+    assert on_txt != off_txt
+
+    # vote_step: same pin
+    def _mkv(kwargs):
+        def step(*a):
+            return vote_step(comm, *a, **kwargs)
+        return step
+
+    vargs = (state, jnp.int32(0), jnp.int32(1), alive)
+    v_legacy = jax.jit(_mkv({})).lower(*vargs).as_text()
+    v_off = jax.jit(
+        _mkv(dict(ring=None, record=False))
+    ).lower(*vargs).as_text()
+    assert v_legacy == v_off
+    v_on = jax.jit(
+        _mkv(dict(ring=ring, record=True, quorum=1))
+    ).lower(*vargs).as_text()
+    assert v_on != v_off
+
+
+# ----------------------------------------------- recorded state identity
+def test_recorded_step_state_outputs_bit_identical():
+    """Recording derives from the transition and never touches the
+    protocol math: the recorded program's state/info outputs equal the
+    unrecorded program's bit for bit."""
+    cfg = _small_cfg()
+    comm = SingleDeviceComm(cfg.n_replicas)
+    state, _, alive, slow = _step_args(cfg)
+    # elect then replicate a real batch, both ways
+    s_a, v_a = vote_step(comm, state, jnp.int32(0), jnp.int32(1), alive)
+    s_b, v_b, ring = vote_step(
+        comm, state, jnp.int32(0), jnp.int32(1), alive,
+        ring=init_ring(64), record=True, quorum=1,
+    )
+    assert jax.tree.all(jax.tree.map(
+        lambda x, y: bool((np.asarray(x) == np.asarray(y)).all()), s_a, s_b
+    ))
+    data = np.arange(cfg.batch_size * cfg.entry_bytes,
+                     dtype=np.uint8).reshape(cfg.batch_size, -1)
+    payload = fold_batch(data, cfg.n_replicas, cfg.batch_size)
+    kw = dict(ec=False, commit_quorum=2, repair=True)
+    r_a, i_a = replicate_step(
+        comm, s_a, payload, jnp.int32(cfg.batch_size), jnp.int32(0),
+        jnp.int32(1), alive, slow, **kw,
+    )
+    r_b, i_b, ring = replicate_step(
+        comm, s_b, payload, jnp.int32(cfg.batch_size), jnp.int32(0),
+        jnp.int32(1), alive, slow, ring=ring, record=True, **kw,
+    )
+    for a, b in ((r_a, r_b), (i_a, i_b)):
+        assert jax.tree.all(jax.tree.map(
+            lambda x, y: bool((np.asarray(x) == np.asarray(y)).all()), a, b
+        ))
+    assert int(ring.count) > 0              # and events WERE recorded
+
+
+# ------------------------------------------------------ interesting mask
+def test_scan_interesting_mask_flags_eventful_steps():
+    """The recorded scan surfaces a per-step scalar: 1 iff that step
+    recorded any event. Quiet heartbeat steps read 0 — exactly the
+    host-escape predicate a K-tick fused launch needs."""
+    cfg = _small_cfg()
+    comm = SingleDeviceComm(cfg.n_replicas)
+    state, _, alive, slow = _step_args(cfg)
+    state, _, ring = vote_step(
+        comm, state, jnp.int32(0), jnp.int32(1), alive,
+        ring=init_ring(256), record=True, quorum=1,
+    )
+    B = cfg.batch_size
+    data = np.ones((B, cfg.entry_bytes), np.uint8)
+    batch = np.asarray(fold_batch(data, cfg.n_replicas, B))
+    T = 5
+    payloads = jnp.asarray(
+        np.stack([batch] + [np.zeros_like(batch)] * (T - 1))
+    )
+    counts = jnp.asarray(np.array([B] + [0] * (T - 1), np.int32))
+    state, infos, ring, interesting = scan_replicate(
+        comm, False, 2, True, state, payloads, counts, jnp.int32(0),
+        jnp.int32(1), alive, slow, ring=ring, record=True,
+    )
+    got = np.asarray(interesting).tolist()
+    # step 0 ingests+commits (events); later heartbeats are quiet
+    assert got[0] == 1
+    assert got[2:] == [0] * (T - 2)
+
+
+# ------------------------------------------------- nodelog byte-compat
+def test_decoded_device_events_match_host_nodelog_single():
+    """ACCEPTANCE: a stable leader window's device-recorded events
+    decode to the byte-identical nodelog lines the host recorder
+    produced for the same ticks (elect + every commit advance)."""
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = _small_cfg()
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg),
+                   recorder=FlightRecorder())
+    e.metrics = MetricsRegistry()
+    dev = e.attach_device_obs(capacity=1024)
+    e.run_until_leader()
+    rng = np.random.default_rng(7)
+    ROUNDS = 3
+    for _ in range(ROUNDS):
+        seqs = [
+            e.submit(rng.integers(0, 256, cfg.entry_bytes,
+                                  np.uint8).tobytes())
+            for _ in range(cfg.batch_size)
+        ]
+        e.run_until_committed(seqs[-1])
+    host = [ev.nodelog() for ev in e.recorder.events()
+            if ev.kind in ("elect", "commit")]
+    assert host, "window produced no elect/commit lines?"
+    assert dev.nodelog_lines() == host
+    # the on-device metrics vector folded into the PR-5 registry
+    snap = e.metrics.snapshot()
+    assert snap["raft_device_elections_total"]["series"][0]["value"] == 1
+    assert snap["raft_device_commits_total"]["series"][0]["value"] == \
+        ROUNDS * cfg.batch_size
+    # merged timeline carries both planes in virtual-time order
+    merged = merged_timeline(e.recorder, dev)
+    assert len(merged) == len(e.recorder.events()) + len(dev.events)
+    assert all(a.t_virtual <= b.t_virtual
+               for a, b in zip(merged, merged[1:]))
+
+
+def test_decoded_device_events_match_host_nodelog_multi():
+    """Same byte-compat contract on the vmapped group engine: per-group
+    rings decode to per-group ``g{G}/Server{r}`` lines."""
+    from raft_tpu.multi.engine import MultiEngine
+
+    cfg = _small_cfg(transport="single")
+    m = MultiEngine(cfg, 3, recorder=FlightRecorder())
+    m.metrics = MetricsRegistry()
+    dev = m.attach_device_obs(capacity=512)
+    rng = np.random.default_rng(3)
+    for g in range(3):
+        m.run_until_leader(g)
+        for _ in range(2):
+            s = m.submit(g, rng.integers(0, 256, cfg.entry_bytes,
+                                         np.uint8).tobytes())
+            m.run_until_committed(g, s)
+    for g in range(3):
+        host = [ev.nodelog() for ev in m.recorder.events(group=g)
+                if ev.kind in ("elect", "commit")]
+        devl = [ev.nodelog() for ev in dev.events
+                if ev.group == g and ev.msg is not None]
+        assert host and devl == host, f"group {g} drifted"
+    snap = m.metrics.snapshot()
+    elect = {s["labels"]["group"]: s["value"]
+             for s in snap["raft_device_elections_total"]["series"]}
+    assert elect == {"0": 1.0, "1": 1.0, "2": 1.0}
+
+
+def test_engine_ring_overflow_reports_dropped_keeps_decoding():
+    """A deliberately tiny engine ring laps under a long window: the
+    device plane stays monotone, reports the loss, and the TAIL still
+    decodes byte-identically (per-tick flush keeps up, so nothing is
+    actually lost here — dropped counts only records lapped out
+    between flushes, which requires a flush gap)."""
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = _small_cfg()
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg),
+                   recorder=FlightRecorder())
+    dev = e.attach_device_obs(capacity=2)   # laps on the first election
+    e.run_until_leader()
+    rng = np.random.default_rng(1)
+    seqs = [e.submit(rng.integers(0, 256, cfg.entry_bytes,
+                                  np.uint8).tobytes())
+            for _ in range(cfg.batch_size)]
+    e.run_until_committed(seqs[-1])
+    # the election launch wrote 1 elect + 3 adoptions into a 2-slot
+    # ring before the flush could run: the overflow is REPORTED
+    assert dev.dropped >= 1
+    assert dev.laps >= 1
+    seqs_seen = [ev.seq for ev in dev.events]
+    assert seqs_seen == sorted(seqs_seen)
+    # commit lines after the lap still decode byte-identically
+    host_commits = [ev.nodelog() for ev in e.recorder.events(kind="commit")]
+    dev_commits = [ev.nodelog() for ev in dev.events
+                   if ev.kind == "commit"]
+    assert dev_commits == host_commits
+
+
+# ----------------------------------------------------- determinism pins
+OBS_DEVICE_SEEDS = [11, 14, 22, 27]
+
+
+def test_device_recording_is_determinism_neutral_on_pinned_seeds():
+    """ACCEPTANCE: the pinned membership seeds replay byte-identical
+    commit CRC + verdict + op counts with device recording on vs off
+    (same seeds and reduced phase count as the PR-10 flight-recorder
+    pin — the nemesis stream is identical at any phase-count prefix;
+    the plain baselines are session-shared with that pin via
+    tests/_torture_fingerprints.py, per the wall-budget rule)."""
+    from raft_tpu.chaos.runner import torture_run
+    from tests._torture_fingerprints import (
+        fingerprint,
+        plain_membership_run,
+    )
+
+    for seed in OBS_DEVICE_SEEDS:
+        plain_fp = plain_membership_run(seed)
+        dev = torture_run(seed, phases=4, membership=True,
+                          observe_device=True)
+        assert plain_fp == fingerprint(dev), (
+            f"seed {seed}: device recording perturbed the run: "
+            f"{plain_fp} != {fingerprint(dev)}"
+        )
+        assert dev.obs is not None and dev.obs.device is not None
+        assert len(dev.obs.device.events) > 0
+
+
+def test_device_obs_accumulates_across_engine_epochs():
+    """One DeviceObs spanning two engine attachments (the chaos
+    crash-restore pattern: ObsStack.attach on the restored engine):
+    totals and counters ACCUMULATE across epochs instead of regressing
+    to the fresh ring's restarted readings, and the accumulated event
+    stream's seqs stay monotone (each epoch re-offsets past the last)."""
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = _small_cfg()
+    rng = np.random.default_rng(2)
+
+    def drive(engine, rounds):
+        engine.run_until_leader()
+        for _ in range(rounds):
+            seqs = [engine.submit(rng.integers(0, 256, cfg.entry_bytes,
+                                               np.uint8).tobytes())
+                    for _ in range(cfg.batch_size)]
+            engine.run_until_committed(seqs[-1])
+
+    obs = None
+    e1 = RaftEngine(cfg, SingleDeviceTransport(cfg),
+                    recorder=FlightRecorder())
+    obs = e1.attach_device_obs()
+    drive(e1, 2)
+    total1 = obs.total_recorded
+    commits1 = obs.counters["raft_device_commits_total"]["0"]
+    assert total1 > 0 and commits1 == 2 * cfg.batch_size
+
+    e2 = RaftEngine(cfg, SingleDeviceTransport(cfg),
+                    recorder=FlightRecorder())
+    e2.attach_device_obs(obs)          # same plane, fresh engine + ring
+    drive(e2, 1)
+    assert obs.total_recorded > total1
+    assert obs.counters["raft_device_commits_total"]["0"] == \
+        3 * cfg.batch_size
+    seqs_seen = [ev.seq for ev in obs.events]
+    assert seqs_seen == sorted(seqs_seen)
+    assert len(set(seqs_seen)) == len(seqs_seen)   # no epoch collisions
+
+
+def test_pipelined_chunks_are_device_recorded():
+    """submit_pipelined's chunked launches record at CHUNK granularity
+    (the fused pipeline cannot carry the per-step ring): one device
+    commit event per chunk — byte-identical to the ONE host nodelog
+    commit line each chunk emits via _advance_commit — and the commits
+    counter stays exact, so the device plane is never silently dark on
+    a path the host observes."""
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = _small_cfg()
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg),
+                   recorder=FlightRecorder())
+    dev = e.attach_device_obs(capacity=1024)
+    e.run_until_leader()
+    rng = np.random.default_rng(4)
+    n = 4 * cfg.batch_size
+    seqs = e.submit_pipelined([
+        rng.integers(0, 256, cfg.entry_bytes, np.uint8).tobytes()
+        for _ in range(n)
+    ])
+    assert all(e.is_durable(s) for s in seqs)
+    host = [ev.nodelog() for ev in e.recorder.events()
+            if ev.kind in ("elect", "commit")]
+    assert dev.nodelog_lines() == host
+    assert dev.counters["raft_device_commits_total"]["0"] == n
+
+
+# ------------------------------------------------------------ forensics
+def test_bundle_carries_device_ring_and_explain_interleaves(tmp_path):
+    """A forensics bundle from a device-observed run carries the ring
+    (events + counters + overflow), and ``--explain`` decodes it: the
+    kind summary and the interleaved device timeline both render."""
+    import json
+
+    from raft_tpu.obs import load_bundle
+    from raft_tpu.obs.forensics import ObsStack, explain, write_bundle
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    obs = ObsStack.build(device=True)
+    cfg = _small_cfg()
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg), recorder=obs.recorder)
+    obs.attach(e)
+    e.run_until_leader()
+    s = e.submit(b"\x01" * cfg.entry_bytes)
+    e.run_until_committed(s)
+    path = write_bundle(
+        str(tmp_path), kind="torture", seed=99, expected="LINEARIZABLE",
+        verdict="VIOLATION", repro="x", obs=obs,
+    )
+    bundle = load_bundle(path)
+    dr = bundle["device_ring"]
+    assert dr is not None and dr["events"]
+    assert dr["counters"]["raft_device_elections_total"]["0"] == 1
+    text = explain(bundle)
+    assert "device ring:" in text
+    assert "[device] elect" in text or "[device] commit" in text
+    # round-trips through JSON (the CLI reads bundles cold)
+    json.dumps(bundle)
+
+
+def test_chaos_cli_observe_device_flag():
+    """`python -m raft_tpu.chaos --observe-device` runs and exits 0 on
+    a healthy seed (the device plane rides the whole torture stack)."""
+    from raft_tpu.chaos.__main__ import main as chaos_main
+
+    rc = chaos_main(["--seed", "3", "--phases", "2", "--observe-device"])
+    assert rc == 0
+
+
+# ------------------------------------------------------------- slow tier
+@pytest.mark.slow
+def test_mesh_recorded_byte_compat():
+    """The recorded program is legal INSIDE shard_map (the ring rides as
+    a replicated operand) and decodes byte-identically on the mesh
+    transport — the layout ROADMAP item 5 makes first-class."""
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.tpu_mesh import TpuMeshTransport
+
+    cfg = _small_cfg()
+    if len(jax.devices()) < cfg.n_replicas:
+        pytest.skip("needs >= 3 (virtual) devices")
+    e = RaftEngine(cfg, TpuMeshTransport(cfg), recorder=FlightRecorder())
+    dev = e.attach_device_obs(capacity=256)
+    e.run_until_leader()
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        seqs = [e.submit(rng.integers(0, 256, cfg.entry_bytes,
+                                      np.uint8).tobytes())
+                for _ in range(cfg.batch_size)]
+        e.run_until_committed(seqs[-1])
+    host = [ev.nodelog() for ev in e.recorder.events()
+            if ev.kind in ("elect", "commit")]
+    assert dev.nodelog_lines() == host
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(8)))
+def test_device_observed_torture_sweep_matches_plain(seed):
+    """Beyond the pinned seeds: an 8-seed sweep of the same on/off
+    fingerprint comparison (slow tier per the wall-budget rule)."""
+    from raft_tpu.chaos.runner import torture_run
+
+    plain = torture_run(seed, phases=8)
+    dev = torture_run(seed, phases=8, observe_device=True)
+    assert (plain.verdict, plain.commit_digest, plain.op_counts) == \
+        (dev.verdict, dev.commit_digest, dev.op_counts)

@@ -25,24 +25,29 @@ from raft_tpu.obs.__main__ import main as obs_main
 OBS_DETERMINISM_SEEDS = [11, 14, 22, 27]
 
 
-def _fingerprint(rep):
-    return (rep.verdict, rep.commit_digest, rep.ops, rep.op_counts,
-            rep.crashes, rep.shed_ops, rep.membership_ops)
+from tests._torture_fingerprints import fingerprint as _fingerprint
 
 
 def test_flight_recorder_is_determinism_neutral_on_pinned_seeds():
     """ACCEPTANCE: seeds 11/14/22/27 with the full observability plane
     attached vs absent — committed bytes (log CRC) and verdicts are
-    byte-identical, as are op counts and crash cycles."""
+    byte-identical, as are op counts and crash cycles. The plain
+    baselines are session-shared with the device-recording pin
+    (tests/_torture_fingerprints.py — wall-budget rule)."""
+    from tests._torture_fingerprints import (
+        fingerprint,
+        plain_membership_run,
+    )
+
     for seed in OBS_DETERMINISM_SEEDS:
-        plain = torture_run(seed, phases=4, membership=True)
+        plain_fp = plain_membership_run(seed)
         observed = torture_run(seed, phases=4, membership=True,
                                observe=True)
-        assert _fingerprint(plain) == _fingerprint(observed), (
+        assert plain_fp == fingerprint(observed), (
             f"seed {seed}: observability perturbed the run: "
-            f"{_fingerprint(plain)} != {_fingerprint(observed)}"
+            f"{plain_fp} != {fingerprint(observed)}"
         )
-        assert plain.verdict == LINEARIZABLE
+        assert plain_fp[0] == LINEARIZABLE
         assert observed.obs is not None and len(observed.obs.recorder) > 0
 
 
