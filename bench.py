@@ -575,6 +575,135 @@ def bench_attribution() -> dict:
     }
 
 
+# ---------------------------------------------------- K-tick fusion sweep
+def bench_fusion() -> dict:
+    """The K-tick fused steady-state engine (ROADMAP item 2) at the
+    headline shape: wall µs/tick of the real engine drain loop for
+    K ∈ {1, 8, 64, 256} (K=1 = the tick-at-a-time baseline the
+    ``attribution`` leg measured; the acceptance bar is ≥10x at K=64),
+    with dispatch amortization (protocol ticks per launch), the
+    device-ring flush cost per tick at each K, and an attribution
+    BEFORE/AFTER table (hostprof phase columns per protocol tick at K=1
+    vs fused K). Each K emits its own row incrementally (``_emit_leg``)
+    under the usual deadline discipline.
+
+    Methodology mirrors the attribution leg: clients submit the backlog
+    OUTSIDE the timed window (submit + staging pre-pack are client-side
+    costs by design — the staging ring exists precisely to move the
+    host→device payload copy onto the submit path), and the timed
+    window covers exactly the ``run_for`` drain of R rounds of
+    K-batch backlogs."""
+    import os
+
+    from raft_tpu.obs.hostprof import HostProfiler
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    rows = {}
+    base_wall = None
+    rng = np.random.default_rng(13)
+    # the engine honors RAFT_TPU_FUSE_K over cfg.fuse_k (the chaos
+    # wiring) — a leftover export would silently run EVERY row,
+    # baseline included, at the env's K and publish a bogus sweep
+    env_k = os.environ.pop("RAFT_TPU_FUSE_K", None)
+    if env_k is not None:
+        print(f'{{"leg": "fusion", "note": "ignoring RAFT_TPU_FUSE_K='
+              f'{env_k} for the sweep"}}', flush=True)
+
+    for K in (1, 8, 64, 256):
+        cfg = RaftConfig(fuse_k=K)           # the c2 headline shape
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+        assert e.fuse_k == K
+        e.run_until_leader()
+        batch = [
+            rng.integers(0, 256, cfg.entry_bytes, np.uint8).tobytes()
+            for _ in range(cfg.batch_size)
+        ]
+
+        def load(n_batches):
+            for _ in range(n_batches):
+                for p in batch:
+                    e.submit(p)
+
+        def drain(n_batches) -> float:
+            """Timed window: exactly the step_event drain (ticks +
+            fused windows) until the backlog is durable."""
+            last_seq = e._next_seq - 1
+            t0 = time.perf_counter()
+            while not e.is_durable(last_seq):
+                e.run_for(cfg.heartbeat_period * max(n_batches, 1))
+            return time.perf_counter() - t0
+
+        # warm: compiles (tick programs + fused sizes) and one ring lap
+        warm = max(2 * cfg.log_capacity // cfg.batch_size, 2 * K)
+        load(warm)
+        drain(warm)
+        ROUNDS = 3
+        per_round = max(K, 8)
+        t0c, f0l, f0t = e._tick_count, e.fused_launches, e.fused_ticks
+        t_wall = 0.0
+        for _ in range(ROUNDS):
+            load(per_round)
+            t_wall += drain(per_round)
+        ticks = e._tick_count - t0c          # fused booking bumps it too
+        fused_t = e.fused_ticks - f0t
+        launches = (e.fused_launches - f0l) + (ticks - fused_t)
+        #   every non-fused tick is its own launch; fused ticks share
+        wall_us = t_wall / max(ticks, 1) * 1e6
+        if K == 1:
+            base_wall = wall_us
+
+        # hostprof column table per PROTOCOL tick (attribution after)
+        e.hostprof = hp = HostProfiler()
+        t0c = e._tick_count
+        load(per_round)
+        drain(per_round)
+        hp_ticks = e._tick_count - t0c
+        cols = {
+            p: round(s / max(hp_ticks, 1) * 1e6, 3)
+            for p, s in sorted(hp.totals().items())
+        }
+        e.hostprof = None
+
+        # device-ring flush cost per tick at this K: one packed fetch
+        # per LAUNCH boundary, amortised K-fold by fusion
+        e.attach_device_obs(capacity=4096)
+        load(per_round)
+        drain(per_round)        # warm recorded programs
+        t0c = e._tick_count
+        load(per_round)
+        ring_wall = drain(per_round)
+        ring_us = ring_wall / max(e._tick_count - t0c, 1) * 1e6
+        e.detach_device_obs()
+
+        row = {
+            "K": K,
+            "wall_us_per_tick": round(wall_us, 3),
+            "ticks": ticks,
+            "launches": launches,
+            "ticks_per_launch": round(ticks / max(launches, 1), 2),
+            "entries_per_sec_wall": round(
+                cfg.batch_size / wall_us * 1e6, 1
+            ),
+            "speedup_vs_k1": (
+                round(base_wall / wall_us, 2) if base_wall else None
+            ),
+            "host_phase_us_per_tick": cols,
+            "wall_us_per_tick_ring_on": round(ring_us, 3),
+        }
+        rows[f"K{K}"] = _emit_leg(f"fusion_k{K}", row)
+    rows["note"] = (
+        "wall µs/tick of the engine drain loop at the headline shape; "
+        "K=1 is the tick-at-a-time baseline (cross-check: the "
+        "attribution leg's wall_us_per_tick_observe_off). Submit + "
+        "staging pre-pack ride the client side of the wall by design "
+        "(docs/PERF.md 'K-tick fusion')."
+    )
+    if env_k is not None:
+        os.environ["RAFT_TPU_FUSE_K"] = env_k
+    return rows
+
+
 # ------------------------------------------------ client-observed latency
 def bench_client_latency() -> dict:
     """What a CLIENT of ``submit_pipelined`` experiences, wall-clock:
@@ -1518,6 +1647,7 @@ def main(argv=None) -> None:
         ("read_index", bench_read_index),
         ("client_chunk", bench_client_latency),
         ("attribution", bench_attribution),
+        ("fusion", bench_fusion),
         ("overload", bench_overload),
         ("reconfig", bench_reconfig),
     ):

@@ -187,6 +187,33 @@ class ChaosTransport:
             return state, info, ring
         return state, info
 
+    def fusion_ready(self) -> bool:
+        """Whether the K-tick fused path may run RIGHT NOW: only while
+        no message-fault plane is armed and no delayed echo is pending.
+        The chaos rng draws (and the deferred-echo due arithmetic) are
+        keyed to per-round calls, so fusing rounds under an armed fault
+        plane would fork the seeded stream — the engine falls back to
+        tick-at-a-time whenever this is False, which is exactly what
+        keeps seeded replays byte-identical with fusion on vs off."""
+        return (
+            self.p_drop == 0.0 and self.p_dup == 0.0
+            and self.p_delay == 0.0 and not self._deferred
+            and hasattr(self.t, "replicate_fused")
+            and getattr(self.t, "fusion_ready", lambda: True)()
+        )
+
+    def replicate_fused(self, state, staging, start_slot, counts, n_run,
+                        *a, **kw):
+        """Fault-free fused window (``fusion_ready`` gated by the
+        engine): forward to the base transport, advancing the round
+        counter by the window's tick count so deferred-echo due rounds
+        stay aligned with what K tick-at-a-time rounds would have
+        produced."""
+        self._round += int(n_run)
+        return self.t.replicate_fused(
+            state, staging, start_slot, counts, n_run, *a, **kw
+        )
+
     def replicate_many(
         self, state, payloads, counts, leader, leader_term, alive, slow,
         **kw,

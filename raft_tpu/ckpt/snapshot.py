@@ -192,24 +192,87 @@ class CheckpointStore:
         self.entry_bytes = entry_bytes
         self.max_entries = max_entries
         self._slots: Dict[int, Tuple[bytes, int]] = {}  # idx -> (bytes, term)
+        self._spans: Dict[int, tuple] = {}
+        #   lo -> (hi, items, term, pick): whole committed RANGES
+        #   archived as one block (put_span — the fused K-tick booking
+        #   path), sliced lazily on read. ``items`` is any indexable of
+        #   per-entry records; ``pick`` selects the payload field (None
+        #   = the record IS the payload bytes). Never mutated after
+        #   insertion; ``_slots`` takes precedence on overlap (a later
+        #   single-index put, e.g. an archive backfill, wins).
+        self._span_los: list = []      # sorted keys of _spans (bisect)
         self.last = 0
         self._first = 1  # compaction floor: indices below it were evicted
 
     def put(self, idx: int, payload: bytes, term: int) -> None:
         self._slots[idx] = (payload, term)
         self.last = max(self.last, idx)
-        if self.max_entries is not None:
-            # indices arrive monotonically, so eviction is an incremental
-            # floor sweep — amortized O(1) per put
-            floor = self.last - self.max_entries
-            while self._first <= floor:
-                self._slots.pop(self._first, None)
-                self._first += 1
+        self._sweep()
+
+    def put_span(self, lo: int, items, term: int,
+                 pick: Optional[int] = None) -> None:
+        """Archive the contiguous committed range ``[lo, lo+len(items))``
+        as ONE block — O(1) per launch instead of O(entries): the fused
+        steady drain hands the queue slice it just committed straight
+        in (``pick=1`` selects the payload out of (seq, payload)
+        records), and reads slice it lazily. Same retention and
+        compaction semantics as per-index puts."""
+        if not len(items):
+            return
+        fresh = lo not in self._spans
+        self._spans[lo] = (lo + len(items) - 1, items, term, pick)
+        if fresh:
+            # a repeated lo replaces the block in place — inserting a
+            # duplicate key into the sorted list would leave a dangling
+            # entry for the retention sweep to KeyError on
+            import bisect
+
+            bisect.insort(self._span_los, lo)
+        self.last = max(self.last, lo + len(items) - 1)
+        self._sweep()
+
+    def _sweep(self) -> None:
+        if self.max_entries is None:
+            return
+        # indices arrive monotonically, so eviction is an incremental
+        # floor sweep — amortized O(1) per put; span blocks drop whole
+        # once fully below the floor (partially-below blocks stay, the
+        # ``get`` floor guard hides their compacted prefix)
+        floor = self.last - self.max_entries
+        while self._first <= floor:
+            self._slots.pop(self._first, None)
+            self._first += 1
+        self._drop_dead_spans()
+
+    def _drop_dead_spans(self) -> None:
+        while self._span_los and \
+                self._spans[self._span_los[0]][0] < self._first:
+            del self._spans[self._span_los.pop(0)]
+
+    def _span_entry(self, idx: int) -> Optional[Tuple[bytes, int]]:
+        if not self._span_los:
+            return None
+        import bisect
+
+        i = bisect.bisect_right(self._span_los, idx) - 1
+        if i < 0:
+            return None
+        lo = self._span_los[i]
+        hi, items, term, pick = self._spans[lo]
+        if idx > hi:
+            return None
+        rec = items[idx - lo]
+        return (rec if pick is None else rec[pick], term)
 
     def get(self, idx: int) -> Optional[Tuple[bytes, int]]:
         """(payload, term) for one archived index; None when compacted
         away or never archived."""
-        return self._slots.get(idx)
+        if idx < self._first:
+            return None
+        got = self._slots.get(idx)
+        if got is not None:
+            return got
+        return self._span_entry(idx)
 
     @property
     def first(self) -> int:
@@ -230,27 +293,30 @@ class CheckpointStore:
         for k in [k for k in self._slots if k < first]:
             del self._slots[k]
         self._first = first
+        self._drop_dead_spans()
 
     def covers(self, lo: int, hi: int) -> bool:
-        return hi >= lo and all(i in self._slots for i in range(lo, hi + 1))
+        return hi >= lo and all(
+            self.get(i) is not None for i in range(lo, hi + 1)
+        )
 
     def covered_lo(self, hi: int) -> int:
         """Smallest ``lo`` such that [lo, hi] is contiguously archived
         (``hi + 1`` when even ``hi`` itself is missing)."""
-        if hi not in self._slots:
+        if self.get(hi) is None:
             return hi + 1
         lo = hi
-        while lo - 1 >= 1 and (lo - 1) in self._slots:
+        while lo - 1 >= 1 and self.get(lo - 1) is not None:
             lo -= 1
         return lo
 
     def snapshot(self, lo: int, hi: int) -> Snapshot:
         assert self.covers(lo, hi), f"store does not cover [{lo}, {hi}]"
         ents = np.frombuffer(
-            b"".join(self._slots[i][0] for i in range(lo, hi + 1)), np.uint8
+            b"".join(self.get(i)[0] for i in range(lo, hi + 1)), np.uint8
         ).reshape(hi - lo + 1, self.entry_bytes)
         terms = np.asarray(
-            [self._slots[i][1] for i in range(lo, hi + 1)], np.int32
+            [self.get(i)[1] for i in range(lo, hi + 1)], np.int32
         )
         return Snapshot(lo, hi, ents, terms)
 

@@ -145,6 +145,22 @@ class RaftConfig:
     # still admitted. Only applies to submits that carry a client id.
     admission_fair_share: bool = True
 
+    # --- K-tick steady-state fusion (ROADMAP item 2) ---
+    # Ticks per fused launch: when > 1, the engine fuses runs of
+    # consecutive steady-state leader ticks — heartbeat emission,
+    # pending-ingest drain from the pre-packed device staging ring,
+    # quorum commit advance and (host-replayed) timer bookkeeping —
+    # into ONE compiled ``lax.scan`` launch of up to this many ticks,
+    # escaping to the host only when a step's ``interesting`` mask
+    # fires (higher term seen, ingest shortfall / ring-lap pressure,
+    # commit stall) or the staging buffer drains. 1 = off (the legacy
+    # one-launch-per-tick cadence). The committed log is byte-identical
+    # either way (pinned by tests/test_fused_ticks.py); the win is wall
+    # time — docs/PERF.md has the K sweep. Env override:
+    # ``RAFT_TPU_FUSE_K`` (read at engine construction) so chaos/torture
+    # harnesses can be pointed at the fused path without config edits.
+    fuse_k: int = 1
+
     # --- steady-state program dispatch ---
     # "auto": run the repair-free step program whenever the last step showed
     #   every live non-slow follower caught up (~11% faster on the 3-replica
@@ -223,6 +239,8 @@ class RaftConfig:
             raise ValueError('steady_dispatch must be "auto" or "off"')
         if self.pipeline_max_laps < 1:
             raise ValueError("pipeline_max_laps must be >= 1")
+        if self.fuse_k < 1:
+            raise ValueError("fuse_k must be >= 1 (1 = fusion off)")
         if self.admission_max_writes is not None and self.admission_max_writes < 1:
             raise ValueError("admission_max_writes must be >= 1 (or None)")
         if self.admission_max_reads is not None and self.admission_max_reads < 1:

@@ -630,6 +630,216 @@ def scan_replicate(
     return jax.lax.scan(body, state, (payloads, counts))
 
 
+def fused_steady_scan(
+    comm, commit_quorum, state, staging, start_slot, counts, n_run,
+    halted0, leader, leader_term, alive, slow, floor_prev_term=0,
+    repair_floor=0, member=None, ring=None, record=False, group_id=-1,
+):
+    """K consecutive steady-state leader ticks as ONE compiled scan with
+    EXACT early exit — the K-tick fusion of ROADMAP item 2.
+
+    ``staging`` is the pre-packed device staging ring: i32[S, B, W]
+    UNTILED payload words (one slot per batch, filled at submit time by
+    the engine's :class:`raft_tpu.raft.steady.StagingRing`, so the
+    16 MB/launch host→device copy rides the client's submit path, not
+    the drain wall). Step ``j`` reads slot ``(start_slot + j) % S`` and
+    tiles it to the replica lane layout on device (bit-identical to
+    ``core.state.fold_batch``'s host tile). ``counts`` is i32[K];
+    ``n_run`` masks the tail (steps ``j >= n_run`` never execute) so one
+    compiled program serves every window length of its launch size.
+
+    Early-exit semantics (the satellite's "escape-mask exactness" pin):
+    a step whose ESCAPE predicate fires is the LAST step executed in
+    its launch — every later step is masked to the group-engine no-op
+    convention (term 0 + dead cluster: bit-exact state pass-through,
+    pinned by tests/test_multi_raft.py) — and ``halted0`` threads the
+    flag ACROSS launches, so a pipelined launch N+1 dispatched before
+    launch N's escape was booked runs as a provable no-op chain instead
+    of diverging. Escape fires when a step observes what the host's
+    fused-eligibility proof said could not happen:
+
+    - ``info.max_term > leader_term`` — a higher term surfaced (fault /
+      step-down evidence; the host books the executed prefix and steps
+      the leader down exactly as the tick path would);
+    - ``info.frontier_len < count`` — ingest shortfall (ring-lap /
+      backpressure: the staging buffer outran ring room);
+    - ``info.commit_index < prev_last + frontier_len`` — the quorum
+      stopped covering this launch's ingest (commit stall).
+
+    ``record=True`` threads an ``obs.device.EventRing`` through the
+    carry (same instrumentation body as ``scan_replicate``'s recorded
+    mode — one flush per LAUNCH boundary amortises the packed fetch
+    over K ticks, the economics docs/PERF.md's device-ring row
+    promised). Masked steps record nothing (``legit`` fails).
+
+    Returns ``(state, infos, escaped, ran, halted[, ring])`` with
+    ``infos`` the stacked per-step RepInfo, ``escaped``/``ran`` i32[K]
+    flags, ``halted`` the final carry flag for the next launch.
+    Non-EC only (the EC frontier carries per-replica shards, which the
+    untiled staging cannot express); steady program (repair window
+    compiled out — fusion eligibility requires a verified-steady
+    cluster, where repair is a provable no-op)."""
+    from jax import lax
+
+    S = staging.shape[0]
+    K = counts.shape[0]
+    reps = state.log_payload.shape[1] // staging.shape[2]
+    if record and ring is None:
+        raise ValueError("record=True requires an EventRing")
+    lasts0 = comm.all_gather(state.last_index)[leader]
+    steps = jnp.arange(K, dtype=jnp.int32)
+
+    def body(carry, xs):
+        if record:
+            st, halted, prev_last, rg = carry
+        else:
+            st, halted, prev_last = carry
+        j, cnt = xs
+        run = (~halted) & (j < n_run)
+        # masked no-op convention (group_replicate_step's): term 0 +
+        # dead cluster + zero count = bit-exact state pass-through
+        eff_term = jnp.where(run, jnp.int32(leader_term), 0)
+        eff_alive = alive & run
+        eff_cnt = jnp.where(run, cnt, 0)
+        slot = lax.rem(jnp.int32(start_slot) + j, jnp.int32(S))
+        win = lax.dynamic_slice(
+            staging, (slot, jnp.int32(0), jnp.int32(0)),
+            (1,) + staging.shape[1:],
+        )[0]
+        winl = jnp.tile(win, (1, reps)) if reps > 1 else win
+        if record:
+            st, info, rg = replicate_step(
+                comm, st, winl, eff_cnt, leader, eff_term, eff_alive,
+                slow, floor_prev_term, repair_floor, member, ec=False,
+                commit_quorum=commit_quorum, repair=False,
+                term_floor=None, ring=rg, record=True, group_id=group_id,
+            )
+        else:
+            st, info = replicate_step(
+                comm, st, winl, eff_cnt, leader, eff_term, eff_alive,
+                slow, floor_prev_term, repair_floor, member, ec=False,
+                commit_quorum=commit_quorum, repair=False,
+                term_floor=None,
+            )
+        new_last = prev_last + info.frontier_len
+        esc = run & (
+            (info.max_term > jnp.int32(leader_term))
+            | (info.frontier_len < cnt)
+            | (info.commit_index < new_last)
+        )
+        out = (info, esc.astype(jnp.int32), run.astype(jnp.int32))
+        prev_last = jnp.where(run, new_last, prev_last)
+        if record:
+            return (st, halted | esc, prev_last, rg), out
+        return (st, halted | esc, prev_last), out
+
+    init = (state, jnp.asarray(halted0, bool), lasts0)
+    if record:
+        init = init + (ring,)
+    carry, (infos, escaped, ran) = jax.lax.scan(
+        body, init, (steps, counts)
+    )
+    if record:
+        state, halted, _, ring = carry
+        return state, infos, escaped, ran, halted, ring
+    state, halted, _ = carry
+    return state, infos, escaped, ran, halted
+
+
+def fused_group_scan(n_replicas: int, *, record: bool = False):
+    """G groups × K ticks as ONE compiled scan-of-vmapped-steps — the
+    multi-Raft shared K-tick launch (``MultiEngine`` fusion): where the
+    tick path batches G same-instant rounds into one launch per TICK,
+    this batches G × K rounds into one launch per WINDOW. Per-group
+    ``halted`` flags carry the exact early-exit semantics of
+    :func:`fused_steady_scan` (an escaped group's later steps are the
+    bit-exact masked no-op; the other groups keep running). Payload
+    windows arrive pre-packed i32[K, G, B, W] untiled (tiled to the
+    lane layout on device); ``counts`` i32[K, G] (count 0 = a plain
+    heartbeat tick for that group, which the tick-at-a-time engine
+    would have fired anyway at the same instant).
+
+    Returned callable:
+    ``(state, payloads[K,G,B,W], counts[K,G], n_run, halted0[G],
+    leaders[G], terms[G], alive[G,R], slow[G,R], member[G,R]
+    [, rings, gids]) -> (state, infos[K,G], escaped[K,G], ran[K,G],
+    halted[G][, rings])``."""
+    from raft_tpu.core.comm import SingleDeviceComm
+
+    comm = SingleDeviceComm(n_replicas)
+
+    def one(state, payload, count, leader, term, alive, slow, member):
+        return replicate_step(
+            comm, state, payload, count, leader, term, alive, slow,
+            member=member, ec=False, commit_quorum=None, repair=False,
+            use_pallas=False,
+        )
+
+    def one_rec(state, payload, count, leader, term, alive, slow,
+                member, ring, gid):
+        return replicate_step(
+            comm, state, payload, count, leader, term, alive, slow,
+            member=member, ec=False, commit_quorum=None, repair=False,
+            use_pallas=False, ring=ring, record=True, group_id=gid,
+        )
+
+    vstep = jax.vmap(one)
+    vstep_rec = jax.vmap(one_rec)
+
+    def run(state, payloads, counts, n_run, halted0, leaders, terms,
+            alive, slow, member, rings=None, gids=None):
+        reps = state.log_payload.shape[-1] // payloads.shape[-1]
+        steps = jnp.arange(counts.shape[0], dtype=jnp.int32)
+        lasts0 = jnp.take_along_axis(
+            state.last_index, leaders[:, None], 1
+        )[:, 0]
+
+        def body(carry, xs):
+            if record:
+                st, halted, prev_last, rg = carry
+            else:
+                st, halted, prev_last = carry
+            j, win, cnt = xs
+            run_g = (~halted) & (j < n_run)                # bool[G]
+            eff_t = jnp.where(run_g, terms, 0)
+            eff_alive = alive & run_g[:, None]
+            eff_cnt = jnp.where(run_g, cnt, 0)
+            winl = jnp.tile(win, (1, 1, reps)) if reps > 1 else win
+            if record:
+                st, info, rg = vstep_rec(
+                    st, winl, eff_cnt, leaders, eff_t, eff_alive, slow,
+                    member, rg, gids,
+                )
+            else:
+                st, info = vstep(
+                    st, winl, eff_cnt, leaders, eff_t, eff_alive, slow,
+                    member,
+                )
+            new_last = prev_last + info.frontier_len
+            esc = run_g & (
+                (info.max_term > terms)
+                | (info.frontier_len < cnt)
+                | (info.commit_index < new_last)
+            )
+            out = (info, esc.astype(jnp.int32), run_g.astype(jnp.int32))
+            prev_last = jnp.where(run_g, new_last, prev_last)
+            if record:
+                return (st, halted | esc, prev_last, rg), out
+            return (st, halted | esc, prev_last), out
+
+        init = (state, halted0, lasts0)
+        if record:
+            init = init + (rings,)
+        carry, (infos, escaped, ran) = jax.lax.scan(
+            body, init, (steps, payloads, counts)
+        )
+        if record:
+            return carry[0], infos, escaped, ran, carry[1], carry[3]
+        return carry[0], infos, escaped, ran, carry[1]
+
+    return run
+
+
 def group_replicate_step(n_replicas: int, *, repair: bool = True,
                          record: bool = False):
     """G independent Raft groups' replication ticks as ONE batched device

@@ -44,6 +44,7 @@ the lag the event loop's tick cadence can create.
 from __future__ import annotations
 
 import heapq
+import os
 import random
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -60,7 +61,11 @@ from raft_tpu.core.state import (
     init_group_state,
     log_entries,
 )
-from raft_tpu.core.step import group_replicate_step, group_vote_step
+from raft_tpu.core.step import (
+    fused_group_scan,
+    group_replicate_step,
+    group_vote_step,
+)
 from raft_tpu.raft.engine import CANDIDATE, FOLLOWER, LEADER, VirtualClock
 
 
@@ -113,6 +118,20 @@ def _programs(n_replicas: int, record: bool = False) -> tuple:
                 group_vote_step(n_replicas, record=record),
                 donate_argnums=(0, 4) if record else (0,),
             ),
+        )
+    return _PROGRAMS[key]
+
+
+def _fused_group_programs(n_replicas: int, record: bool = False):
+    """Process-wide jitted K-tick fused group program per cluster size
+    (core.step.fused_group_scan): G groups × K ticks in one launch with
+    per-group exact early exit; state (and the per-group event rings)
+    donated. Shared across MultiEngine instances like ``_programs``."""
+    key = (n_replicas, "fused", record)
+    if key not in _PROGRAMS:
+        _PROGRAMS[key] = jax.jit(
+            fused_group_scan(n_replicas, record=record),
+            donate_argnums=(0, 10) if record else (0,),
         )
     return _PROGRAMS[key]
 
@@ -244,6 +263,17 @@ class MultiEngine:
         self._seq_events = 0
         self._timer_gen = np.zeros((n_groups, R), np.int64)
         self._fault_events: list = []
+        self.fuse_k = max(
+            1, int(os.environ.get("RAFT_TPU_FUSE_K", "") or cfg.fuse_k)
+        )
+        #   K-tick fusion across same-tick groups: >1 lets a run_for-
+        #   driven drain fuse K consecutive instants of ALL ticking
+        #   groups' rounds into one scan-of-vmapped-steps launch
+        #   (core.step.fused_group_scan) — the shared-launch batching
+        #   extended along the time axis. Same env override as the
+        #   single engine.
+        self.fused_launches = 0
+        self.fused_ticks = 0
         for g in range(n_groups):
             for r in range(R):
                 self._arm_follower(g, r)
@@ -594,11 +624,15 @@ class MultiEngine:
         self._campaign_many([(g, r)])
 
     # ------------------------------------------------------------- event loop
-    def step_event(self) -> bool:
+    def step_event(self, horizon: Optional[float] = None) -> bool:
         """Advance the clock to the next timer and handle it. Leader-tick
         events sharing the SAME virtual instant are drained together and
         their replication rounds fused into one batched launch — the
-        shared-launch batching the group axis exists for."""
+        shared-launch batching the group axis exists for. With
+        ``fuse_k > 1`` and a drive ``horizon`` (set by ``run_for``), K
+        consecutive such instants additionally fuse into ONE K-tick
+        launch shared by every ticking group (``_fire_fused_window``)
+        whenever the window provably contains nothing but those ticks."""
         if not self._q:
             return False
         hp = self.hostprof
@@ -615,7 +649,11 @@ class MultiEngine:
             if hp is not None:
                 hp.mark("heap_pop")
                 self._hp_groups = set()
-            self._fire_leader_ticks(ticks)
+            if not (
+                self.fuse_k > 1 and horizon is not None
+                and self._fire_fused_window(ticks, horizon)
+            ):
+                self._fire_leader_ticks(ticks)
             if hp is not None:
                 hp.tick_end(
                     groups=sorted(str(gg) for gg in self._hp_groups)
@@ -646,8 +684,8 @@ class MultiEngine:
         for _ in range(max_events):
             if not self._q or self._q[0][0] > end:
                 break
-            self.step_event()
-        self.clock.now = end
+            self.step_event(horizon=end)
+        self.clock.now = max(self.clock.now, end)
 
     def run_until_leader(self, g: int, limit: float = 600.0) -> int:
         end = self.clock.now + limit
@@ -815,6 +853,231 @@ class MultiEngine:
         self._last_info = info
         return np.asarray(info.max_term), np.asarray(info.commit_index)
 
+    def _fused_heap_bound(self, ticking: Dict[int, int]) -> float:
+        """Earliest heap event the fused window must not run past —
+        the single engine's rule (raft.steady.FusedDriver._heap_bound)
+        scoped per group: stale timers and the participating groups'
+        follower timers (re-armed by the window's first tick) are
+        ignorable; anything of a NON-participating group, a fault-plan
+        event, or an unexpected role's timer bounds the window."""
+        bound = float("inf")
+        for (te, _seq, kind, g, row) in self._q:
+            tag, _, gen = kind.partition(":")
+            if tag in ("e", "c") and g in ticking:
+                if int(gen) != self._timer_gen[g, row]:
+                    continue                       # stale: no-op pop
+                if (tag == "e" and row != ticking[g]
+                        and self.roles[g][row] == FOLLOWER):
+                    continue                       # re-armed by tick 1
+                if tag == "c" and self.roles[g][row] != CANDIDATE:
+                    continue                       # draw-free no-op pop
+            bound = min(bound, te)
+        return bound
+
+    def _fire_fused_window(self, ticks: List[Tuple[int, int]],
+                           horizon: float) -> bool:
+        """Handle this instant's leader ticks as a fused K-tick window —
+        ONE ``fused_group_scan`` launch covering every ticking group's
+        next K rounds — when the eligibility proof holds: every ticking
+        group has a routed current-term leader holding its group's
+        highest term, no other role is live anywhere in those groups,
+        every row is alive, connected and caught up to a fully
+        committed log, and the window contains no other heap event.
+        Booking replays each tick's host bookkeeping in the exact order
+        ``_fire_leader_ticks`` performs it (same rng draws, heap
+        tiebreaks, nodelog emissions), so replays are byte-identical
+        with fusion on or off. False = fall back to the tick path."""
+        cfg = self.cfg
+        G, R, B = self.G, cfg.n_replicas, cfg.batch_size
+        hb = cfg.heartbeat_period
+        if len(ticks) != len({g for g, _ in ticks}):
+            return False                 # same-group split-brain instant
+        ticking = {g: r for g, r in ticks}
+        for g, r in ticks:
+            if (self.leader_id[g] != r or self.roles[g][r] != LEADER
+                    or not self.alive[g, r]):
+                return False
+            term = int(self.lead_terms[g, r])
+            if int(self.terms[g].max()) > term:
+                return False
+            if any(p != r and self.roles[g][p] != FOLLOWER
+                   for p in range(R)):
+                return False
+            if not self.alive[g].all() or not self.connectivity[g].all():
+                return False
+            if self.slow[g].any():
+                return False
+        if not any(self._queue[g] for g in ticking):
+            return False                 # pure-idle cluster: tick path
+        lasts = np.asarray(self.state.last_index)
+        commits_dev = np.asarray(self.state.commit_index)
+        for g in ticking:
+            if not (lasts[g] == lasts[g, ticking[g]]).all():
+                return False             # someone lags: repair business
+            if int(lasts[g, ticking[g]]) != int(self.commit_watermark[g]):
+                return False
+            if not (commits_dev[g] == int(self.commit_watermark[g])).all():
+                return False
+        t0 = self.clock.now
+        bound = self._fused_heap_bound(ticking)
+        if bound <= t0:
+            return False
+        # incremental tick times — the same ``t + hb`` float chain the
+        # tick path's pushes use (see raft.steady.FusedDriver.fire)
+        times = [t0]
+        tj = t0
+        while len(times) < self.fuse_k:
+            tj = tj + hb
+            if tj > horizon or tj >= bound:
+                break
+            times.append(tj)
+        n = len(times)
+        if n >= 2:
+            n = 1 << (n.bit_length() - 1)      # power-of-two program set
+        if n < 2:
+            return False
+        times = times[:n]
+        # ---- pack: per-group per-tick batch plan + payload words -----
+        counts = np.zeros((n, G), np.int32)
+        payloads = np.zeros((n, G, B, cfg.shard_words), np.int32)
+        leaders = np.zeros(G, np.int32)
+        terms = np.zeros(G, np.int32)
+        for g, r in ticks:
+            leaders[g] = r
+            terms[g] = int(self.lead_terms[g, r])
+            q = self._queue[g]
+            for j in range(n):
+                take = min(max(len(q) - j * B, 0), B)
+                counts[j, g] = take
+                if take:
+                    chunk = q[j * B:j * B + take]
+                    payloads[j, g, :take] = np.frombuffer(
+                        b"".join(p for _, p in chunk), np.uint8
+                    ).reshape(take, cfg.entry_bytes).view(np.int32)
+        hp = self.hostprof
+        if hp is not None:
+            self._hp_groups.update(ticking)
+            hp.mark("host_pre")
+        payloads_dev = jnp.asarray(payloads)
+        counts_dev = jnp.asarray(counts)
+        if hp is not None:
+            hp.mark("pack")
+        slow = jnp.asarray(self.slow)
+        halted0 = jnp.zeros((G,), bool)
+        # groups NOT ticking this instant run masked no-op lanes: the
+        # group-step convention (term 0 + dead cluster) is exactly a
+        # leaderless group's launch treatment in _replicate_round
+        alive_np = self.alive.copy()
+        for g in range(G):
+            if g not in ticking:
+                terms[g] = 0
+                alive_np[g] = False
+        alive = jnp.asarray(alive_np)
+        record = self._dev_rings is not None
+        prog = _fused_group_programs(R, record)
+        args = (
+            self.state, payloads_dev, counts_dev, jnp.int32(n), halted0,
+            jnp.asarray(leaders), jnp.asarray(terms), alive, slow,
+            self._member,
+        )
+        if record:
+            out = prog(*args, self._dev_rings, self._dev_gids)
+            (self.state, infos, escaped, ran, _halted,
+             self._dev_rings) = out
+        else:
+            self.state, infos, escaped, ran, _halted = prog(*args)
+        self.fused_launches += 1
+        if hp is not None:
+            hp.mark("dispatch")
+            hp.sync(infos.commit_index, escaped, ran)
+        self._flush_device_obs()
+        self._book_fused_window(
+            ticks, times, np.asarray(infos.commit_index),
+            np.asarray(infos.frontier_len), np.asarray(infos.max_term),
+            np.asarray(escaped), np.asarray(ran),
+        )
+        return True
+
+    def _book_fused_window(self, ticks, times, ci, fl, mt, esc,
+                           rn) -> None:
+        """Replay the window's host bookkeeping tick by tick, group by
+        group, in ``_fire_leader_ticks``'s exact order."""
+        cfg = self.cfg
+        B, hb = cfg.batch_size, cfg.heartbeat_period
+        n = len(times)
+        done = {g: False for g, _ in ticks}
+        qpos = {g: 0 for g, _ in ticks}
+        lasts = {g: int(self.commit_watermark[g]) for g, _ in ticks}
+        for j in range(n):
+            t_j = times[j]
+            self.clock.now = max(self.clock.now, t_j)
+            self.fused_ticks += 1
+            for g, r in ticks:
+                if done[g] or not rn[j, g]:
+                    continue
+                term = int(self.lead_terms[g, r])
+                # (no heartbeat-ticks metric here: the multi tick path
+                # records none — replay must not invent one)
+                escaped_now = bool(esc[j, g])
+                if escaped_now and int(mt[j, g]) > term:
+                    # higher term surfaced: the tick path books nothing
+                    # from this round and steps the leader down
+                    self._step_down_leader(g, r, int(mt[j, g]))
+                    done[g] = True
+                    continue
+                eff = self._reach(g, r)
+                self.terms[g][eff] = np.maximum(self.terms[g][eff], term)
+                frontier = int(fl[j, g])
+                if frontier:
+                    base = lasts[g]
+                    chunk = self._queue[g][qpos[g]:qpos[g] + frontier]
+                    self._seq_at_index[g].update(
+                        zip(range(base + 1, base + frontier + 1),
+                            (s for s, _ in chunk))
+                    )
+                    self._uncommitted[g].update(
+                        (base + 1 + i, (p, term))
+                        for i, (_, p) in enumerate(chunk)
+                    )
+                    qpos[g] += frontier
+                    lasts[g] += frontier
+                self._advance_commit(g, r, int(ci[j, g]), at_last=lasts[g])
+                self._reset_heard_timers(g, r)
+                last_exec = escaped_now or j == n - 1
+                if last_exec:
+                    self._push(t_j + hb, "l", g, r)
+                    done[g] = done[g] or escaped_now
+                else:
+                    # intermediate push+pop pair: replay the tiebreak
+                    # counter only (see raft.steady._WindowBook)
+                    self._seq_events += 1
+        for g, r in ticks:
+            if qpos[g]:
+                self._queue[g] = self._queue[g][qpos[g]:]
+
+    def _nodelog_at(self, g: int, r: int, msg: str, commit: int,
+                    last: int, kind: Optional[str] = None) -> str:
+        """``nodelog`` with caller-supplied commit/last (the fused
+        booking replay's emission — byte-identical rendering, no device
+        fetch mid-booking)."""
+        rec = self.recorder
+        if self._trace is None and rec is None:
+            return ""
+        line = (
+            f"[g{g}/Server{r}:{self.terms[g, r]}:{commit}:"
+            f"{last}][{self.roles[g][r]}]{msg}"
+        )
+        if rec is not None:
+            rec.record(
+                node=f"g{g}/Server{r}", group=g,
+                term=int(self.terms[g, r]), kind=kind,
+                t_virtual=self.clock.now, state=self.roles[g][r],
+                commit_index=commit, last_index=last, msg=msg,
+            )
+        if self._trace is not None:
+            self._trace(line)
+        return line
+
     def _fire_leader_ticks(self, ticks: List[Tuple[int, int]]) -> None:
         """All leader ticks that share this virtual instant, as ONE
         batched device launch (ingest + repair + replicate + commit per
@@ -896,7 +1159,14 @@ class MultiEngine:
                 self._arm_follower(g, p)
 
     # ------------------------------------------------------------ commit side
-    def _advance_commit(self, g: int, leader: int, commit: int) -> None:
+    def _advance_commit(self, g: int, leader: int, commit: int,
+                        at_last: Optional[int] = None) -> None:
+        """Host bookkeeping for a commit advance. ``at_last`` is the
+        fused-booking replay's reconstructed leader last_index: when
+        given, the nodelog line renders from the supplied values
+        (``_nodelog_at`` — no device fetch mid-booking) instead of
+        fetching state; everything else is identical by construction
+        (one body, not two copies)."""
         wm = int(self.commit_watermark[g])
         if commit <= wm:
             return
@@ -917,7 +1187,12 @@ class MultiEngine:
                     )
         self._archive_committed(g, leader, wm + 1, commit)
         self.commit_watermark[g] = commit
-        self.nodelog(g, leader, f"commit index changed to {commit}")
+        if at_last is None:
+            self.nodelog(g, leader, f"commit index changed to {commit}")
+        else:
+            self._nodelog_at(g, leader,
+                             f"commit index changed to {commit}",
+                             commit, at_last)
         for idx in [i for i in self._uncommitted[g] if i <= commit]:
             del self._uncommitted[g][idx]
         for idx in [i for i in self._seq_at_index[g] if i <= commit]:

@@ -45,6 +45,7 @@ paces the event heap against wall time.
 from __future__ import annotations
 
 import heapq
+import os
 import random
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -377,6 +378,22 @@ class RaftEngine:
         #   replicated log into a replicated state machine.
         self._lost_gaps: set = set()   # unrecoverable apply gaps, logged once
         self._queue: List[Tuple[int, bytes]] = []  # pending (seq, payload)
+        self.fuse_k = max(
+            1, int(os.environ.get("RAFT_TPU_FUSE_K", "") or cfg.fuse_k)
+        )
+        #   K-tick steady-state fusion (ROADMAP item 2; raft.steady):
+        #   >1 lets ``run_for``-driven drains fuse runs of consecutive
+        #   steady leader ticks into single compiled scan launches. The
+        #   env override exists so chaos/torture runners can be pointed
+        #   at the fused path without touching configs — replays are
+        #   pinned byte-identical either way.
+        self.fused_launches = 0
+        self.fused_ticks = 0
+        self._fused_driver = None
+        if self.fuse_k > 1:
+            from raft_tpu.raft.steady import FusedDriver
+
+            self._fused_driver = FusedDriver(self)
         self.admission = AdmissionGate.from_config(cfg, self.clock)
         #   Bounded admission (raft_tpu.admission; None = legacy
         #   unbounded): submit/submit_read arrivals pass the gate before
@@ -426,6 +443,30 @@ class RaftEngine:
                 self._arm_follower(r)
 
     # ------------------------------------------------------------------ util
+    def _nodelog_at(self, r: int, msg: str, commit: int, last: int,
+                    kind: Optional[str] = None, **fields) -> str:
+        """``nodelog`` with caller-supplied commit/last values — the
+        fused-window booking replay's emission path (the per-tick state
+        is reconstructed from the launch's stacked infos, so no device
+        fetch happens mid-booking). Rendering and recorder schema are
+        byte-identical to :meth:`nodelog`'s."""
+        rec = self.recorder
+        if rec is None and self._trace is None:
+            return ""
+        line = (
+            f"[Server{r}:{self.terms[r]}:{commit}:{last}]"
+            f"[{self.roles[r]}]{msg}"
+        )
+        if rec is not None:
+            rec.record(
+                node=f"Server{r}", term=int(self.terms[r]), kind=kind,
+                t_virtual=self.clock.now, state=self.roles[r],
+                commit_index=commit, last_index=last, msg=msg, **fields,
+            )
+        if self._trace is not None:
+            self._trace(line)
+        return line
+
     def nodelog(self, r: int, msg: str, kind: Optional[str] = None,
                 **fields) -> str:
         """The reference's trace schema (main.go:399-401) — the differential
@@ -692,6 +733,10 @@ class RaftEngine:
                 "raft_queue_depth_high_water",
                 "max host write-queue depth observed", ("group",),
             ).set_max(len(self._queue), group="0")
+        if self._fused_driver is not None:
+            # pre-pack the completed batch into the device staging ring
+            # (client-side cost — the fused drain reads it by index)
+            self._fused_driver.on_submit()
         return seq
 
     def is_durable(self, seq: int) -> bool:
@@ -754,8 +799,19 @@ class RaftEngine:
                 raise ValueError(
                     f"payload must be exactly {cfg.entry_bytes} bytes"
                 )
-        seqs = [self.submit(p) for p in payloads]
+        # the pipelined path owns the queue wholesale from here on
+        # (swaps, re-queues, deferred splices): the staging mirror
+        # cannot track it. Detach the driver around the intake so the
+        # per-submit staging hook doesn't pay a device copy per batch
+        # that the reset below would immediately discard.
+        drv, self._fused_driver = self._fused_driver, None
+        try:
+            seqs = [self.submit(p) for p in payloads]
+        finally:
+            self._fused_driver = drv
         pending, self._queue = self._queue, []
+        if self._fused_driver is not None:
+            self._fused_driver.on_queue_replaced()
         # Configuration entries do not ride pipelined scans: a chunk would
         # keep committing batches beyond the entry under the stale member
         # mask. Stop the pipeline before the first config entry; the tick
@@ -1915,8 +1971,18 @@ class RaftEngine:
             self._push(ev.t, f"f:{base + i}", ev.replica)
 
     # ------------------------------------------------------------- event loop
-    def step_event(self) -> bool:
-        """Advance the clock to the next timer and handle it."""
+    def step_event(self, horizon: Optional[float] = None) -> bool:
+        """Advance the clock to the next timer and handle it.
+
+        ``horizon`` (set by ``run_for``) is the caller's drive window
+        end: with K-tick fusion enabled (``fuse_k > 1``), a popped
+        leader tick whose next K-1 successors provably fit before both
+        the horizon and the next non-ignorable heap event is handled as
+        ONE fused window (raft.steady.FusedDriver) instead of K
+        separate events. Without a horizon the engine cannot know how
+        far the caller meant to drive, so fusion never engages — every
+        direct ``step_event()`` caller sees the legacy one-tick-per-
+        event cadence unchanged."""
         if not self._q:
             return False
         hp = self.hostprof
@@ -1938,7 +2004,12 @@ class RaftEngine:
             elif tag == "c":
                 self._fire_candidate(r)
             elif tag == "l":
-                self._fire_leader_tick(r)
+                if not (
+                    self._fused_driver is not None
+                    and horizon is not None
+                    and self._fused_driver.fire(r, horizon)
+                ):
+                    self._fire_leader_tick(r)
             elif tag == "f":
                 ev = self._fault_events[int(gen)]
                 {
@@ -2079,8 +2150,8 @@ class RaftEngine:
         for _ in range(max_events):
             if not self._q or self._q[0][0] > end:
                 break
-            self.step_event()
-        self.clock.now = end
+            self.step_event(horizon=end)
+        self.clock.now = max(self.clock.now, end)
 
     def run_until_leader(self, limit: float = 600.0) -> int:
         end = self.clock.now + limit
@@ -2483,16 +2554,34 @@ class RaftEngine:
         ingested = int(info.frontier_len)
         if ingested:
             last = int(self._fetch(self.state.last_index)[r])  # post-ingest
-            for i, (seq, p) in enumerate(self._queue[:ingested]):
-                idx = last - ingested + 1 + i
-                self._seq_at_index[idx] = seq
-                self._uncommitted[idx] = (p, term)
-                self._note_config_ingest(idx, seq, term)
-                if self.spans is not None:
-                    self.spans.note_ingest(
-                        seq, idx, self.clock.now, self._tick_count
-                    )
+            base = last - ingested
+            chunk = self._queue[:ingested]
+            if self._config_seqs or self.spans is not None:
+                for i, (seq, p) in enumerate(chunk):
+                    idx = base + 1 + i
+                    self._seq_at_index[idx] = seq
+                    self._uncommitted[idx] = (p, term)
+                    self._note_config_ingest(idx, seq, term)
+                    if self.spans is not None:
+                        self.spans.note_ingest(
+                            seq, idx, self.clock.now, self._tick_count
+                        )
+            else:
+                # host_post micro-fix (docs/PERF.md attribution table):
+                # the per-entry seq→index mapping is two bulk dict
+                # updates instead of a Python loop with per-item index
+                # arithmetic — same mappings, ~5x less host time at the
+                # headline batch
+                self._seq_at_index.update(
+                    zip(range(base + 1, last + 1), (s for s, _ in chunk))
+                )
+                self._uncommitted.update(
+                    (base + 1 + i, (p, term))
+                    for i, (_, p) in enumerate(chunk)
+                )
             self._queue = self._queue[ingested:]
+            if self._fused_driver is not None:
+                self._fused_driver.on_consumed(ingested)
         self._advance_commit(r, int(info.commit_index))
         self._confirm_reads(r, term, eff, max_term)
         #   every successful tick round doubles as the §6.4 read
@@ -2546,6 +2635,9 @@ class RaftEngine:
             if ent is not None and seq is not None and i != cfg_idx:
                 requeue.append((seq, ent[0]))
         self._queue = requeue + self._queue
+        if self._fused_driver is not None:
+            # a prepend breaks the staging ring's queue mirror
+            self._fused_driver.on_queue_replaced()
         for q in range(self.cfg.rows):
             if int(lasts[q]) > cut:
                 self._ring_floor[q] = max(
@@ -2647,6 +2739,7 @@ class RaftEngine:
         durable seqs, archive to the checkpoint store, prune buffers."""
         if commit <= self.commit_watermark:
             return
+        old_wm = self.commit_watermark
         for idx in range(self.commit_watermark + 1, commit + 1):
             seq = self._seq_at_index.get(idx)
             if seq is not None and seq not in self.commit_time:
@@ -2686,10 +2779,13 @@ class RaftEngine:
                 self.roles[lead] = FOLLOWER
                 self.leader_id = None
                 self.nodelog(lead, "step down to follower (removed)")
-        for idx in [i for i in self._uncommitted if i <= commit]:
-            del self._uncommitted[idx]
-        for idx in [i for i in self._seq_at_index if i <= commit]:
-            del self._seq_at_index[idx]
+        # host_post micro-fix: prune by the known just-committed RANGE
+        # instead of scanning the whole dict per commit (both maps hold
+        # only indices above the previous watermark, all > old_wm, and
+        # anything <= commit is in [old_wm+1, commit] by construction)
+        for idx in range(old_wm + 1, commit + 1):
+            self._uncommitted.pop(idx, None)
+            self._seq_at_index.pop(idx, None)
         self._drain_apply()
 
     def _reset_heard_timers(self, r: int) -> None:

@@ -1,0 +1,651 @@
+"""The K-tick fused steady-state engine (ROADMAP item 2).
+
+The attribution leg (docs/PERF.md) measured the headline path host-bound:
+~2 µs of device time per tick buried under two orders of magnitude of
+host control plane — one undonated launch per heartbeat, plus per-entry
+``host_post`` bookkeeping costing 2.5× the device wait. Ongaro's
+dissertation treats the steady state (stable leader, no config change,
+every follower caught up) as the overwhelmingly common case, and that is
+exactly the case a compiler can own: this module fuses runs of K
+consecutive leader ticks into ONE compiled ``lax.scan`` launch
+(``core.step.fused_steady_scan``), escaping to the host only when a
+step's ``interesting`` mask fires or the staging buffer drains.
+
+Three pieces:
+
+- :class:`StagingRing` — the pre-packed DEVICE staging buffer. Client
+  submits flush full batches into a device-resident ring of untiled
+  payload words (one donated ``dynamic_update_slice`` per batch, paid on
+  the client's submit path), so the fused launch reads its windows by
+  ring index and the drain loop never pays the 16 MB/launch host→device
+  copy. The ring mirrors a queue suffix; any queue mutation other than
+  append / aligned pop-front invalidates it (``reset``), and the driver
+  re-stages lazily.
+- :class:`FusedDriver` — eligibility, window planning, pipelined
+  dispatch, and EXACT booking. Eligibility is a host-side proof that
+  nothing interesting CAN happen inside the window (stable routed
+  leader holding the cluster's highest term, verified steady, fully
+  committed, quorum of reachable non-slow voters, no config change in
+  flight, no fault/election event due in the window, fault-free
+  transport) — the device escape mask is the safety net for the cases
+  the proof missed, not the common path. Dispatch pipelines launch N+1
+  before booking launch N (``jax.block_until_ready`` only at the
+  booking boundary, hostprof marks kept faithful); the previous
+  launch's ``halted`` flag threads into the next as a DEVICE scalar, so
+  an unbooked escape turns every later launch into a provable no-op
+  chain instead of a divergence.
+- exact booking — the host replays each fused tick's control-plane
+  bookkeeping in order (virtual clock, timer re-arms with the SAME rng
+  draws, heap tiebreak counter, CheckQuorum lease, admission delay
+  observation, nodelog/metrics emissions) while the per-ENTRY work the
+  attribution table blamed (seq→index mapping, commit stamping, archive
+  puts, read-ticket confirmation) collapses into one vectorized pass
+  per launch: range-keyed commit stamps, a span-archived payload block
+  (``CheckpointStore.put_span``), and a single read-confirmation sweep.
+  The result is pinned byte-identical to the tick-at-a-time engine —
+  committed log, commit/submit stamps, rng stream, heap evolution, and
+  seeded chaos fingerprints all replay bit-exact with fusion on or off
+  (tests/test_fused_ticks.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax import lax
+
+#: shared staging-slot writer: one donated DUS per staged batch (shape-
+#: cached per (S, B, W) like any jit; process-wide so chaos restarts
+#: never recompile it)
+_STAGE_JIT = jax.jit(
+    lambda buf, words, slot: lax.dynamic_update_slice(
+        buf, words[None], (slot, jnp.int32(0), jnp.int32(0))
+    ),
+    donate_argnums=(0,),
+)
+
+
+class StagingRing:
+    """Device staging ring of untiled payload words, i32[S, B, W].
+
+    Mirrors the engine queue's aligned prefix: with ``consumed`` entries
+    popped since the last reset, absolute batch ``k`` (entries
+    ``[kB, (k+1)B)`` counted from the reset point) lives in slot
+    ``k % S`` once staged; the queue's head sits at absolute entry
+    ``consumed``. Full batches only — the window's trailing partial
+    batch drains through the ordinary tick path, which is also where
+    the fused window's "staging drained" escape hands control back.
+    """
+
+    def __init__(self, batch: int, words: int, slots: int):
+        self.B = batch
+        self.W = words
+        self.S = slots
+        self.buf = None          # jnp i32[S, B, W], allocated lazily
+        self.consumed = 0        # entries popped since reset
+        self.staged = 0          # absolute batches staged since reset
+
+    def _alloc(self) -> None:
+        if self.buf is None:
+            self.buf = jnp.zeros((self.S, self.B, self.W), jnp.int32)
+
+    def reset(self) -> None:
+        """The queue mutated in a way the mirror cannot track (prepend,
+        reorder, wholesale swap): drop the staged region. The buffer is
+        kept — re-staging overwrites slots."""
+        self.consumed = 0
+        self.staged = 0
+
+    def consume(self, n_entries: int, queue_len_after: int) -> None:
+        """``n_entries`` popped from the queue front. An empty queue
+        resets the frame for free (nothing staged is live), which also
+        heals any partial-batch misalignment a final short tick left."""
+        self.consumed += n_entries
+        if queue_len_after == 0:
+            self.reset()
+
+    def available_batches(self) -> int:
+        """Staged, unconsumed, alignment-verified batches from the
+        queue head (0 when the consume cursor sits mid-batch — the
+        driver then realigns via reset + top_up)."""
+        if self.consumed % self.B:
+            return 0
+        return max(self.staged - self.consumed // self.B, 0)
+
+    def free_slots(self) -> int:
+        return self.S - (self.staged - self.consumed // self.B)
+
+    def stage_tail(self, queue: List, entry_bytes: int,
+                   offset: int, count: int) -> None:
+        """Stage the queue's trailing PARTIAL batch (zero-padded) into
+        the next free slot for the window about to launch, WITHOUT
+        advancing the full-batch bookkeeping: the window consumes
+        through it (emptying the queue resets the frame) or escapes
+        (the next window rebuilds). ``offset`` is the queue position of
+        the tail's first entry."""
+        self._alloc()
+        chunk = queue[offset:offset + count]
+        words = np.zeros((self.B, self.W), np.int32)
+        words[:count] = np.frombuffer(
+            b"".join(p for _, p in chunk), np.uint8
+        ).reshape(count, entry_bytes).view(np.int32)
+        self.buf = _STAGE_JIT(
+            self.buf, words, jnp.int32(self.staged % self.S)
+        )
+
+    def top_up(self, queue: List, entry_bytes: int,
+               max_new: Optional[int] = None) -> int:
+        """Stage as many unstaged full batches as fit (bounded by
+        ``max_new`` — the submit hook stages at most the one batch the
+        arriving entry completed, keeping submit latency flat). Bytes
+        come straight from the queue's (seq, payload) tuples; the
+        host→device copy happens HERE, on the caller's (client) side of
+        the wall, which is the whole point of pre-packing."""
+        if self.consumed % self.B:
+            return 0
+        if self.staged * self.B < self.consumed:
+            # the tick path drained PAST the staged region (the ring
+            # filled and fusion stayed ineligible — faults armed, not
+            # steady — while ordinary ticks kept consuming): the frame
+            # fell behind and the next staged offset would be negative.
+            # Realign to the current queue head and re-stage from it.
+            self.reset()
+        self._alloc()
+        B = self.B
+        total = self.consumed + len(queue)
+        staged_new = 0
+        while (self.staged + 1) * B <= total and self.free_slots() > 0:
+            if max_new is not None and staged_new >= max_new:
+                break
+            lo = self.staged * B - self.consumed     # queue offset
+            chunk = queue[lo:lo + B]
+            words = np.frombuffer(
+                b"".join(p for _, p in chunk), np.uint8
+            ).reshape(B, entry_bytes).view(np.int32)
+            self.buf = _STAGE_JIT(
+                self.buf, words, jnp.int32(self.staged % self.S)
+            )
+            self.staged += 1
+            staged_new += 1
+        return staged_new
+
+
+class FusedDriver:
+    """Plans, dispatches, and books fused K-tick windows for one
+    :class:`~raft_tpu.raft.engine.RaftEngine` (see module doc)."""
+
+    #: minimum fused window: below 2 ticks the ordinary tick path is
+    #: strictly cheaper (no window planning, no staging checks)
+    MIN_TICKS = 2
+
+    def __init__(self, engine):
+        self.e = engine
+        cfg = engine.cfg
+        slots = max(4, min(2 * engine.fuse_k, 256))
+        self.staging = StagingRing(cfg.batch_size, cfg.shard_words, slots)
+        self._single_process = jax.process_count() == 1
+
+    # ------------------------------------------------------ engine hooks
+    def on_submit(self) -> None:
+        """A submit appended to the queue: stage the batch it completed
+        (if any) into the device ring — client-side cost, off the drain
+        wall."""
+        self.staging.top_up(self.e._queue, self.e.cfg.entry_bytes,
+                            max_new=1)
+
+    def on_consumed(self, n_entries: int) -> None:
+        self.staging.consume(n_entries, len(self.e._queue))
+
+    def on_queue_replaced(self) -> None:
+        self.staging.reset()
+
+    # ------------------------------------------------------- eligibility
+    def _heap_bound(self, r: int, eff: np.ndarray) -> float:
+        """Earliest heap event the fused window must NOT run past.
+        Ignorable (no-op pops or provably-restale-armed timers):
+
+        - stale-generation election/candidate timers (gen mismatch);
+        - election timers of rows the window's FIRST tick re-arms
+          (heard live member followers — any such timer is stale the
+          moment tick 1's re-arm bumps the generation, exactly as in
+          the tick-at-a-time run) and of rows whose pop is a no-op
+          (dead / non-member: ``_fire_follower`` returns before any
+          draw);
+        - candidate timers while no candidate exists (eligibility
+          guarantees none — the pop is a draw-free no-op);
+        - leader-tick events of rows not in the leader role (draw-free
+          no-op pops).
+
+        Everything else — fault-plan events, a live unreachable
+        member's election timer, unknown kinds — bounds the window.
+        """
+        e = self.e
+        bound = float("inf")
+        roles = e.roles
+        for (te, _seq, kind, row) in e._q:
+            tag, _, gen = kind.partition(":")
+            if tag in ("e", "c"):
+                if int(gen) != e._timer_gen[row]:
+                    continue                     # stale: no-op pop
+                if tag == "e" and (
+                    not e.alive[row] or not e.member[row]
+                    or (eff[row] and roles[row] == "follower"
+                        and row != r)
+                ):
+                    continue
+                if tag == "c" and roles[row] != "candidate":
+                    continue
+            elif tag == "l" and roles[row] != "leader":
+                continue
+            bound = min(bound, te)
+        return bound
+
+    # ------------------------------------------------------------- fire
+    def fire(self, r: int, horizon: float) -> bool:
+        """Handle the just-popped leader tick for ``r`` as a fused
+        window when the eligibility proof holds; False hands the tick
+        back to the ordinary ``_fire_leader_tick`` path untouched."""
+        e = self.e
+        cfg = e.cfg
+        if cfg.ec_enabled or cfg.mirror_check_every:
+            return False
+        if not self._single_process:
+            return False
+        fused = getattr(e.t, "replicate_fused", None)
+        if fused is None:
+            return False
+        ready = getattr(e.t, "fusion_ready", None)
+        if ready is not None and not ready():
+            return False
+        if (e.leader_id != r or e.roles[r] != "leader"
+                or not e.alive[r] or e.slow[r]):
+            return False
+        term = int(e.lead_terms[r])
+        if int(e.terms[r]) > term or int(e.terms.max()) > term:
+            return False
+        if any(p != r and e.roles[p] != "follower"
+               for p in range(cfg.rows)):
+            return False
+        if (e._staged_config or e._config_seqs
+                or e._pending_config is not None or e.learner.any()):
+            return False
+        if cfg.steady_dispatch == "off" or not e._steady:
+            return False
+        if e.admission is not None and e.admission.shedding:
+            # a shedding window's delay observations gate client-facing
+            # refusals tick by tick; keep that on the scrutable path
+            return False
+        lasts = e._pre_lasts()
+        if int(lasts[r]) != e.commit_watermark:
+            return False
+        eff = e._reach(r)
+        live_members = e.alive & e.member
+        if not eff[live_members].all():
+            return False
+        quorum = int(e.member.sum()) // 2 + 1
+        if int((eff & e.member & ~e.slow).sum()) < quorum:
+            return False
+        # window bound: horizon and the heap. The window covers the
+        # staged ingest PLUS trailing heartbeat ticks — the tick path
+        # fires those at the same instants regardless of backlog, so
+        # fusing them is faithful and amortises idle heartbeats too.
+        B = cfg.batch_size
+        q = len(e._queue)
+        t0 = e.clock.now
+        hb = cfg.heartbeat_period
+        bound = self._heap_bound(r, eff)
+        if bound <= t0:
+            return False
+        # Tick times are generated by the SAME incremental ``t + hb``
+        # chain the tick path's heap pushes use — a closed-form
+        # ``t0 + j*hb`` differs in the last float ulp, which would leak
+        # into commit stamps and heap times (exactness pin).
+        times = [t0]
+        tj = t0
+        while len(times) < 100_000:
+            tj = tj + hb
+            if tj > horizon or tj >= bound:
+                break
+            times.append(tj)
+        n = len(times)
+        if n < self.MIN_TICKS:
+            return False
+        # staging coverage for the ingest prefix (top up; rebuild when
+        # the mirror went stale — misaligned consume, post-failover)
+        st = self.staging
+        full_need = min(q // B, n)
+        if full_need:
+            st.top_up(e._queue, cfg.entry_bytes)
+            if st.available_batches() < full_need:
+                st.reset()
+                st.top_up(e._queue, cfg.entry_bytes)
+        full_b = min(full_need, st.available_batches()) if full_need else 0
+        counts = np.zeros(n, np.int32)
+        counts[:full_b] = B
+        tail = q - full_b * B
+        staged_tail = 0
+        if (0 < tail < B and full_b == q // B and full_b < n
+                and st.free_slots() > 0):
+            # the trailing partial batch rides the window's next tick
+            # (the free-slot check keeps it from clobbering a staged,
+            # unconsumed full batch when the ring is saturated)
+            st.stage_tail(e._queue, cfg.entry_bytes, full_b * B, tail)
+            counts[full_b] = tail
+            staged_tail = tail
+        if full_b * B + staged_tail < q:
+            # the staging ring does not cover the whole backlog: the
+            # window must END at its last covered ingest tick — a fused
+            # heartbeat where the tick path would have ingested is a
+            # divergence. The remainder drains via later windows.
+            n = full_b + (1 if staged_tail else 0)
+            if n < self.MIN_TICKS:
+                return False
+            counts = counts[:n]
+            times = times[:n]
+        st._alloc()   # a pure-heartbeat window still passes the ring
+        #               operand (count-0 steps mask its content away)
+        self._run_window(r, term, eff, times, counts)
+        return True
+
+    # ----------------------------------------------------------- window
+    def _run_window(self, r: int, term: int, eff: np.ndarray,
+                    times: List[float], counts: np.ndarray) -> None:
+        """Dispatch the planned window as a chain of power-of-two-sized
+        launches (≤ K ticks each; ``n_run`` masks a residual tail
+        inside the last launch so the compiled-program set stays at
+        ~log2(K) shapes) with the async pipeline: launch i+1 is
+        dispatched — carrying launch i's ``halted`` flag as an
+        unmaterialised device scalar — BEFORE launch i's booking blocks
+        on its outputs, so host booking overlaps device compute and
+        ``block_until_ready`` happens only at the booking boundary."""
+        e = self.e
+        cfg = e.cfg
+        hp = e.hostprof
+        st = self.staging
+        # terms of heard rows reach the leader's before anything books
+        # (they already hold it in the steady state; exact replay of
+        # the tick path's pre-commit durability fence)
+        e.terms[eff] = np.maximum(e.terms[eff], term)
+        e._persist_votes()
+        floor, fpt = e._floor_attest(r)
+        member_arg = e._member_arg()
+        eff_dev = jnp.asarray(eff)
+        slow_dev = jnp.asarray(e.slow)
+        lasts0 = np.asarray(e._pre_lasts()).copy()
+        if hp is not None:
+            hp.mark("host_pre")
+        n = len(counts)
+        win = _WindowBook(
+            self, r, term, eff, times, int(lasts0[r]), floor,
+        )
+        win.set_window(n)
+        halted = False
+        start_batch = st.consumed // st.B
+        prev = None
+        pos = 0
+        k = e.fuse_k
+        while pos < n:
+            left = n - pos
+            size = 1 << (min(left, k).bit_length() - 1)
+            if size < left and size * 2 <= k:
+                size *= 2                 # round UP: mask the tail with
+                #                           n_run instead of a 2nd launch
+            n_run = min(left, size)
+            cnt = np.zeros(size, np.int32)
+            cnt[:n_run] = counts[pos:pos + n_run]
+            out = e.t.replicate_fused(
+                e.state, st.buf, start_batch % st.S, jnp.asarray(cnt),
+                n_run, halted, r, term, eff_dev, slow_dev,
+                member=member_arg, repair_floor=floor,
+                floor_prev_term=fpt,
+                ring=e._dev_ring,
+            )
+            if e._dev_ring is not None:
+                (e.state, infos, escaped, ran, halted, e._dev_ring) = out
+            else:
+                e.state, infos, escaped, ran, halted = out
+            e.fused_launches += 1
+            if hp is not None:
+                hp.mark("dispatch")
+            if prev is not None:
+                win.book_launch(*prev)
+            prev = (infos, escaped, ran)
+            start_batch += n_run
+            pos += n_run
+        win.book_launch(*prev)
+        win.finish(lasts0)
+
+    # --------------------------------------------------------- plumbing
+    @property
+    def slots(self) -> int:
+        return self.staging.S
+
+
+class _WindowBook:
+    """EXACT host booking of one fused window: per-tick control-plane
+    replay (clock, rng draws, heap counter, leases, admission
+    observations, nodelog emissions) with the per-entry work vectorized
+    per launch — see the module doc. One instance spans the window's
+    pipelined launches."""
+
+    def __init__(self, driver: FusedDriver, r: int, term: int,
+                 eff: np.ndarray, times: List[float], last0: int,
+                 floor: int):
+        self.d = driver
+        self.r = r
+        self.term = term
+        self.eff = eff
+        self.times = times
+        self.last = last0           # leader last_index booked so far
+        self.floor = floor
+        self.g = 0                  # global tick index in the window
+        self.qpos = 0               # queue entries booked (consumed)
+        self.halted = False         # no later launch may book (it ran
+        #                             as a device no-op chain)
+        self.stepped_down = False
+        self.final_match = None
+        self.confirmed = False
+
+    # ---------------------------------------------------------- booking
+    def book_launch(self, infos, escaped, ran) -> None:
+        e = self.d.e
+        hp = e.hostprof
+        if self.halted:
+            # the halted flag was threaded into this launch on device:
+            # it ran as a no-op chain; there is nothing to book
+            return
+        if hp is not None:
+            hp.sync(infos.commit_index, escaped, ran)
+        ci = np.asarray(infos.commit_index)
+        fl = np.asarray(infos.frontier_len)
+        mt = np.asarray(infos.max_term)
+        match = np.asarray(infos.match)
+        esc = np.asarray(escaped)
+        rn = np.asarray(ran)
+        e._flush_device_obs()
+        n_run = int(rn.sum())
+        for j in range(n_run):
+            last_exec = (j == n_run - 1) and bool(esc[j])
+            self._book_tick(
+                int(ci[j]), int(fl[j]), int(mt[j]), match[j],
+                escape=last_exec,
+            )
+            if self.halted:
+                return
+        if n_run:
+            self.final_match = match[n_run - 1]
+
+    def _book_tick(self, commit: int, frontier: int, max_term: int,
+                   match: np.ndarray, escape: bool) -> None:
+        """Replay ONE fused tick's host bookkeeping, in the exact order
+        ``_fire_leader_tick`` performs it."""
+        d = self.d
+        e = d.e
+        cfg = e.cfg
+        r = self.r
+        term = self.term
+        hb = cfg.heartbeat_period
+        t_j = self.times[self.g]
+        e.clock.now = max(e.clock.now, t_j)
+        e._tick_count += 1
+        e.fused_ticks += 1
+        e._metric_inc("raft_heartbeat_ticks_total")
+        if cfg.check_quorum:
+            # the voter quorum is reachable by the eligibility proof:
+            # the lease renews exactly as the tick path's branch would
+            e._quorum_contact_at[r] = t_j
+        if e.admission is not None:
+            head_delay = 0.0
+            if self.qpos < len(e._queue):
+                head_seq = e._queue[self.qpos][0]
+                head_delay = t_j - e.submit_time.get(head_seq, t_j)
+            transition = e.admission.observe_delay(head_delay)
+            if transition == "shed_start":
+                e._nodelog_at(
+                    r, f"admission shedding ON (head delay "
+                    f"{head_delay:.1f}s >= target "
+                    f"{e.admission.target_delay_s:g}s for a full "
+                    f"interval)", e.commit_watermark, self.last,
+                )
+            elif transition == "shed_stop":
+                e._nodelog_at(
+                    r, "admission shedding OFF (delay back under "
+                    "target)", e.commit_watermark, self.last,
+                )
+        if self.g > 0 and e.recorder is not None:
+            # the tick path fires the repair_floor_raise event inside
+            # tick j's PRE-DISPATCH _floor_attest, computed from the
+            # previous tick's end-of-step last (_pre_lasts) — replay at
+            # the same position with the same value (tick 0's event was
+            # already fired by _run_window's own _floor_attest call)
+            self._replay_floor_event(self.last)
+        if escape and max_term > term:
+            # the step that surfaced a higher term: the tick path books
+            # NOTHING from it (no ingest mapping, no commit, no timer
+            # re-arm, no next-tick push, no steady update — the flag
+            # goes stale exactly as it would there, and the next
+            # election win resets it) and steps the leader down
+            self.g += 1
+            e._step_down_leader(r, max_term)
+            self.stepped_down = True
+            self.halted = True
+            return
+        chunk = e._queue[self.qpos:self.qpos + frontier]
+        new_last = self.last + frontier
+        if frontier and commit >= new_last:
+            # the whole batch committed inside its own tick — the
+            # steady common case: stamp + archive + watermark in one
+            # vectorized pass, skipping the _uncommitted/_seq_at_index
+            # round-trip entirely (the entries were never observable
+            # as uncommitted)
+            self._book_committed_batch(chunk, t_j, new_last, commit)
+        elif frontier:
+            # escape tick with a partial / uncommitted ingest: the slow
+            # path books exactly what the tick path would
+            for i, (seq, p) in enumerate(chunk):
+                idx = self.last + 1 + i
+                e._seq_at_index[idx] = seq
+                e._uncommitted[idx] = (p, term)
+                if e.spans is not None:
+                    e.spans.note_ingest(seq, idx, t_j, e._tick_count)
+            e._advance_commit(r, commit)
+        self.qpos += frontier
+        self.last = new_last
+        if escape:
+            # the tick path's _update_steady, replayed from this tick's
+            # verified match against the post-ingest leader tail
+            others = self.eff & ~e.slow
+            others[self.r] = False
+            e._steady = bool((match[others] >= new_last).all())
+        if not self.confirmed and max_term <= term:
+            e._confirm_reads(r, term, self.eff, max_term)
+            self.confirmed = True
+        e._reset_heard_timers(r)
+        self.g += 1
+        if escape or self.g == self._n_ticks:
+            # the LAST EXECUTED tick pushes the real next leader tick
+            e._push(t_j + hb, "l:x", r)
+        else:
+            # intermediate ticks' pushes are popped by the next fused
+            # tick: replay only the tiebreak counter the push+pop pair
+            # would have advanced
+            e._seq_events += 1
+        if escape:
+            self.halted = True   # window over: later launches ran as
+            #                      device no-op chains, nothing to book
+
+    def set_window(self, n_ticks: int) -> None:
+        self._n_ticks = n_ticks
+
+    def _book_committed_batch(self, chunk, t_j: float, new_last: int,
+                              commit: int) -> None:
+        e = self.d.e
+        r = self.r
+        term = self.term
+        n = len(chunk)
+        s0, sl = chunk[0][0], chunk[-1][0]
+        if (e.spans is None and e.metrics is None
+                and sl - s0 + 1 == n):
+            e.commit_time.update(dict.fromkeys(range(s0, sl + 1), t_j))
+        else:
+            for i, (seq, p) in enumerate(chunk):
+                e.commit_time[seq] = t_j
+                if e.spans is not None:
+                    e.spans.note_ingest(
+                        seq, new_last - n + 1 + i, t_j, e._tick_count
+                    )
+                    e.spans.note_commit(seq, t_j, e._tick_count)
+                if e.metrics is not None:
+                    e._metric_inc("raft_commits_total")
+                    e.metrics.histogram(
+                        "raft_commit_latency_seconds",
+                        "submit -> durable, virtual seconds", ("group",),
+                    ).observe(
+                        t_j - e.submit_time.get(seq, t_j), group="0",
+                    )
+        e.store.put_span(new_last - n + 1, chunk, term, pick=1)
+        e.commit_watermark = commit
+        e._nodelog_at(r, f"commit index changed to {commit}",
+                      commit, new_last, kind="commit")
+        e._drain_apply()
+
+    def _replay_floor_event(self, last: int) -> None:
+        """The tick path's ``_floor_attest`` records a recorder-only
+        event when the lap horizon raises the repair floor past the
+        high-water mark; replay it at the tick where it would fire."""
+        e = self.d.e
+        r = self.r
+        cap = e.state.capacity
+        lap = last - cap + 1
+        floor = max(int(e._ring_floor[r]), lap)
+        if floor > 1 and floor > e._floor_event_hwm.get(r, 0):
+            e._floor_event_hwm[r] = floor
+            e._record_event(
+                r, "repair_floor_raise", floor=floor, lap_horizon=lap,
+                ring_floor=int(e._ring_floor[r]),
+            )
+
+    # ------------------------------------------------------------ close
+    def finish(self, lasts0: np.ndarray) -> None:
+        """Window epilogue: consume the booked queue prefix, retire the
+        staging mirror, refresh the host snapshots, and re-derive the
+        steady flag from the final tick's verified match — all the
+        state the tick path maintains incrementally."""
+        d = self.d
+        e = d.e
+        if self.qpos:
+            e._queue = e._queue[self.qpos:]
+            d.staging.consume(self.qpos, len(e._queue))
+        e._note_truncations(lasts0)
+        if self.stepped_down:
+            return
+        if not self.halted and self.final_match is not None:
+            others = self.eff & ~e.slow
+            others[self.r] = False
+            e._steady = bool(
+                (self.final_match[others] >= self.last).all()
+            )

@@ -21,10 +21,43 @@ from raft_tpu.core.state import ReplicaState, init_state
 from raft_tpu.core.step import (
     RepInfo,
     VoteInfo,
+    fused_steady_scan,
     replicate_step,
     scan_replicate,
     vote_step,
 )
+
+#: process-wide fused K-tick program cache, keyed
+#: (rows, commit_quorum, member_mode, record): every transport instance
+#: over the same cluster shape shares ONE jitted program per launch
+#: size (jit caches per input shape), so chaos crash-restore cycles —
+#: which build a fresh transport per restart — never recompile the
+#: fused scan. Donation: the state pytree (and the event ring on the
+#: recorded variant) updates in place instead of round-tripping HBM.
+_FUSED_PROGRAMS: dict = {}
+
+
+def _fused_program(rows: int, commit_quorum, member_mode: bool,
+                   record: bool):
+    key = (rows, commit_quorum, member_mode, record)
+    if key not in _FUSED_PROGRAMS:
+        comm = SingleDeviceComm(rows)
+
+        def fn(state, staging, start_slot, counts, n_run, halted0,
+               leader, leader_term, alive, slow, fpt, rf, *rest):
+            member = rest[0] if member_mode else None
+            ring = rest[-1] if record else None
+            return fused_steady_scan(
+                comm, commit_quorum, state, staging, start_slot, counts,
+                n_run, halted0, leader, leader_term, alive, slow, fpt,
+                rf, member, ring=ring, record=record,
+            )
+
+        ring_arg = 12 + (1 if member_mode else 0)
+        _FUSED_PROGRAMS[key] = jax.jit(
+            fn, donate_argnums=(0,) + ((ring_arg,) if record else ()),
+        )
+    return _FUSED_PROGRAMS[key]
 
 
 class SingleDeviceTransport:
@@ -148,6 +181,35 @@ class SingleDeviceTransport:
         return self._replicate_many[bool(repair)](
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
             alive, slow, fpt, rf, term_floor=tf,
+        )
+
+    def replicate_fused(
+        self, state, staging, start_slot, counts, n_run, halted0,
+        leader, leader_term, alive, slow, member=None, repair_floor=0,
+        floor_prev_term=0, ring=None,
+    ):
+        """One K-tick fused steady-state launch (core.step.
+        fused_steady_scan): ``staging`` is the device staging ring
+        i32[S, B, W] of untiled payload words, ``start_slot``/``counts``
+        /``n_run`` select the window. The state pytree is DONATED (and
+        the event ring on the recorded variant) — the scan updates in
+        place; callers must treat the passed-in state as consumed.
+        Returns ``(state, infos, escaped, ran, halted[, ring])``."""
+        member_mode = self._member_mode
+        if member_mode and member is None:
+            member = jnp.ones(self.cfg.rows, bool)
+        prog = _fused_program(
+            self.cfg.rows, self.cfg.commit_quorum, member_mode,
+            ring is not None,
+        )
+        extra = (member,) if member_mode else ()
+        if ring is not None:
+            extra = extra + (ring,)
+        return prog(
+            state, staging, jnp.int32(start_slot), counts,
+            jnp.int32(n_run), jnp.asarray(halted0, bool),
+            jnp.int32(leader), jnp.int32(leader_term), alive, slow,
+            jnp.int32(floor_prev_term), jnp.int32(repair_floor), *extra,
         )
 
     def request_votes(

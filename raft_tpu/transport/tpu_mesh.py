@@ -37,6 +37,7 @@ from raft_tpu.core.state import ReplicaState, init_state
 from raft_tpu.core.step import (
     RepInfo,
     VoteInfo,
+    fused_steady_scan,
     replicate_step,
     scan_replicate,
     vote_step,
@@ -386,6 +387,82 @@ class TpuMeshTransport:
             state, payloads, counts, jnp.int32(leader), jnp.int32(leader_term),
             alive, slow, jnp.int32(floor_prev_term), jnp.int32(repair_floor),
             *extra,
+        )
+
+    def _fused_scan_program(self, record: bool):
+        """The K-tick fused steady-state scan over the mesh
+        (core.step.fused_steady_scan with MeshComm): the staging ring's
+        per-replica payload WORDS are exactly each device's local lane
+        block on a full-copy cluster, so the ring rides in replicated
+        over the replica axis (split over the payload axis when byte
+        sharding is on) and the per-device scan body consumes it with
+        no tile at all. Built lazily per record flag and cached with
+        the other fused-dispatch programs."""
+        key = ("fused_scan", record)
+        if key in self._fused:
+            return self._fused[key]
+        cfg = self.cfg
+        comm = self._comm
+        mm = self._member_mode
+
+        def fn(state, staging, start_slot, counts, n_run, halted0,
+               leader, lterm, alive, slow, fpt, rf, *rest):
+            member = rest[0] if mm else None
+            ring = rest[-1] if record else None
+            return fused_steady_scan(
+                comm, cfg.commit_quorum, state, staging, start_slot,
+                counts, n_run, halted0, leader, lterm, alive, slow,
+                fpt, rf, member, ring=ring, record=record,
+            )
+
+        stag_spec = (
+            P(None, None, PAYLOAD_AXIS) if self.payload_shards > 1
+            else P()
+        )
+        flag_specs = (P(), P(), P())        # escaped, ran, halted
+        extra_in = self._mem_spec
+        extra_out = ()
+        if record:
+            from raft_tpu.obs.device import EventRing
+
+            ring_specs = EventRing(buf=P(), count=P(), tick=P(),
+                                   counters=P())
+            extra_in = extra_in + (ring_specs,)
+            extra_out = (ring_specs,)
+        prog = jax.jit(
+            shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(
+                    self._state_specs, stag_spec,
+                    P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                ) + extra_in,
+                out_specs=(
+                    self._state_specs, self._info_specs,
+                ) + flag_specs + extra_out,
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+        self._fused[key] = prog
+        return prog
+
+    def replicate_fused(
+        self, state, staging, start_slot, counts, n_run, halted0,
+        leader, leader_term, alive, slow, member=None, repair_floor=0,
+        floor_prev_term=0, ring=None,
+    ):
+        """Same contract as ``SingleDeviceTransport.replicate_fused``
+        (state donated; returns ``(state, infos, escaped, ran,
+        halted[, ring])``), over the mesh."""
+        extra = (self._member_or_ones(member),) if self._member_mode else ()
+        if ring is not None:
+            extra = extra + (ring,)
+        return self._fused_scan_program(ring is not None)(
+            state, staging, jnp.int32(start_slot), counts,
+            jnp.int32(n_run), jnp.asarray(halted0, bool),
+            jnp.int32(leader), jnp.int32(leader_term), alive, slow,
+            jnp.int32(floor_prev_term), jnp.int32(repair_floor), *extra,
         )
 
     def replicate_pipeline(
