@@ -533,6 +533,58 @@ def bench_attribution() -> dict:
         "wall_us_per_tick_ring_off": round(wall_us_off, 3),
         "added_us_per_tick": round(wall_dev_us - wall_us_off, 3),
     }
+    # -- online safety/SLO plane (obs.audit + obs.slo + obs.serve) -----
+    # same drive loop with the WHOLE online plane attached: invariant
+    # audit per tick, per-commit SLO observation + burn evaluation, and
+    # the lock-free status publish — the acceptance contract is added
+    # wall <= 5% at this (headline) shape, with zero violations on a
+    # healthy cluster
+    from raft_tpu.obs.audit import SafetyAuditor
+    from raft_tpu.obs.serve import StatusBoard
+    from raft_tpu.obs.slo import SLObjective, SloTracker
+
+    # bracketed like the hostprof window: a fresh off-window on EACH
+    # side of the on-window, so allocator/dict drift accumulated this
+    # deep into the process is not misread as plane overhead
+    wall_po1, ev_po1, _ = drive_rounds(ROUNDS)
+    e.auditor = SafetyAuditor(
+        registry=e.metrics, max_entries=2 * cfg.log_capacity
+    )
+    e.slo = SloTracker(
+        objectives=(
+            SLObjective("commit_fast", "commit",
+                        threshold_s=2 * cfg.heartbeat_period),
+        ),
+        registry=e.metrics,
+    )
+    e.status_board = StatusBoard()
+    drive_rounds(2)                               # warm the plane's dicts
+    wall_onl, ev_onl, _ = drive_rounds(ROUNDS)
+    wall_onl_us = wall_onl / max(ev_onl, 1) * 1e6
+    auditor, slo_tracker, board = e.auditor, e.slo, e.status_board
+    e.auditor = e.slo = e.status_board = None
+    wall_po2, ev_po2, _ = drive_rounds(ROUNDS)
+    wall_plane_off = min(
+        wall_po1 / max(ev_po1, 1), wall_po2 / max(ev_po2, 1)
+    ) * 1e6
+    online_plane = {
+        "wall_us_per_tick_plane_on": round(wall_onl_us, 3),
+        "wall_us_per_tick_plane_off": round(wall_plane_off, 3),
+        "added_us_per_tick": round(wall_onl_us - wall_plane_off, 3),
+        "added_pct_of_wall": round(
+            (wall_onl_us - wall_plane_off) / wall_plane_off * 100, 2
+        ),
+        "audit_violations": auditor.total_violations,
+        "status_generations": board.generation,
+        "slo_commit_digest_n": (
+            slo_tracker.digests[("commit", None)].n
+            if ("commit", None) in slo_tracker.digests else 0
+        ),
+        "note": ("safety auditor + SLO tracker + status-board publish "
+                 "per tick; acceptance: added wall <= 5% at the "
+                 "headline shape, 0 violations on a healthy cluster"),
+    }
+
     device_obs_row = {
         "records": int(dev_records),
         "records_per_s": round(dev_records / max(wall_dev, 1e-9), 1),
@@ -564,6 +616,7 @@ def bench_attribution() -> dict:
         ),
         "device_ring": device_ring,
         "device_obs": device_obs_row,
+        "online_plane": online_plane,
         "metrics": e.metrics.to_json(),
         "note": ("columns_us are boundary-marked phases tiling each "
                  "step_event; their sum must land within 10% of "
@@ -1523,6 +1576,17 @@ def main(argv=None) -> None:
              "once exceeded, and the final combined JSON still prints "
              "(see _Deadline)",
     )
+    ap.add_argument(
+        "--compare", metavar="OLD.json", default=None,
+        help="after the run, diff this run's legs against a previous "
+             "bench artifact (raw stdout, BENCH_rNN wrapper, or bare "
+             "combined JSON — tools/bench_diff.py) and exit non-zero "
+             "if any gated metric regressed past --regress-threshold",
+    )
+    ap.add_argument(
+        "--regress-threshold", type=float, default=0.10,
+        help="fractional regression gate for --compare (default 0.10)",
+    )
     args = ap.parse_args(argv)
     dl = _Deadline(args.deadline_s)
 
@@ -1690,6 +1754,28 @@ def main(argv=None) -> None:
         out["deadline_s"] = dl.seconds
         out["deadline_skipped"] = dl.skipped
     print(json.dumps(out))
+
+    if args.compare:
+        # regression gate (tools/bench_diff.py): the delta table goes to
+        # stderr so stdout stays a clean JSON-lines stream for existing
+        # consumers; a gated regression past the threshold exits 1
+        import sys
+
+        from tools.bench_diff import (
+            _flatten_legs,
+            compare_runs,
+            format_table,
+            load_bench,
+        )
+
+        deltas, regressions = compare_runs(
+            load_bench(args.compare), _flatten_legs(out),
+            args.regress_threshold,
+        )
+        print(format_table(deltas, args.regress_threshold),
+              file=sys.stderr)
+        if regressions:
+            raise SystemExit(1)
 
 
 if __name__ == "__main__":

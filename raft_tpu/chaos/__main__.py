@@ -65,11 +65,25 @@ def main(argv=None) -> int:
                          "checks linearizable, the queue bound held, "
                          "AND goodput recovered inside the documented "
                          "window")
-    ap.add_argument("--broken", choices=["dirty_reads"], default=None,
-                    help="deliberately broken client variant; the run "
-                         "SUCCEEDS (exit 0) only if the checker rejects "
-                         "it — a passing broken run means the harness "
-                         "lost its teeth")
+    ap.add_argument("--broken", choices=["dirty_reads", "commit_rewind"],
+                    default=None,
+                    help="deliberately broken variant; the run SUCCEEDS "
+                         "(exit 0) only if the harness catches it — "
+                         "dirty_reads must be REJECTED by the offline "
+                         "checker, commit_rewind (acked commits lost by "
+                         "a lying storage layer; usually invisible to "
+                         "the checker) must trip the ONLINE safety "
+                         "auditor during the run (--audit is implied). "
+                         "A passing broken run means the harness lost "
+                         "its teeth")
+    ap.add_argument("--audit", action="store_true",
+                    help="attach the ONLINE safety plane: the "
+                         "obs.audit.SafetyAuditor invariant checks "
+                         "(one leader per term, monotone commit/terms, "
+                         "committed-prefix CRC, per-client monotone "
+                         "reads) plus the obs.slo.SloTracker burn-rate "
+                         "plane — determinism-neutral; violations are "
+                         "reported in the JSON result line")
     ap.add_argument("--observe", action="store_true",
                     help="attach the observability plane (flight "
                          "recorder, per-op spans, metrics registry) — "
@@ -157,7 +171,13 @@ def main(argv=None) -> int:
             )
         return 0 if ok else 1
 
-    expect = "VIOLATION" if args.broken else "LINEARIZABLE"
+    audit = args.audit or args.broken == "commit_rewind"
+    #   commit_rewind's whole point is a fault the offline checker
+    #   usually CANNOT see (no client-visible effect): the success
+    #   criterion is the online auditor tripping, so the audit plane is
+    #   implied on
+    expect = ("VIOLATION" if args.broken == "dirty_reads"
+              else "LINEARIZABLE")
     for seed in range(args.seed, args.seed + args.sweep):
         if args.multi:
             rep = torture_run_multi(
@@ -167,6 +187,7 @@ def main(argv=None) -> int:
                 step_budget=args.step_budget,
                 observe=args.observe,
                 observe_device=args.observe_device,
+                audit=audit,
                 bundle_dir=args.bundle_dir,
                 blackbox_dir=args.blackbox_dir,
             )
@@ -180,9 +201,14 @@ def main(argv=None) -> int:
                 step_budget=args.step_budget,
                 observe=args.observe,
                 observe_device=args.observe_device,
+                audit=audit,
                 bundle_dir=args.bundle_dir,
                 blackbox_dir=args.blackbox_dir,
             )
+        violations = (
+            rep.obs.audit.total_violations
+            if rep.obs is not None and rep.obs.audit is not None else None
+        )
         print(rep.summary())
         print(json.dumps({
             "seed": seed,
@@ -196,8 +222,14 @@ def main(argv=None) -> int:
             "open_loop_ops": rep.open_loop_ops,
             "membership_ops": rep.membership_ops,
             "checker_steps": rep.check.steps,
+            "audit_violations": violations,
         }), flush=True)
-        ok = ok and rep.verdict == expect
+        if args.broken == "commit_rewind":
+            ok = ok and bool(violations)
+        elif args.broken:
+            ok = ok and rep.verdict == expect
+        else:
+            ok = ok and rep.verdict == expect and not violations
     return 0 if ok else 1
 
 

@@ -266,16 +266,33 @@ class _TortureBase:
     OP_TIMEOUT_S = 90.0
 
     def __init__(self, seed, phases, clients, keys, phase_s,
-                 observe: bool = False, observe_device: bool = False):
+                 observe: bool = False, observe_device: bool = False,
+                 audit: bool = False):
         self.seed = seed
         self.phases = phases
         self.phase_s = phase_s
+        slo_objectives = None
+        if audit:
+            from raft_tpu.obs.slo import SLObjective
+
+            # a generic commit objective so the SLO plane evaluates
+            # burn rates during the run (alerts are passive events)
+            slo_objectives = (
+                SLObjective("commit_p99", "commit",
+                            threshold_s=10.0, target=0.99),
+            )
         self.obs: Optional[ObsStack] = (
-            ObsStack.build(device=observe_device)
-            if (observe or observe_device) else None
+            ObsStack.build(device=observe_device, audit=audit,
+                           slo_objectives=slo_objectives)
+            if (observe or observe_device or audit) else None
         )
         #   observe_device additionally attaches the device-resident
         #   plane (obs.device in-kernel rings); it implies observe.
+        #   audit additionally attaches the ONLINE safety plane
+        #   (obs.audit.SafetyAuditor + obs.slo.SloTracker); it also
+        #   implies observe. Both are determinism-neutral: every seeded
+        #   run replays byte-identically with them on or off (pinned by
+        #   tests/test_obs_plane.py and tests/test_audit.py).
         #   the observability plane (flight recorder + spans + metrics;
         #   docs/OBSERVABILITY.md). Recording is determinism-neutral:
         #   every seeded run replays byte-identically with it on or off
@@ -395,6 +412,20 @@ class _TortureBase:
         """Membership-plane housekeeping hook, called once per drive
         slice (wipe-replace rejoin timing — see _SingleTorture)."""
 
+    def pump_broken(self) -> None:
+        """Broken-variant hook, called once per drive slice (the
+        ``commit_rewind`` fault injection — see _SingleTorture)."""
+
+    def _audit_read(self, client: int, key: bytes,
+                    value, group=None) -> None:
+        """Report one SERVED read to the online auditor (no-op when the
+        audit plane is detached) — the serve-side half of the per-client
+        monotone-read watermark."""
+        obs = self.obs
+        if obs is not None and obs.audit is not None:
+            obs.audit.observe_read(client, key, value, self.now(),
+                                   group=group)
+
     def membership_view(self) -> Optional[MembershipView]:
         """The nemesis's configuration snapshot; None = plane disabled
         (the default — membership kinds never enter the choice pool)."""
@@ -421,6 +452,7 @@ class _TortureBase:
                 self.pump_open_loop(self.phase_s / 4)
                 self.drive(self.phase_s / 4)
                 self.pump_membership()
+                self.pump_broken()
                 self._poll_all()
                 self._invoke_idle()
         blackbox.mark("quiesce", t_virtual=round(self.now(), 3),
@@ -446,10 +478,16 @@ def torture_run(
     step_budget: int = 500_000,
     observe: bool = False,
     observe_device: bool = False,
+    audit: bool = False,
     bundle_dir: Optional[str] = None,
     blackbox_dir: Optional[str] = None,
 ) -> TortureReport:
     """One full single-engine torture run; see module docstring.
+    ``audit=True`` attaches the ONLINE safety plane — the
+    ``obs.audit.SafetyAuditor`` invariant checks plus the
+    ``obs.slo.SloTracker`` latency/burn-rate plane (implies observe;
+    determinism-neutral, pinned) — reachable afterwards as
+    ``report.obs.audit`` / ``report.obs.slo``.
     ``overload=True`` arms admission (``_overload_cfg`` unless ``cfg``
     is given) and lets the nemesis open 2-10x open-loop arrival
     windows, composable with every other fault plane.
@@ -477,7 +515,7 @@ def torture_run(
         run = _SingleTorture(
             seed, phases, clients, keys, phase_s,
             cfg or base, workdir, broken, membership=membership,
-            observe=observe, observe_device=observe_device,
+            observe=observe, observe_device=observe_device, audit=audit,
         )
         nemesis = Nemesis(
             seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
@@ -502,6 +540,8 @@ def torture_run(
         flags.append("--overload")
     if membership:
         flags.append("--membership")
+    if audit:
+        flags.append("--audit")
     repro = (
         f"python -m raft_tpu.chaos --seed {seed} --phases {phases} "
         f"--clients {clients} --keys {keys} --phase-s {phase_s:g}"
@@ -558,9 +598,11 @@ def _maybe_bundle(
 class _SingleTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg,
                  workdir, broken, membership: bool = False,
-                 observe: bool = False, observe_device: bool = False):
+                 observe: bool = False, observe_device: bool = False,
+                 audit: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s,
-                         observe=observe, observe_device=observe_device)
+                         observe=observe, observe_device=observe_device,
+                         audit=audit)
         from raft_tpu.transport.device import SingleDeviceTransport
 
         self.cfg = cfg
@@ -581,6 +623,9 @@ class _SingleTorture(_TortureBase):
         self.chaos_t = ChaosTransport(SingleDeviceTransport(cfg), seed)
         self._msg_params = None
         self.partitioned = False
+        self._broken_rng = random.Random(f"broken:{seed}")
+        #   the commit_rewind variant's own seeded stream (deterministic
+        #   fault timing independent of the workload draws)
         self._boot_fresh()
         # dirty-read oracle for the broken variant: key -> last value
         # SUBMITTED (not committed) — exactly the cache a naive server
@@ -599,7 +644,26 @@ class _SingleTorture(_TortureBase):
         if self.obs is not None:
             self.obs.attach(self.engine)
         self.kv = ReplicatedKV(self.engine)
+        self._register_audit_apply()
         self.engine.run_until_leader()
+
+    def _register_audit_apply(self) -> None:
+        """With the online audit plane attached, feed every applied KV
+        op to the auditor (value -> applied index per key — the lookup
+        table the serve-side read audits consult). Registered AFTER the
+        KV store so serve order matches apply order."""
+        if self.obs is None or self.obs.audit is None:
+            return
+        from raft_tpu.examples.kv import decode_op
+
+        auditor = self.obs.audit
+
+        def _feed(idx: int, payload: bytes) -> None:
+            op, key, value = decode_op(payload)
+            if op:
+                auditor.note_apply(key, idx, value)
+
+        self.engine.register_apply(_feed)
 
     def _restart(self) -> None:
         from raft_tpu.examples.kv import ReplicatedKV
@@ -633,6 +697,7 @@ class _SingleTorture(_TortureBase):
         # history clock (heap entries armed below t0 simply fire "now")
         self.engine.clock.now = t0
         self.kv = ReplicatedKV(self.engine, replay=True)
+        self._register_audit_apply()
         if self._msg_params is not None:
             self.chaos_t.set_message_faults(*self._msg_params)
         self.partitioned = False
@@ -696,6 +761,12 @@ class _SingleTorture(_TortureBase):
         return self.engine.is_durable(handle)
 
     def commit_digest(self) -> str:
+        # Composed from per-entry payload CRCs (idx : term : crc32 of
+        # bytes) so the online auditor can reproduce the identical
+        # digest from its own incremental records
+        # (SafetyAuditor.commit_digest — the cross-check pinned by
+        # tests/test_audit.py). Same coverage as before: the archive's
+        # contiguous tail below the watermark.
         e = self.engine
         wm = int(e.commit_watermark)
         crc = zlib.crc32(f"wm:{wm}".encode())
@@ -704,9 +775,37 @@ class _SingleTorture(_TortureBase):
                 ent = e.store.get(idx)
                 if ent is not None:
                     crc = zlib.crc32(
-                        ent[0], zlib.crc32(f"{idx}:{ent[1]}".encode(), crc)
+                        f"{idx}:{ent[1]}:{zlib.crc32(ent[0]):08x}"
+                        .encode(),
+                        crc,
                     )
         return f"{crc:08x}"
+
+    def pump_broken(self) -> None:
+        """The broken-COMMIT variant (``broken="commit_rewind"``): a
+        server whose storage layer silently loses acknowledged commits
+        — the commit watermark rewinds by up to a batch and the rewound
+        entries' durability stamps vanish, as if an fsync had lied.
+        The device log is untouched, so the watermark re-advances on
+        the next tick and applied state stays consistent: the OFFLINE
+        checker usually cannot see this fault at all (no client-visible
+        read serves the regression), which is exactly the
+        falsifiability point — the ONLINE auditor's commit-monotonicity
+        watermark must trip DURING the run
+        (tests/test_audit.py::test_commit_rewind_trips_auditor_online)."""
+        if self.broken != "commit_rewind":
+            return
+        e = self.engine
+        if self._broken_rng.random() > 0.5 or e.commit_watermark < 4:
+            return
+        k = self._broken_rng.randint(1, min(self.cfg.batch_size,
+                                            e.commit_watermark - 1))
+        e.commit_watermark -= k
+        # the "lost" acks: drop the newest k durability stamps (dict
+        # order is stamp order) — the durability API now denies entries
+        # it already acknowledged, the broken half the auditor flags
+        for seq in list(e.commit_time)[-k:]:
+            del e.commit_time[seq]
 
     def invoke(self, cl: _Client) -> None:
         from raft_tpu.raft.engine import LinearizableReadRefused
@@ -726,6 +825,7 @@ class _SingleTorture(_TortureBase):
                     value = self._dirty[key]
                 else:
                     value = self.kv.get(key)
+                self._audit_read(cl.cid, key, value)
                 cl.rec.ok(self.history.stamp(self.now()), value)
                 cl.rec = None
                 return
@@ -776,7 +876,9 @@ class _SingleTorture(_TortureBase):
                 cl.ticket = ("applied", idx)
             if self.kv.last_applied < idx:
                 return
-            rec.ok(self.history.stamp(self.now()), self.kv.get(rec.key))
+            value = self.kv.get(rec.key)
+            self._audit_read(cl.cid, rec.key, value)
+            rec.ok(self.history.stamp(self.now()), value)
             cl.rec, cl.ticket = None, None
             return
         if self.engine.is_durable(cl.seq):
@@ -983,6 +1085,7 @@ def torture_run_multi(
     step_budget: int = 500_000,
     observe: bool = False,
     observe_device: bool = False,
+    audit: bool = False,
     bundle_dir: Optional[str] = None,
     blackbox_dir: Optional[str] = None,
 ) -> TortureReport:
@@ -1002,7 +1105,7 @@ def torture_run_multi(
         run = _MultiTorture(
             seed, phases, clients, keys, phase_s, cfg, n_groups,
             overload=overload, observe=observe,
-            observe_device=observe_device,
+            observe_device=observe_device, audit=audit,
         )
         nemesis = Nemesis(
             seed, run.cfg.n_replicas, allow_crash=False, allow_msg=False,
@@ -1037,9 +1140,10 @@ def torture_run_multi(
 class _MultiTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg, n_groups,
                  overload: bool = False, observe: bool = False,
-                 observe_device: bool = False):
+                 observe_device: bool = False, audit: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s,
-                         observe=observe, observe_device=observe_device)
+                         observe=observe, observe_device=observe_device,
+                         audit=audit)
         from raft_tpu.examples.kv_sharded import ShardedKV
         from raft_tpu.multi.engine import MultiEngine
         from raft_tpu.multi.router import Router
@@ -1056,6 +1160,9 @@ class _MultiTorture(_TortureBase):
         )
         if obs is not None:
             self.engine.metrics = obs.registry
+            if obs.audit is not None:
+                self.engine.auditor = obs.audit
+                self.engine.slo = obs.slo
             if obs.device is not None:
                 self.engine.attach_device_obs(obs.device)
         self.engine.seed_leaders()
@@ -1066,6 +1173,20 @@ class _MultiTorture(_TortureBase):
         #   is SHED (fail, no effect) — retrying it would re-close the
         #   loop the overload model exists to open
         self.kv = ShardedKV(self.engine, self.router)
+        if obs is not None and obs.audit is not None:
+            from raft_tpu.examples.kv import decode_op
+
+            auditor = obs.audit
+
+            def _make_feed(g: int):
+                def _feed(idx: int, payload: bytes) -> None:
+                    op, key, value = decode_op(payload)
+                    if op:
+                        auditor.note_apply(key, idx, value, group=g)
+                return _feed
+
+            for g in range(self.engine.G):
+                self.engine.register_apply(g, _make_feed(g))
         self.partitioned = False
         self._part_group: Optional[int] = None
         self.nem_rng = random.Random(f"multi-nemesis:{seed}")
@@ -1160,7 +1281,9 @@ class _MultiTorture(_TortureBase):
                 if self.kv.last_applied[g] < idx:
                     cl.rec.fail(self.history.stamp(self.now()))   # apply lag: no value served
                 else:
-                    cl.rec.ok(self.history.stamp(self.now()), self.kv.get(key))
+                    value = self.kv.get(key)
+                    self._audit_read(cl.cid, key, value, group=g)
+                    cl.rec.ok(self.history.stamp(self.now()), value)
                 cl.rec = None
                 return
             with self._ambient_span(cl.rec):

@@ -49,6 +49,20 @@ def encode_op(entry_bytes: int, op: int, key: bytes, value: bytes) -> bytes:
     return body + bytes(entry_bytes - len(body))
 
 
+def decode_op(payload: bytes):
+    """Decode one log entry back into ``(op, key, value)`` — ``(0, b"",
+    None)`` for padding/heartbeat entries, ``value=None`` for deletes.
+    The read-audit feed (``obs.audit``) uses this to map applied entries
+    to per-key values without re-implementing the wire format."""
+    op, klen, vlen = _HDR.unpack_from(payload)
+    if op not in (_SET, _DELETE):
+        return 0, b"", None
+    key = payload[_HDR.size:_HDR.size + klen]
+    if op == _DELETE:
+        return op, key, None
+    return op, key, payload[_HDR.size + klen:_HDR.size + klen + vlen]
+
+
 def apply_op(data: Dict[bytes, bytes], payload: bytes) -> None:
     """Apply one committed entry to a dict state machine (op 0 =
     padding/heartbeat: ignore)."""

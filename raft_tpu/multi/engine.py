@@ -201,6 +201,17 @@ class MultiEngine:
         #   batched launch serves several groups at once, so each phase
         #   observation is recorded once per participating group label
         #   (the launch is shared; the group axis is what amortizes it).
+        self.auditor = None
+        #   obs.audit.SafetyAuditor (None = off): the online safety
+        #   plane, per-group — election wins, commit advances, archive
+        #   feeds and tick boundaries audited from host mirrors (zero
+        #   device syncs; docs/OBSERVABILITY.md "Online plane").
+        self.slo = None
+        #   obs.slo.SloTracker (None = off): per-group commit/queue-
+        #   delay latency digests + burn-rate SLO evaluation.
+        self.status_board = None
+        #   obs.serve.StatusBoard (None = off): immutable per-flush
+        #   status snapshot for the ops HTTP endpoint (obs.serve).
         self.device_obs = None
         #   obs.device.DeviceObs (None = off): device-resident event
         #   rings, one per group (vmapped alongside the state), flushed
@@ -250,7 +261,12 @@ class MultiEngine:
         #   idx -> committed payload bytes, per group — the apply stream's
         #   source and the differential tests' read surface. Unbounded by
         #   design at this layer (a production deployment snapshots +
-        #   truncates, as the single engine's CheckpointStore does).
+        #   truncates, as the single engine's CheckpointStore does) —
+        #   and the per-group commit/submit stamp dicts share that
+        #   scope: the floor-aware stamp eviction lives on the single
+        #   engine (RaftEngine._evict_commit_stamps), whose archive
+        #   actually compacts; bounding stamps here without bounding
+        #   the archive would not bound the layer's memory.
         self.submit_time: List[Dict[int, float]] = [{} for _ in range(n_groups)]
         self.commit_time: List[Dict[int, float]] = [{} for _ in range(n_groups)]
         self._apply_fns: List[List[Callable[[int, bytes], None]]] = [
@@ -633,6 +649,76 @@ class MultiEngine:
         consecutive such instants additionally fuse into ONE K-tick
         launch shared by every ticking group (``_fire_fused_window``)
         whenever the window provably contains nothing but those ticks."""
+        fired = self._step_event_inner(horizon)
+        if fired:
+            # online plane (docs/OBSERVABILITY.md "Online plane"):
+            # per-flush invariant scan + SLO evaluation + status
+            # publish, all from host mirrors — three None checks when
+            # detached, zero device syncs either way
+            if self.auditor is not None:
+                t = self.clock.now
+                for g in range(self.G):
+                    self.auditor.note_state(
+                        self.terms[g], int(self.commit_watermark[g]), t,
+                        group=g, node_prefix=f"g{g}/Server",
+                    )
+            if self.slo is not None:
+                self.slo.maybe_evaluate(self.clock.now)
+            if self.status_board is not None:
+                self.status_board.publish(self._status_snapshot())
+        return fired
+
+    def _status_snapshot(self) -> dict:
+        """The ``/status`` snapshot (obs.serve), host mirrors only:
+        per-group leader map, term/commit/applied watermarks,
+        replication lag and queue depths."""
+        snap = {
+            "t_virtual": self.clock.now,
+            "groups": self.G,
+            "leaders": {
+                str(g): (
+                    {
+                        "replica": self.leader_id[g],
+                        "term": int(
+                            self.lead_terms[g, self.leader_id[g]]
+                        ),
+                    }
+                    if self.leader_id[g] is not None else None
+                )
+                for g in range(self.G)
+            },
+            "terms": {
+                str(g): [int(x) for x in self.terms[g]]
+                for g in range(self.G)
+            },
+            "commit_watermark": {
+                str(g): int(self.commit_watermark[g])
+                for g in range(self.G)
+            },
+            "applied_index": {
+                str(g): int(self.applied_index[g])
+                for g in range(self.G)
+            },
+            "replication_lag": {
+                str(g): len(self._seq_at_index[g])
+                for g in range(self.G)
+            },
+            "queue_depth": {
+                str(g): len(self._queue[g]) for g in range(self.G)
+            },
+            "leader_spread": {
+                str(r): n for r, n in self.leader_spread().items()
+            },
+            "fused": {
+                "launches": self.fused_launches,
+                "ticks": self.fused_ticks,
+            },
+        }
+        if self.auditor is not None:
+            snap["audit"] = self.auditor.summary()
+        return snap
+
+    def _step_event_inner(self, horizon: Optional[float] = None) -> bool:
         if not self._q:
             return False
         hp = self.hostprof
@@ -778,6 +864,11 @@ class MultiEngine:
                         self.roles[g][p] = FOLLOWER
                         self._arm_follower(g, p)
                 self.nodelog(g, r, "state changed to leader")
+                if self.auditor is not None:
+                    self.auditor.note_elect(
+                        f"g{g}/Server{r}", cand_term, self.clock.now,
+                        group=g,
+                    )
                 self._metric_inc(g, "raft_elections_total")
                 self._push(self.clock.now, "l", g, r)
             else:
@@ -1101,6 +1192,17 @@ class MultiEngine:
                 overflow.append((g, r))
                 continue
             routed = self.leader_id[g] == r
+            if routed and self.slo is not None:
+                # head-of-queue sojourn, the same value the single
+                # engine's delay controller observes per tick
+                hd = 0.0
+                if self._queue[g]:
+                    hd = self.clock.now - self.submit_time[g].get(
+                        self._queue[g][0][0], self.clock.now
+                    )
+                self.slo.observe(
+                    "queue_delay", hd, self.clock.now, group=g
+                )
             take = min(len(self._queue[g]), B) if routed else 0
             data = None
             if take:
@@ -1185,8 +1287,20 @@ class MultiEngine:
                         ),
                         group=str(g),
                     )
+                if self.slo is not None:
+                    self.slo.observe(
+                        "commit",
+                        self.clock.now - self.submit_time[g].get(
+                            seq, self.clock.now
+                        ),
+                        self.clock.now, group=g,
+                    )
         self._archive_committed(g, leader, wm + 1, commit)
         self.commit_watermark[g] = commit
+        if self.auditor is not None:
+            # entries were fed (with their real terms) inside
+            # _archive_committed, where the term evidence lives
+            self.auditor.note_commit(commit, self.clock.now, group=g)
         if at_last is None:
             self.nodelog(g, leader, f"commit index changed to {commit}")
         else:
@@ -1220,32 +1334,48 @@ class MultiEngine:
         leader's device ring (the just-committed window is inside the
         ring by construction)."""
         term_now = int(self.lead_terms[g, leader])
+        aud = self.auditor
+        fed = [] if aud is not None else None
         pend = []
         for idx in range(lo, hi + 1):
             ent = self._uncommitted[g].get(idx)
             if ent is not None and ent[1] == term_now:
                 self._archive[g][idx] = ent[0]
+                if fed is not None:
+                    fed.append((idx, ent[0], term_now))
             else:
                 pend.append(idx)
-        if not pend:
-            return
-        cap = self.cfg.log_capacity
-        plo, phi = min(pend), max(pend)
-        slots = (np.arange(plo, phi + 1) - 1) % cap
-        lead_terms = np.asarray(self.state.log_term[g, leader])[slots]
-        missing = []
-        for idx in pend:
-            ent = self._uncommitted[g].get(idx)
-            if ent is not None and ent[1] == int(lead_terms[idx - plo]):
-                self._archive[g][idx] = ent[0]
-            else:
-                missing.append(idx)
-        if not missing:
-            return
-        mlo, mhi = min(missing), max(missing)
-        data = log_entries(group_view(self.state, g), leader, mlo, mhi)
-        for idx in missing:
-            self._archive[g][idx] = data[idx - mlo].tobytes()
+        if pend:
+            cap = self.cfg.log_capacity
+            plo, phi = min(pend), max(pend)
+            slots = (np.arange(plo, phi + 1) - 1) % cap
+            lead_terms = np.asarray(self.state.log_term[g, leader])[slots]
+            missing = []
+            for idx in pend:
+                ent = self._uncommitted[g].get(idx)
+                if ent is not None and ent[1] == int(lead_terms[idx - plo]):
+                    self._archive[g][idx] = ent[0]
+                    if fed is not None:
+                        fed.append((idx, ent[0], ent[1]))
+                else:
+                    missing.append(idx)
+            if missing:
+                mlo, mhi = min(missing), max(missing)
+                data = log_entries(group_view(self.state, g), leader,
+                                   mlo, mhi)
+                for idx in missing:
+                    payload = data[idx - mlo].tobytes()
+                    self._archive[g][idx] = payload
+                    if fed is not None:
+                        fed.append((
+                            idx, payload, int(lead_terms[idx - plo]),
+                        ))
+        if fed:
+            # per-group committed-prefix feed WITH real term evidence
+            # (the archive dict keeps bytes only); sorted so the bulk
+            # run detection sees ascending indices
+            fed.sort()
+            aud.note_entries(fed, self.clock.now, group=g)
 
     # ---------------------------------------------------- state machine
     def register_apply(

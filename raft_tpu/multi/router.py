@@ -92,13 +92,16 @@ class Router:
             )
             for g in range(engine.G)
         ]
+        self._breaker_states = ["closed"] * engine.G
 
     def _breaker_transition(self, g: int):
         """Breaker open/half_open/close transitions into the engine's
         flight recorder (a previously-silent client-side plane). Bound
         lazily so a recorder attached after construction still sees
         them; the engine clock stamps the event (breaker success paths
-        carry no timestamp of their own)."""
+        carry no timestamp of their own). With a status board attached
+        to the engine (obs.serve), the per-group breaker states also
+        publish as the ``breakers`` section of ``/status``."""
         def _note(state: str, _now: float, g=g) -> None:
             rec = getattr(self.engine, "recorder", None)
             if rec is not None:
@@ -106,6 +109,14 @@ class Router:
                     node=f"g{g}/client", group=g, term=-1,
                     kind=f"breaker_{state}",
                     t_virtual=self.engine.clock.now, state="client",
+                )
+            self._breaker_states[g] = state
+            board = getattr(self.engine, "status_board", None)
+            if board is not None:
+                board.publish(
+                    {str(gg): s
+                     for gg, s in enumerate(self._breaker_states)},
+                    section="breakers",
                 )
             sp = self.spans.current if self.spans is not None else None
             if sp is not None:

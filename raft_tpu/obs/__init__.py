@@ -33,6 +33,18 @@ differential-test join key between the golden model, the engine, and
   launch boundaries into byte-compatible ``Event`` objects. The trace
   rides inside the compiled program, so the coming K-tick scan fusion
   (ROADMAP item 2) keeps full visibility.
+- ``audit``     — the ONLINE safety plane: an incremental
+  ``SafetyAuditor`` checking Raft invariants per tick/launch (one
+  leader per term, monotone commit/terms, committed-prefix CRC
+  immutability, per-client monotone-read watermarks) while the run is
+  still going — typed ``AuditViolation`` events, never post-hoc only.
+- ``slo``       — streaming log-bucket latency digests (mergeable
+  across groups) + per-group SLO objectives with multi-window
+  burn-rate evaluation and typed ``SloAlert`` events.
+- ``serve``     — the live ops surface: a lock-free ``StatusBoard``
+  snapshot the engines publish at flush boundaries, served by a
+  stdlib-HTTP ``OpsServer`` (``/metrics`` ``/healthz`` ``/slo``
+  ``/status``; ``python -m raft_tpu.obs --serve``).
 """
 
 from raft_tpu.obs import blackbox
@@ -51,6 +63,7 @@ from raft_tpu.obs.blackbox import (
     explain_stall,
     read_journal,
 )
+from raft_tpu.obs.audit import AuditViolation, SafetyAuditor
 from raft_tpu.obs.events import Event, FlightRecorder, kind_of
 from raft_tpu.obs.forensics import (
     ObsStack,
@@ -61,22 +74,37 @@ from raft_tpu.obs.forensics import (
 from raft_tpu.obs.hostprof import HostProfiler
 from raft_tpu.obs.metrics import LatencySummary, summarize_engine
 from raft_tpu.obs.registry import MetricsRegistry, parse_prometheus
+from raft_tpu.obs.serve import OpsServer, StatusBoard, serve_demo
+from raft_tpu.obs.slo import (
+    LatencyDigest,
+    SLObjective,
+    SloAlert,
+    SloTracker,
+)
 from raft_tpu.obs.spans import Span, SpanTracker
 from raft_tpu.obs.trace import TraceRecord, TraceRecorder
 
 __all__ = [
+    "AuditViolation",
     "BlackboxJournal",
     "DeviceObs",
     "Event",
     "EventRing",
     "FlightRecorder",
     "HostProfiler",
+    "LatencyDigest",
     "LatencySummary",
     "MetricsRegistry",
     "ObsStack",
+    "OpsServer",
+    "SLObjective",
+    "SafetyAuditor",
+    "SloAlert",
+    "SloTracker",
     "Span",
     "SpanTracker",
     "StallWatchdog",
+    "StatusBoard",
     "TraceRecord",
     "TraceRecorder",
     "blackbox",
@@ -91,6 +119,7 @@ __all__ = [
     "merged_timeline",
     "parse_prometheus",
     "read_journal",
+    "serve_demo",
     "summarize_engine",
     "write_bundle",
 ]

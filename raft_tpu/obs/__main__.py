@@ -142,13 +142,37 @@ def main(argv: Optional[list] = None) -> int:
                    help="bundle span table -> Chrome/Perfetto trace JSON")
     g.add_argument("--metrics-dump", metavar="BUNDLE",
                    help="bundle metrics snapshot -> Prometheus text")
+    g.add_argument("--serve", action="store_true",
+                   help="boot a demo MultiEngine with the full online "
+                        "plane attached (metrics registry, SLO tracker, "
+                        "safety auditor, status board) and serve the ops "
+                        "endpoints /metrics /healthz /slo /status while "
+                        "driving synthetic traffic (Ctrl-C to stop)")
     ap.add_argument("-o", "--output", default=None,
                     help="output file (default stdout)")
     ap.add_argument("--json", action="store_true",
                     help="with --metrics-dump: raw JSON snapshot instead "
                          "of Prometheus text")
+    ap.add_argument("--port", type=int, default=8900,
+                    help="with --serve: TCP port to bind (0 = ephemeral; "
+                         "default 8900)")
+    ap.add_argument("--serve-groups", type=int, default=4,
+                    help="with --serve: number of demo Raft groups")
+    ap.add_argument("--serve-duration", type=float, default=None,
+                    metavar="S",
+                    help="with --serve: stop after S wall seconds "
+                         "(default: run until Ctrl-C)")
     args = ap.parse_args(argv)
 
+    if args.serve:
+        from raft_tpu.obs.serve import serve_demo
+
+        result = serve_demo(
+            port=args.port, groups=args.serve_groups,
+            duration_s=args.serve_duration,
+        )
+        print(json.dumps(result))
+        return 0
     if args.explain:
         text = _explain_any(args.explain)
     elif args.render_perfetto:

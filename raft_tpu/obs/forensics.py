@@ -43,10 +43,12 @@ class ObsStack:
     spans: Any
     registry: Any
     device: Any = None
+    audit: Any = None          # obs.audit.SafetyAuditor (online plane)
+    slo: Any = None            # obs.slo.SloTracker (online plane)
 
     @classmethod
-    def build(cls, capacity: int = 65536,
-              device: bool = False) -> "ObsStack":
+    def build(cls, capacity: int = 65536, device: bool = False,
+              audit: bool = False, slo_objectives=None) -> "ObsStack":
         from raft_tpu.obs.events import FlightRecorder
         from raft_tpu.obs.registry import MetricsRegistry
         from raft_tpu.obs.spans import SpanTracker
@@ -56,11 +58,25 @@ class ObsStack:
             from raft_tpu.obs.device import DeviceObs
 
             dev = DeviceObs()
+        recorder = FlightRecorder(capacity=capacity)
+        registry = MetricsRegistry()
+        auditor = tracker = None
+        if audit or slo_objectives is not None:
+            from raft_tpu.obs.audit import SafetyAuditor
+            from raft_tpu.obs.slo import SloTracker
+
+            auditor = SafetyAuditor(recorder=recorder, registry=registry)
+            tracker = SloTracker(
+                objectives=tuple(slo_objectives or ()),
+                recorder=recorder, registry=registry,
+            )
         return cls(
-            recorder=FlightRecorder(capacity=capacity),
+            recorder=recorder,
             spans=SpanTracker(),
-            registry=MetricsRegistry(),
+            registry=registry,
             device=dev,
+            audit=auditor,
+            slo=tracker,
         )
 
     def attach(self, engine) -> None:
@@ -68,6 +84,13 @@ class ObsStack:
         engine.recorder = self.recorder
         engine.spans = self.spans
         engine.metrics = self.registry
+        if self.audit is not None:
+            engine.auditor = self.audit
+            # re-attachment across a crash-restore cycle re-verifies
+            # the restored committed state against the audit record
+            self.audit.on_attach(engine)
+        if self.slo is not None:
+            engine.slo = self.slo
         if self.device is not None and hasattr(engine, "attach_device_obs"):
             engine.attach_device_obs(self.device)
 
@@ -134,6 +157,16 @@ def write_bundle(
         "device_ring": (
             obs.device.to_jsonable()
             if obs is not None and getattr(obs, "device", None) is not None
+            else None
+        ),
+        "audit": (
+            obs.audit.to_jsonable()
+            if obs is not None and getattr(obs, "audit", None) is not None
+            else None
+        ),
+        "slo": (
+            obs.slo.snapshot()
+            if obs is not None and getattr(obs, "slo", None) is not None
             else None
         ),
         "extra": extra or {},

@@ -66,7 +66,15 @@ def summarize_engine(engine, trace=None) -> EngineReport:
     when present)."""
     lat = engine.commit_latencies()
     elapsed = engine.clock.now
-    committed = len(engine.commit_time)
+    # ``commit_time`` is a BOUNDED stamp window (oldest stamps evict
+    # past the archive retention horizon — the host_post residue fix);
+    # the all-time committed count lives in ``committed_total``, and
+    # the eviction drops submit stamps pairwise so the lost-entry
+    # arithmetic stays exact with ``commit_stamps_evicted`` added back.
+    committed = getattr(engine, "committed_total", None)
+    evicted = getattr(engine, "commit_stamps_evicted", 0)
+    if committed is None:
+        committed = len(engine.commit_time)
     leader_changes = 0
     recorder = getattr(engine, "recorder", None)
     if recorder is not None:
@@ -81,7 +89,8 @@ def summarize_engine(engine, trace=None) -> EngineReport:
         commit_latency=LatencySummary.of(lat),
         in_flight_entries=in_flight,
         lost_entries=(
-            len(engine.submit_time) - committed - len(engine._queue) - in_flight
+            len(engine.submit_time) + evicted - committed
+            - len(engine._queue) - in_flight
         ),
         leader_changes=leader_changes,
         admission=(

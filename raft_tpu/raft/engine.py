@@ -202,6 +202,25 @@ class RaftEngine:
         #   syncs: the profiler's block_until_ready lives only behind
         #   HostProfiler.sync, which no detached path calls (pinned by
         #   tests/test_perf_obs.py, like the nodelog no-fetch pin).
+        self.auditor = None
+        #   obs.audit.SafetyAuditor (None = off): the online safety
+        #   plane — guarded host-side hooks at election wins, commit
+        #   advances, archive feeds and tick boundaries check Raft
+        #   invariants (one leader per term, monotone commit/terms,
+        #   committed-prefix immutability) DURING the run. Pure host
+        #   arithmetic over mirrors the engine already maintains: no
+        #   device fetches, determinism-neutral (docs/OBSERVABILITY.md
+        #   "Online plane").
+        self.slo = None
+        #   obs.slo.SloTracker (None = off): streaming latency digests
+        #   (commit / read / queue-delay) with multi-window burn-rate
+        #   SLO evaluation on the virtual clock. Same contract: guarded
+        #   host-side observes, zero extra device syncs.
+        self.status_board = None
+        #   obs.serve.StatusBoard (None = off): the engine publishes an
+        #   immutable host-mirror snapshot at each event-loop flush
+        #   boundary; the ops HTTP server (obs.serve.OpsServer) reads
+        #   it lock-free from its own thread.
         self.device_obs = None
         #   obs.device.DeviceObs (None = off): the device-resident
         #   observability plane — attach_device_obs allocates an
@@ -298,6 +317,19 @@ class RaftEngine:
         self.commit_time: Dict[int, float] = {}    # seq -> commit time
         #   (commit_time[s] - submit_time[s] is the per-entry commit latency
         #    the obs package histograms — the BASELINE p50/p99 metric)
+        self.committed_total = 0
+        #   All-time committed-entry count: ``commit_time`` itself is
+        #   BOUNDED (the host_post residue ROADMAP item 2 left behind —
+        #   per-entry stamps grew without bound over a long run). Stamps
+        #   are evicted oldest-first past ``_commit_stamp_cap``,
+        #   mirroring the CheckpointStore's floor-aware retention; the
+        #   durability answer for evicted committed seqs survives in
+        #   ``_durable_ranges`` (merged seq intervals — tiny: one
+        #   interval per loss gap), so ``is_durable`` still answers for
+        #   every seq ever issued.
+        self.commit_stamps_evicted = 0
+        self._commit_stamp_cap = 2 * cfg.log_capacity
+        self._durable_ranges: List[List[int]] = []
         self._seq_at_index: Dict[int, int] = {}    # log index -> client seq
         #   Mapped at ingestion time, because log indices and sequence
         #   numbers diverge once a leadership change drops queued entries.
@@ -740,7 +772,89 @@ class RaftEngine:
         return seq
 
     def is_durable(self, seq: int) -> bool:
-        return seq in self.commit_time
+        if seq in self.commit_time:
+            return True
+        return self._durable_range_covers(seq)
+
+    def _durable_range_covers(self, seq: int) -> bool:
+        """True iff ``seq``'s stamp was evicted from the bounded
+        ``commit_time`` window — evicted seqs were committed by
+        construction, summarized as merged intervals (bisect lookup)."""
+        rs = self._durable_ranges
+        if not rs:
+            return False
+        import bisect as _bisect
+
+        i = _bisect.bisect_right(rs, [seq, float("inf")]) - 1
+        return i >= 0 and rs[i][0] <= seq <= rs[i][1]
+
+    def _evict_commit_stamps(self) -> None:
+        """Bound the per-entry stamp dicts (the ``host_post`` residue of
+        ROADMAP item 2): past ``_commit_stamp_cap`` retained stamps,
+        evict oldest-first (dict order IS stamp order) into the merged
+        durable-seq intervals, dropping the matching ``submit_time``
+        records too. Mirrors the CheckpointStore retention horizon
+        (``2 * log_capacity`` entries), so latency samples stay
+        available exactly as long as the archived bytes do.
+
+        Trim-to-exactly-cap makes the retained set a pure function of
+        the stamp SEQUENCE, not of check cadence — the fused K-tick
+        path (one check per launch) and the tick path (one per advance)
+        end every run with identical dicts, which the fused byte-
+        identity pins compare. Bulk C-level rebuilds keep the amortized
+        per-entry cost far below the host_post budget PR 8 fought for."""
+        n_evict = len(self.commit_time) - self._commit_stamp_cap
+        if n_evict <= 0:
+            return
+        from itertools import islice
+
+        it = iter(self.commit_time.items())
+        evicted = list(islice(it, n_evict))
+        self.commit_time = dict(it)            # retained tail, C-level
+        self.commit_stamps_evicted += n_evict
+        st = self.submit_time
+        if n_evict * 4 < len(st):
+            for seq, _ in evicted:
+                st.pop(seq, None)
+        else:
+            drop = {s for s, _ in evicted}
+            self.submit_time = {
+                k: v for k, v in st.items() if k not in drop
+            }
+        # fold the evicted seqs into the merged durable intervals:
+        # contiguous runs collapse via one numpy pass (seqs stamp in
+        # near-ascending order, so the interval list stays tiny — one
+        # interval per loss gap)
+        arr = np.fromiter((s for s, _ in evicted), np.int64, n_evict)
+        arr.sort()
+        breaks = np.flatnonzero(np.diff(arr) != 1)
+        starts = np.concatenate(([0], breaks + 1))
+        ends = np.concatenate((breaks, [n_evict - 1]))
+        for a, b in zip(arr[starts], arr[ends]):
+            self._merge_durable_range(int(a), int(b))
+
+    def _merge_durable_range(self, a: int, b: int) -> None:
+        """Insert [a, b] into the sorted, disjoint ``_durable_ranges``,
+        coalescing with adjacent/overlapping neighbours."""
+        import bisect as _bisect
+
+        rs = self._durable_ranges
+        if rs and rs[-1][0] <= a <= rs[-1][1] + 1:
+            # common case: the run starts inside or immediately after
+            # the tail range (evictions proceed in stamp order)
+            if rs[-1][1] < b:
+                rs[-1][1] = b
+            return
+        i = _bisect.bisect_right(rs, [a, float("inf")])
+        if i > 0 and rs[i - 1][1] >= a - 1:
+            rs[i - 1][1] = max(rs[i - 1][1], b)
+            i -= 1
+        else:
+            rs.insert(i, [a, b])
+        # absorb any following ranges the new one now touches
+        while i + 1 < len(rs) and rs[i + 1][0] <= rs[i][1] + 1:
+            rs[i][1] = max(rs[i][1], rs[i + 1][1])
+            del rs[i + 1]
 
     def _pack_entries(self, entries, padded_len: int) -> np.ndarray:
         """(seq, payload) pairs -> u8[padded_len, entry_bytes], zero-padded
@@ -1252,6 +1366,12 @@ class RaftEngine:
             self._drop_read_ticket(ticket)
             if self.spans is not None:
                 self.spans.note_read_confirmed(ticket, idx, self.clock.now)
+            if self.slo is not None:
+                # read latency = ticket mint -> confirmation (rec[4] is
+                # the mint time; the serve itself is applied-state local)
+                self.slo.observe(
+                    "read", self.clock.now - rec[4], self.clock.now
+                )
             return idx
         if (self.roles[row] != LEADER or not self.alive[row]
                 or int(self.lead_terms[row]) != tterm
@@ -1809,6 +1929,10 @@ class RaftEngine:
         self._steady = False
         if self.member[r]:
             self._wiped[r] = True
+        if self.auditor is not None:
+            # a wipe legally resets the row's term to 0: the auditor's
+            # per-node term-monotonicity watermark resets with it
+            self.auditor.note_wipe(f"Server{r}")
         self.nodelog(r, "wiped (durable state destroyed)")
 
     def set_slow(self, r: int, is_slow: bool) -> None:
@@ -2025,9 +2149,60 @@ class RaftEngine:
             self._mirror_digest_step(
                 t, kind + ("|stale" if stale else ""), r
             )
+        # ---- online plane (docs/OBSERVABILITY.md "Online plane") ----
+        # Per-tick/launch flush boundary: invariant scan over host
+        # mirrors, SLO window evaluation, and the lock-free status
+        # snapshot publish. Pure host work (no device fetch, no rng);
+        # detached costs three None checks. Runs BEFORE hp.tick_end so
+        # the attribution columns still tile the tick honestly.
+        if self.auditor is not None:
+            self.auditor.note_state(
+                self.terms, self.commit_watermark, self.clock.now
+            )
+        if self.slo is not None:
+            self.slo.maybe_evaluate(self.clock.now)
+        if self.status_board is not None:
+            self.status_board.publish(self._status_snapshot())
         if hp is not None:
             hp.tick_end()
         return True
+
+    def _status_snapshot(self) -> dict:
+        """The ``/status`` snapshot (obs.serve): host mirrors only —
+        leader map, watermarks, replication lag (ingested-uncommitted
+        depth), queue depths, audit summary. Built fresh per publish so
+        the server thread always reads an immutable dict."""
+        lead = self.leader_id
+        snap = {
+            "t_virtual": self.clock.now,
+            "groups": 1,
+            "leaders": {
+                "0": (
+                    {"replica": lead, "term": int(self.lead_terms[lead])}
+                    if lead is not None else None
+                )
+            },
+            "terms": [int(x) for x in self.terms],
+            "roles": list(self.roles),
+            "alive": [bool(a) for a in self.alive],
+            "commit_watermark": {"0": int(self.commit_watermark)},
+            "applied_index": {"0": int(self.applied_index)},
+            "replication_lag": {"0": len(self._seq_at_index)},
+            "queue_depth": {"0": len(self._queue)},
+            "reads_pending": len(self._reads),
+            "committed_total": self.committed_total,
+            "fused": {
+                "launches": self.fused_launches,
+                "ticks": self.fused_ticks,
+            },
+        }
+        if self.admission is not None:
+            snap["shedding"] = bool(
+                getattr(self.admission, "shedding", False)
+            )
+        if self.auditor is not None:
+            snap["audit"] = self.auditor.summary()
+        return snap
 
     # ------------------------------------------------ mirror desync guard
     def _mirror_digest_step(self, t: float, kind: str, r: int) -> None:
@@ -2357,6 +2532,11 @@ class RaftEngine:
                     self.roles[p] = FOLLOWER
                     self._arm_follower(p)
             self.nodelog(r, "state changed to leader")
+            if self.auditor is not None:
+                # Election Safety, online: at most one winner per term
+                self.auditor.note_elect(
+                    f"Server{r}", cand_term, self.clock.now
+                )
             self._metric_inc("raft_elections_total")
             if self.metrics is not None:
                 self.metrics.gauge(
@@ -2412,16 +2592,20 @@ class RaftEngine:
         B = cfg.batch_size
         routed = self.leader_id == r
         eff = self._reach(r)
-        if routed and self.admission is not None:
+        if routed and (self.admission is not None or self.slo is not None):
             # Feed the delay controller the head-of-queue sojourn (0 on
             # an empty queue, which is what exits the shedding state).
             # Ticks are the drain cadence, so this is also the natural
-            # observation cadence.
+            # observation cadence — the SLO tracker's queue-delay series
+            # samples the same value.
             head_delay = 0.0
             if self._queue:
                 head_delay = self.clock.now - self.submit_time.get(
                     self._queue[0][0], self.clock.now
                 )
+            if self.slo is not None:
+                self.slo.observe("queue_delay", head_delay, self.clock.now)
+        if routed and self.admission is not None:
             transition = self.admission.observe_delay(head_delay)
             if transition == "shed_start":
                 self.nodelog(
@@ -2740,27 +2924,36 @@ class RaftEngine:
         if commit <= self.commit_watermark:
             return
         old_wm = self.commit_watermark
+        slo_lat = [] if self.slo is not None else None
+        now = self.clock.now
+        sq_get = self._seq_at_index.get
+        st_get = self.submit_time.get
+        ct = self.commit_time
+        need_lat = self.metrics is not None or slo_lat is not None
         for idx in range(self.commit_watermark + 1, commit + 1):
-            seq = self._seq_at_index.get(idx)
-            if seq is not None and seq not in self.commit_time:
-                self.commit_time[seq] = self.clock.now
+            seq = sq_get(idx)
+            if seq is not None and seq not in ct:
+                ct[seq] = now
+                self.committed_total += 1
+                lat = (now - st_get(seq, now)) if need_lat else 0.0
                 if self.spans is not None:
-                    self.spans.note_commit(
-                        seq, self.clock.now, self._tick_count
-                    )
+                    self.spans.note_commit(seq, now, self._tick_count)
                 if self.metrics is not None:
                     self._metric_inc("raft_commits_total")
                     self.metrics.histogram(
                         "raft_commit_latency_seconds",
                         "submit -> durable, virtual seconds", ("group",),
-                    ).observe(
-                        self.clock.now - self.submit_time.get(
-                            seq, self.clock.now
-                        ),
-                        group="0",
-                    )
+                    ).observe(lat, group="0")
+                if slo_lat is not None:
+                    slo_lat.append(lat)
+        if slo_lat:
+            # one vectorized digest/window update per advance, not one
+            # Python call per entry (the <= 5% overhead contract)
+            self.slo.observe_batch("commit", slo_lat, now)
         self._archive_committed(r, self.commit_watermark + 1, commit)
         self.commit_watermark = commit
+        if self.auditor is not None:
+            self.auditor.note_commit(commit, self.clock.now)
         self.nodelog(r, f"commit index changed to {commit}")
         if self._pending_config is not None and self._pending_config[0] <= commit:
             idx = self._pending_config[0]
@@ -2786,6 +2979,7 @@ class RaftEngine:
         for idx in range(old_wm + 1, commit + 1):
             self._uncommitted.pop(idx, None)
             self._seq_at_index.pop(idx, None)
+        self._evict_commit_stamps()
         self._drain_apply()
 
     def _reset_heard_timers(self, r: int) -> None:
@@ -2838,12 +3032,21 @@ class RaftEngine:
         # compiles a fresh gather per slot-vector shape)
         lead_terms = self._fetch(self.state.log_term)[leader, slots_all]
         missing = []
+        aud = self.auditor
+        fed = [] if aud is not None else None
         for i, idx in enumerate(range(lo, hi + 1)):
             ent = self._uncommitted.get(idx)
             if ent is not None and ent[1] == int(lead_terms[i]):
                 self.store.put(idx, ent[0], ent[1])
+                if fed is not None:
+                    fed.append((idx, ent[0], ent[1]))
             else:
                 missing.append(idx)
+        if fed:
+            # committed-prefix immutability feed: fresh contiguous runs
+            # record as one lazy span (O(1)); a re-archive of an
+            # already-recorded index is compared byte-for-byte
+            aud.note_entries(fed, self.clock.now)
         if not missing:
             return
         mlo, mhi = min(missing), max(missing)
@@ -2878,9 +3081,12 @@ class RaftEngine:
         except ValueError:
             return
         for idx in missing:
-            self.store.put(
-                idx, data[idx - mlo].tobytes(), int(terms[idx - mlo])
-            )
+            payload = data[idx - mlo].tobytes()
+            self.store.put(idx, payload, int(terms[idx - mlo]))
+            if self.auditor is not None:
+                self.auditor.note_entry(
+                    idx, int(terms[idx - mlo]), payload, self.clock.now
+                )
 
     def _try_install_snapshot(self, replica: int, lo: int, hi: int) -> bool:
         """Install the committed range [lo, hi] (clamped to one ring

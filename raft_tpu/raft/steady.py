@@ -588,10 +588,11 @@ class _WindowBook:
         term = self.term
         n = len(chunk)
         s0, sl = chunk[0][0], chunk[-1][0]
-        if (e.spans is None and e.metrics is None
+        if (e.spans is None and e.metrics is None and e.slo is None
                 and sl - s0 + 1 == n):
             e.commit_time.update(dict.fromkeys(range(s0, sl + 1), t_j))
         else:
+            slo_lat = [] if e.slo is not None else None
             for i, (seq, p) in enumerate(chunk):
                 e.commit_time[seq] = t_j
                 if e.spans is not None:
@@ -607,10 +608,23 @@ class _WindowBook:
                     ).observe(
                         t_j - e.submit_time.get(seq, t_j), group="0",
                     )
+                if slo_lat is not None:
+                    slo_lat.append(t_j - e.submit_time.get(seq, t_j))
+            if slo_lat:
+                e.slo.observe_batch("commit", slo_lat, t_j)
+        e.committed_total += n
         e.store.put_span(new_last - n + 1, chunk, term, pick=1)
+        if e.auditor is not None:
+            # span-granularity audit feed, O(1) per launch like
+            # put_span: entries resolve lazily inside the auditor
+            e.auditor.note_entry_span(
+                new_last - n + 1, chunk, term, t_j, pick=1
+            )
+            e.auditor.note_commit(commit, t_j)
         e.commit_watermark = commit
         e._nodelog_at(r, f"commit index changed to {commit}",
                       commit, new_last, kind="commit")
+        e._evict_commit_stamps()
         e._drain_apply()
 
     def _replay_floor_event(self, last: int) -> None:

@@ -1,0 +1,359 @@
+"""Online safety auditor (obs.audit): invariant units, falsifiability,
+determinism pins, and the bounded commit-stamp satellite.
+
+The falsifiability contract (ISSUE 9 acceptance): BOTH deliberately
+broken variants must trip the auditor DURING the run — ``dirty_reads``
+(also rejected by the offline checker) and ``commit_rewind`` (usually
+INVISIBLE to the offline checker: no client-observable effect — the
+online plane is the only thing that can catch it). The determinism pins
+replay membership seeds 11/14/22/27 with the auditor + SLO plane
+attached and compare the full fingerprint against the session-shared
+plain baselines (tests/_torture_fingerprints.py)."""
+
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs.audit import SafetyAuditor
+from tests._torture_fingerprints import fingerprint, plain_membership_run
+
+
+# ---------------------------------------------------------------- units
+class TestInvariantUnits:
+    def test_leader_unique_trips_on_second_winner(self):
+        a = SafetyAuditor()
+        a.note_elect("Server0", 3, 1.0)
+        a.note_elect("Server0", 3, 2.0)       # same winner: fine
+        assert a.total_violations == 0
+        a.note_elect("Server1", 3, 3.0)       # different winner, same term
+        assert a.by_invariant == {"leader_unique": 1}
+        v = a.violations[0]
+        assert v.invariant == "leader_unique" and v.t_virtual == 3.0
+
+    def test_commit_monotone_trips_on_rewind(self):
+        a = SafetyAuditor()
+        a.note_state([1, 1, 1], 10, 1.0)
+        a.note_state([1, 1, 1], 12, 2.0)
+        assert a.total_violations == 0
+        a.note_state([1, 1, 1], 9, 3.0)       # watermark regressed
+        assert a.by_invariant == {"commit_monotone": 1}
+        # re-anchored: reported once, not every tick thereafter
+        a.note_state([1, 1, 1], 9, 4.0)
+        assert a.total_violations == 1
+
+    def test_term_monotone_trips_without_wipe(self):
+        a = SafetyAuditor()
+        a.note_state([2, 5, 2], 0, 1.0)
+        a.note_state([2, 4, 2], 0, 2.0)       # Server1 term regressed
+        assert a.by_invariant == {"term_monotone": 1}
+
+    def test_wipe_resets_term_watermark(self):
+        a = SafetyAuditor()
+        a.note_state([2, 5, 2], 0, 1.0)
+        a.note_wipe("Server1")
+        a.note_state([2, 0, 2], 0, 2.0)       # legal: wiped row
+        assert a.total_violations == 0
+
+    def test_log_matching_trips_on_refed_mismatch(self):
+        a = SafetyAuditor()
+        a.note_entry(5, 2, b"alpha", 1.0)
+        a.note_entry(5, 2, b"alpha", 2.0)     # identical re-feed: fine
+        assert a.total_violations == 0
+        a.note_entry(5, 2, b"bravo", 3.0)     # same index, new bytes
+        assert a.by_invariant == {"log_matching": 1}
+
+    def test_log_matching_covers_lazy_span_blocks(self):
+        a = SafetyAuditor()
+        a.note_entry_span(10, [(1, b"p10"), (2, b"p11")], 7, 1.0, pick=1)
+        a.note_entry(10, 7, b"p10", 2.0)      # consistent with the span
+        assert a.total_violations == 0
+        a.note_entry(11, 7, b"XXX", 3.0)
+        assert a.by_invariant == {"log_matching": 1}
+
+    def test_read_uncommitted_and_monotone(self):
+        a = SafetyAuditor()
+        a.note_apply(b"k", 1, b"v1")
+        a.note_apply(b"k", 2, b"v2")
+        a.observe_read(7, b"k", b"v2", 1.0)
+        assert a.total_violations == 0
+        a.observe_read(7, b"k", b"v1", 2.0)   # older applied state
+        assert a.by_invariant == {"read_monotone": 1}
+        a.observe_read(7, b"k", b"ghost", 3.0)   # never applied
+        assert a.by_invariant["read_uncommitted"] == 1
+        # a different client has its own watermark: v1 is fresh to it
+        a.observe_read(8, b"k", b"v2", 4.0)
+        assert a.by_invariant.get("read_monotone") == 1
+
+    def test_initial_none_read_is_fine_then_stale_after_write(self):
+        a = SafetyAuditor()
+        a.observe_read(1, b"k", None, 1.0)    # initial state
+        assert a.total_violations == 0
+        a.note_apply(b"k", 3, b"v")
+        a.observe_read(1, b"k", b"v", 2.0)
+        a.observe_read(1, b"k", None, 3.0)    # back to pre-write state
+        assert a.by_invariant == {"read_monotone": 1}
+
+    def test_attach_recheck_flags_rewound_restore(self):
+        from raft_tpu.ckpt import CheckpointStore
+
+        class _Eng:
+            def __init__(self):
+                self.store = CheckpointStore(4)
+                self.commit_watermark = 3
+
+            class clock:
+                now = 9.0
+
+        a = SafetyAuditor()
+        a.note_state([1], 8, 1.0)
+        a.on_attach(_Eng())                   # restored below high-water
+        assert a.by_invariant == {"commit_monotone": 1}
+
+    def test_violation_cap_counts_drops(self):
+        a = SafetyAuditor()
+        a.VIOLATION_CAP = 4
+        for t in range(8):
+            a.note_elect("Server0", t, float(t))
+            a.note_elect("Server1", t, float(t))
+        assert len(a.violations) == 4
+        assert a.total_violations == 8
+        assert a.violations_dropped == 4
+
+
+# ------------------------------------------------------- falsifiability
+@pytest.mark.parametrize("seed", [0])
+def test_dirty_reads_trips_auditor_online(seed):
+    """The dirty-read variant must be caught by the ONLINE plane (not
+    only by the offline checker at run end): the auditor's serve-side
+    read audit flags reads of never-applied values during the run."""
+    from raft_tpu.chaos.runner import torture_run
+
+    rep = torture_run(seed, phases=6, keys=2, broken="dirty_reads",
+                      audit=True)
+    aud = rep.obs.audit
+    assert aud.total_violations > 0
+    kinds = set(aud.by_invariant)
+    assert kinds & {"read_uncommitted", "read_monotone"}
+    # online means online: the first violation carries a virtual-clock
+    # stamp from INSIDE the run, and the recorder saw the typed event
+    assert aud.violations[0].t_virtual > 0.0
+    assert rep.obs.recorder.events(kind="audit_violation")
+    # the offline checker agrees (the pre-existing pin, still true)
+    assert rep.verdict != "LINEARIZABLE"
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_commit_rewind_trips_auditor_online(seed):
+    """The broken-COMMIT variant: acked commits silently lost by the
+    storage layer. The offline checker typically CANNOT see it (the
+    device log re-advances; no read serves the regression) — the online
+    commit-monotonicity watermark is the only tooth that bites."""
+    from raft_tpu.chaos.runner import torture_run
+
+    rep = torture_run(seed, phases=6, keys=2, broken="commit_rewind",
+                      audit=True)
+    aud = rep.obs.audit
+    assert aud.by_invariant.get("commit_monotone", 0) > 0
+    assert aud.violations[0].t_virtual > 0.0
+    # counter surfaced in the registry too
+    c = rep.obs.registry.get("raft_audit_violations_total")
+    assert c is not None and c.value(invariant="commit_monotone") > 0
+
+
+def test_legit_run_zero_violations_and_digest_crosscheck():
+    """A healthy seeded run audits clean, and the auditor's incremental
+    committed-prefix CRC reproduces TortureReport.commit_digest exactly
+    — proof it watched the same log the checker judged."""
+    from raft_tpu.chaos.runner import torture_run
+
+    rep = torture_run(3, phases=6, keys=2, audit=True)
+    aud = rep.obs.audit
+    assert rep.verdict == "LINEARIZABLE"
+    assert aud.total_violations == 0
+    assert aud.commit_digest() == rep.commit_digest
+    # attach adopted the engine archive's retention horizon, so digest
+    # coverage keeps matching even once the store starts compacting
+    assert aud.max_entries == 2 * 128
+    # SLO plane rode along: commit digests saw every committed entry
+    dig = rep.obs.slo.digests.get(("commit", None))
+    assert dig is not None and dig.n > 0
+
+
+# --------------------------------------------------- determinism pins
+@pytest.mark.parametrize("seed", [
+    11,
+    22,
+    # wall budget (README "Testing strategy"): all four acceptance
+    # seeds are pinned; two ride the slow tier (same parametrize, same
+    # shared plain baselines)
+    pytest.param(14, marks=pytest.mark.slow),
+    pytest.param(27, marks=pytest.mark.slow),
+])
+def test_audit_plane_replays_byte_identical(seed):
+    """ISSUE 9 acceptance: membership seeds 11/14/22/27 replay with the
+    auditor AND SLO plane attached vs detached byte-identically —
+    verdict, commit CRC, op counts, crashes, sheds, membership ops
+    (the shared fingerprint of tests/_torture_fingerprints.py)."""
+    from raft_tpu.chaos.runner import torture_run
+
+    audited = torture_run(seed, phases=4, membership=True, audit=True)
+    assert fingerprint(audited) == plain_membership_run(seed)
+    assert audited.obs.audit.total_violations == 0
+
+
+# ------------------------------------------- bounded commit stamps
+def test_commit_stamp_window_bounded_durability_api_still_answers():
+    """Satellite: the per-entry commit_time dict no longer grows without
+    bound — stamps evict oldest-first past 2*log_capacity (mirroring
+    CheckpointStore retention), and ``is_durable`` still answers for
+    every seq ever issued (True for evicted committed seqs via the
+    merged interval summary, False for lost/unknown seqs)."""
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = RaftConfig(n_replicas=3, entry_bytes=32, batch_size=8,
+                     log_capacity=32, transport="single")
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e.run_until_leader()
+    cap = 2 * cfg.log_capacity
+    seqs = [e.submit(bytes([i % 251]) * cfg.entry_bytes)
+            for i in range(4 * cap)]
+    e.run_until_committed(seqs[-1], limit=30000.0)
+    assert len(e.commit_time) == cap
+    assert e.committed_total == len(seqs)
+    assert e.commit_stamps_evicted == len(seqs) - cap
+    # durability answers: evicted-committed True, retained True,
+    # never-issued False
+    assert e.is_durable(seqs[0])
+    assert e.is_durable(seqs[len(seqs) // 2])
+    assert e.is_durable(seqs[-1])
+    assert not e.is_durable(10 ** 9)
+    # submit stamps evicted pairwise: no unbounded residue
+    assert len(e.submit_time) <= cap
+    # the interval summary stays tiny on a loss-free run
+    assert len(e._durable_ranges) == 1
+    # latency samples still available for the retained window
+    assert len(e.commit_latencies()) == cap
+
+
+def test_commit_stamp_eviction_interval_merge_handles_gaps():
+    """The durable-interval summary must never cover a seq that was not
+    committed: simulate eviction around a loss gap."""
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = RaftConfig(n_replicas=3, entry_bytes=32, batch_size=4,
+                     log_capacity=16, transport="single")
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    e._commit_stamp_cap = 4
+    # seqs 1..6 and 10..13 committed; 7..9 lost
+    for s in list(range(1, 7)) + list(range(10, 14)):
+        e.commit_time[s] = float(s)
+        e.committed_total += 1
+    e._evict_commit_stamps()
+    assert len(e.commit_time) == 4
+    for s in list(range(1, 7)):
+        assert e.is_durable(s), s
+    for s in (7, 8, 9):
+        assert not e.is_durable(s), s
+    assert e.is_durable(10)
+
+
+# --------------------------------------------- zero-extra-syncs pin
+def test_online_plane_zero_extra_device_syncs():
+    """The acceptance's detached/attached contract: attaching auditor +
+    SLO tracker + status board performs ZERO additional device fetches
+    (pure host-mirror reads), pinned by fetch-counting — the hostprof
+    pin's analogue for the online plane."""
+    from raft_tpu.obs.registry import MetricsRegistry
+    from raft_tpu.obs.serve import StatusBoard
+    from raft_tpu.obs.slo import SLObjective, SloTracker
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = RaftConfig(n_replicas=3, entry_bytes=32, batch_size=4,
+                     log_capacity=64, transport="single")
+
+    def run(online: bool):
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+        e.metrics = MetricsRegistry()
+        if online:
+            e.auditor = SafetyAuditor(registry=e.metrics)
+            e.slo = SloTracker(
+                objectives=(SLObjective("c", "commit", 4.0),),
+                registry=e.metrics,
+            )
+            e.status_board = StatusBoard()
+        e.run_until_leader()
+        fetches = [0]
+        orig = e._fetch
+        e._fetch = lambda x: (
+            fetches.__setitem__(0, fetches[0] + 1), orig(x)
+        )[1]
+        seqs = [e.submit(bytes(cfg.entry_bytes)) for _ in range(32)]
+        e.run_until_committed(seqs[-1], limit=3000.0)
+        tk = e.submit_read()
+        while e.read_confirmed(tk) is None:
+            e.step_event()
+        return fetches[0], int(e.commit_watermark)
+
+    f_off, wm_off = run(False)
+    f_on, wm_on = run(True)
+    assert wm_on == wm_off
+    assert f_on == f_off
+
+
+def test_audit_note_entries_bulk_matches_per_entry():
+    """The bulk archive feed (lazy span blocks) and the per-entry feed
+    must produce identical digests — the hot path may not change what
+    is recorded."""
+    entries = [(i, f"p{i}".encode(), 3) for i in range(1, 40)]
+    a1 = SafetyAuditor()
+    a1.note_entries(entries, 1.0)
+    a1.note_state([3], 39, 1.0)
+    a2 = SafetyAuditor()
+    for idx, p, t in entries:
+        a2.note_entry(idx, t, p, 1.0)
+    a2.note_state([3], 39, 1.0)
+    assert a1.commit_digest() == a2.commit_digest()
+    assert a1.total_violations == a2.total_violations == 0
+
+
+def test_digest_matches_runner_formula_past_store_eviction():
+    """The digest cross-check must survive archive compaction: feed an
+    auditor and a CheckpointStore identically PAST the store's
+    retention horizon (the attach hook aligns the caps) and pin the
+    auditor's digest equal to the runner formula computed over the
+    store — coverage (covered_lo) must sweep identically."""
+    import zlib
+
+    from raft_tpu.ckpt import CheckpointStore
+
+    store = CheckpointStore(8, max_entries=16)
+    a = SafetyAuditor(max_entries=16)
+    wm = 50                                   # far past the 16-entry cap
+    for idx in range(1, wm + 1):
+        payload = f"e{idx:06d}".encode().ljust(8, b"\0")
+        store.put(idx, payload, 3)
+        a.note_entry(idx, 3, payload, float(idx))
+    a.note_state([3], wm, 99.0)
+    crc = zlib.crc32(f"wm:{wm}".encode())
+    for idx in range(store.covered_lo(wm), wm + 1):
+        ent = store.get(idx)
+        crc = zlib.crc32(
+            f"{idx}:{ent[1]}:{zlib.crc32(ent[0]):08x}".encode(), crc
+        )
+    assert a.commit_digest() == f"{crc:08x}"
+
+
+def test_ledger_floor_eviction_mirrors_store():
+    """Entry records evict below the retention floor like the
+    CheckpointStore; the digest covers the retained contiguous tail."""
+    a = SafetyAuditor(max_entries=8)
+    for i in range(1, 30):
+        a.note_entry(i, 1, f"e{i}".encode(), float(i))
+    led = a._ledgers[None]
+    assert led.first == 29 - 8 + 1     # CheckpointStore's sweep rule
+    assert led.get(led.first - 1) is None
+    assert led.get(29) is not None
+    a.note_state([1], 29, 30.0)
+    assert a.commit_digest()      # computes over the retained window

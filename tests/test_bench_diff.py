@@ -1,0 +1,160 @@
+"""Bench regression gate (tools/bench_diff.py + bench.py --compare):
+artifact-shape parsing, gated-delta semantics, CLI exit codes."""
+
+import json
+
+import pytest
+
+from tools.bench_diff import (
+    compare_runs,
+    format_table,
+    load_bench,
+    main as diff_main,
+)
+
+
+def _combined(p50, eps, extra=None):
+    doc = {
+        "metric": "commit_p50_latency", "value": p50, "unit": "us",
+        "p99_us": p50 * 2, "entries_per_sec": eps,
+        "configs": {
+            "c2_batched": {"p50_us": p50, "p99_us": p50 * 2,
+                           "entries_per_sec": eps},
+            "attribution": {"wall_us_per_tick": 5000.0},
+        },
+    }
+    if extra:
+        doc["configs"].update(extra)
+    return doc
+
+
+class TestLoadBench:
+    def test_json_lines_stdout(self, tmp_path):
+        p = tmp_path / "run.json"
+        lines = [
+            json.dumps({"leg": "c2_batched", "p50_us": 2.0,
+                        "entries_per_sec": 1e6}),
+            json.dumps({"leg": "overload", "goodput_eps": 12.0}),
+            json.dumps(_combined(2.0, 1e6)),
+        ]
+        p.write_text("\n".join(lines) + "\n")
+        legs = load_bench(str(p))
+        assert legs["c2_batched"]["p50_us"] == 2.0
+        assert legs["overload"]["goodput_eps"] == 12.0
+        assert legs["headline"]["p50_us"] == 2.0
+
+    def test_legs_only_no_combined(self, tmp_path):
+        """A deadline- or externally-killed run has leg rows but no
+        final combined object — its finished legs must still load."""
+        p = tmp_path / "killed.json"
+        p.write_text(json.dumps({"leg": "c2_batched", "p50_us": 3.0}))
+        assert load_bench(str(p))["c2_batched"]["p50_us"] == 3.0
+
+    def test_wrapper_with_parsed(self, tmp_path):
+        p = tmp_path / "BENCH_r99.json"
+        p.write_text(json.dumps({
+            "n": 1, "cmd": "python bench.py", "rc": 0,
+            "tail": "noise\n", "parsed": _combined(2.5, 9e5),
+        }))
+        legs = load_bench(str(p))
+        assert legs["c2_batched"]["p50_us"] == 2.5
+
+    def test_wrapper_parsed_null_falls_back_to_tail(self, tmp_path):
+        p = tmp_path / "BENCH_rkill.json"
+        tail = ("WARNING: noise\n"
+                + json.dumps({"leg": "c4_slow", "p50_us": 7.0}) + "\n")
+        p.write_text(json.dumps({
+            "n": 1, "cmd": "x", "rc": 124, "tail": tail, "parsed": None,
+        }))
+        assert load_bench(str(p))["c4_slow"]["p50_us"] == 7.0
+
+    def test_not_a_bench_artifact(self, tmp_path):
+        p = tmp_path / "junk.json"
+        p.write_text("not json at all")
+        with pytest.raises(ValueError):
+            load_bench(str(p))
+
+    def test_real_repo_artifact_loads(self):
+        from pathlib import Path
+
+        artifact = Path(__file__).resolve().parent.parent / "BENCH_r04.json"
+        legs = load_bench(str(artifact))
+        assert "c2_batched" in legs and "p50_us" in legs["c2_batched"]
+
+
+class TestCompare:
+    def _legs(self, p50, eps):
+        return {"c2_batched": {"p50_us": p50, "entries_per_sec": eps}}
+
+    def test_no_regression_within_threshold(self):
+        deltas, reg = compare_runs(self._legs(2.0, 1e6),
+                                   self._legs(2.1, 0.96e6), 0.10)
+        assert reg == []
+        assert all(d.status in ("ok",) for d in deltas if d.gated)
+
+    def test_latency_regression_gates(self):
+        _, reg = compare_runs(self._legs(2.0, 1e6),
+                              self._legs(2.5, 1e6), 0.10)
+        assert [(d.leg, d.metric) for d in reg] == [
+            ("c2_batched", "p50_us")]
+        assert reg[0].change == pytest.approx(0.25)
+
+    def test_throughput_regression_gates_in_the_down_direction(self):
+        _, reg = compare_runs(self._legs(2.0, 1e6),
+                              self._legs(2.0, 0.7e6), 0.10)
+        assert [d.metric for d in reg] == ["entries_per_sec"]
+        # and an IMPROVEMENT never gates
+        deltas, reg2 = compare_runs(self._legs(2.0, 1e6),
+                                    self._legs(1.0, 2e6), 0.10)
+        assert reg2 == []
+        assert {d.status for d in deltas if d.gated} == {"improved"}
+
+    def test_added_removed_skipped_never_gate(self):
+        old = {"a": {"p50_us": 1.0}, "gone": {"p50_us": 1.0},
+               "skip": {"p50_us": 1.0}}
+        new = {"a": {"p50_us": 1.0}, "fresh": {"p50_us": 9.0},
+               "skip": {"skipped": "deadline"}}
+        deltas, reg = compare_runs(old, new, 0.10)
+        assert reg == []
+        statuses = {(d.leg, d.status) for d in deltas}
+        assert ("fresh", "added") in statuses
+        assert ("gone", "removed") in statuses
+        assert ("skip", "skipped") in statuses
+
+    def test_ungated_metrics_ignored(self):
+        old = {"x": {"mystery_number": 1.0}}
+        new = {"x": {"mystery_number": 100.0}}
+        deltas, reg = compare_runs(old, new, 0.10)
+        assert reg == [] and all(not d.gated for d in deltas)
+
+    def test_format_table_mentions_threshold(self):
+        deltas, _ = compare_runs(self._legs(2.0, 1e6),
+                                 self._legs(2.5, 1e6), 0.10)
+        table = format_table(deltas, 0.10)
+        assert "p50_us" in table and "10%" in table
+        assert "1 regression(s)" in table
+
+
+class TestCli:
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_exit_zero_clean(self, tmp_path, capsys):
+        old = self._write(tmp_path, "old.json", _combined(2.0, 1e6))
+        new = self._write(tmp_path, "new.json", _combined(2.05, 1e6))
+        assert diff_main([old, new]) == 0
+        assert "no regressions" in capsys.readouterr().out
+
+    def test_exit_one_on_regression(self, tmp_path, capsys):
+        old = self._write(tmp_path, "old.json", _combined(2.0, 1e6))
+        new = self._write(tmp_path, "new.json", _combined(3.0, 1e6))
+        assert diff_main([old, new]) == 1
+        assert "regressed" in capsys.readouterr().out
+
+    def test_threshold_flag(self, tmp_path):
+        old = self._write(tmp_path, "old.json", _combined(2.0, 1e6))
+        new = self._write(tmp_path, "new.json", _combined(2.4, 1e6))
+        assert diff_main([old, new]) == 1                  # 20% > 10%
+        assert diff_main([old, new, "--threshold", "0.5"]) == 0

@@ -1,0 +1,173 @@
+"""Live ops surface (obs.serve): status board semantics, the HTTP
+endpoints end to end (ephemeral port, scraped while a MultiEngine run
+drives traffic), and the CLI demo hook."""
+
+import json
+import urllib.error
+import urllib.request
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs.audit import SafetyAuditor
+from raft_tpu.obs.events import FlightRecorder
+from raft_tpu.obs.registry import MetricsRegistry, parse_prometheus
+from raft_tpu.obs.serve import OpsServer, StatusBoard
+from raft_tpu.obs.slo import SLObjective, SloTracker
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as ex:      # 404s carry a JSON body too
+        return ex.code, ex.read().decode()
+
+
+class TestStatusBoard:
+    def test_publish_compose_sections(self):
+        b = StatusBoard()
+        assert b.compose() == {"board_generation": 0}
+        b.publish({"t_virtual": 1.0, "leaders": {}})
+        b.publish({"0": "open"}, section="breakers")
+        snap = b.compose()
+        assert snap["t_virtual"] == 1.0
+        assert snap["breakers"] == {"0": "open"}
+        assert snap["board_generation"] == 2
+
+    def test_reader_holds_consistent_snapshot(self):
+        """A composed snapshot taken before a publish must not mutate
+        under the reader (the lock-free contract)."""
+        b = StatusBoard()
+        b.publish({"v": 1})
+        old = b.compose()
+        b.publish({"v": 2})
+        assert old["v"] == 1
+
+
+def test_serve_smoke_multiengine_traffic():
+    """ISSUE 9 acceptance: end-to-end --serve smoke — ephemeral port,
+    scrape /metrics and /status (plus /healthz and /slo) while a
+    MultiEngine run drives traffic through the full online plane."""
+    from raft_tpu.multi.engine import MultiEngine
+
+    cfg = RaftConfig(n_replicas=3, entry_bytes=32, batch_size=4,
+                     log_capacity=128, transport="single")
+    G = 3
+    eng = MultiEngine(cfg, G, recorder=FlightRecorder())
+    eng.metrics = MetricsRegistry()
+    eng.auditor = SafetyAuditor(recorder=eng.recorder,
+                                registry=eng.metrics)
+    eng.slo = SloTracker(
+        objectives=(SLObjective("commit_fast", "commit",
+                                threshold_s=2 * cfg.heartbeat_period),),
+        recorder=eng.recorder, registry=eng.metrics,
+    )
+    board = StatusBoard()
+    eng.status_board = board
+    eng.seed_leaders()
+
+    with OpsServer(board=board, registry=eng.metrics, slo=eng.slo,
+                   auditor=eng.auditor, port=0) as srv:
+        submitted = []
+        for round_no in range(6):
+            for g in range(G):
+                if eng.leader_id[g] is None:
+                    continue
+                seq = eng.submit(g, f"r{round_no}g{g}".encode().ljust(
+                    cfg.entry_bytes, b"\0"))
+                submitted.append((g, seq))
+            eng.run_for(2 * cfg.heartbeat_period)
+            if round_no == 2:
+                # scrape MID-run: the board serves a consistent
+                # snapshot while the engine keeps ticking
+                st, body = _get(srv.port, "/status")
+                assert st == 200
+                mid = json.loads(body)
+                assert mid["groups"] == G
+        g0, s0 = submitted[0]
+        eng.run_until_committed(g0, s0)
+
+        st, body = _get(srv.port, "/healthz")
+        assert st == 200 and json.loads(body)["status"] == "ok"
+
+        st, body = _get(srv.port, "/status")
+        assert st == 200
+        snap = json.loads(body)
+        # leader map + per-group watermarks + lag + queue depth + audit
+        assert set(snap["leaders"]) == {str(g) for g in range(G)}
+        lead0 = snap["leaders"]["0"]
+        assert lead0 is not None and lead0["term"] >= 1
+        assert int(snap["commit_watermark"]["0"]) >= 1
+        assert "applied_index" in snap and "replication_lag" in snap
+        assert "queue_depth" in snap
+        assert snap["audit"]["violations_total"] == 0
+
+        st, body = _get(srv.port, "/metrics")
+        assert st == 200
+        metrics = parse_prometheus(body)
+        assert "raft_elections_total" in metrics
+        assert any(k.startswith("raft_commit_latency_seconds")
+                   for k in metrics)
+
+        st, body = _get(srv.port, "/slo")
+        assert st == 200
+        slo = json.loads(body)
+        assert slo["objectives"][0]["name"] == "commit_fast"
+        assert "commit" in slo["digests"]
+
+        st, body = _get(srv.port, "/nope")
+        assert st == 404
+
+
+def test_serve_single_engine_status_and_unattached_endpoints():
+    from raft_tpu.raft.engine import RaftEngine
+    from raft_tpu.transport.device import SingleDeviceTransport
+
+    cfg = RaftConfig(n_replicas=3, entry_bytes=32, batch_size=4,
+                     log_capacity=64, transport="single")
+    e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+    board = StatusBoard()
+    e.status_board = board
+    e.run_until_leader()
+    seq = e.submit(bytes(cfg.entry_bytes))
+    e.run_until_committed(seq)
+    with OpsServer(board=board, port=0) as srv:
+        st, body = _get(srv.port, "/status")
+        snap = json.loads(body)
+        assert snap["groups"] == 1
+        assert snap["commit_watermark"]["0"] >= 1
+        assert snap["leaders"]["0"]["replica"] == e.leader_id
+        # unattached planes answer 404, not 500
+        assert _get(srv.port, "/metrics")[0] == 404
+        assert _get(srv.port, "/slo")[0] == 404
+
+
+def test_router_breakers_publish_into_status():
+    from raft_tpu.multi.engine import MultiEngine
+    from raft_tpu.multi.router import Router
+
+    cfg = RaftConfig(n_replicas=3, entry_bytes=32, batch_size=4,
+                     log_capacity=64, transport="single")
+    eng = MultiEngine(cfg, 2)
+    board = StatusBoard()
+    eng.status_board = board
+    router = Router(eng, breaker_threshold=2)
+    # drive the group-0 breaker open through its own state machine —
+    # every transition must publish the breakers section to the board
+    for _ in range(2):
+        router.breakers[0].on_failure(eng.clock.now)
+    snap = board.compose()
+    assert snap.get("breakers", {}).get("0") == "open"
+    assert snap["breakers"]["1"] == "closed"
+
+
+def test_serve_demo_smoke():
+    """The CLI entry (python -m raft_tpu.obs --serve) drives traffic and
+    returns its result dict after the duration elapses."""
+    from raft_tpu.obs.serve import serve_demo
+
+    out = serve_demo(port=0, groups=2, duration_s=0.4)
+    assert out["submitted"] > 0
+    assert out["committed"] > 0
+    assert out["violations"] == 0

@@ -1,0 +1,190 @@
+"""SLO plane (obs.slo): digest accuracy/merge bounds and multi-window
+burn-rate alerting on the virtual clock."""
+
+import math
+
+import numpy as np
+import pytest
+
+from raft_tpu.obs.slo import (
+    _FACTOR,
+    LatencyDigest,
+    SLObjective,
+    SloAlert,
+    SloTracker,
+)
+
+#: one bucket factor is the documented relative-error bound; the
+#: geometric-midpoint estimate is within sqrt(factor) of a bucket edge,
+#: so factor itself is a safe outer bound for the assertion
+REL_ERR = _FACTOR - 1.0
+
+
+class TestLatencyDigest:
+    def test_quantile_accuracy_bound(self):
+        rng = np.random.default_rng(7)
+        samples = rng.lognormal(mean=-4.0, sigma=1.5, size=20_000)
+        d = LatencyDigest()
+        for v in samples:
+            d.observe(float(v))
+        for q in (0.5, 0.9, 0.99, 0.999):
+            true = float(np.quantile(samples, q))
+            est = d.quantile(q)
+            assert abs(est - true) / true <= REL_ERR, (q, est, true)
+
+    def test_observe_many_matches_observe(self):
+        rng = np.random.default_rng(3)
+        samples = rng.lognormal(mean=-2.0, sigma=2.0, size=5_000)
+        one = LatencyDigest()
+        for v in samples:
+            one.observe(float(v))
+        bulk = LatencyDigest()
+        bulk.observe_many(samples)
+        assert (one.counts == bulk.counts).all()
+        assert one.n == bulk.n
+        assert math.isclose(one.total, bulk.total, rel_tol=1e-9)
+
+    def test_merge_equals_union(self):
+        rng = np.random.default_rng(11)
+        a_s = rng.lognormal(-3.0, 1.0, 3_000)
+        b_s = rng.lognormal(-1.0, 1.0, 3_000)
+        a, b, u = LatencyDigest(), LatencyDigest(), LatencyDigest()
+        a.observe_many(a_s)
+        b.observe_many(b_s)
+        u.observe_many(np.concatenate([a_s, b_s]))
+        a.merge(b)
+        assert (a.counts == u.counts).all()
+        assert a.n == u.n
+        for q in (0.5, 0.99):
+            assert a.quantile(q) == u.quantile(q)
+
+    def test_extremes_clamp_into_terminal_buckets(self):
+        d = LatencyDigest()
+        d.observe(0.0)
+        d.observe(-1.0)
+        d.observe(float("nan"))
+        d.observe(1e12)
+        assert d.n == 4
+        assert d.counts[0] == 3
+        assert d.counts[-1] == 1
+        assert math.isfinite(d.quantile(0.5))
+
+    def test_empty_digest(self):
+        d = LatencyDigest()
+        assert math.isnan(d.quantile(0.5))
+        j = d.to_jsonable()
+        assert j["n"] == 0 and j["p99"] is None
+
+
+class TestBurnRate:
+    WINDOWS = ((600.0, 60.0, 10.0, "page"),)
+
+    def _tracker(self):
+        return SloTracker(
+            objectives=(SLObjective("lat", "commit", threshold_s=1.0,
+                                    target=0.99),),
+            bucket_s=10.0, windows=self.WINDOWS,
+        )
+
+    def test_quiet_under_target(self):
+        tr = self._tracker()
+        for i in range(600):
+            tr.observe("commit", 0.5, float(i))   # all good
+        tr.evaluate(600.0)
+        assert tr.alerts == [] and tr.active_alerts() == []
+
+    def test_fires_on_fast_burn_and_clears(self):
+        tr = self._tracker()
+        t = 0.0
+        for i in range(700):
+            t = float(i)
+            # 50% bad >> the 1% budget: burn rate 50 > threshold 10
+            tr.observe("commit", 2.0 if i % 2 else 0.5, t)
+            tr.maybe_evaluate(t)
+        assert any(a.kind == "fire" and a.severity == "page"
+                   for a in tr.alerts)
+        assert tr.active_alerts()
+        # recovery: the short window drains while the long still burns
+        for i in range(120):
+            t += 1.0
+            tr.observe("commit", 0.5, t)
+            tr.maybe_evaluate(t)
+        assert not tr.active_alerts()
+        assert any(a.kind == "clear" for a in tr.alerts)
+
+    def test_both_windows_required(self):
+        """A short bad blip must NOT page: the long window has no
+        significant burn yet."""
+        tr = self._tracker()
+        t = 0.0
+        for i in range(580):
+            t = float(i)
+            tr.observe("commit", 0.5, t)          # long quiet history
+        for i in range(20):
+            t += 1.0
+            tr.observe("commit", 5.0, t)          # 20 s blip
+        tr.evaluate(t)
+        # short window burns hot, long window stays under threshold
+        assert not tr.active_alerts()
+
+    def test_alert_recorded_and_counted(self):
+        from raft_tpu.obs.events import FlightRecorder
+        from raft_tpu.obs.registry import MetricsRegistry
+
+        rec, reg = FlightRecorder(), MetricsRegistry()
+        tr = SloTracker(
+            objectives=(SLObjective("lat", "commit", 1.0, 0.99),),
+            recorder=rec, registry=reg, bucket_s=10.0,
+            windows=self.WINDOWS,
+        )
+        for i in range(700):
+            tr.observe("commit", 2.0, float(i))
+            tr.maybe_evaluate(float(i))
+        evs = rec.events(kind="slo_alert")
+        assert evs and evs[0].fields["severity"] == "page"
+        assert reg.get("raft_slo_alerts_total").value(
+            slo="lat", severity="page") >= 1
+
+    def test_per_group_isolation(self):
+        tr = self._tracker()
+        for i in range(700):
+            tr.observe("commit", 2.0, float(i), group=1)   # group 1 burns
+            tr.observe("commit", 0.5, float(i), group=2)   # group 2 fine
+            tr.maybe_evaluate(float(i))
+        groups = {a.group for a in tr.alerts if a.kind == "fire"}
+        assert groups == {1}
+
+    def test_snapshot_jsonable(self):
+        import json
+
+        tr = self._tracker()
+        for i in range(100):
+            tr.observe("commit", 0.5 if i % 2 else 3.0, float(i))
+        tr.evaluate(100.0)
+        snap = tr.snapshot()
+        json.dumps(snap)                          # must round-trip
+        assert snap["objectives"][0]["name"] == "lat"
+        grp = snap["objectives"][0]["groups"]["default"]
+        assert grp["total"] == 100 and 0 < grp["good_fraction"] < 1
+        assert "commit" in snap["digests"]
+
+
+def test_alert_dataclass_fields():
+    a = SloAlert(slo="x", group=None, severity="page", burn_rate=12.0,
+                 long_s=600.0, short_s=60.0, t_virtual=5.0)
+    assert a.kind == "fire"
+
+
+@pytest.mark.parametrize("bad_frac,should_fire", [(0.0, False),
+                                                  (0.5, True)])
+def test_threshold_edge(bad_frac, should_fire):
+    tr = SloTracker(
+        objectives=(SLObjective("lat", "commit", 1.0, 0.99),),
+        bucket_s=10.0, windows=((600.0, 60.0, 10.0, "page"),),
+    )
+    rng = np.random.default_rng(1)
+    for i in range(700):
+        bad = rng.random() < bad_frac
+        tr.observe("commit", 2.0 if bad else 0.5, float(i))
+        tr.maybe_evaluate(float(i))
+    assert bool([a for a in tr.alerts if a.kind == "fire"]) == should_fire

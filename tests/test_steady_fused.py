@@ -280,6 +280,8 @@ def test_engine_differential_fused_vs_general():
     assert outs["general"][0] == outs["fused"][0]
 
 
+@pytest.mark.slow   # wall budget: EC composition variant; the non-EC
+#   fused-vs-general differential stays tier-1
 def test_ec_schedule_fused_vs_general():
     """EC (RS(5,3)) steps through the fused kernel: the EC program has no
     repair window, so the pre-encoded shard batch must ride the fused
@@ -458,6 +460,8 @@ class TestPipelineKernel:
         st, info = self._run_both(cfg, wins, counts, [False] * N)
         assert int(info.commit_index) == T * B
 
+    @pytest.mark.slow   # wall budget (README "Testing strategy"): composition
+    #   variant; its base equivalence pin stays tier-1
     def test_slow_follower_matches_scan(self):
         cfg = RaftConfig(n_replicas=N, entry_bytes=8, batch_size=B,
                          log_capacity=1024)
@@ -483,6 +487,8 @@ class TestPipelineKernel:
         assert int(np.asarray(st.last_index)[0]) == C   # 2 steps appended
         assert int(info.commit_index) == 0
 
+    @pytest.mark.slow   # wall budget (README "Testing strategy"): composition
+    #   variant; its base equivalence pin stays tier-1
     def test_member_shrunk_pipeline_commits(self):
         """ADVICE r4 (medium), pipeline flavor: with membership shrunk
         below the initial majority (non-EC), the launch-feasibility
@@ -953,6 +959,8 @@ class TestTurnoverKernel:
             )
 
 
+    @pytest.mark.slow   # wall budget (README "Testing strategy"): composition
+    #   variant; its base equivalence pin stays tier-1
     def test_slow_row_turnover_scale_preserves_quiet_rows(self):
         """At turnover scale with a non-accepting row, all_accept must
         route to the general (aliased) pipeline: the quiet row's ring

@@ -1,0 +1,84 @@
+"""Tier-1 wall-budget guard (riding test_lint.py's skip-if-unavailable
+pattern).
+
+conftest.py has written the per-file duration artifact since round 6
+(RAFT_TPU_T1_DURATIONS, default /tmp/raft_tpu_t1_durations.json:
+budget / total / headroom + per-file seconds) — but nothing ENFORCED
+the headroom rule, so suite growth was only caught when a run finally
+died rc=124 at the external 870 s kill. This gate fails when the last
+recorded FULL tier-1 run's headroom dropped below 5% of the budget, so
+the PR that eats the margin is the PR that sees the failure.
+
+The artifact is written at session FINISH, so the gate necessarily
+judges the previous full run (this run's own total is unknowable while
+it is still running); a partial session's artifact (single file, -k
+filter) is self-identifying via its file count and is skipped, exactly
+as conftest documents. Missing artifact = skip (first run on a fresh
+machine), visible in the report like the ruff gate's missing-tool skip.
+"""
+
+import json
+import os
+
+import pytest
+
+from conftest import T1_BUDGET_S
+
+#: below this fraction of the budget remaining, the suite is one bad
+#: variance roll away from rc=124 — fail the PR, not the next one
+MIN_HEADROOM_FRAC = 0.05
+
+#: a genuine tier-1 session touches ~50 test files; anything far below
+#: that is a partial run (-k / single file) whose headroom says nothing
+MIN_FILES_FOR_FULL_RUN = 30
+
+
+def _artifact_path() -> str:
+    return os.environ.get(
+        "RAFT_TPU_T1_DURATIONS", "/tmp/raft_tpu_t1_durations.json"
+    )
+
+
+def test_tier1_headroom_above_floor():
+    path = _artifact_path()
+    if not path or not os.path.exists(path):
+        pytest.skip(
+            f"no duration artifact at {path!r} yet (first run on this "
+            "machine); the gate engages from the next full session"
+        )
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError) as ex:
+        pytest.skip(f"duration artifact unreadable ({ex})")
+    n_files = doc.get("n_files", 0)
+    if n_files < MIN_FILES_FOR_FULL_RUN:
+        pytest.skip(
+            f"artifact records a partial session ({n_files} files, "
+            f"argv={doc.get('argv')}): headroom not meaningful"
+        )
+    budget = float(doc.get("budget_s", T1_BUDGET_S))
+    headroom = float(doc.get("headroom_s", budget))
+    floor = MIN_HEADROOM_FRAC * budget
+    slowest = list(doc.get("files", {}).items())[:5]
+    assert headroom >= floor, (
+        f"tier-1 headroom {headroom:.0f}s is below the "
+        f"{MIN_HEADROOM_FRAC:.0%} floor ({floor:.0f}s of the "
+        f"{budget:.0f}s budget): the suite is one variance roll from "
+        f"rc=124. Move the heaviest additions behind the `slow` marker "
+        f"(README 'Testing strategy'); slowest files last run: "
+        f"{slowest}"
+    )
+
+
+def test_artifact_schema_matches_conftest():
+    """If someone edits conftest's artifact writer, this gate must not
+    silently go blind: pin the fields the guard reads."""
+    path = _artifact_path()
+    if not path or not os.path.exists(path):
+        pytest.skip("no duration artifact yet")
+    with open(path) as fh:
+        doc = json.load(fh)
+    for field in ("argv", "n_files", "budget_s", "total_wall_s",
+                  "headroom_s", "files"):
+        assert field in doc, f"artifact lost field {field!r}"

@@ -1,0 +1,229 @@
+"""Bench regression gate: diff two bench JSON artifacts leg by leg.
+
+``bench.py`` has emitted per-leg JSON rows plus a final combined object
+since round 2, and the repo keeps the per-round artifacts
+(BENCH_r01..r05) — but nothing ever READ them, so the perf trajectory
+was write-only: a regression surfaced only when a human eyeballed two
+files. This module closes the loop:
+
+    python tools/bench_diff.py OLD.json NEW.json [--threshold 0.10]
+    python bench.py --compare OLD.json [--regress-threshold 0.10]
+
+Both print a leg-by-leg delta table and exit non-zero when any GATED
+metric regressed past the threshold (fractional: 0.10 = 10%).
+
+Accepted artifact shapes (auto-detected):
+- raw ``bench.py`` stdout: one JSON object per line, final line the
+  combined object (``configs`` maps leg name -> row);
+- the repo's BENCH_rNN wrapper: ``{"cmd", "rc", "tail", "parsed"}`` —
+  ``parsed`` when present, else the combined/leg lines inside ``tail``
+  (a deadline- or rc=124-killed run still yields its finished legs);
+- a bare combined object.
+
+Gating policy: only well-known metric keys gate (direction matters —
+``p50_us`` regresses UP, ``entries_per_sec`` regresses DOWN); legs or
+keys present on one side only are reported as ``added``/``removed`` but
+never gate, and rows skipped by the deadline (``{"skipped":
+"deadline"}``) are reported as ``skipped`` — "not measured" must stay
+distinguishable from "measured and regressed".
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: metric key -> direction ("down" = smaller is better). Only these
+#: gate; every other shared numeric key is reported ungated.
+GATED_METRICS: Dict[str, str] = {
+    "p50_us": "down",
+    "p99_us": "down",
+    "wall_slope_us": "down",
+    "wall_us_per_tick": "down",
+    "wall_us_per_tick_observe_off": "down",
+    "wall_us_per_leader_tick": "down",
+    "us_per_tick": "down",
+    "entries_per_sec": "up",
+    "goodput_eps": "up",
+    "entries_per_sec_wall": "up",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Delta:
+    leg: str
+    metric: str
+    old: Optional[float]
+    new: Optional[float]
+    change: Optional[float]       # signed fraction, regression-positive
+    status: str                   # ok|regressed|improved|added|removed|skipped
+    gated: bool
+
+
+def _flatten_legs(doc: dict) -> Dict[str, dict]:
+    """Leg name -> row from a combined object (top-level headline
+    metrics become a synthetic ``headline`` leg)."""
+    legs: Dict[str, dict] = {}
+    configs = doc.get("configs")
+    if isinstance(configs, dict):
+        for name, row in configs.items():
+            if isinstance(row, dict):
+                legs[name] = row
+    headline = {
+        k: doc[k]
+        for k in ("value", "p99_us", "entries_per_sec", "wall_slope_us")
+        if isinstance(doc.get(k), (int, float))
+    }
+    if headline:
+        if "value" in headline and doc.get("metric") == "commit_p50_latency":
+            headline["p50_us"] = headline.pop("value")
+        legs["headline"] = headline
+    return legs
+
+
+def load_bench(path: str) -> Dict[str, dict]:
+    """Parse any accepted artifact shape into leg name -> row."""
+    with open(path) as fh:
+        text = fh.read()
+    legs: Dict[str, dict] = {}
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc and "cmd" in doc:
+        # BENCH_rNN wrapper: prefer the parsed combined object, fall
+        # back to the JSON lines inside the captured stdout tail
+        if isinstance(doc.get("parsed"), dict):
+            return _flatten_legs(doc["parsed"])
+        text = doc.get("tail") or ""
+        doc = None
+    if isinstance(doc, dict) and "leg" in doc:
+        # a single leg row (the sole survivor of a killed run)
+        return {doc["leg"]: {k: v for k, v in doc.items() if k != "leg"}}
+    if isinstance(doc, dict):
+        flattened = _flatten_legs(doc)
+        if flattened:
+            return flattened
+        raise ValueError(
+            f"{path}: no bench legs found (not a bench.py artifact?)"
+        )
+    # JSON-lines stdout: leg rows first, combined object last
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(row, dict):
+            continue
+        if "configs" in row:
+            combined = _flatten_legs(row)
+            combined.update(
+                {k: v for k, v in legs.items() if k not in combined}
+            )
+            legs = combined
+        elif "leg" in row:
+            name = row["leg"]
+            legs[name] = {k: v for k, v in row.items() if k != "leg"}
+    if not legs:
+        raise ValueError(
+            f"{path}: no bench legs found (not a bench.py artifact?)"
+        )
+    return legs
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        return float(v)
+    return None
+
+
+def compare_runs(
+    old: Dict[str, dict], new: Dict[str, dict], threshold: float = 0.10
+) -> Tuple[List[Delta], List[Delta]]:
+    """(all deltas, gated regressions past threshold)."""
+    deltas: List[Delta] = []
+    for leg in sorted(set(old) | set(new)):
+        orow, nrow = old.get(leg), new.get(leg)
+        if orow is None or nrow is None:
+            deltas.append(Delta(
+                leg, "-", None, None, None,
+                "added" if orow is None else "removed", False,
+            ))
+            continue
+        if nrow.get("skipped") or orow.get("skipped"):
+            deltas.append(Delta(leg, "-", None, None, None,
+                                "skipped", False))
+            continue
+        for metric in sorted(set(orow) & set(nrow)):
+            ov, nv = _num(orow.get(metric)), _num(nrow.get(metric))
+            if ov is None or nv is None:
+                continue
+            direction = GATED_METRICS.get(metric)
+            if direction is None:
+                continue
+            # signed change, positive = regression in the gated sense
+            if ov == 0:
+                change = 0.0 if nv == 0 else math.inf
+            else:
+                change = (nv - ov) / abs(ov)
+            if direction == "up":
+                change = -change
+            status = ("regressed" if change > threshold
+                      else "improved" if change < -threshold else "ok")
+            deltas.append(Delta(leg, metric, ov, nv, change, status, True))
+    regressions = [d for d in deltas
+                   if d.gated and d.status == "regressed"]
+    return deltas, regressions
+
+
+def format_table(deltas: List[Delta], threshold: float) -> str:
+    """The human-readable delta table (regression-positive percent)."""
+    rows = [("leg", "metric", "old", "new", "delta", "status")]
+    for d in deltas:
+        rows.append((
+            d.leg, d.metric,
+            "-" if d.old is None else f"{d.old:.4g}",
+            "-" if d.new is None else f"{d.new:.4g}",
+            "-" if d.change is None else f"{d.change * 100:+.1f}%",
+            d.status,
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(6)]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    lines.insert(1, "-" * len(lines[0]))
+    n_reg = sum(1 for d in deltas if d.status == "regressed")
+    lines.append(
+        f"{n_reg} regression(s) past the {threshold * 100:g}% threshold"
+        if n_reg else
+        f"no regressions past the {threshold * 100:g}% threshold"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/bench_diff.py",
+        description="diff two bench.py JSON artifacts leg by leg; "
+                    "non-zero exit on regression past the threshold",
+    )
+    ap.add_argument("old", help="baseline artifact (e.g. BENCH_r04.json)")
+    ap.add_argument("new", help="candidate artifact")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="fractional regression gate (default 0.10)")
+    args = ap.parse_args(argv)
+    deltas, regressions = compare_runs(
+        load_bench(args.old), load_bench(args.new), args.threshold
+    )
+    print(format_table(deltas, args.threshold))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
