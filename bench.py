@@ -43,6 +43,9 @@ replication tick, main.go:394).
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 from typing import Callable
 
@@ -1566,6 +1569,333 @@ def bench_multi_group() -> dict:
     return rows
 
 
+def _group_shard_sweep(deadline_s: float | None = None) -> dict:
+    """The sharded G-sweep body (runs where >= 2 devices are visible —
+    the virtual-CPU mesh child, or any real multi-chip backend).
+
+    Per G ∈ {64, 256, 1024}, incrementally (``_emit_leg``):
+
+    - **device row**: one K-tick ``fused_group_scan`` launch through the
+      ``mesh_groups`` shard_map program — per-group µs/tick with the
+      launch shared by every shard (the acceptance metric: at G=256
+      this must beat the single-device G=16 saturation value in
+      docs/PERF.md), plus the same launch through the single-device
+      vmap path for the in-leg amortization comparison;
+    - **engine row**: end-to-end aggregate committed entries/s through
+      the sharded ``MultiEngine`` (submit → durable-ack, host control
+      plane included), ``leader_spread``, and launches-per-tick
+      (every same-instant round must ride ONE shared launch across all
+      shards, not one per shard);
+    - **migration row** (largest completed G): a mid-load
+      ``migrate_group`` — host wall ms for the staged move and the
+      virtual catch-up window it consumed.
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu.core.state import init_group_state
+    from raft_tpu.core.step import fused_group_scan
+    from raft_tpu.multi import MultiEngine
+    from raft_tpu.transport.group_mesh import GroupMeshTransport
+
+    t0 = time.monotonic()
+
+    def expired() -> bool:
+        return (
+            deadline_s is not None
+            and time.monotonic() - t0 >= deadline_s
+        )
+
+    rows: dict = {}
+    K = 32
+    mig_engine = mig_mk = None
+    for G in (64, 256, 1024):
+        name = f"group_shard_g{G}"
+        if expired():
+            rows[f"G{G}"] = _emit_leg(name, {"skipped": "deadline"})
+            continue
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=64, batch_size=16,
+            log_capacity=1 << 10, transport="mesh_groups", seed=9,
+        )
+        R, B = cfg.n_replicas, cfg.batch_size
+        rng = np.random.default_rng(G)
+        # ---- device row: one fused K-tick launch over the mesh -------
+        t = GroupMeshTransport(cfg, G)
+        payloads = jnp.asarray(rng.integers(
+            np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+            (K, G, B, cfg.shard_words), dtype=np.int32,
+        ))
+        counts = jnp.full((K, G), B, jnp.int32)
+        leaders = jnp.asarray([g % R for g in range(G)], jnp.int32)
+        terms = jnp.ones((G,), jnp.int32)
+        alive = jnp.ones((G, R), bool)
+        slow = jnp.zeros((G, R), bool)
+        member = jnp.ones((G, R), bool)
+        halted0 = jnp.zeros((G,), bool)
+
+        # timed region = the LAUNCH only: the donated state chains from
+        # launch to launch (the steady cluster keeps committing, so no
+        # escape ever fires), keeping host state construction and
+        # device placement — O(G) setup work — OUT of the gated
+        # per-tick metric
+        def mesh_launch(st):
+            out = t.replicate_fused(
+                st, payloads, counts, jnp.int32(K), halted0, leaders,
+                terms, alive, slow, member,
+            )
+            jax.block_until_ready(out[1].commit_index)
+            return out
+
+        out = mesh_launch(t.shard_state(init_group_state(cfg, G)))
+        assert int(np.asarray(out[1].commit_index)[-1].min()) == K * B
+        assert not np.asarray(out[2]).any()       # no escapes: steady
+        st = out[0]
+        samples = []
+        for _ in range(3):
+            w0 = time.perf_counter()
+            out = mesh_launch(st)
+            samples.append(time.perf_counter() - w0)
+            st = out[0]
+        mesh_us = min(samples) / (K * G) * 1e6
+        # same shape through the single-device vmap path (payloads
+        # resident on one device) — the saturation the sharding exists
+        # to break
+        vstep = jax.jit(
+            fused_group_scan(R),
+            donate_argnums=(0,), device=jax.devices()[0],
+        )
+        pay_1d = jax.device_put(payloads, jax.devices()[0])
+
+        def single_launch(st):
+            out = vstep(st, pay_1d, counts, jnp.int32(K), halted0,
+                        leaders, terms, alive, slow, member)
+            jax.block_until_ready(out[1].commit_index)
+            return out
+
+        out = single_launch(jax.device_put(
+            init_group_state(cfg, G), jax.devices()[0]
+        ))
+        st = out[0]
+        samples = []
+        for _ in range(3):
+            w0 = time.perf_counter()
+            out = single_launch(st)
+            samples.append(time.perf_counter() - w0)
+            st = out[0]
+        single_us = min(samples) / (K * G) * 1e6
+
+        # the single-device saturation REFERENCE at this exact shape:
+        # G=16 through the vmap path (the knee docs/PERF.md measured at
+        # the heavier shape) — measured once, in-leg, so the G=256
+        # acceptance comparison is shape-fair
+        if "single_g16_us_per_group_tick" not in rows:
+            pay16 = jax.device_put(payloads[:, :16], jax.devices()[0])
+
+            def g16_launch(st):
+                out = vstep(
+                    st, pay16, counts[:, :16], jnp.int32(K),
+                    halted0[:16], leaders[:16], terms[:16],
+                    alive[:16], slow[:16], member[:16],
+                )
+                jax.block_until_ready(out[1].commit_index)
+                return out[0]
+
+            st16 = g16_launch(jax.device_put(
+                init_group_state(cfg, 16), jax.devices()[0]
+            ))
+            g16 = []
+            for _ in range(3):
+                w0 = time.perf_counter()
+                st16 = g16_launch(st16)
+                g16.append(time.perf_counter() - w0)
+            rows["single_g16_us_per_group_tick"] = round(
+                min(g16) / (K * 16) * 1e6, 3
+            )
+
+        # ---- engine row: end-to-end through the sharded engine -------
+        e = MultiEngine(cfg, G)
+        e.seed_leaders()
+        launches = [0]
+        ticks = [0]
+        orig_rep = e._gshard.replicate
+        orig_fire = e._fire_leader_ticks
+
+        def counting(*a, **kw):
+            launches[0] += 1
+            return orig_rep(*a, **kw)
+
+        def counting_fire(tick_list):
+            ticks[0] += 1                 # one same-instant round
+            return orig_fire(tick_list)
+
+        e._gshard.replicate = counting
+        e._fire_leader_ticks = counting_fire
+        per_group = 64
+        mk = lambda: rng.integers(
+            0, 256, cfg.entry_bytes, np.uint8
+        ).tobytes()
+        last = {}
+        for g in range(G):                        # warm one batch
+            for _ in range(B):
+                last[g] = e.submit(g, mk())
+        for g in range(G):
+            e.run_until_committed(g, last[g])
+        launches[0] = ticks[0] = 0
+        t_virtual0 = e.clock.now
+        w0 = time.perf_counter()
+        for g in range(G):
+            for _ in range(per_group):
+                last[g] = e.submit(g, mk())
+        for g in range(G):
+            e.run_until_committed(g, last[g])
+        wall = time.perf_counter() - w0
+        total = G * per_group
+        lat = np.array([
+            e.commit_time[g][s] - e.submit_time[g][s]
+            for g in range(G) for s in e.commit_time[g]
+            if e.submit_time[g].get(s, -1.0) >= t_virtual0
+        ])
+
+        # keep the engine for the post-sweep migration row (measured
+        # ONCE, on the largest completed G — measuring per G would burn
+        # a swap-program compile per shape for rows that get discarded)
+        mig_engine, mig_mk = e, mk
+
+        rows[f"G{G}"] = _emit_leg(name, {
+            "groups": G,
+            "shards": e.n_shards,
+            "fused_ticks": K,
+            "mesh_us_per_group_tick": round(mesh_us, 3),
+            "single_device_us_per_group_tick": round(single_us, 3),
+            # aggregate launch throughput: K*G*B entries per launch over
+            # wall = mesh_us*K*G, so the G cancels — B/µs-per-group-tick
+            "mesh_entries_per_sec": round(B / mesh_us * 1e6, 1),
+            "entries": total,
+            "entries_per_sec_wall": round(total / wall, 1),
+            "wall_s": round(wall, 3),
+            "virtual_commit_p50_s": round(
+                float(np.percentile(lat, 50)), 3
+            ) if lat.size else None,
+            # ONE shared launch per same-instant round across all
+            # shards (the amortization acceptance): must stay ~1.0, a
+            # per-shard dispatch would read n_shards
+            "launches_per_tick": round(
+                launches[0] / max(ticks[0], 1), 3
+            ),
+            "leader_spread": {str(k): v for k, v in sorted(
+                e.leader_spread().items()
+            )},
+            "batch": B,
+            "entry_bytes": cfg.entry_bytes,
+        })
+    # ---- migration under load: once, on the largest completed G -----
+    # two moves: the first pays the one-time swap-program compile, the
+    # second is the steady per-move cost
+    if mig_engine is not None and not expired():
+        e, mk = mig_engine, mig_mk
+        for g in range(e.G):
+            e.submit(g, mk())                     # queued load
+        mig_ms = []
+        mvs = []
+        for _ in range(2):
+            # always one shard over from wherever the group sits NOW —
+            # a real move on any shard count >= 2 (a fixed offset pair
+            # would make the second move a src==dst no-op on 2 shards)
+            m0 = time.perf_counter()
+            mv = e.migrate_group(0, (e.shard_of(0) + 1) % e.n_shards)
+            mig_ms.append((time.perf_counter() - m0) * 1e3)
+            mvs.append(mv)
+        s = e.submit(0, mk())
+        e.run_until_committed(0, s)
+        rows["migration"] = _emit_leg("group_shard_migration", {
+            "groups": e.G,
+            "moves": [
+                {k: mv[k] for k in ("group", "src", "dst", "catch_up_s")}
+                for mv in mvs
+            ],
+            "first_move_ms": round(mig_ms[0], 2),
+            "steady_move_ms": round(mig_ms[1], 2),
+            "committed_after_move": True,
+        })
+    return rows
+
+
+def bench_group_shard(deadline_s: float | None = None) -> dict:
+    """The ``group_shard`` leg: the sharded-group-axis sweep
+    (``_group_shard_sweep``) on a multi-device backend. With one device
+    visible (this environment's default CPU), re-exec the sweep in a
+    child on the 8-virtual-device CPU mesh — the ``dryrun_multichip``
+    env recipe — streaming the child's incremental rows through so the
+    one-JSON-row-per-leg protocol (and a deadline kill mid-sweep) keeps
+    working."""
+    if len(jax.devices()) >= 2:
+        return _group_shard_sweep(deadline_s)
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    child = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"import sys; sys.path.insert(0, {here!r})\n"
+        "import json\n"
+        "import bench\n"
+        f"rows = bench._group_shard_sweep({deadline_s!r})\n"
+        "print('GROUP_SHARD_RESULT ' + json.dumps(rows), flush=True)\n"
+    )
+    timeout = (deadline_s if deadline_s is not None else 600.0) + 120.0
+    # stream the child's stdout LINE BY LINE: each completed G row
+    # re-prints the moment it arrives, so an external kill of THIS
+    # process mid-sweep (the rc=124 scenario the incremental protocol
+    # exists for) still leaves every finished row on stdout. A kill
+    # timer backstops a child that wedges before its own deadline
+    # machinery arms (the dryrun_multichip failure mode).
+    import tempfile
+    import threading
+
+    # stderr to a file, not a pipe: an unread stderr PIPE backs up at
+    # ~64 KB and deadlocks a chatty child against our stdout loop
+    with tempfile.TemporaryFile(mode="w+") as err:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child], env=env, cwd=here,
+            stdout=subprocess.PIPE, stderr=err, text=True,
+        )
+        killed = []
+        timer = threading.Timer(
+            timeout, lambda: (killed.append(True), proc.kill())
+        )
+        timer.start()
+        rows = None
+        try:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                if line.startswith('{"leg"'):
+                    print(line, flush=True)   # incremental pass-through
+                elif line.startswith("GROUP_SHARD_RESULT "):
+                    rows = json.loads(line[len("GROUP_SHARD_RESULT "):])
+            proc.wait()
+        finally:
+            timer.cancel()
+        err.seek(0)
+        stderr_tail = err.read()[-2000:]
+    if killed:
+        return {"error": f"virtual-device child killed after {timeout:g}s"}
+    if proc.returncode != 0 or rows is None:
+        return {
+            "error": "group-shard child failed",
+            "returncode": proc.returncode,
+            "stderr_tail": stderr_tail,
+        }
+    return rows
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -1723,6 +2053,19 @@ def main(argv=None) -> None:
         )
     else:
         configs["multi_group"] = bench_multi_group()
+    if dl.expired:
+        dl.skipped.append("group_shard")
+        configs["group_shard"] = _emit_leg(
+            "group_shard", {"skipped": "deadline"}
+        )
+    else:
+        # the sharded sweep inherits the REMAINING budget (its child
+        # self-truncates per G, the dryrun_multichip discipline)
+        remaining = (
+            None if dl.seconds is None
+            else max(dl.seconds - (time.monotonic() - dl.t0), 0.0)
+        )
+        configs["group_shard"] = bench_group_shard(remaining)
 
     # Deadline-degraded runs carry nulls for the headline fields rather
     # than dying with no JSON at all (the rc=124 / parsed:null failure

@@ -13,6 +13,7 @@ import json
 import sys
 
 from raft_tpu.chaos.runner import (
+    migration_run,
     overload_run,
     reconfig_run,
     torture_run,
@@ -57,6 +58,15 @@ def main(argv=None) -> int:
                          "linearizable AND commit progress resumes "
                          "within the documented window after every "
                          "configuration commit")
+    ap.add_argument("--migration", action="store_true",
+                    help="run the deterministic group-migration drill "
+                         "(a mesh-sharded MultiEngine moves groups "
+                         "between shards mid-traffic, Rebalancer-"
+                         "planned) instead of a torture run; succeeds "
+                         "only if the history checks linearizable AND "
+                         "commit progress resumes on every moved group "
+                         "within the documented window; needs a multi-"
+                         "device backend (virtual CPU devices work)")
     ap.add_argument("--overload-recovery", type=float, default=None,
                     metavar="MULT",
                     help="run the deterministic overload-and-recover "
@@ -119,8 +129,35 @@ def main(argv=None) -> int:
     if args.reconfig and (args.multi or args.broken or args.overload
                           or args.overload_recovery is not None):
         ap.error("--reconfig is a standalone single-engine drill")
+    if args.migration and (args.multi or args.broken or args.overload
+                           or args.reconfig
+                           or args.overload_recovery is not None):
+        ap.error("--migration is a standalone sharded-multi drill")
 
     ok = True
+    if args.migration:
+        for seed in range(args.seed, args.seed + args.sweep):
+            rep = migration_run(
+                seed, n_groups=args.groups,
+                clients=args.clients, keys=args.keys,
+                step_budget=args.step_budget,
+                observe=args.observe, bundle_dir=args.bundle_dir,
+                blackbox_dir=args.blackbox_dir,
+            )
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "progress_ok": rep.progress_ok,
+                "moves": rep.moves,
+                "n_shards": rep.n_shards,
+                "ops": rep.ops,
+                "op_counts": rep.op_counts,
+            }), flush=True)
+            ok = ok and (
+                rep.verdict == "LINEARIZABLE" and rep.progress_ok
+            )
+        return 0 if ok else 1
     if args.reconfig:
         for seed in range(args.seed, args.seed + args.sweep):
             rep = reconfig_run(

@@ -1802,3 +1802,153 @@ def _reconfig_run_impl(
         availability_ok=availability_ok,
         repro=repro, bundle_path=bundle_path, obs=run.obs,
     )
+
+
+# --------------------------------------------- group-migration drill
+@dataclasses.dataclass
+class MigrationReport:
+    """Result of :func:`migration_run` — the group-shard acceptance
+    drill: Rebalancer-driven group moves between mesh shards while the
+    sharded-KV client workload runs, with a per-move commit-progress
+    probe. ``verdict`` must stay LINEARIZABLE and every move's probe
+    must land inside ``resume_window_s`` virtual seconds."""
+
+    seed: int
+    check: CheckResult
+    ops: int
+    op_counts: Dict[str, int]
+    moves: List[dict]
+    resume_window_s: float
+    progress_ok: bool
+    n_shards: int
+    repro: str
+    commit_digest: str = ""
+    bundle_path: Optional[str] = None
+    obs: Optional[ObsStack] = None
+
+    @property
+    def verdict(self) -> str:
+        return self.check.verdict
+
+    def summary(self) -> str:
+        return (
+            f"seed={self.seed} verdict={self.verdict} "
+            f"moves={len(self.moves)} shards={self.n_shards} "
+            f"progress_ok={self.progress_ok} ops={self.ops}"
+        )
+
+
+def migration_run(
+    seed: int,
+    n_groups: int = 8,
+    n_moves: int = 3,
+    resume_window_s: float = 120.0,
+    clients: int = 3,
+    keys: int = 8,
+    cfg: Optional[RaftConfig] = None,
+    step_budget: int = 500_000,
+    observe: bool = False,
+    bundle_dir: Optional[str] = None,
+    blackbox_dir: Optional[str] = None,
+) -> MigrationReport:
+    """Migration-under-load: the deterministic drill behind the
+    group-shard acceptance criteria (the randomized composition rides
+    ``torture_run_multi`` under ``RAFT_TPU_GSHARD=1`` — this run
+    isolates the placement story so the progress assertion is crisp).
+
+    A sharded ``MultiEngine`` (``transport="mesh_groups"``; needs a
+    multi-device backend — the 8-virtual-device CPU mesh in CI) serves
+    the ShardedKV torture workload while ``n_moves`` group migrations
+    fire mid-traffic: each move is planned by the StatusBoard-fed
+    :class:`raft_tpu.multi.rebalancer.Rebalancer` when the load spread
+    warrants one, else forced round-robin (the drill must exercise the
+    move even when the synthetic load happens to be balanced). After
+    every move, a probe write on the MOVED group must commit within
+    ``resume_window_s`` virtual seconds, and the whole per-key history
+    must check LINEARIZABLE."""
+    with blackbox.journal_for(f"migration_seed{seed}", blackbox_dir):
+        blackbox.mark("migration_run", seed=seed, n_groups=n_groups,
+                      moves=n_moves)
+        base = cfg or RaftConfig(
+            n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=128,
+            transport="mesh_groups", seed=seed,
+        )
+        run = _MultiTorture(
+            seed, 0, clients, keys, 30.0, base, n_groups,
+            observe=observe,
+        )
+        e = run.engine
+        if e.n_shards < 2:
+            raise RuntimeError(
+                "migration_run needs a sharded layout (>= 2 devices "
+                f"for the gshard axis; engine degraded to "
+                f"{e.transport_mode!r})"
+            )
+        from raft_tpu.multi.rebalancer import Rebalancer
+
+        reb = Rebalancer(e)
+        slice_s = 2 * run.cfg.heartbeat_period
+        moves: List[dict] = []
+
+        def drive(seconds: float) -> None:
+            t_end = run.now() + seconds
+            while run.now() < t_end:
+                run._invoke_idle()
+                run.drive(slice_s)
+                run._poll_all()
+
+        drive(30.0)                               # baseline traffic
+        for i in range(n_moves):
+            plan = reb.plan(max_moves=1)
+            if plan:
+                mv = e.migrate_group(plan[0]["group"], plan[0]["dst"])
+                planned = True
+            else:
+                # balanced load: force the busiest group one shard over
+                g = max(range(e.G),
+                        key=lambda gg: (len(e._queue[gg]), -gg))
+                mv = e.migrate_group(g, (e.shard_of(g) + 1) % e.n_shards)
+                planned = False
+            assert mv is not None
+            blackbox.mark("migrate", group=mv["group"], src=mv["src"],
+                          dst=mv["dst"])
+            # progress probe ON THE MOVED GROUP: commit must resume
+            # inside the window, with the client workload still running
+            t0 = run.now()
+            probe = e.submit(mv["group"], bytes(run.cfg.entry_bytes))
+            end = t0 + resume_window_s
+            while not e.is_durable(mv["group"], probe) and \
+                    run.now() < end and e._q:
+                e.step_event()
+            mv.update({
+                "planned": planned,
+                "resume_s": (run.now() - t0)
+                if e.is_durable(mv["group"], probe) else None,
+                "ok": e.is_durable(mv["group"], probe),
+            })
+            moves.append(mv)
+            drive(slice_s)                        # traffic between moves
+
+        run.quiesce()
+        run.history.close()
+        blackbox.mark("check_history", ops=len(run.history))
+        check = check_history(run.history, step_budget=step_budget)
+        blackbox.mark("check_done", verdict=check.verdict)
+    progress_ok = bool(moves) and all(m["ok"] for m in moves)
+    repro = (
+        f"python -m raft_tpu.chaos --migration --seed {seed} "
+        f"--groups {n_groups}"
+    )
+    bundle_path = _maybe_bundle(
+        "migration", run, check, LINEARIZABLE, repro, [], bundle_dir,
+        extra={"moves": moves, "n_shards": e.n_shards},
+        force_unexpected=not progress_ok,
+    )
+    return MigrationReport(
+        seed=seed, check=check, ops=len(run.history),
+        op_counts=run.history.counts(), moves=moves,
+        resume_window_s=resume_window_s, progress_ok=progress_ok,
+        n_shards=e.n_shards, repro=repro,
+        commit_digest=run.commit_digest(), bundle_path=bundle_path,
+        obs=run.obs,
+    )

@@ -43,12 +43,14 @@ divergent suffix left over from an old term and must not (Raft safety).
 
 from __future__ import annotations
 
-from typing import Optional
+import re
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from raft_tpu.config import RaftConfig
 
@@ -193,6 +195,123 @@ def group_view(state: ReplicaState, g: int) -> ReplicaState:
 def slot_of(index: jax.Array, capacity: int) -> jax.Array:
     """Ring slot of 1-based log index ``index``."""
     return (index - 1) % capacity
+
+
+# --------------------------------------------------------------------------
+# Group-axis mesh layout (the (group, replica) sharding of multi-Raft).
+#
+# The group-batched state (``init_group_state``) lays every leaf out with a
+# leading GROUP axis; laying G groups over a device mesh means splitting
+# exactly that axis over a ``gshard`` mesh axis while the within-group
+# axes (replica rows, ring slots, payload lanes) stay shard-local. The
+# layout is expressed as a PARTITION-RULE TABLE — ``(regex, PartitionSpec)``
+# pairs matched against leaf names — rather than a hand-built spec pytree,
+# so a new state leaf is either caught by a rule or fails loudly at
+# construction instead of silently defaulting to replicated (the
+# match_partition_rules / make_shard_and_gather_fns pattern of the big
+# pjit training codebases, SNIPPETS.md [1]-[3]).
+
+#: Mesh axis names of the group layout: ``gshard`` splits the group axis,
+#: ``replica`` is reserved for replica-row placement (size 1 on the
+#: resident per-shard layout, where each shard holds all R rows of its
+#: groups — the vmapped step bodies run unchanged per shard).
+GROUP_AXIS = "gshard"
+REPLICA_AXIS = "replica"
+
+
+def group_partition_rules() -> Tuple[Tuple[str, PartitionSpec], ...]:
+    """The (group, replica) layout as a rule table over leaf names.
+
+    Every ``ReplicaState`` leaf leads with the group axis, so every rule
+    splits dimension 0 over ``gshard``. Each leaf is named EXPLICITLY —
+    no catch-all — so a future leaf that no rule covers fails loudly in
+    ``match_partition_rules`` (a leaf whose leading axis is NOT the
+    group axis must force a conscious rule, never inherit a silent
+    wrong-dimension split). Scalar (0-d) leaves are replicated by
+    ``match_partition_rules`` before any rule is consulted.
+    """
+    return (
+        # the payload ring: [G, C, R*W] — slots and lanes stay local
+        (r"log_payload$", PartitionSpec(GROUP_AXIS)),
+        # the term ring: [G, R, C]
+        (r"log_term$", PartitionSpec(GROUP_AXIS)),
+        # per-replica scalar planes — [G, R]
+        (r"^(term|voted_for|last_index|commit_index"
+         r"|match_index|match_term)$", PartitionSpec(GROUP_AXIS)),
+    )
+
+
+def match_partition_rules(rules, tree):
+    """Rule table -> pytree of ``PartitionSpec`` (SNIPPETS.md [1]).
+
+    Each leaf is matched by the '/'-joined path of its field names
+    against the rules in order; scalar leaves (0-d or single-element)
+    are never partitioned. A leaf no rule matches raises — silence here
+    would mean a silently replicated (= G-times-duplicated) log buffer.
+    """
+    def name_of(path) -> str:
+        parts = []
+        for p in path:
+            if hasattr(p, "name"):
+                parts.append(str(p.name))
+            elif hasattr(p, "key"):
+                parts.append(str(p.key))
+            else:
+                parts.append(str(getattr(p, "idx", p)))
+        return "/".join(parts)
+
+    def spec_of(path, leaf):
+        name = name_of(path)
+        if getattr(leaf, "ndim", 0) == 0 or np.prod(leaf.shape) == 1:
+            return PartitionSpec()
+        for rule, ps in rules:
+            if re.search(rule, name) is not None:
+                return ps
+        raise ValueError(f"no partition rule matched leaf {name!r}")
+
+    return jax.tree_util.tree_map_with_path(spec_of, tree)
+
+
+def make_shard_and_gather_fns(mesh: Mesh, partition_specs):
+    """Pytree of specs -> (shard_fns, gather_fns) pytrees (SNIPPETS [2]).
+
+    ``shard_fns`` place a host/device value onto the mesh with its
+    spec's layout (jit identity with ``out_shardings`` — one transfer,
+    no host-side split); ``gather_fns`` bring a sharded value back to a
+    fully-addressable host array. Both are built once per spec and
+    reused for every launch-boundary placement.
+    """
+    def make_shard_fn(spec):
+        sharding = NamedSharding(mesh, spec)
+
+        def shard_fn(x):
+            return jax.device_put(x, sharding)
+
+        return shard_fn
+
+    def make_gather_fn(spec):
+        def gather_fn(x):
+            return np.asarray(jax.device_get(x))
+
+        return gather_fn
+
+    shard_fns = jax.tree_util.tree_map(
+        make_shard_fn, partition_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+    gather_fns = jax.tree_util.tree_map(
+        make_gather_fn, partition_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+    return shard_fns, gather_fns
+
+
+def group_state_specs(cfg: RaftConfig, n_groups: int) -> ReplicaState:
+    """The group-batched state's spec pytree via the rule table (one
+    source of truth: built from a zero state's leaf names + shapes, so
+    the specs can never drift from the dataclass)."""
+    tmpl = jax.eval_shape(lambda: init_group_state(cfg, n_groups))
+    return match_partition_rules(group_partition_rules(), tmpl)
 
 
 def fold_batch(

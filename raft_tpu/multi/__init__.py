@@ -1,8 +1,26 @@
 """Multi-Raft: G independent consensus groups as one batched device
-program (``MultiEngine``), behind a key-routed sharding front end
-(``Router``). See ``multi.engine`` for the design notes."""
+program (``MultiEngine``) — resident on one device or laid out
+``(group, replica)`` over a mesh (``transport="mesh_groups"``) — behind
+a key-routed sharding front end (``Router``) with a StatusBoard-driven
+placement controller (``Rebalancer``). See ``multi.engine`` for the
+design notes."""
 
-from raft_tpu.multi.engine import MultiEngine, NotLeader, UnsupportedMembership
+from raft_tpu.multi.engine import (
+    GROUP_AXIS_TRANSPORTS,
+    MultiEngine,
+    NotLeader,
+    UnsupportedGroupTransport,
+    UnsupportedMembership,
+)
+from raft_tpu.multi.rebalancer import Rebalancer
 from raft_tpu.multi.router import Router
 
-__all__ = ["MultiEngine", "NotLeader", "Router", "UnsupportedMembership"]
+__all__ = [
+    "GROUP_AXIS_TRANSPORTS",
+    "MultiEngine",
+    "NotLeader",
+    "Rebalancer",
+    "Router",
+    "UnsupportedGroupTransport",
+    "UnsupportedMembership",
+]

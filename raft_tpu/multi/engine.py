@@ -1,4 +1,4 @@
-"""Multi-Raft: G independent consensus groups multiplexed over one device.
+"""Multi-Raft: G independent consensus groups as one batched device program.
 
 Production Raft stores (TiKV, CockroachDB) shard the keyspace into many
 independent Raft groups so no single leader/log/commit stream caps
@@ -8,6 +8,15 @@ axis*, not G engines: all groups' state lives in one group-batched
 ``ReplicaState`` (``core.state.init_group_state``) and same-tick
 replication rounds across groups ride ONE vmapped launch
 (``core.step.group_replicate_step``) instead of G host round-trips.
+
+With ``transport="mesh_groups"`` (or env ``RAFT_TPU_GSHARD=1``) the
+group axis is additionally a real MESH axis: state leaves split over a
+``gshard`` axis by the ``core.state`` partition rules, one shard_map
+launch drives every shard's block of groups, and a logical→physical
+slot table makes group placement DYNAMIC (``migrate_group`` /
+``multi.rebalancer``). One device degrades to the resident vmap path
+below; the two layouts are bit-identical per group (pinned by
+``tests/test_group_shard.py``).
 
 Division of labor mirrors ``raft.engine.RaftEngine`` (which stays the
 single-group engine with the full feature surface — EC, membership
@@ -97,6 +106,41 @@ class UnsupportedMembership(ValueError):
     docs/MEMBERSHIP.md for the single-group-only scope note."""
 
 
+#: Transports that support the GROUP axis (a MultiEngine's state carries
+#: a leading group dimension; a transport must either keep it resident —
+#: "single", the vmapped one-device layout — or shard it over a mesh
+#: axis — "mesh_groups", ``transport.group_mesh``). The per-ROW
+#: transports ("tpu_mesh", "multihost") place replica rows of ONE group
+#: across devices and have no group dimension to carry; they are named
+#: here so the capability refusal can say so precisely.
+GROUP_AXIS_TRANSPORTS = ("single", "mesh_groups")
+
+
+class UnsupportedGroupTransport(ValueError):
+    """Typed capability refusal: the configured transport cannot carry
+    the group axis. Names the supported set (``GROUP_AXIS_TRANSPORTS``)
+    so callers learn the fix, and stays a ``ValueError`` subclass so the
+    pre-existing broad handlers (and the pinned loud-refusal tests) keep
+    working. Raised both for known per-row transports ("tpu_mesh",
+    "multihost" — a setting that would otherwise be silently ignored)
+    and for unknown transport strings (a typo must never fall through to
+    the resident default)."""
+
+    def __init__(self, transport: str):
+        known = transport in ("tpu_mesh", "multihost")
+        why = (
+            "is a per-replica-row transport with no group axis"
+            if known else "is not a known transport"
+        )
+        super().__init__(
+            f"MultiEngine: transport {transport!r} {why}; the group "
+            f"axis is supported by {GROUP_AXIS_TRANSPORTS} (see "
+            "transport.group_mesh for the (group, replica) mesh layout)"
+        )
+        self.transport = transport
+        self.supported = GROUP_AXIS_TRANSPORTS
+
+
 _PROGRAMS: Dict[tuple, tuple] = {}
 
 
@@ -151,6 +195,7 @@ class MultiEngine:
         n_groups: int,
         trace: Optional[Callable[[str], None]] = None,
         recorder=None,
+        mesh=None,
     ):
         if cfg.ec_enabled:
             raise ValueError(
@@ -163,22 +208,60 @@ class MultiEngine:
                 "None (live reconfiguration — learners, add_server, "
                 "replace — is single-group RaftEngine scope)"
             )
-        if cfg.transport != "single":
-            # loud, like the other unsupported knobs: the group axis is
-            # resident (SingleDeviceComm under vmap) — a mesh/multihost
-            # transport setting would otherwise be silently ignored.
-            # Sharding the group axis over a mesh is the natural next
-            # step but is not built yet.
-            raise ValueError(
-                "MultiEngine runs the resident single-device layout; set "
-                f"transport='single' (got {cfg.transport!r})"
-            )
+        transport = cfg.transport
+        if transport not in GROUP_AXIS_TRANSPORTS:
+            # loud AND typed: a per-row transport ("tpu_mesh",
+            # "multihost") or an unknown string must never be silently
+            # ignored in favor of the resident layout
+            raise UnsupportedGroupTransport(transport)
+        if (
+            transport == "single"
+            and (os.environ.get("RAFT_TPU_GSHARD", "") or "0") != "0"
+        ):
+            # env upgrade, mirroring RAFT_TPU_FUSE_K: points every
+            # chaos/torture runner at the sharded layout without config
+            # edits (degrades right back to resident below when the
+            # device set cannot shard this G)
+            transport = "mesh_groups"
         if n_groups < 1:
             raise ValueError("n_groups must be >= 1")
         self.cfg = cfg
         self.G = n_groups
         R = cfg.n_replicas
         self.state: ReplicaState = init_group_state(cfg, n_groups)
+        # ---- group-axis placement (transport.group_mesh) -------------
+        # transport="mesh_groups": the group axis is a real mesh axis —
+        # state leaves split over ``gshard`` by the core.state partition
+        # rules, launches go through shard_map-wrapped builds of the
+        # SAME vmapped step bodies, and the logical→physical slot table
+        # below is what makes group migration a device-side permutation.
+        # One device (or a G the device set cannot split) degrades to
+        # the resident vmap path: placement stays the identity and every
+        # launch uses the process-cached single-device programs.
+        self._gshard = None
+        if transport == "mesh_groups":
+            from raft_tpu.transport.group_mesh import GroupMeshTransport
+
+            t = GroupMeshTransport(cfg, n_groups, mesh=mesh)
+            if t.n_shards > 1:
+                self._gshard = t
+                self.state = t.shard_state(self.state)
+        self.transport_mode = (
+            "mesh_groups" if self._gshard is not None else "single"
+        )
+        self.n_shards = (
+            self._gshard.n_shards if self._gshard is not None else 1
+        )
+        self._slot = np.arange(n_groups)
+        #   logical group -> physical device slot. Identity until a
+        #   migration swaps two groups' slots; EVERY device-facing index
+        #   (state reads, launch operand packing, result unpacking, ring
+        #   decode) goes through it. Host mirrors (roles/terms/queues/
+        #   stamps/heap/rngs) are logical-indexed and never move — which
+        #   is why a migration cannot perturb the control plane.
+        self._phys_group = np.arange(n_groups)
+        #   physical slot -> logical group (the inverse table).
+        self.migrations = 0
         # One compiled program per entry point for EVERY activity subset:
         # masked groups no-op bit-exactly, so the launch shape never varies
         # — and the programs are process-cached across engines (_programs).
@@ -259,16 +342,32 @@ class MultiEngine:
         ]
         self._archive: List[Dict[int, bytes]] = [{} for _ in range(n_groups)]
         #   idx -> committed payload bytes, per group — the apply stream's
-        #   source and the differential tests' read surface. Unbounded by
-        #   design at this layer (a production deployment snapshots +
-        #   truncates, as the single engine's CheckpointStore does) —
-        #   and the per-group commit/submit stamp dicts share that
-        #   scope: the floor-aware stamp eviction lives on the single
-        #   engine (RaftEngine._evict_commit_stamps), whose archive
-        #   actually compacts; bounding stamps here without bounding
-        #   the archive would not bound the layer's memory.
+        #   source and the differential tests' read surface. BOUNDED
+        #   (since the group-shard round): retention sweeps to the same
+        #   ``2 * log_capacity`` horizon the single engine's
+        #   CheckpointStore keeps, never past the apply stream's cursor
+        #   (``_evict_group_history``). At G=256+ the previous
+        #   unbounded-by-design scope was a real memory leak.
+        self._archive_floor = np.ones(n_groups, np.int64)
+        #   first archived index still retained, per group (1 = full
+        #   history). ``register_apply(replay=True)`` can only replay
+        #   from here and says so loudly.
         self.submit_time: List[Dict[int, float]] = [{} for _ in range(n_groups)]
         self.commit_time: List[Dict[int, float]] = [{} for _ in range(n_groups)]
+        #   Per-group bounded stamp dicts, the single engine's eviction
+        #   contract scoped per group (RaftEngine._evict_commit_stamps):
+        #   oldest-first past ``2 * log_capacity`` retained stamps,
+        #   trim-to-exactly-cap (batching-invariant, so the fused and
+        #   tick paths retain identical dicts), evicted committed seqs
+        #   collapsed into merged ``_durable_ranges`` intervals so
+        #   ``is_durable(g, seq)`` still answers for every seq ever
+        #   issued on the group.
+        self.committed_total = np.zeros(n_groups, np.int64)
+        self.commit_stamps_evicted = np.zeros(n_groups, np.int64)
+        self._commit_stamp_cap = 2 * cfg.log_capacity
+        self._durable_ranges: List[List[List[int]]] = [
+            [] for _ in range(n_groups)
+        ]
         self._apply_fns: List[List[Callable[[int, bytes], None]]] = [
             [] for _ in range(n_groups)
         ]
@@ -307,9 +406,10 @@ class MultiEngine:
         rec = self.recorder
         if self._trace is None and rec is None:
             return ""
+        s = self._slot[g]
         ci_li = np.asarray(
             jnp.stack(
-                [self.state.commit_index[g, r], self.state.last_index[g, r]]
+                [self.state.commit_index[s, r], self.state.last_index[s, r]]
             )
         )
         line = (
@@ -350,8 +450,15 @@ class MultiEngine:
 
         self.device_obs = obs if obs is not None else DeviceObs(capacity)
         self.device_obs.new_epoch()   # see RaftEngine.attach_device_obs
-        self._dev_rings = init_group_rings(self.device_obs.capacity, self.G)
-        self._dev_gids = jnp.arange(self.G, dtype=jnp.int32)
+        rings = init_group_rings(self.device_obs.capacity, self.G)
+        if self._gshard is not None:
+            # ring slot s records the group RESIDENT at s: the gid
+            # operand carries the logical id, and a migration swaps the
+            # ring slices along with the state (events stay with their
+            # logical group)
+            rings = self._gshard.shard_rings(rings)
+        self._dev_rings = rings
+        self._dev_gids = jnp.asarray(self._phys_group, dtype=jnp.int32)
         self._dev_flushed = np.zeros(self.G, np.int64)
         self._dev_counters_folded = np.zeros((self.G, N_COUNTERS), np.int64)
         self._replicate_rec, self._vote_rec = _programs(
@@ -361,7 +468,10 @@ class MultiEngine:
 
     def _flush_device_obs(self) -> None:
         """Decode every group's new records from ONE packed fetch; fold
-        per-group counter deltas into the registry (raft_device_*)."""
+        per-group counter deltas into the registry (raft_device_*). On
+        the sharded layout the fetch gathers all shards' ring slices in
+        one device_get and the decode walks them shard by shard in slot
+        order (``_slot[g]`` = the group's physical ring slice)."""
         if self.device_obs is None or self._dev_rings is None:
             return
         from raft_tpu.obs.device import (
@@ -373,7 +483,7 @@ class MultiEngine:
         packed = np.asarray(packed_flush(self._dev_rings))   # [G, cap+1, W]
         for g in range(self.G):
             events, count, lost, counters, _tick = decode_records(
-                packed[g], int(self._dev_flushed[g]),
+                packed[self._slot[g]], int(self._dev_flushed[g]),
                 t_virtual=self.clock.now,
             )
             if count == self._dev_flushed[g] and not np.any(
@@ -457,7 +567,11 @@ class MultiEngine:
         return self.submit(g, payload)
 
     def is_durable(self, g: int, seq: int) -> bool:
-        return seq in self.commit_time[g]
+        if seq in self.commit_time[g]:
+            return True
+        from raft_tpu.raft.ledger import durable_range_covers
+
+        return durable_range_covers(self._durable_ranges[g], seq)
 
     def read_index(self, g: int, r: Optional[int] = None) -> int:
         """Per-group ReadIndex (dissertation §6.4): confirm group ``g``'s
@@ -535,7 +649,7 @@ class MultiEngine:
             eff = self._reach(g, target)
             if int(eff.sum()) <= self.cfg.n_replicas // 2:
                 continue
-            gv = group_view(self.state, g)
+            gv = group_view(self.state, self._slot[g])
             lasts = np.asarray(gv.last_index)
             lterms = np.asarray(last_log_term(gv))
             tkey = (int(lterms[target]), int(lasts[target]))
@@ -561,6 +675,127 @@ class MultiEngine:
             if lid is not None:
                 out[lid] = out.get(lid, 0) + 1
         return out
+
+    # ------------------------------------------------- group placement
+    def shard_of(self, g: int) -> int:
+        """Physical shard currently holding logical group ``g`` (block
+        layout over the ``gshard`` axis; always 0 on the resident
+        single-device path)."""
+        if self._gshard is None:
+            return 0
+        return int(self._slot[g]) // self._gshard.groups_per_shard
+
+    def groups_on_shard(self, shard: int) -> List[int]:
+        """Logical groups resident on ``shard``, in slot order."""
+        if self._gshard is None:
+            return list(range(self.G)) if shard == 0 else []
+        gps = self._gshard.groups_per_shard
+        return [
+            int(self._phys_group[s])
+            for s in range(shard * gps, (shard + 1) * gps)
+        ]
+
+    def migrate_group(
+        self,
+        g: int,
+        dst_shard: int,
+        partner: Optional[int] = None,
+        catch_up_s: Optional[float] = None,
+    ) -> Optional[dict]:
+        """Move logical group ``g`` onto ``dst_shard`` by swapping device
+        slots with a ``partner`` group resident there — the group-axis
+        recast of the PR-4 membership ladder, staged the same way:
+
+        1. **catch-up** (the learner phase): drive the event loop for a
+           bounded window until the group has no in-flight uncommitted
+           bookkeeping, so the move lands between replication rounds
+           with nothing mid-verification. Best-effort — the move is
+           SAFE regardless (step 2 is atomic and complete); catch-up
+           just keeps the post-move first tick an ordinary round.
+        2. **install** (the promote): ONE device launch permutes the
+           two groups' slots across shards (state + event-ring slices,
+           ``transport.group_mesh.swap_slots`` — donated, sharding-
+           preserving). Both groups' full rings, terms, votes and match
+           state move wholesale, so no divergent copy can ever exist.
+        3. **release** (the remove): the placement tables swap, the
+           ring-decode gid map rebuilds, and both groups' next timers
+           fire against their new slots. Host mirrors (queues, stamps,
+           rng streams, the event heap) are logical-indexed and never
+           move — which is why a migration cannot perturb the control
+           plane, the property the migration drill's byte-level
+           LINEARIZABLE + progress assertions pin.
+
+        Returns a summary dict, or ``None`` when the move is a no-op
+        (already resident on ``dst_shard``). Raises on the resident
+        single-device layout (there is only one shard to live on)."""
+        if self._gshard is None:
+            raise ValueError(
+                "migrate_group needs the sharded layout "
+                "(transport='mesh_groups' with >1 shard); the resident "
+                "path has a single shard"
+            )
+        if not (0 <= dst_shard < self.n_shards):
+            raise ValueError(
+                f"dst_shard {dst_shard} out of range "
+                f"[0, {self.n_shards})"
+            )
+        src = self.shard_of(g)
+        if src == dst_shard:
+            return None
+        if partner is None:
+            # deterministic victim choice: the destination group with
+            # the least queued work (ties by group id) — the cheapest
+            # state to bounce back to the source shard
+            partner = min(
+                self.groups_on_shard(dst_shard),
+                key=lambda gg: (len(self._queue[gg]), gg),
+            )
+        elif self.shard_of(partner) != dst_shard:
+            raise ValueError(
+                f"partner group {partner} is not on shard {dst_shard}"
+            )
+        t0 = self.clock.now
+        # ---- 1. catch-up (bounded, best-effort) ----------------------
+        window = (
+            catch_up_s if catch_up_s is not None
+            else 2 * self.cfg.heartbeat_period
+        )
+        end = self.clock.now + window
+        while (
+            (self._uncommitted[g] or self._seq_at_index[g]
+             or self._uncommitted[partner] or self._seq_at_index[partner])
+            and self.clock.now < end and self._q
+        ):
+            self.step_event()
+        # ---- 2. install: atomic device slot swap ---------------------
+        sa, sb = int(self._slot[g]), int(self._slot[partner])
+        perm = np.arange(self.G)
+        perm[[sa, sb]] = [sb, sa]
+        self.state = self._gshard.swap_slots(self.state, perm)
+        if self._dev_rings is not None:
+            self._dev_rings = self._gshard.swap_ring_slots(
+                self._dev_rings, perm
+            )
+        # ---- 3. release: placement tables + decode maps --------------
+        self._slot[g], self._slot[partner] = sb, sa
+        self._phys_group[sa], self._phys_group[sb] = (
+            self._phys_group[sb], self._phys_group[sa],
+        )
+        if self._dev_rings is not None:
+            self._dev_gids = jnp.asarray(self._phys_group, jnp.int32)
+        self.migrations += 1
+        self._metric_inc(g, "raft_group_migrations_total",
+                         "group moves between shards")
+        self.nodelog(
+            g, self.leader_id[g] if self.leader_id[g] is not None else 0,
+            f"migrated shard {src} -> {dst_shard} "
+            f"(partner g{partner})", kind="migrate",
+        )
+        return {
+            "group": g, "partner": partner, "src": src,
+            "dst": dst_shard, "t_start": t0, "t_done": self.clock.now,
+            "catch_up_s": round(self.clock.now - t0, 6),
+        }
 
     # ---------------------------------------------------------- fault toggles
     def fail(self, g: int, r: int) -> None:
@@ -713,7 +948,22 @@ class MultiEngine:
                 "launches": self.fused_launches,
                 "ticks": self.fused_ticks,
             },
+            # group-axis placement (transport.group_mesh): which shard
+            # each group lives on — with queue depths, burn alerts and
+            # breaker states this is the Rebalancer's whole input
+            "transport": self.transport_mode,
+            "shards": self.n_shards,
+            "placement": {
+                str(g): self.shard_of(g) for g in range(self.G)
+            },
+            "migrations": self.migrations,
         }
+        if self.slo is not None:
+            snap["slo_alerts"] = [
+                {"slo": a.slo, "group": a.group, "severity": a.severity,
+                 "burn_rate": a.burn_rate}
+                for a in self.slo.active_alerts()
+            ]
         if self.auditor is not None:
             snap["audit"] = self.auditor.summary()
         return snap
@@ -809,30 +1059,52 @@ class MultiEngine:
 
     def _campaign_many(self, cands: List[Tuple[int, int]]) -> None:
         """One batched vote launch for every (group, candidate) pair —
-        groups without a campaign this round are masked to a no-op."""
+        groups without a campaign this round are masked to a no-op.
+        Operand arrays are packed in PHYSICAL slot order (the device
+        layout; identity until a migration) and results read back per
+        logical group through the slot table."""
         G, R = self.G, self.cfg.n_replicas
+        slot = self._slot
         candidates = np.zeros(G, np.int32)
+        cterms_l = np.zeros(G, np.int32)       # logical-indexed terms
         cterms = np.zeros(G, np.int32)
         eff = np.zeros((G, R), bool)
         for g, r in cands:
-            candidates[g] = r
-            cterms[g] = int(self.terms[g, r])
-            eff[g] = self._reach(g, r)
+            s = slot[g]
+            candidates[s] = r
+            cterms_l[g] = cterms[s] = int(self.terms[g, r])
+            eff[s] = self._reach(g, r)
         if self._dev_rings is not None:
-            self.state, info, self._dev_rings = self._vote_rec(
-                self.state, jnp.asarray(candidates), jnp.asarray(cterms),
-                jnp.asarray(eff), self._dev_rings, self._dev_gids,
-            )
+            if self._gshard is not None:
+                self.state, info, self._dev_rings = (
+                    self._gshard.request_votes(
+                        self.state, jnp.asarray(candidates),
+                        jnp.asarray(cterms), jnp.asarray(eff),
+                        self._dev_rings, self._dev_gids,
+                    )
+                )
+            else:
+                self.state, info, self._dev_rings = self._vote_rec(
+                    self.state, jnp.asarray(candidates),
+                    jnp.asarray(cterms), jnp.asarray(eff),
+                    self._dev_rings, self._dev_gids,
+                )
             self._flush_device_obs()
+        elif self._gshard is not None:
+            self.state, info = self._gshard.request_votes(
+                self.state, jnp.asarray(candidates), jnp.asarray(cterms),
+                jnp.asarray(eff),
+            )
         else:
             self.state, info = self._vote(
                 self.state, jnp.asarray(candidates), jnp.asarray(cterms),
                 jnp.asarray(eff),
             )
-        votes = np.asarray(info.votes)
-        max_terms = np.asarray(info.max_term)
+        votes = np.asarray(info.votes)[slot]
+        max_terms = np.asarray(info.max_term)[slot]
+        eff = eff[slot]
         for g, r in cands:
-            cand_term = int(cterms[g])
+            cand_term = int(cterms_l[g])
             e = eff[g]
             self.terms[g][e] = np.maximum(self.terms[g][e], cand_term)
             if int(max_terms[g]) > cand_term:
@@ -849,10 +1121,18 @@ class MultiEngine:
                     # the archive path term-checks each entry against the
                     # committing leader's log before trusting it.
                     wm = int(self.commit_watermark[g])
+                    old_map = self._seq_at_index[g]
                     self._seq_at_index[g] = {
-                        i: s for i, s in self._seq_at_index[g].items()
-                        if i <= wm
+                        i: s for i, s in old_map.items() if i <= wm
                     }
+                    # a trimmed seq can never be stamped committed, so
+                    # its submit stamp would otherwise persist forever —
+                    # the leak that would unbound the stamp layer across
+                    # repeated elections (queued-but-uningested entries
+                    # keep theirs: the new leader will ingest them)
+                    for i, s in old_map.items():
+                        if i > wm:
+                            self.submit_time[g].pop(s, None)
                 self.roles[g][r] = LEADER
                 self.leader_id[g] = r
                 self.lead_terms[g, r] = cand_term
@@ -885,9 +1165,13 @@ class MultiEngine:
     def _replicate_round(self, active: Dict[int, tuple]):
         """One batched replicate launch. ``active``: g -> (leader, term,
         take, packed u8 batch or None). Returns (max_term[G], commit[G])
-        as host arrays; ingest bookkeeping is the caller's."""
+        as host arrays in LOGICAL group order; ingest bookkeeping is the
+        caller's. Operands pack in physical slot order (identity until a
+        migration); on the sharded layout the launch goes through the
+        group-mesh transport — one shard_map launch drives every shard."""
         cfg = self.cfg
         G, R, B = self.G, cfg.n_replicas, cfg.batch_size
+        slot = self._slot
         hp = self.hostprof
         if hp is not None:
             # tick prep up to here (role checks, queue slicing) is
@@ -902,38 +1186,53 @@ class MultiEngine:
             payloads = np.zeros((G, B, R * cfg.shard_words), np.int32)
             for g, (_, _, take, data) in active.items():
                 if take:
-                    payloads[g] = np.asarray(fold_batch(data, R, B))
+                    payloads[slot[g]] = np.asarray(fold_batch(data, R, B))
             payloads_dev = jnp.asarray(payloads)
         else:
             # heartbeat / read-confirmation round: nothing to ingest —
             # reuse one device-resident zero batch instead of building
             # and transferring a fresh (G, B, R*W) buffer per round
             if self._hb_payloads is None:
-                self._hb_payloads = jnp.zeros(
-                    (G, B, R * cfg.shard_words), jnp.int32
-                )
+                hb = jnp.zeros((G, B, R * cfg.shard_words), jnp.int32)
+                if self._gshard is not None:
+                    hb = self._gshard.shard_payloads(hb)
+                self._hb_payloads = hb
             payloads_dev = self._hb_payloads
         if hp is not None:
             hp.mark("pack")
         for g, (r, term, take, _) in active.items():
-            leaders[g] = r
-            lterms[g] = term
-            eff[g] = self._reach(g, r)
-            counts[g] = take
+            s = slot[g]
+            leaders[s] = r
+            lterms[s] = term
+            eff[s] = self._reach(g, r)
+            counts[s] = take
         if hp is not None:
             hp.mark("host_pre")
-        if self._dev_rings is not None:
+        slow = jnp.asarray(self.slow[self._phys_group])
+        if self._gshard is not None:
+            self.state, info, *ring = self._gshard.replicate(
+                self.state, payloads_dev, jnp.asarray(counts),
+                jnp.asarray(leaders), jnp.asarray(lterms),
+                jnp.asarray(eff), slow, self._member,
+                *(
+                    (self._dev_rings, self._dev_gids)
+                    if self._dev_rings is not None else ()
+                ),
+            )
+            if ring:
+                self._dev_rings = ring[0]
+        elif self._dev_rings is not None:
             self.state, info, self._dev_rings = self._replicate_rec(
                 self.state, payloads_dev, jnp.asarray(counts),
                 jnp.asarray(leaders), jnp.asarray(lterms),
-                jnp.asarray(eff), jnp.asarray(self.slow), self._member,
+                jnp.asarray(eff), slow, self._member,
                 self._dev_rings, self._dev_gids,
             )
         else:
             self.state, info = self._replicate(
                 self.state, payloads_dev, jnp.asarray(counts),
                 jnp.asarray(leaders), jnp.asarray(lterms),
-                jnp.asarray(eff), jnp.asarray(self.slow), self._member,
+                jnp.asarray(eff), slow, self._member,
             )
         if hp is not None:
             hp.mark("dispatch")
@@ -942,7 +1241,10 @@ class MultiEngine:
         # syncs; inside the dispatch window it would misattribute)
         self._flush_device_obs()
         self._last_info = info
-        return np.asarray(info.max_term), np.asarray(info.commit_index)
+        return (
+            np.asarray(info.max_term)[slot],
+            np.asarray(info.commit_index)[slot],
+        )
 
     def _fused_heap_bound(self, ticking: Dict[int, int]) -> float:
         """Earliest heap event the fused window must not run past —
@@ -1000,8 +1302,9 @@ class MultiEngine:
                 return False
         if not any(self._queue[g] for g in ticking):
             return False                 # pure-idle cluster: tick path
-        lasts = np.asarray(self.state.last_index)
-        commits_dev = np.asarray(self.state.commit_index)
+        # one fetch, reindexed to LOGICAL group order (slot table)
+        lasts = np.asarray(self.state.last_index)[self._slot]
+        commits_dev = np.asarray(self.state.commit_index)[self._slot]
         for g in ticking:
             if not (lasts[g] == lasts[g, ticking[g]]).all():
                 return False             # someone lags: repair business
@@ -1029,20 +1332,24 @@ class MultiEngine:
             return False
         times = times[:n]
         # ---- pack: per-group per-tick batch plan + payload words -----
+        # (physical slot order — the device layout; identity until a
+        # migration, so the resident path's bytes are untouched)
+        slot = self._slot
         counts = np.zeros((n, G), np.int32)
         payloads = np.zeros((n, G, B, cfg.shard_words), np.int32)
         leaders = np.zeros(G, np.int32)
         terms = np.zeros(G, np.int32)
         for g, r in ticks:
-            leaders[g] = r
-            terms[g] = int(self.lead_terms[g, r])
+            s = slot[g]
+            leaders[s] = r
+            terms[s] = int(self.lead_terms[g, r])
             q = self._queue[g]
             for j in range(n):
                 take = min(max(len(q) - j * B, 0), B)
-                counts[j, g] = take
+                counts[j, s] = take
                 if take:
                     chunk = q[j * B:j * B + take]
-                    payloads[j, g, :take] = np.frombuffer(
+                    payloads[j, s, :take] = np.frombuffer(
                         b"".join(p for _, p in chunk), np.uint8
                     ).reshape(take, cfg.entry_bytes).view(np.int32)
         hp = self.hostprof
@@ -1053,39 +1360,52 @@ class MultiEngine:
         counts_dev = jnp.asarray(counts)
         if hp is not None:
             hp.mark("pack")
-        slow = jnp.asarray(self.slow)
+        slow = jnp.asarray(self.slow[self._phys_group])
         halted0 = jnp.zeros((G,), bool)
         # groups NOT ticking this instant run masked no-op lanes: the
         # group-step convention (term 0 + dead cluster) is exactly a
         # leaderless group's launch treatment in _replicate_round
-        alive_np = self.alive.copy()
-        for g in range(G):
-            if g not in ticking:
-                terms[g] = 0
-                alive_np[g] = False
+        alive_np = self.alive[self._phys_group].copy()
+        for s in range(G):
+            if int(self._phys_group[s]) not in ticking:
+                terms[s] = 0
+                alive_np[s] = False
         alive = jnp.asarray(alive_np)
         record = self._dev_rings is not None
-        prog = _fused_group_programs(R, record)
         args = (
             self.state, payloads_dev, counts_dev, jnp.int32(n), halted0,
             jnp.asarray(leaders), jnp.asarray(terms), alive, slow,
             self._member,
         )
+        if self._gshard is not None:
+            # the sharded K-tick window: ONE shard_map launch drives
+            # every shard's K ticks, with per-shard (per-group) halted
+            # flags riding the gshard-split carry and donated buffers
+            rings = (
+                (self._dev_rings, self._dev_gids) if record else ()
+            )
+            out = self._gshard.replicate_fused(*args, *rings)
+        else:
+            prog = _fused_group_programs(R, record)
+            if record:
+                out = prog(*args, self._dev_rings, self._dev_gids)
+            else:
+                out = prog(*args)
         if record:
-            out = prog(*args, self._dev_rings, self._dev_gids)
             (self.state, infos, escaped, ran, _halted,
              self._dev_rings) = out
         else:
-            self.state, infos, escaped, ran, _halted = prog(*args)
+            self.state, infos, escaped, ran, _halted = out
         self.fused_launches += 1
         if hp is not None:
             hp.mark("dispatch")
             hp.sync(infos.commit_index, escaped, ran)
         self._flush_device_obs()
         self._book_fused_window(
-            ticks, times, np.asarray(infos.commit_index),
-            np.asarray(infos.frontier_len), np.asarray(infos.max_term),
-            np.asarray(escaped), np.asarray(ran),
+            ticks, times, np.asarray(infos.commit_index)[:, slot],
+            np.asarray(infos.frontier_len)[:, slot],
+            np.asarray(infos.max_term)[:, slot],
+            np.asarray(escaped)[:, slot], np.asarray(ran)[:, slot],
         )
         return True
 
@@ -1215,7 +1535,7 @@ class MultiEngine:
                 self._fire_leader_ticks(overflow)
             return
         max_terms, commits = self._replicate_round(active)
-        frontier = np.asarray(self._last_info.frontier_len)
+        frontier = np.asarray(self._last_info.frontier_len)[self._slot]
         lasts = None
         for g, (r, term, take, _) in active.items():
             if int(max_terms[g]) > term:
@@ -1228,7 +1548,7 @@ class MultiEngine:
             if ingested:
                 if lasts is None:
                     lasts = np.asarray(self.state.last_index)
-                last = int(lasts[g, r])
+                last = int(lasts[self._slot[g], r])
                 for i, (seq, p) in enumerate(self._queue[g][:ingested]):
                     idx = last - ingested + 1 + i
                     self._seq_at_index[g][idx] = seq
@@ -1272,6 +1592,7 @@ class MultiEngine:
         wm = int(self.commit_watermark[g])
         if commit <= wm:
             return
+        self.committed_total[g] += commit - wm
         for idx in range(wm + 1, commit + 1):
             seq = self._seq_at_index[g].get(idx)
             if seq is not None and seq not in self.commit_time[g]:
@@ -1311,7 +1632,9 @@ class MultiEngine:
             del self._uncommitted[g][idx]
         for idx in [i for i in self._seq_at_index[g] if i <= commit]:
             del self._seq_at_index[g][idx]
+        self._evict_commit_stamps(g)
         self._drain_apply(g)
+        self._evict_group_history(g)
 
     def _archive_committed(self, g: int, leader: int, lo: int, hi: int) -> None:
         """Move group ``g``'s just-committed range into the host archive.
@@ -1349,7 +1672,9 @@ class MultiEngine:
             cap = self.cfg.log_capacity
             plo, phi = min(pend), max(pend)
             slots = (np.arange(plo, phi + 1) - 1) % cap
-            lead_terms = np.asarray(self.state.log_term[g, leader])[slots]
+            lead_terms = np.asarray(
+                self.state.log_term[self._slot[g], leader]
+            )[slots]
             missing = []
             for idx in pend:
                 ent = self._uncommitted[g].get(idx)
@@ -1361,8 +1686,10 @@ class MultiEngine:
                     missing.append(idx)
             if missing:
                 mlo, mhi = min(missing), max(missing)
-                data = log_entries(group_view(self.state, g), leader,
-                                   mlo, mhi)
+                data = log_entries(
+                    group_view(self.state, self._slot[g]), leader,
+                    mlo, mhi,
+                )
                 for idx in missing:
                     payload = data[idx - mlo].tobytes()
                     self._archive[g][idx] = payload
@@ -1377,6 +1704,38 @@ class MultiEngine:
             fed.sort()
             aud.note_entries(fed, self.clock.now, group=g)
 
+    # --------------------------------------------- bounded history layer
+    def _evict_commit_stamps(self, g: int) -> None:
+        """Per-group stamp bound — the single engine's contract scoped
+        to group ``g`` (see the ``commit_time`` comment in
+        ``__init__``), delegating to the SHARED ledger algorithms
+        (``raft.ledger``): trim-to-exactly-cap oldest-first, evicted
+        seqs folded into merged durable intervals, matching
+        ``submit_time`` records dropped."""
+        from raft_tpu.raft.ledger import evict_commit_stamps
+
+        self.commit_time[g], self.submit_time[g], n = evict_commit_stamps(
+            self.commit_time[g], self.submit_time[g],
+            self._commit_stamp_cap, self._durable_ranges[g],
+        )
+        self.commit_stamps_evicted[g] += n
+
+    def _evict_group_history(self, g: int) -> None:
+        """Archive retention sweep: keep the last ``2 * log_capacity``
+        committed payloads of group ``g`` (the CheckpointStore horizon),
+        never past the apply stream's cursor — a registered apply
+        callback must always find ``applied_index + 1`` archived."""
+        floor = int(self._archive_floor[g])
+        keep_from = int(self.commit_watermark[g]) - self._commit_stamp_cap + 1
+        if self._apply_fns[g]:
+            keep_from = min(keep_from, int(self.applied_index[g]) + 1)
+        if keep_from <= floor:
+            return
+        arch = self._archive[g]
+        for idx in range(floor, keep_from):
+            arch.pop(idx, None)
+        self._archive_floor[g] = keep_from
+
     # ---------------------------------------------------- state machine
     def register_apply(
         self, g: int, fn: Callable[[int, bytes], None], replay: bool = False
@@ -1384,9 +1743,22 @@ class MultiEngine:
         """Register group ``g``'s state-machine apply callback:
         ``fn(index, payload)`` for every committed entry of the group, in
         log order, exactly once. ``replay=True`` first replays the
-        archived history (index 1 up to the watermark). Returns the first
-        index the callback will have seen."""
+        archived history (index 1 up to the watermark) — only possible
+        while the retention sweep (``_evict_group_history``) has not yet
+        passed index 1; a late registrar on a long-lived group must
+        rebuild from a snapshot instead, and the refusal says so.
+        Returns the first index the callback will have seen."""
         if replay:
+            floor = int(self._archive_floor[g])
+            if floor > 1:
+                raise ValueError(
+                    f"group {g}: archived history starts at index "
+                    f"{floor} (retention horizon "
+                    f"{self._commit_stamp_cap} entries swept the "
+                    "prefix); replay=True needs the full history — "
+                    "rebuild from a snapshot, then register without "
+                    "replay"
+                )
             for idx in range(1, int(self.commit_watermark[g]) + 1):
                 fn(idx, self._archive[g][idx])
             start = 1
@@ -1417,7 +1789,10 @@ class MultiEngine:
 
         if replica is None:
             replica = self.leader_id[g] if self.leader_id[g] is not None else 0
-        return [bytes(row) for row in _cp(group_view(self.state, g), replica)]
+        return [
+            bytes(row)
+            for row in _cp(group_view(self.state, self._slot[g]), replica)
+        ]
 
     def commit_latencies(self, g: Optional[int] = None) -> np.ndarray:
         """Per-entry commit latency (virtual seconds) for every durable

@@ -124,6 +124,27 @@ class Router:
                             group=g)
         return _note
 
+    # --------------------------------------------------------- placement
+    def rebalance(self, max_moves: Optional[int] = None) -> dict:
+        """Drive BOTH placement planes from the online signals: leader
+        respread within replica rows (``MultiEngine.rebalance`` — the
+        §5.4.1-gated round-robin campaigns) and, on the sharded layout,
+        group→shard migration planned by the StatusBoard-fed
+        :class:`raft_tpu.multi.rebalancer.Rebalancer` (burn-rate alerts,
+        queue depths, this router's own published breaker states).
+        Returns ``{"leader_moves": n, "migrations": [...]}``."""
+        from raft_tpu.multi.rebalancer import Rebalancer
+
+        leader_moves = self.engine.rebalance(max_moves)
+        migrations = []
+        if self.engine.n_shards > 1:
+            if not hasattr(self, "_rebalancer"):
+                self._rebalancer = Rebalancer(self.engine)
+            migrations = self._rebalancer.step(
+                max_moves=max_moves if max_moves is not None else 1
+            )
+        return {"leader_moves": leader_moves, "migrations": migrations}
+
     # ------------------------------------------------------------- routing
     def group_of(self, key: bytes) -> int:
         """Stable key -> group hash. CRC32 rather than ``hash()``:

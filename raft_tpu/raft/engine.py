@@ -779,14 +779,11 @@ class RaftEngine:
     def _durable_range_covers(self, seq: int) -> bool:
         """True iff ``seq``'s stamp was evicted from the bounded
         ``commit_time`` window — evicted seqs were committed by
-        construction, summarized as merged intervals (bisect lookup)."""
-        rs = self._durable_ranges
-        if not rs:
-            return False
-        import bisect as _bisect
+        construction, summarized as merged intervals
+        (``raft.ledger`` — the shared ledger both engines delegate to)."""
+        from raft_tpu.raft.ledger import durable_range_covers
 
-        i = _bisect.bisect_right(rs, [seq, float("inf")]) - 1
-        return i >= 0 and rs[i][0] <= seq <= rs[i][1]
+        return durable_range_covers(self._durable_ranges, seq)
 
     def _evict_commit_stamps(self) -> None:
         """Bound the per-entry stamp dicts (the ``host_post`` residue of
@@ -801,60 +798,16 @@ class RaftEngine:
         the stamp SEQUENCE, not of check cadence — the fused K-tick
         path (one check per launch) and the tick path (one per advance)
         end every run with identical dicts, which the fused byte-
-        identity pins compare. Bulk C-level rebuilds keep the amortized
-        per-entry cost far below the host_post budget PR 8 fought for."""
-        n_evict = len(self.commit_time) - self._commit_stamp_cap
-        if n_evict <= 0:
-            return
-        from itertools import islice
+        identity pins compare. The algorithm (bulk C-level rebuilds,
+        numpy run-collapse) lives in ``raft.ledger``, shared verbatim
+        with ``MultiEngine``'s per-group ledgers."""
+        from raft_tpu.raft.ledger import evict_commit_stamps
 
-        it = iter(self.commit_time.items())
-        evicted = list(islice(it, n_evict))
-        self.commit_time = dict(it)            # retained tail, C-level
-        self.commit_stamps_evicted += n_evict
-        st = self.submit_time
-        if n_evict * 4 < len(st):
-            for seq, _ in evicted:
-                st.pop(seq, None)
-        else:
-            drop = {s for s, _ in evicted}
-            self.submit_time = {
-                k: v for k, v in st.items() if k not in drop
-            }
-        # fold the evicted seqs into the merged durable intervals:
-        # contiguous runs collapse via one numpy pass (seqs stamp in
-        # near-ascending order, so the interval list stays tiny — one
-        # interval per loss gap)
-        arr = np.fromiter((s for s, _ in evicted), np.int64, n_evict)
-        arr.sort()
-        breaks = np.flatnonzero(np.diff(arr) != 1)
-        starts = np.concatenate(([0], breaks + 1))
-        ends = np.concatenate((breaks, [n_evict - 1]))
-        for a, b in zip(arr[starts], arr[ends]):
-            self._merge_durable_range(int(a), int(b))
-
-    def _merge_durable_range(self, a: int, b: int) -> None:
-        """Insert [a, b] into the sorted, disjoint ``_durable_ranges``,
-        coalescing with adjacent/overlapping neighbours."""
-        import bisect as _bisect
-
-        rs = self._durable_ranges
-        if rs and rs[-1][0] <= a <= rs[-1][1] + 1:
-            # common case: the run starts inside or immediately after
-            # the tail range (evictions proceed in stamp order)
-            if rs[-1][1] < b:
-                rs[-1][1] = b
-            return
-        i = _bisect.bisect_right(rs, [a, float("inf")])
-        if i > 0 and rs[i - 1][1] >= a - 1:
-            rs[i - 1][1] = max(rs[i - 1][1], b)
-            i -= 1
-        else:
-            rs.insert(i, [a, b])
-        # absorb any following ranges the new one now touches
-        while i + 1 < len(rs) and rs[i + 1][0] <= rs[i][1] + 1:
-            rs[i][1] = max(rs[i][1], rs[i + 1][1])
-            del rs[i + 1]
+        self.commit_time, self.submit_time, n = evict_commit_stamps(
+            self.commit_time, self.submit_time, self._commit_stamp_cap,
+            self._durable_ranges,
+        )
+        self.commit_stamps_evicted += n
 
     def _pack_entries(self, entries, padded_len: int) -> np.ndarray:
         """(seq, payload) pairs -> u8[padded_len, entry_bytes], zero-padded

@@ -1,0 +1,343 @@
+"""Group-axis mesh transport: G Raft groups laid out ``(group, replica)``
+over a device mesh.
+
+``MultiEngine``'s resident layout vmaps all G groups onto ONE device —
+the batched launch amortizes beautifully at small G and saturates once
+the groups outgrow the chip (docs/PERF.md G-sweep: amortizing at G=4,
+linear again by G=16). The production shape is hundreds-to-thousands of
+groups, which is a SHARDING problem, not a batching problem: split the
+group axis over a ``gshard`` mesh axis so each device runs the same
+vmapped group program over its own block of groups, and ONE launch
+drives every shard.
+
+Layout (``core.state.group_partition_rules`` — the partition-rule
+table): every group-state leaf splits its leading group axis over
+``gshard``; ring slots, payload lanes and replica rows stay shard-local
+(each shard holds ALL R replica rows of its groups, so the per-group
+step bodies — ``core.step.group_replicate_step`` et al. — run unchanged
+inside ``core.comm.shard_map``; a second ``replica`` mesh axis is
+declared for the future replica-row spread and is size 1 here). Groups
+are block-placed: physical slot ``s`` lives on shard
+``s // (G / n_shards)``. The ENGINE owns the logical→physical slot
+mapping (its placement table), which is what makes group migration a
+device-side slot permutation (``swap_slots``) instead of a state
+hand-off protocol.
+
+Byte-identity by construction: ``shard_map(vmap(step))`` over a
+block-split group axis computes, per group, exactly what the global
+``vmap(step)`` computes — groups never communicate, so the split
+introduces no collective into the step and no reordering into any
+reduction. The pins in ``tests/test_group_shard.py`` hold this to
+bit-exactness (state fields, committed logs, commit stamps, chaos
+fingerprints).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.comm import shard_map
+from raft_tpu.core.state import (
+    GROUP_AXIS,
+    REPLICA_AXIS,
+    ReplicaState,
+    group_state_specs,
+    make_shard_and_gather_fns,
+)
+from raft_tpu.core.step import (
+    RepInfo,
+    VoteInfo,
+    fused_group_scan,
+    group_replicate_step,
+    group_vote_step,
+)
+from raft_tpu.obs import blackbox
+
+
+def n_shards_for(n_groups: int, n_devices: int) -> int:
+    """Largest shard count that divides G and fits the device set (block
+    placement needs equal-sized shards; XLA needs the split exact)."""
+    for d in range(min(n_groups, max(n_devices, 1)), 0, -1):
+        if n_groups % d == 0:
+            return d
+    return 1
+
+
+#: Process-wide program cache: one compiled program family per
+#: (mesh devices, R, G-per-shard shape) — chaos runners build a fresh
+#: MultiEngine per seed/crash cycle, and a shard_map rebuild per engine
+#: would recompile the whole family every run.
+_PROGRAMS: Dict[tuple, object] = {}
+_MESHES: Dict[tuple, Mesh] = {}
+
+
+class GroupMeshTransport:
+    """The ``transport="mesh_groups"`` backend (module docstring).
+
+    Accepts an existing 2-axis ``Mesh`` (axes ``('gshard', 'replica')``)
+    or builds one from ``devices``/``jax.devices()``. All programs are
+    ``shard_map`` wraps of the SAME vmapped group-step callables the
+    resident engine jits, with state (and event rings) donated, so the
+    sharded and resident paths cannot drift: there is one step body.
+    """
+
+    def __init__(
+        self,
+        cfg: RaftConfig,
+        n_groups: int,
+        mesh: Optional[Mesh] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        self.cfg = cfg
+        self.G = n_groups
+        R = cfg.n_replicas
+        if mesh is not None:
+            if GROUP_AXIS not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh must carry a {GROUP_AXIS!r} axis "
+                    f"(got {mesh.axis_names})"
+                )
+            self.n_shards = mesh.shape[GROUP_AXIS]
+            if n_groups % self.n_shards:
+                raise ValueError(
+                    f"n_groups ({n_groups}) must divide evenly over the "
+                    f"{self.n_shards}-way {GROUP_AXIS!r} axis"
+                )
+            self.mesh = mesh
+        else:
+            devices = (
+                list(devices) if devices is not None else jax.devices()
+            )
+            self.n_shards = n_shards_for(n_groups, len(devices))
+            key = tuple(d.id for d in devices[: self.n_shards])
+            if key not in _MESHES:
+                _MESHES[key] = Mesh(
+                    np.array(devices[: self.n_shards]).reshape(
+                        self.n_shards, 1
+                    ),
+                    (GROUP_AXIS, REPLICA_AXIS),
+                )
+            self.mesh = _MESHES[key]
+        # write-before-block (obs.blackbox): the shard_map program builds
+        # below are where an incompatible backend wedges — same contract
+        # as TpuMeshTransport's mesh_build mark
+        blackbox.mark(
+            "group_mesh_build", groups=n_groups, shards=self.n_shards,
+            rows=R,
+        )
+        self.groups_per_shard = n_groups // self.n_shards
+        self._state_specs = group_state_specs(cfg, n_groups)
+        self._shard_fns, self._gather_fns = make_shard_and_gather_fns(
+            self.mesh, self._state_specs
+        )
+        self._key = (
+            tuple(d.id for d in np.asarray(self.mesh.devices).flat),
+            R, n_groups, cfg.log_capacity, cfg.batch_size,
+            cfg.shard_words,
+        )
+        blackbox.mark("group_mesh_ready", shards=self.n_shards)
+
+    # ------------------------------------------------------------ placement
+    def shard_of_slot(self, slot: int) -> int:
+        """Physical shard of physical group slot ``slot`` (block layout)."""
+        return slot // self.groups_per_shard
+
+    def shard_state(self, state: ReplicaState) -> ReplicaState:
+        """Place a (host or resident) group state onto the mesh with the
+        rule-table layout."""
+        return jax.tree.map(
+            lambda fn, x: fn(x), self._shard_fns, state
+        )
+
+    def shard_payloads(self, payloads):
+        """Place a group-leading payload batch (``[G, ...]`` or
+        ``[K, G, ...]``) with its group axis split over ``gshard``."""
+        spec = (
+            P(GROUP_AXIS) if payloads.ndim == 3
+            else P(None, GROUP_AXIS)
+        )
+        return jax.device_put(payloads, NamedSharding(self.mesh, spec))
+
+    def shard_rings(self, rings):
+        """Place the per-group event-ring pytree (leading group axis on
+        every leaf) with its group axis split over ``gshard``."""
+        sh = NamedSharding(self.mesh, P(GROUP_AXIS))
+        return jax.tree.map(lambda a: jax.device_put(a, sh), rings)
+
+    def _gspec(self, *trailing) -> P:
+        return P(GROUP_AXIS, *trailing)
+
+    def _cached(self, kind: str, record: bool, build):
+        key = self._key + (kind, record)
+        if key not in _PROGRAMS:
+            _PROGRAMS[key] = build()
+        return _PROGRAMS[key]
+
+    def _ring_specs(self):
+        from raft_tpu.obs.device import EventRing
+
+        g = self._gspec()
+        return EventRing(buf=g, count=g, tick=g, counters=g)
+
+    # ------------------------------------------------------------- programs
+    def _replicate_program(self, record: bool):
+        def build():
+            body = group_replicate_step(
+                self.cfg.n_replicas, record=record
+            )
+            g = self._gspec()
+            info_specs = RepInfo(
+                commit_index=g, match=g, max_term=g, repair_start=g,
+                frontier_len=g,
+            )
+            in_specs = (
+                self._state_specs, g, g, g, g, g, g, g,
+            )
+            out_specs = (self._state_specs, info_specs)
+            if record:
+                in_specs = in_specs + (self._ring_specs(), g)
+                out_specs = out_specs + (self._ring_specs(),)
+            return jax.jit(
+                shard_map(
+                    body, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ),
+                donate_argnums=(0, 8) if record else (0,),
+            )
+
+        return self._cached("replicate", record, build)
+
+    def _vote_program(self, record: bool):
+        def build():
+            body = group_vote_step(self.cfg.n_replicas, record=record)
+            g = self._gspec()
+            vote_specs = VoteInfo(votes=g, max_term=g, grants=g)
+            in_specs = (self._state_specs, g, g, g)
+            out_specs = (self._state_specs, vote_specs)
+            if record:
+                in_specs = in_specs + (self._ring_specs(), g)
+                out_specs = out_specs + (self._ring_specs(),)
+            return jax.jit(
+                shard_map(
+                    body, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ),
+                donate_argnums=(0, 4) if record else (0,),
+            )
+
+        return self._cached("vote", record, build)
+
+    def _fused_program(self, record: bool):
+        def build():
+            body = fused_group_scan(self.cfg.n_replicas, record=record)
+            g = self._gspec()
+            kg = P(None, GROUP_AXIS)
+            info_specs = RepInfo(
+                commit_index=kg, match=kg, max_term=kg, repair_start=kg,
+                frontier_len=kg,
+            )
+            in_specs = (
+                self._state_specs, kg, kg, P(), g, g, g, g, g, g,
+            )
+            out_specs = (self._state_specs, info_specs, kg, kg, g)
+            if record:
+                in_specs = in_specs + (self._ring_specs(), g)
+                out_specs = out_specs + (self._ring_specs(),)
+            return jax.jit(
+                shard_map(
+                    body, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ),
+                donate_argnums=(0, 10) if record else (0,),
+            )
+
+        return self._cached("fused", record, build)
+
+    def _swap_program(self):
+        def build():
+            shardings = jax.tree.map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                self._state_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            return jax.jit(
+                lambda st, perm: jax.tree.map(lambda a: a[perm], st),
+                donate_argnums=(0,),
+                out_shardings=shardings,
+            )
+
+        return self._cached("swap", False, build)
+
+    def _ring_swap_program(self):
+        def build():
+            ring_sh = jax.tree.map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                self._ring_specs(),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            return jax.jit(
+                lambda rg, perm: jax.tree.map(lambda a: a[perm], rg),
+                donate_argnums=(0,),
+                out_shardings=ring_sh,
+            )
+
+        return self._cached("ring_swap", False, build)
+
+    # ------------------------------------------------------------ entry API
+    def replicate(self, state, payloads, counts, leaders, lterms, eff,
+                  slow, member, rings=None, gids=None):
+        """One sharded batched replicate launch — the exact operand
+        contract of the resident engine's jitted
+        ``group_replicate_step`` (all leading axes G, physical slot
+        order)."""
+        if rings is not None:
+            return self._replicate_program(True)(
+                state, payloads, counts, leaders, lterms, eff, slow,
+                member, rings, gids,
+            )
+        return self._replicate_program(False)(
+            state, payloads, counts, leaders, lterms, eff, slow, member,
+        )
+
+    def request_votes(self, state, candidates, cterms, eff, rings=None,
+                      gids=None):
+        if rings is not None:
+            return self._vote_program(True)(
+                state, candidates, cterms, eff, rings, gids,
+            )
+        return self._vote_program(False)(state, candidates, cterms, eff)
+
+    def replicate_fused(self, state, payloads, counts, n_run, halted0,
+                        leaders, terms, alive, slow, member, rings=None,
+                        gids=None):
+        """The K-tick fused group window over the mesh: per-shard
+        ``halted`` flags (a P('gshard') slice of the per-group flags),
+        state and rings donated, one launch for every shard's K ticks."""
+        if rings is not None:
+            return self._fused_program(True)(
+                state, payloads, counts, n_run, halted0, leaders, terms,
+                alive, slow, member, rings, gids,
+            )
+        return self._fused_program(False)(
+            state, payloads, counts, n_run, halted0, leaders, terms,
+            alive, slow, member,
+        )
+
+    def swap_slots(self, state, perm) -> ReplicaState:
+        """Permute the group axis by ``perm`` (i32[G], physical order) —
+        the device side of a group migration. GSPMD emits the cross-
+        shard moves; the caller (engine placement table) guarantees the
+        permutation is a pairwise swap, so the traffic is two groups'
+        state, not a reshuffle."""
+        return self._swap_program()(state, jnp.asarray(perm, jnp.int32))
+
+    def swap_ring_slots(self, rings, perm):
+        """The event rings ride the same slot permutation (recorded
+        events stay with their logical group)."""
+        return self._ring_swap_program()(rings, jnp.asarray(perm, jnp.int32))

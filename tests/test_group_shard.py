@@ -1,0 +1,567 @@
+"""Group-axis sharding (the (group, replica) mesh layout + dynamic
+placement): ``core.state`` partition rules, the ``mesh_groups``
+transport, sharded ``MultiEngine`` byte-identity, the bounded per-group
+history layer, and the migration drill.
+
+Acceptance pins (ISSUE 10):
+
+- **Sharded-vs-vmapped byte identity** — committed logs, durability
+  stamps, rng/heap streams of a 2-shard G=8 engine bit-equal to the
+  resident vmap path; chaos seeds 11/14/22/27 replay bit-exact with
+  ``RAFT_TPU_GSHARD`` on vs off (shared plain baselines,
+  ``tests/_torture_fingerprints.py``).
+- **Migration under load** — a Rebalancer-driven group move mid-traffic
+  keeps the verdict LINEARIZABLE and commit progress resumes inside the
+  drill's virtual window.
+- **Typed capability refusals** — per-row transports and unknown
+  transport strings refuse loudly, naming the group-axis set.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+
+ENTRY = 64
+
+
+def payloads(n, seed):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, ENTRY, np.uint8).tobytes() for _ in range(n)]
+
+
+def mk_cfg(**kw):
+    base = dict(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=8, log_capacity=256,
+        transport="single", seed=5,
+    )
+    base.update(kw)
+    return RaftConfig(**base)
+
+
+def two_shard_mesh():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from raft_tpu.core.state import GROUP_AXIS, REPLICA_AXIS
+
+    return Mesh(
+        np.array(jax.devices()[:2]).reshape(2, 1),
+        (GROUP_AXIS, REPLICA_AXIS),
+    )
+
+
+# ------------------------------------------------------- partition rules
+class TestPartitionRules:
+    def test_rule_table_covers_group_state(self):
+        """Every group-state leaf splits its leading group axis over
+        ``gshard``; a 0-d leaf is replicated before any rule runs."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from raft_tpu.core.state import (
+            GROUP_AXIS,
+            group_partition_rules,
+            group_state_specs,
+            match_partition_rules,
+        )
+
+        specs = group_state_specs(mk_cfg(), 4)
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]:
+            assert spec == P(GROUP_AXIS), (path, spec)
+        # scalar leaves replicate regardless of the rules
+        scalars = match_partition_rules(
+            group_partition_rules(), {"x": np.zeros(())}
+        )
+        assert scalars["x"] == P()
+
+    def test_unmatched_leaf_refuses(self):
+        """A leaf no rule names must fail loudly, not silently
+        replicate a G-sized buffer onto every shard."""
+        from jax.sharding import PartitionSpec as P
+
+        from raft_tpu.core.state import match_partition_rules
+
+        with pytest.raises(ValueError, match="no partition rule"):
+            match_partition_rules(
+                ((r"^only_this$", P()),), {"other": np.zeros((4, 2))}
+            )
+
+    def test_shard_and_gather_round_trip(self):
+        import jax
+
+        from raft_tpu.core.state import (
+            group_state_specs,
+            init_group_state,
+            make_shard_and_gather_fns,
+        )
+
+        cfg = mk_cfg()
+        mesh = two_shard_mesh()
+        specs = group_state_specs(cfg, 4)
+        shard_fns, gather_fns = make_shard_and_gather_fns(mesh, specs)
+        state = init_group_state(cfg, 4)
+        sharded = jax.tree.map(lambda fn, x: fn(x), shard_fns, state)
+        assert "gshard" in str(sharded.log_payload.sharding)
+        back = jax.tree.map(lambda fn, x: fn(x), gather_fns, sharded)
+        for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------ sharded kernels
+class TestShardedKernels:
+    def test_shard_map_matches_vmap_byte_for_byte(self):
+        """shard_map(vmap(step)) over a 2-way gshard split == the global
+        vmap, every state field, vote and replicate."""
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.core.state import fold_batch, init_group_state
+        from raft_tpu.core.step import group_replicate_step, group_vote_step
+        from raft_tpu.transport.group_mesh import GroupMeshTransport
+
+        cfg = mk_cfg()
+        G, R, B = 8, cfg.n_replicas, cfg.batch_size
+        t = GroupMeshTransport(cfg, G, mesh=two_shard_mesh())
+        assert t.n_shards == 2
+        rng = np.random.default_rng(0)
+
+        alive = jnp.ones((G, R), bool)
+        cands = jnp.asarray([g % R for g in range(G)], jnp.int32)
+        cterms = jnp.ones(G, jnp.int32)
+        s_sh = t.shard_state(init_group_state(cfg, G))
+        s_vm = init_group_state(cfg, G)
+        s_sh, vi_sh = t.request_votes(s_sh, cands, cterms, alive)
+        s_vm, vi_vm = jax.jit(group_vote_step(R))(s_vm, cands, cterms, alive)
+        np.testing.assert_array_equal(
+            np.asarray(vi_sh.votes), np.asarray(vi_vm.votes)
+        )
+
+        pay = np.zeros((G, B, R * cfg.shard_words), np.int32)
+        for g in range(G):
+            pay[g] = np.asarray(fold_batch(
+                rng.integers(0, 256, (B, ENTRY), np.uint8), R
+            ))
+        counts = jnp.asarray([B - (g % 3) for g in range(G)], jnp.int32)
+        leaders, lterms = cands, cterms
+        slow = jnp.zeros((G, R), bool)
+        member = jnp.ones((G, R), bool)
+        s_sh, ri_sh = t.replicate(
+            s_sh, jnp.asarray(pay), counts, leaders, lterms, alive,
+            slow, member,
+        )
+        s_vm, ri_vm = jax.jit(group_replicate_step(R))(
+            s_vm, jnp.asarray(pay), counts, leaders, lterms, alive,
+            slow, member,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ri_sh.commit_index), np.asarray(ri_vm.commit_index)
+        )
+        for f in ("term", "voted_for", "last_index", "commit_index",
+                  "match_index", "match_term", "log_term", "log_payload"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(s_sh, f)), np.asarray(getattr(s_vm, f)),
+                err_msg=f,
+            )
+
+    def test_slot_swap_moves_state_between_shards(self):
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.core.state import init_group_state
+        from raft_tpu.transport.group_mesh import GroupMeshTransport
+
+        cfg = mk_cfg()
+        G = 8
+        t = GroupMeshTransport(cfg, G, mesh=two_shard_mesh())
+        state = t.shard_state(init_group_state(cfg, G))
+        state = state.replace(
+            last_index=jax.device_put(
+                jnp.arange(G * 3, dtype=jnp.int32).reshape(G, 3),
+                state.last_index.sharding,
+            )
+        )
+        perm = np.arange(G)
+        perm[[0, 6]] = [6, 0]                  # shard 0 slot <-> shard 1 slot
+        out = t.swap_slots(state, perm)
+        got = np.asarray(out.last_index)
+        assert (got[0] == np.arange(18, 21)).all()
+        assert (got[6] == np.arange(0, 3)).all()
+        assert "gshard" in str(out.last_index.sharding)
+
+
+# ------------------------------------------------------- sharded engine
+def drive_schedule(me):
+    """A churny deterministic schedule: traffic on every group, one
+    leader kill + re-election, more traffic."""
+    me.seed_leaders()
+    last = {}
+    for g in range(me.G):
+        for p in payloads(12 + g, seed=100 + g):
+            last[g] = me.submit(g, p)
+    for g in range(me.G):
+        me.run_until_committed(g, last[g])
+    me.fail(0, me.leader_id[0])
+    me.run_until_leader(0)
+    s = me.submit(0, payloads(1, seed=9)[0])
+    me.run_until_committed(0, s)
+    return me
+
+
+def assert_engines_byte_identical(a, b):
+    """Committed logs, durability stamps, rng streams and the event
+    heap of two engines — the sharded-vs-vmapped identity contract."""
+    for g in range(a.G):
+        assert a.committed_payloads(g) == b.committed_payloads(g), g
+        assert a.commit_time[g] == b.commit_time[g], g
+        assert a.submit_time[g] == b.submit_time[g], g
+        assert a._durable_ranges[g] == b._durable_ranges[g], g
+        assert a.rngs[g].getstate() == b.rngs[g].getstate(), g
+    assert a._q == b._q
+    np.testing.assert_array_equal(a.commit_watermark, b.commit_watermark)
+
+
+class TestShardedEngine:
+    def test_sharded_vs_vmapped_byte_identity(self):
+        """G=8 over 2 shards vs the resident vmap path: committed logs,
+        commit/submit stamps, rng streams and the heap, bit for bit —
+        through traffic AND a leader kill + re-election."""
+        from raft_tpu.multi import MultiEngine
+
+        plain = drive_schedule(MultiEngine(mk_cfg(), 8))
+        shard = drive_schedule(MultiEngine(
+            mk_cfg(transport="mesh_groups"), 8, mesh=two_shard_mesh(),
+        ))
+        assert shard.transport_mode == "mesh_groups"
+        assert shard.n_shards == 2
+        assert_engines_byte_identical(plain, shard)
+
+    def test_fused_window_sharded_identity(self):
+        """The K-tick fused group window through the shard_map program
+        (per-shard halted flags, donated sharded buffers) == the
+        resident fused path, and fusion genuinely engages."""
+        from raft_tpu.multi import MultiEngine
+
+        def drive(me):
+            me.seed_leaders()
+            last = {}
+            for g in range(me.G):
+                for p in payloads(64, seed=200 + g):
+                    last[g] = me.submit(g, p)
+            me.run_for(300.0)
+            for g in range(me.G):
+                assert me.is_durable(g, last[g])
+            return me
+
+        a = drive(MultiEngine(mk_cfg(fuse_k=8), 4))
+        b = drive(MultiEngine(
+            mk_cfg(fuse_k=8, transport="mesh_groups"), 4,
+            mesh=two_shard_mesh(),
+        ))
+        assert a.fused_launches > 0, "fusion never engaged"
+        assert (a.fused_launches, a.fused_ticks) == (
+            b.fused_launches, b.fused_ticks
+        )
+        assert_engines_byte_identical(a, b)
+
+    def test_device_ring_sharded_identity(self):
+        """Per-shard event rings: recorded launches on the sharded
+        layout decode to the same event stream as the resident path
+        (one packed fetch, per-slot decode)."""
+        from raft_tpu.multi import MultiEngine
+
+        def drive(me):
+            me.attach_device_obs()
+            me.seed_leaders()
+            last = {}
+            for g in range(me.G):
+                for p in payloads(10, seed=g):
+                    last[g] = me.submit(g, p)
+            for g in range(me.G):
+                me.run_until_committed(g, last[g])
+            return me
+
+        a = drive(MultiEngine(mk_cfg(), 4))
+        b = drive(MultiEngine(
+            mk_cfg(transport="mesh_groups"), 4, mesh=two_shard_mesh(),
+        ))
+        key = lambda e: (e.seq, e.node, e.group, e.term, e.kind,
+                         e.commit_index, e.last_index)
+        assert sorted(map(key, a.device_obs.events)) == \
+            sorted(map(key, b.device_obs.events))
+        assert len(a.device_obs.events) > 0
+
+    def test_transport_capability_refusals_typed(self):
+        """Per-row transports and unknown strings refuse loudly with
+        the typed capability error naming the group-axis set (the
+        pinned unknown-transport refusal)."""
+        from raft_tpu.multi import (
+            GROUP_AXIS_TRANSPORTS,
+            MultiEngine,
+            UnsupportedGroupTransport,
+        )
+
+        for t in ("tpu_mesh", "multihost", "no_such_transport"):
+            with pytest.raises(UnsupportedGroupTransport) as ei:
+                MultiEngine(mk_cfg(transport=t), 2)
+            assert ei.value.supported == GROUP_AXIS_TRANSPORTS
+            assert "mesh_groups" in str(ei.value)
+            assert isinstance(ei.value, ValueError)   # compat contract
+
+    def test_single_device_degrade(self, monkeypatch):
+        """mesh_groups on a device set that cannot shard the G degrades
+        to the resident vmap path (placement identity, one shard)."""
+        import jax
+
+        from raft_tpu.multi import MultiEngine
+        from raft_tpu.transport import group_mesh
+
+        one = jax.devices()[:1]
+        monkeypatch.setattr(group_mesh.jax, "devices", lambda: one)
+        me = MultiEngine(mk_cfg(transport="mesh_groups"), 4)
+        assert me.transport_mode == "single"
+        assert me.n_shards == 1
+        me.seed_leaders()
+        s = me.submit(0, payloads(1, seed=1)[0])
+        me.run_until_committed(0, s)
+        with pytest.raises(ValueError, match="sharded layout"):
+            me.migrate_group(0, 0)
+
+    def test_status_snapshot_carries_placement(self):
+        from raft_tpu.multi import MultiEngine
+
+        me = MultiEngine(
+            mk_cfg(transport="mesh_groups"), 8, mesh=two_shard_mesh(),
+        )
+        me.seed_leaders()
+        snap = me._status_snapshot()
+        assert snap["shards"] == 2
+        assert snap["transport"] == "mesh_groups"
+        assert set(snap["placement"]) == {str(g) for g in range(8)}
+        assert snap["migrations"] == 0
+        g = me.groups_on_shard(0)[0]
+        me.migrate_group(g, 1)
+        snap = me._status_snapshot()
+        assert snap["placement"][str(g)] == 1
+        assert snap["migrations"] == 1
+
+
+# ----------------------------------------------------- bounded history
+class TestBoundedHistory:
+    def test_stamp_eviction_and_durable_ranges(self):
+        """Past 2*log_capacity retained stamps per group: oldest-first
+        eviction, merged durable ranges, is_durable still answering for
+        every seq ever issued — and the sibling group's dicts
+        untouched."""
+        from raft_tpu.multi import MultiEngine
+
+        cfg = mk_cfg(batch_size=4, log_capacity=8)
+        me = MultiEngine(cfg, 2)
+        me.seed_leaders()
+        cap = 2 * cfg.log_capacity
+        n = 3 * cap
+        last = None
+        for p in payloads(n, seed=3):
+            last = me.submit(0, p)
+            # drain as we go so the ring never backs up
+            if last % 8 == 0:
+                me.run_until_committed(0, last)
+        me.run_until_committed(0, last)
+        assert len(me.commit_time[0]) == cap
+        assert int(me.commit_stamps_evicted[0]) == n - cap
+        assert int(me.committed_total[0]) == n
+        for seq in range(1, n + 1):
+            assert me.is_durable(0, seq), seq
+        assert not me.is_durable(0, n + 1)
+        assert me._durable_ranges[0] == [[1, n - cap]]
+        assert len(me.submit_time[0]) == cap
+        # group 1 untouched
+        assert me.commit_time[1] == {}
+        assert me._durable_ranges[1] == []
+
+    def test_archive_retention_floor_and_replay_refusal(self):
+        from raft_tpu.multi import MultiEngine
+
+        cfg = mk_cfg(batch_size=4, log_capacity=8)
+        me = MultiEngine(cfg, 1)
+        me.seed_leaders()
+        n = 3 * 2 * cfg.log_capacity
+        last = None
+        for p in payloads(n, seed=4):
+            last = me.submit(0, p)
+            if last % 8 == 0:
+                me.run_until_committed(0, last)
+        me.run_until_committed(0, last)
+        floor = int(me._archive_floor[0])
+        assert floor > 1
+        assert min(me._archive[0]) == floor
+        # committed bytes above the floor still serve the apply stream
+        seen = []
+        with pytest.raises(ValueError, match="retention horizon"):
+            me.register_apply(0, lambda i, p: seen.append(i), replay=True)
+        start = me.register_apply(0, lambda i, p: seen.append(i))
+        assert start == int(me.commit_watermark[0]) + 1
+        s = me.submit(0, payloads(1, seed=5)[0])
+        me.run_until_committed(0, s)
+        assert seen and seen[-1] == int(me.commit_watermark[0])
+
+    def test_apply_stream_blocks_archive_sweep(self):
+        """A registered apply stream pins the sweep at its cursor: the
+        drain must always find applied_index + 1 archived."""
+        from raft_tpu.multi import MultiEngine
+
+        cfg = mk_cfg(batch_size=4, log_capacity=8)
+        me = MultiEngine(cfg, 1)
+        me.seed_leaders()
+        applied = []
+        me.register_apply(0, lambda i, p: applied.append(i))
+        n = 3 * 2 * cfg.log_capacity
+        last = None
+        for p in payloads(n, seed=6):
+            last = me.submit(0, p)
+            if last % 8 == 0:
+                me.run_until_committed(0, last)
+        me.run_until_committed(0, last)
+        assert applied == list(range(1, n + 1))
+
+
+# ----------------------------------------------------------- placement
+class TestRebalancer:
+    def test_plan_moves_burning_group_off_hot_shard(self):
+        """Pure snapshot-in, plan-out: a burn-rate alert plus an open
+        breaker make one shard hot; the plan moves its hottest group to
+        the coolest shard and respects hysteresis."""
+        from types import SimpleNamespace
+
+        from raft_tpu.multi.rebalancer import Rebalancer
+
+        reb = Rebalancer(SimpleNamespace(status_board=None))
+        snap = {
+            "shards": 2,
+            "placement": {"0": 0, "1": 0, "2": 1, "3": 1},
+            "queue_depth": {"0": 2, "1": 30, "2": 1, "3": 0},
+            "slo_alerts": [
+                {"slo": "commit_fast", "group": 0, "severity": "page",
+                 "burn_rate": 20.0},
+            ],
+            "breakers": {"0": "open", "2": "closed"},
+        }
+        plan = reb.plan(snap, max_moves=2)
+        assert plan and plan[0]["group"] == 0
+        assert (plan[0]["src"], plan[0]["dst"]) == (0, 1)
+        # swap-aware: the planned partner is the destination's lightest
+        # group (it rides back to the hot shard)
+        assert plan[0]["partner"] == 3
+        # balanced load: no moves (hysteresis)
+        balanced = {
+            "shards": 2,
+            "placement": {"0": 0, "1": 1},
+            "queue_depth": {"0": 3, "1": 2},
+        }
+        assert reb.plan(balanced) == []
+        # a group carrying the WHOLE gap never moves: swapping which
+        # shard is hot would ping-pong on every rebalance call
+        whole_gap = {
+            "shards": 2,
+            "placement": {"0": 0, "1": 1},
+            "queue_depth": {"0": 40, "1": 0},
+        }
+        assert reb.plan(whole_gap) == []
+
+    def test_router_rebalance_drives_migration(self):
+        """Router.rebalance on the sharded layout: leadership respread
+        plus a Rebalancer-planned migration when one shard is hot."""
+        from raft_tpu.multi import MultiEngine, Router
+
+        me = MultiEngine(
+            mk_cfg(transport="mesh_groups"), 8, mesh=two_shard_mesh(),
+        )
+        me.seed_leaders()
+        router = Router(me)
+        # pile queued work onto every shard-0 group
+        for g in me.groups_on_shard(0):
+            for p in payloads(12, seed=g):
+                me.submit(g, p)
+        out = router.rebalance()
+        assert out["migrations"], "hot shard not rebalanced"
+        mv = out["migrations"][0]
+        assert mv["src"] == 0 and mv["dst"] == 1
+        assert me.shard_of(mv["group"]) == 1
+        # the moved group still commits
+        s = me.submit(mv["group"], payloads(1, seed=99)[0])
+        me.run_until_committed(mv["group"], s)
+
+
+# ------------------------------------------------------ migration drill
+class TestMigrationDrill:
+    def test_migration_run_linearizable_and_progress(self):
+        """The acceptance drill: Rebalancer-driven moves mid-traffic,
+        LINEARIZABLE verdict, commit progress resuming inside the
+        window after EVERY move."""
+        from raft_tpu.chaos.runner import migration_run
+
+        rep = migration_run(0, n_groups=4, n_moves=2, clients=2, keys=4)
+        assert rep.verdict == "LINEARIZABLE"
+        assert rep.progress_ok
+        assert len(rep.moves) == 2
+        assert all(m["resume_s"] is not None for m in rep.moves)
+        assert rep.n_shards >= 2
+
+
+# -------------------------------------------------- chaos fingerprints
+def _gshard_fingerprint(seed: int, phases: int = 4):
+    """The membership-seed torture fingerprint with the sharded layout
+    armed process-wide (env, like the fused-path pins)."""
+    from raft_tpu.chaos.runner import torture_run
+
+    from tests._torture_fingerprints import fingerprint
+
+    os.environ["RAFT_TPU_GSHARD"] = "1"
+    try:
+        return fingerprint(
+            torture_run(seed, phases=phases, membership=True)
+        )
+    finally:
+        del os.environ["RAFT_TPU_GSHARD"]
+
+
+@pytest.mark.parametrize("seed", [11, 22])
+def test_chaos_seed_fingerprint_gshard_on_vs_off(seed):
+    """Membership chaos seeds replay bit-exact with the group-shard
+    layout armed vs off (shared plain baselines — the same fingerprints
+    the fused/device-obs determinism pins compare)."""
+    from tests._torture_fingerprints import plain_membership_run
+
+    assert _gshard_fingerprint(seed) == plain_membership_run(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [14, 27])
+def test_chaos_seed_fingerprint_gshard_on_vs_off_slow(seed):
+    from tests._torture_fingerprints import plain_membership_run
+
+    assert _gshard_fingerprint(seed) == plain_membership_run(seed)
+
+
+def test_multi_torture_gshard_on_vs_off():
+    """The multi-Raft torture (where the sharded layout actually
+    engages — MultiEngine under the Router/ShardedKV workload) replays
+    bit-exact with sharding on vs off."""
+    from raft_tpu.chaos.runner import torture_run_multi
+
+    def fp(rep):
+        return (rep.verdict, rep.commit_digest, rep.ops, rep.op_counts,
+                rep.shed_ops)
+
+    plain = torture_run_multi(0, n_groups=4, phases=6)
+    os.environ["RAFT_TPU_GSHARD"] = "1"
+    try:
+        sharded = torture_run_multi(0, n_groups=4, phases=6)
+    finally:
+        del os.environ["RAFT_TPU_GSHARD"]
+    assert fp(plain) == fp(sharded)

@@ -50,6 +50,13 @@ GATED_METRICS: Dict[str, str] = {
     "entries_per_sec": "up",
     "goodput_eps": "up",
     "entries_per_sec_wall": "up",
+    # group_shard leg (the sharded group-axis sweep): per-group device
+    # cost and per-group commit p50 gate DOWN, the aggregate mesh
+    # throughput gates UP (entries_per_sec_wall above already covers
+    # the end-to-end column)
+    "mesh_us_per_group_tick": "down",
+    "mesh_entries_per_sec": "up",
+    "virtual_commit_p50_s": "down",
 }
 
 
