@@ -111,7 +111,35 @@ class _Deadline:
         if self.expired:
             self.skipped.append(name)
             return _emit_leg(name, {"skipped": "deadline"})
-        return _emit_leg(name, fn())
+        return _emit_leg(name, _observed_leg(fn))
+
+
+def _observed_leg(fn: Callable[[], dict]) -> dict:
+    """Run one leg under the XLA compile-and-memory plane and merge its
+    accounting into the row: ``compile_count``/``compile_s`` (every
+    trace-and-compile the leg incurred, obs.compile) and
+    ``mem_high_water_bytes`` (live-buffer census, obs.memory —
+    sampled at leg entry/exit; metadata-only, no device sync).
+    ``tools/bench_diff.py`` gates compile_count and mem_high_water
+    DOWN: a leg that newly started recompiling, or whose buffer high
+    water grew past threshold, fails the --compare gate."""
+    from raft_tpu.obs.compile import CompileWatch
+    from raft_tpu.obs.memory import MemoryWatch
+
+    watch = CompileWatch()
+    mem = MemoryWatch()
+    watch.install()
+    try:
+        mem.census()
+        row = fn()
+    finally:
+        watch.uninstall()
+    mem.census()
+    if isinstance(row, dict) and "skipped" not in row:
+        row.setdefault("compile_count", watch.total_compiles)
+        row.setdefault("compile_s", round(watch.total_compile_s, 3))
+        row.setdefault("mem_high_water_bytes", mem.high_water_bytes)
+    return row
 
 
 def _percentiles(vals):

@@ -105,6 +105,16 @@ def main(argv=None) -> int:
                          "on-device counters; implies --observe on the "
                          "torture runners) — determinism-neutral, and "
                          "bundles gain a device_ring section")
+    ap.add_argument("--observe-compile", action="store_true",
+                    help="additionally attach the XLA compile-and-"
+                         "memory plane (obs.compile CompileWatch + "
+                         "RetraceSentinel, obs.memory census): every "
+                         "trace/compile is recorded per program label, "
+                         "the sentinel freezes after the warmup phase "
+                         "(later hot-path compiles are typed "
+                         "violations), and the device-memory census "
+                         "baselines there — determinism-neutral; also "
+                         "armed by env RAFT_TPU_COMPILE_SENTINEL=1")
     ap.add_argument("--bundle-dir", default=None, metavar="DIR",
                     help="write a repro bundle to DIR whenever a run "
                          "ends in anything but its expected verdict "
@@ -225,6 +235,7 @@ def main(argv=None) -> int:
                 observe=args.observe,
                 observe_device=args.observe_device,
                 audit=audit,
+                observe_compile=args.observe_compile,
                 bundle_dir=args.bundle_dir,
                 blackbox_dir=args.blackbox_dir,
             )
@@ -239,6 +250,7 @@ def main(argv=None) -> int:
                 observe=args.observe,
                 observe_device=args.observe_device,
                 audit=audit,
+                observe_compile=args.observe_compile,
                 bundle_dir=args.bundle_dir,
                 blackbox_dir=args.blackbox_dir,
             )
@@ -260,6 +272,15 @@ def main(argv=None) -> int:
             "membership_ops": rep.membership_ops,
             "checker_steps": rep.check.steps,
             "audit_violations": violations,
+            **(
+                {
+                    "compiles": rep.obs.compile.total_compiles,
+                    "compile_violations":
+                        len(rep.obs.compile.sentinel.violations),
+                }
+                if rep.obs is not None and rep.obs.compile is not None
+                else {}
+            ),
         }), flush=True)
         if args.broken == "commit_rewind":
             ok = ok and bool(violations)

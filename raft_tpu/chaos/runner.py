@@ -56,6 +56,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import math
+import os
 import random
 import tempfile
 import zlib
@@ -267,10 +268,16 @@ class _TortureBase:
 
     def __init__(self, seed, phases, clients, keys, phase_s,
                  observe: bool = False, observe_device: bool = False,
-                 audit: bool = False):
+                 audit: bool = False, observe_compile: bool = False):
         self.seed = seed
         self.phases = phases
         self.phase_s = phase_s
+        # sentinel opt-in via env, the RAFT_TPU_FUSE_K pattern: arm the
+        # compile plane without touching the harness call sites
+        observe_compile = observe_compile or (
+            (os.environ.get("RAFT_TPU_COMPILE_SENTINEL", "") or "0")
+            != "0"
+        )
         slo_objectives = None
         if audit:
             from raft_tpu.obs.slo import SLObjective
@@ -283,9 +290,17 @@ class _TortureBase:
             )
         self.obs: Optional[ObsStack] = (
             ObsStack.build(device=observe_device, audit=audit,
-                           slo_objectives=slo_objectives)
-            if (observe or observe_device or audit) else None
+                           slo_objectives=slo_objectives,
+                           compile_plane=observe_compile)
+            if (observe or observe_device or audit or observe_compile)
+            else None
         )
+        #   observe_compile additionally attaches the XLA plane
+        #   (obs.compile CompileWatch + RetraceSentinel, obs.memory
+        #   census). The sentinel freezes after the warmup phase
+        #   (run_phases); crash-restore cycles after that must hit the
+        #   process-wide program caches or violate. Determinism-neutral
+        #   like every other plane (pinned in tests/test_compile_plane).
         #   observe_device additionally attaches the device-resident
         #   plane (obs.device in-kernel rings); it implies observe.
         #   audit additionally attaches the ONLINE safety plane
@@ -432,33 +447,65 @@ class _TortureBase:
         return None
 
     def run_phases(self, nemesis: Nemesis) -> None:
-        for phase_no in range(self.phases):
-            self._invoke_idle()
-            act = nemesis.next_action(
-                self.members(), self.alive_map(), self.partitioned,
-                self.now(), membership=self.membership_view(),
-            )
-            # blackbox progress mark (no-op without a journal): a run
-            # killed externally mid-phase leaves WHICH phase and which
-            # nemesis action it was executing in the journal tail
-            blackbox.mark(
-                "torture_phase", phase_no=phase_no, action=act.describe(),
-                t_virtual=round(self.now(), 3), ops=len(self.history),
-            )
-            self.apply_nemesis(act)
-            # drive in slices so completions are stamped near the event
-            # that produced them, not at phase granularity
-            for _ in range(4):
-                self.pump_open_loop(self.phase_s / 4)
-                self.drive(self.phase_s / 4)
-                self.pump_membership()
-                self.pump_broken()
-                self._poll_all()
+        try:
+            for phase_no in range(self.phases):
                 self._invoke_idle()
-        blackbox.mark("quiesce", t_virtual=round(self.now(), 3),
-                      ops=len(self.history), crashes=self.crashes)
-        self.quiesce()
-        self.history.close()
+                act = nemesis.next_action(
+                    self.members(), self.alive_map(), self.partitioned,
+                    self.now(), membership=self.membership_view(),
+                )
+                # blackbox progress mark (no-op without a journal): a
+                # run killed externally mid-phase leaves WHICH phase and
+                # which nemesis action it was executing in the journal
+                blackbox.mark(
+                    "torture_phase", phase_no=phase_no,
+                    action=act.describe(),
+                    t_virtual=round(self.now(), 3), ops=len(self.history),
+                )
+                self.apply_nemesis(act)
+                # drive in slices so completions are stamped near the
+                # event that produced them, not at phase granularity
+                for _ in range(4):
+                    self.pump_open_loop(self.phase_s / 4)
+                    self.drive(self.phase_s / 4)
+                    self.pump_membership()
+                    self.pump_broken()
+                    self._poll_all()
+                    self._invoke_idle()
+                if phase_no == 0:
+                    self._freeze_compile_plane()
+            blackbox.mark("quiesce", t_virtual=round(self.now(), 3),
+                          ops=len(self.history), crashes=self.crashes)
+            self.quiesce()
+            self.history.close()
+            obs = self.obs
+            if (obs is not None and obs.memory is not None
+                    and obs.memory.baseline is not None):
+                # the flatness verdict must be taken NOW, while the
+                # final engine generation is still alive — after the
+                # run object dies the census only shows teardown
+                obs.memory.final_drift = obs.memory.drift()
+        finally:
+            if self.obs is not None:
+                self.obs.close()   # detach the process-global compile
+                #                    hook; the stats stay readable
+
+    def _freeze_compile_plane(self) -> None:
+        """Warmup over (one full nemesis phase drove every program the
+        run will steady-state on): pin the memory baseline and freeze
+        the retrace sentinel — every later hot-path compile is a
+        violation, every census drift a potential leak."""
+        obs = self.obs
+        if obs is None:
+            return
+        if obs.memory is not None:
+            obs.memory.set_baseline()
+        if obs.compile is not None and obs.compile.sentinel is not None:
+            obs.compile.sentinel.freeze()
+            blackbox.mark(
+                "compile_sentinel_frozen",
+                compiles=obs.compile.total_compiles,
+            )
 
 
 def torture_run(
@@ -479,10 +526,17 @@ def torture_run(
     observe: bool = False,
     observe_device: bool = False,
     audit: bool = False,
+    observe_compile: bool = False,
     bundle_dir: Optional[str] = None,
     blackbox_dir: Optional[str] = None,
 ) -> TortureReport:
     """One full single-engine torture run; see module docstring.
+    ``observe_compile=True`` (or env ``RAFT_TPU_COMPILE_SENTINEL=1``)
+    attaches the XLA compile-and-memory plane: every trace/compile is
+    recorded per program label, the RetraceSentinel freezes after the
+    warmup phase (any later hot-path compile is a typed violation), and
+    the device-memory census baselines there (drift = leak candidate).
+    Determinism-neutral like every other plane.
     ``audit=True`` attaches the ONLINE safety plane — the
     ``obs.audit.SafetyAuditor`` invariant checks plus the
     ``obs.slo.SloTracker`` latency/burn-rate plane (implies observe;
@@ -516,6 +570,7 @@ def torture_run(
             seed, phases, clients, keys, phase_s,
             cfg or base, workdir, broken, membership=membership,
             observe=observe, observe_device=observe_device, audit=audit,
+            observe_compile=observe_compile,
         )
         nemesis = Nemesis(
             seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
@@ -542,6 +597,8 @@ def torture_run(
         flags.append("--membership")
     if audit:
         flags.append("--audit")
+    if observe_compile:
+        flags.append("--observe-compile")
     repro = (
         f"python -m raft_tpu.chaos --seed {seed} --phases {phases} "
         f"--clients {clients} --keys {keys} --phase-s {phase_s:g}"
@@ -599,10 +656,10 @@ class _SingleTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg,
                  workdir, broken, membership: bool = False,
                  observe: bool = False, observe_device: bool = False,
-                 audit: bool = False):
+                 audit: bool = False, observe_compile: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s,
                          observe=observe, observe_device=observe_device,
-                         audit=audit)
+                         audit=audit, observe_compile=observe_compile)
         from raft_tpu.transport.device import SingleDeviceTransport
 
         self.cfg = cfg
@@ -1086,6 +1143,7 @@ def torture_run_multi(
     observe: bool = False,
     observe_device: bool = False,
     audit: bool = False,
+    observe_compile: bool = False,
     bundle_dir: Optional[str] = None,
     blackbox_dir: Optional[str] = None,
 ) -> TortureReport:
@@ -1106,6 +1164,7 @@ def torture_run_multi(
             seed, phases, clients, keys, phase_s, cfg, n_groups,
             overload=overload, observe=observe,
             observe_device=observe_device, audit=audit,
+            observe_compile=observe_compile,
         )
         nemesis = Nemesis(
             seed, run.cfg.n_replicas, allow_crash=False, allow_msg=False,
@@ -1140,10 +1199,11 @@ def torture_run_multi(
 class _MultiTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg, n_groups,
                  overload: bool = False, observe: bool = False,
-                 observe_device: bool = False, audit: bool = False):
+                 observe_device: bool = False, audit: bool = False,
+                 observe_compile: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s,
                          observe=observe, observe_device=observe_device,
-                         audit=audit)
+                         audit=audit, observe_compile=observe_compile)
         from raft_tpu.examples.kv_sharded import ShardedKV
         from raft_tpu.multi.engine import MultiEngine
         from raft_tpu.multi.router import Router
@@ -1165,6 +1225,11 @@ class _MultiTorture(_TortureBase):
                 self.engine.slo = obs.slo
             if obs.device is not None:
                 self.engine.attach_device_obs(obs.device)
+            if obs.memory is not None:
+                # the multi path wires the stack by hand (no
+                # ObsStack.attach); the memory census still needs its
+                # roots or every engine buffer reads as a leak
+                obs.memory.watch_engine(self.engine, name="multi")
         self.engine.seed_leaders()
         spans = obs.spans if obs is not None else None
         self.router = Router(self.engine, spans=spans)

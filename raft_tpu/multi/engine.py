@@ -63,6 +63,7 @@ import numpy as np
 
 from raft_tpu.admission import Overloaded
 from raft_tpu.config import RaftConfig
+from raft_tpu.obs.compile import labeled
 from raft_tpu.core.state import (
     ReplicaState,
     fold_batch,
@@ -154,14 +155,14 @@ def _programs(n_replicas: int, record: bool = False) -> tuple:
     key = (n_replicas, record)
     if key not in _PROGRAMS:
         _PROGRAMS[key] = (
-            jax.jit(
+            labeled("group.replicate", jax.jit(
                 group_replicate_step(n_replicas, record=record),
                 donate_argnums=(0, 8) if record else (0,),
-            ),
-            jax.jit(
+            )),
+            labeled("group.vote", jax.jit(
                 group_vote_step(n_replicas, record=record),
                 donate_argnums=(0, 4) if record else (0,),
-            ),
+            )),
         )
     return _PROGRAMS[key]
 
@@ -173,10 +174,10 @@ def _fused_group_programs(n_replicas: int, record: bool = False):
     donated. Shared across MultiEngine instances like ``_programs``."""
     key = (n_replicas, "fused", record)
     if key not in _PROGRAMS:
-        _PROGRAMS[key] = jax.jit(
+        _PROGRAMS[key] = labeled("group.fused", jax.jit(
             fused_group_scan(n_replicas, record=record),
             donate_argnums=(0, 10) if record else (0,),
-        )
+        ))
     return _PROGRAMS[key]
 
 

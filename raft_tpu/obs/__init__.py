@@ -44,7 +44,22 @@ differential-test join key between the golden model, the engine, and
 - ``serve``     — the live ops surface: a lock-free ``StatusBoard``
   snapshot the engines publish at flush boundaries, served by a
   stdlib-HTTP ``OpsServer`` (``/metrics`` ``/healthz`` ``/slo``
-  ``/status``; ``python -m raft_tpu.obs --serve``).
+  ``/status`` ``/compile`` ``/memory`` ``/profile``;
+  ``python -m raft_tpu.obs --serve``).
+- ``compile``   — the XLA compile plane: ``CompileWatch`` subscribes to
+  ``jax.monitoring`` compile events (program attribution via the
+  ``labeled`` wrapper at every transport program-cache seam) and the
+  ``RetraceSentinel`` turns any post-``freeze()`` compile on a
+  registered hot path into a typed ``CompileViolation``
+  (``assert_no_recompiles()`` is the tier-1 face).
+- ``memory``    — device-memory accounting: a live-buffer census
+  (``jax.live_arrays``, bucketed by state-leaf label), baseline/drift
+  leak detection across chaos crash-restore and group migration,
+  high-water gauges, and the donated-buffer audit.
+- ``profiling`` — on-demand ``jax.profiler`` capture
+  (``/profile?seconds=N``) merged with the span Perfetto export into
+  one timeline artifact, plus per-launch ``StepTraceAnnotation``
+  boundaries and the bench device-time helpers.
 """
 
 from raft_tpu.obs import blackbox
@@ -64,7 +79,21 @@ from raft_tpu.obs.blackbox import (
     read_journal,
 )
 from raft_tpu.obs.audit import AuditViolation, SafetyAuditor
+from raft_tpu.obs.compile import (
+    CompileRecord,
+    CompileViolation,
+    CompileWatch,
+    RecompileError,
+    RetraceSentinel,
+    assert_no_recompiles,
+)
 from raft_tpu.obs.events import Event, FlightRecorder, kind_of
+from raft_tpu.obs.memory import (
+    DonationReport,
+    MemoryCensus,
+    MemoryWatch,
+    audit_donation,
+)
 from raft_tpu.obs.forensics import (
     ObsStack,
     explain,
@@ -87,16 +116,24 @@ from raft_tpu.obs.trace import TraceRecord, TraceRecorder
 __all__ = [
     "AuditViolation",
     "BlackboxJournal",
+    "CompileRecord",
+    "CompileViolation",
+    "CompileWatch",
     "DeviceObs",
+    "DonationReport",
     "Event",
     "EventRing",
     "FlightRecorder",
     "HostProfiler",
     "LatencyDigest",
     "LatencySummary",
+    "MemoryCensus",
+    "MemoryWatch",
     "MetricsRegistry",
     "ObsStack",
     "OpsServer",
+    "RecompileError",
+    "RetraceSentinel",
     "SLObjective",
     "SafetyAuditor",
     "SloAlert",
@@ -107,6 +144,8 @@ __all__ = [
     "StatusBoard",
     "TraceRecord",
     "TraceRecorder",
+    "assert_no_recompiles",
+    "audit_donation",
     "blackbox",
     "decode_records",
     "dev_record",

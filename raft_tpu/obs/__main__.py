@@ -7,7 +7,9 @@ artifacts (``obs.blackbox``) — nothing here re-runs a seed:
   whatever PATH is: a repro bundle (minimal failure timeline: last
   leader per term, faults in flight, the violating op — and, when the
   run carried the device plane, the decoded device ring: kind summary,
-  overflow laps flagged, device events interleaved into the timeline),
+  overflow laps flagged, device events interleaved into the timeline;
+  when it carried the compile-&-memory plane, ``RETRACE:`` /
+  ``CENSUS GREW:`` flags from the compile log and memory census),
   a **stall bundle** (who stalled, the blocked phase, journal tail,
   all-thread stacks), a **blackbox journal** ``.jsonl`` (per-process
   phase timeline with durations; the final in-flight phase flagged),
@@ -145,9 +147,11 @@ def main(argv: Optional[list] = None) -> int:
     g.add_argument("--serve", action="store_true",
                    help="boot a demo MultiEngine with the full online "
                         "plane attached (metrics registry, SLO tracker, "
-                        "safety auditor, status board) and serve the ops "
-                        "endpoints /metrics /healthz /slo /status while "
-                        "driving synthetic traffic (Ctrl-C to stop)")
+                        "safety auditor, status board, compile watch + "
+                        "retrace sentinel, memory census) and serve the "
+                        "ops endpoints /metrics /healthz /slo /status "
+                        "/compile /memory /profile while driving "
+                        "synthetic traffic (Ctrl-C to stop)")
     ap.add_argument("-o", "--output", default=None,
                     help="output file (default stdout)")
     ap.add_argument("--json", action="store_true",

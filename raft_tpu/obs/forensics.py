@@ -45,10 +45,13 @@ class ObsStack:
     device: Any = None
     audit: Any = None          # obs.audit.SafetyAuditor (online plane)
     slo: Any = None            # obs.slo.SloTracker (online plane)
+    compile: Any = None        # obs.compile.CompileWatch (XLA plane)
+    memory: Any = None         # obs.memory.MemoryWatch (XLA plane)
 
     @classmethod
     def build(cls, capacity: int = 65536, device: bool = False,
-              audit: bool = False, slo_objectives=None) -> "ObsStack":
+              audit: bool = False, slo_objectives=None,
+              compile_plane: bool = False) -> "ObsStack":
         from raft_tpu.obs.events import FlightRecorder
         from raft_tpu.obs.registry import MetricsRegistry
         from raft_tpu.obs.spans import SpanTracker
@@ -70,6 +73,15 @@ class ObsStack:
                 objectives=tuple(slo_objectives or ()),
                 recorder=recorder, registry=registry,
             )
+        watch = memwatch = None
+        if compile_plane:
+            from raft_tpu.obs.compile import CompileWatch, RetraceSentinel
+            from raft_tpu.obs.memory import MemoryWatch
+
+            watch = CompileWatch(recorder=recorder, registry=registry)
+            RetraceSentinel(watch)
+            watch.install()
+            memwatch = MemoryWatch(registry=registry, recorder=recorder)
         return cls(
             recorder=recorder,
             spans=SpanTracker(),
@@ -77,6 +89,8 @@ class ObsStack:
             device=dev,
             audit=auditor,
             slo=tracker,
+            compile=watch,
+            memory=memwatch,
         )
 
     def attach(self, engine) -> None:
@@ -93,6 +107,19 @@ class ObsStack:
             engine.slo = self.slo
         if self.device is not None and hasattr(engine, "attach_device_obs"):
             engine.attach_device_obs(self.device)
+        if self.memory is not None:
+            # re-attachment replaces the previous generation's weakref
+            # getters: the census follows the LIVE engine across chaos
+            # crash-restore cycles (old generations must collect away —
+            # exactly what the flatness pin checks)
+            self.memory.watch_engine(engine)
+
+    def close(self) -> None:
+        """Detach process-global hooks (the compile watch's monitoring
+        subscription). Runners call this when the run ends so one run's
+        plane never bleeds into the next."""
+        if self.compile is not None:
+            self.compile.uninstall()
 
 
 def resolve_bundle_dir(bundle_dir: Optional[str]) -> Optional[str]:
@@ -167,6 +194,18 @@ def write_bundle(
         "slo": (
             obs.slo.snapshot()
             if obs is not None and getattr(obs, "slo", None) is not None
+            else None
+        ),
+        "compile_log": (
+            obs.compile.snapshot()
+            if obs is not None
+            and getattr(obs, "compile", None) is not None
+            else None
+        ),
+        "memory": (
+            obs.memory.snapshot()
+            if obs is not None
+            and getattr(obs, "memory", None) is not None
             else None
         ),
         "extra": extra or {},
@@ -338,6 +377,60 @@ def explain(bundle: dict) -> str:
             )
             for e in dev_evs
         ]
+
+    # -- compile plane (obs.compile: retraces + sentinel) ---------------
+    cl = bundle.get("compile_log")
+    if cl is not None:
+        sent = cl.get("sentinel") or {}
+        viols = sent.get("violations") or []
+        post_freeze = [
+            r for r in cl.get("log", [])
+            if r.get("frozen") and r.get("event") in ("trace", "compile")
+        ]
+        out.append(
+            f"compile plane: {cl.get('total_traces', 0)} traces, "
+            f"{cl.get('total_compiles', 0)} compiles "
+            f"({cl.get('total_compile_s', 0.0):.2f}s), "
+            f"{len(viols)} hot-path violation(s)"
+        )
+        for v in viols[:6]:
+            shapes = v.get("arg_shapes")
+            out.append(
+                f"  RETRACE: post-freeze {v['event']} on "
+                f"{v['program']!r} at t_wall={v['t_wall']:.1f}s"
+                + (f" args=({', '.join(shapes)})" if shapes else "")
+            )
+        if not viols and post_freeze:
+            progs = sorted({r["program"] for r in post_freeze})
+            out.append(
+                f"  (post-freeze compiles off the hot paths: "
+                f"{', '.join(progs)})"
+            )
+
+    # -- memory plane (obs.memory: census growth) -----------------------
+    mem = bundle.get("memory")
+    if mem is not None and mem.get("census"):
+        cur, base = mem["census"], mem.get("baseline")
+        line = (
+            f"memory plane: {cur['n_arrays']} live buffers, "
+            f"{cur['total_bytes']} bytes "
+            f"(high water {mem.get('high_water_bytes', 0)})"
+        )
+        out.append(line)
+        if base is not None:
+            growth = cur["total_bytes"] - base["total_bytes"]
+            if growth > 0:
+                out.append(
+                    f"  CENSUS GREW: {growth:+d} bytes over baseline "
+                    f"({base['total_bytes']} -> {cur['total_bytes']}) — "
+                    "possible leak across crash-restore/migration"
+                )
+        don = mem.get("donation")
+        if don is not None and not don.get("engaged", True):
+            out.append(
+                f"  donation IGNORED on backend "
+                f"{don.get('backend')!r}: {don.get('detail')}"
+            )
 
     # -- faults in flight (device events interleaved) ------------------
     faults = []

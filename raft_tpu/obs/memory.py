@@ -1,0 +1,417 @@
+"""The memory plane: device-buffer census, leak detection, donation
+audit.
+
+The fused steady state donates its state pytree (and event ring) into
+every launch, chaos crash-restore rebuilds engines per cycle, and
+``migrate_group`` permutes whole device slots — any of which could leak
+buffers silently at G=1024 scale. Nothing measured device memory until
+this module:
+
+- :meth:`MemoryWatch.census` walks ``jax.live_arrays()`` — metadata
+  only: shapes, dtypes, nbytes; NO device sync, NO data transfer — and
+  buckets every live buffer. Buffers identity-matched to a registered
+  root's pytree leaves (:meth:`register_root` /
+  :meth:`watch_engine`) bucket under their state-leaf label
+  (``engine.state.payload``); the rest bucket by ``dtype[shape]``.
+- **Leak detector**: :meth:`set_baseline` pins the steady-state census;
+  :meth:`drift` / :meth:`assert_flat` compare a later census
+  bucket-by-bucket — the chaos pins assert the census returns to
+  baseline across crash-restore cycles and ``migrate_group`` moves.
+- **High-water gauges**: every census updates
+  ``raft_device_mem_bytes`` / ``raft_device_mem_bytes_high_water`` /
+  ``raft_device_arrays`` (per-root bytes ride
+  ``raft_device_state_bytes{root}``).
+- :func:`audit_donation` proves donated buffers are NOT silently
+  copied: it runs one donated call and checks the donated operands'
+  leaves are actually deleted (``Array.is_deleted``). On a backend
+  that ignores donation the report says so honestly
+  (``honored=False``) instead of passing vacuously.
+
+Determinism contract: census taking is pure host metadata walking — a
+seeded run replays byte-identically with the plane attached or absent
+(pinned with the compile plane's chaos identity test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gc
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+def _leaf_labels(name: str, tree: Any) -> Dict[int, str]:
+    """id(leaf array) -> "name.path" for a registered root pytree."""
+    import jax
+
+    out: Dict[int, str] = {}
+    try:
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    except Exception:
+        return out
+    for path, leaf in leaves:
+        if hasattr(leaf, "nbytes") and hasattr(leaf, "shape"):
+            key = "".join(str(p) for p in path)
+            out[id(leaf)] = f"{name}{key}"
+    return out
+
+
+@dataclasses.dataclass
+class MemoryCensus:
+    """One point-in-time live-buffer census. ``by_shape`` covers every
+    live buffer; ``unattr_by_shape`` only those NOT reachable from a
+    registered root — the population the leak detector watches (a
+    leaked old engine generation, an orphaned staging buffer, a
+    silently-copied donated state all land there, while a live root's
+    fixed-structure pytree cannot grow without bound)."""
+
+    total_bytes: int
+    n_arrays: int
+    by_label: Dict[str, Tuple[int, int]]    # label -> (count, bytes)
+    by_shape: Dict[str, Tuple[int, int]]    # dtype[shape] -> (count, bytes)
+    unattr_by_shape: Dict[str, Tuple[int, int]]
+    attributed_bytes: int
+
+    @property
+    def unattributed_bytes(self) -> int:
+        return self.total_bytes - self.attributed_bytes
+
+    def to_jsonable(self) -> dict:
+        return {
+            "total_bytes": self.total_bytes,
+            "n_arrays": self.n_arrays,
+            "attributed_bytes": self.attributed_bytes,
+            "unattributed_bytes": self.unattributed_bytes,
+            "by_label": {
+                k: {"count": c, "bytes": b}
+                for k, (c, b) in sorted(self.by_label.items())
+            },
+            "by_shape": {
+                k: {"count": c, "bytes": b}
+                for k, (c, b) in sorted(self.by_shape.items())
+            },
+            "unattr_by_shape": {
+                k: {"count": c, "bytes": b}
+                for k, (c, b) in sorted(self.unattr_by_shape.items())
+            },
+        }
+
+
+class MemoryWatch:
+    """Device-memory accounting for one run (module docstring)."""
+
+    def __init__(self, registry=None, recorder=None) -> None:
+        self.registry = registry
+        self.recorder = recorder
+        self._roots: Dict[str, Callable[[], Any]] = {}
+        self.baseline: Optional[MemoryCensus] = None
+        self.last: Optional[MemoryCensus] = None
+        self.high_water_bytes = 0
+        self.high_water_arrays = 0
+        self.donation: Optional["DonationReport"] = None
+        #: the chaos runner's end-of-run flatness verdict (drift()
+        #: taken at quiesce, while the final engine is still alive)
+        self.final_drift: Optional[List[str]] = None
+
+    # ------------------------------------------------------------- roots
+    def register_root(self, name: str,
+                      getter: Callable[[], Any]) -> None:
+        """Label the leaves of ``getter()``'s pytree in every census.
+        ``getter`` returning ``None`` skips the root (a crashed
+        engine)."""
+        self._roots[name] = getter
+
+    def watch_engine(self, engine, name: str = "engine") -> None:
+        """Register an engine's device-resident roots under ``name``:
+        the state pytree and event ring (precise per-leaf labels), plus
+        a shallow walk of the engine's, its fused driver's and its
+        transport chain's instance attributes — which attributes the
+        LAZY singletons (the heartbeat zero batch, the staging ring,
+        a chaos transport's deferred in-flight messages): buffers
+        allocated on first use, which must be attributed or their first
+        appearance after ``set_baseline`` would read as a leak. Held
+        via weakref so a watched engine can be garbage-collected across
+        chaos crash-restore cycles — the whole point of the flatness
+        pin."""
+        ref = weakref.ref(engine)
+
+        def state_getter():
+            e = ref()
+            return None if e is None else getattr(e, "state", None)
+
+        def ring_getter():
+            e = ref()
+            return None if e is None else getattr(e, "_dev_ring", None)
+
+        def host_getter():
+            e = ref()
+            if e is None:
+                return None
+            # the engine's own attribute dict (plain containers recurse
+            # as pytrees; foreign objects stay opaque leaves), the fused
+            # driver's staging ring, and the transport wrapper chain
+            # (a ChaosTransport retains delayed message payloads)
+            out: Dict[str, Any] = {"self": dict(vars(e))}
+            driver = getattr(e, "_fused_driver", None)
+            if driver is not None:
+                out["staging"] = getattr(driver.staging, "buf", None)
+            t = getattr(e, "t", None) or getattr(e, "transport", None)
+            depth = 0
+            while t is not None and depth < 3:
+                out[f"t{depth}"] = dict(vars(t))
+                t = getattr(t, "t", None)
+                depth += 1
+            return out
+
+        # host first: census label maps apply roots in registration
+        # order with later wins, so the precise state/ring leaf labels
+        # override the generic host-walk labels for shared buffers
+        self.register_root(f"{name}.host", host_getter)
+        self.register_root(f"{name}.state", state_getter)
+        self.register_root(f"{name}.ring", ring_getter)
+
+    # ------------------------------------------------------------ census
+    def census(self, collect: bool = False) -> MemoryCensus:
+        """Take a census (see module docstring). ``collect=True`` runs
+        ``gc.collect()`` first — the leak-detector comparisons want
+        dropped-but-uncollected engine generations out of the picture;
+        the passive /memory endpoint leaves the collector alone."""
+        import jax
+
+        if collect:
+            gc.collect()
+        labels: Dict[int, str] = {}
+        for name, getter in self._roots.items():
+            try:
+                tree = getter()
+            except Exception:
+                tree = None
+            if tree is not None:
+                labels.update(_leaf_labels(name, tree))
+        by_label: Dict[str, List[int]] = {}
+        by_shape: Dict[str, List[int]] = {}
+        unattr: Dict[str, List[int]] = {}
+        total = 0
+        n = 0
+        attributed = 0
+        for arr in jax.live_arrays():
+            try:
+                nbytes = int(arr.nbytes)
+                shape_key = (
+                    f"{arr.dtype}[{','.join(map(str, arr.shape))}]"
+                )
+            except Exception:
+                continue
+            total += nbytes
+            n += 1
+            sc = by_shape.setdefault(shape_key, [0, 0])
+            sc[0] += 1
+            sc[1] += nbytes
+            label = labels.get(id(arr))
+            if label is not None:
+                attributed += nbytes
+                lc = by_label.setdefault(label, [0, 0])
+                lc[0] += 1
+                lc[1] += nbytes
+            else:
+                uc = unattr.setdefault(shape_key, [0, 0])
+                uc[0] += 1
+                uc[1] += nbytes
+        census = MemoryCensus(
+            total_bytes=total, n_arrays=n,
+            by_label={k: (c, b) for k, (c, b) in by_label.items()},
+            by_shape={k: (c, b) for k, (c, b) in by_shape.items()},
+            unattr_by_shape={k: (c, b) for k, (c, b) in unattr.items()},
+            attributed_bytes=attributed,
+        )
+        self.last = census
+        self.high_water_bytes = max(self.high_water_bytes, total)
+        self.high_water_arrays = max(self.high_water_arrays, n)
+        if self.registry is not None:
+            self.registry.gauge(
+                "raft_device_mem_bytes", "live device buffer bytes",
+            ).set(total)
+            self.registry.gauge(
+                "raft_device_mem_bytes_high_water",
+                "max live device buffer bytes observed",
+            ).set(self.high_water_bytes)
+            self.registry.gauge(
+                "raft_device_arrays", "live device buffer count",
+            ).set(n)
+            roots: Dict[str, int] = {}
+            for label, (_c, b) in census.by_label.items():
+                root = label.split(".", 1)[0]
+                roots[root] = roots.get(root, 0) + b
+            for root, b in roots.items():
+                self.registry.gauge(
+                    "raft_device_state_bytes",
+                    "live bytes attributed to a registered root",
+                    ("root",),
+                ).set_max(b, root=root)
+        return census
+
+    # ----------------------------------------------------- leak detector
+    def set_baseline(self, collect: bool = True) -> MemoryCensus:
+        """Pin the steady-state census the flatness pins compare to."""
+        self.baseline = self.census(collect=collect)
+        return self.baseline
+
+    def drift(self, tolerance_bytes: int = 0,
+              collect: bool = True) -> List[str]:
+        """Census-vs-baseline deltas worth flagging, as human-readable
+        strings (empty = FLAT). The watched population is the
+        UNATTRIBUTED buffers (see :class:`MemoryCensus`): a leaked old
+        engine generation, an orphaned staging buffer, or a silently
+        copied donated state is by definition unreachable from any live
+        registered root and lands in ``unattr_by_shape`` — while a
+        registered root's own leaves (including lazily-allocated
+        singletons like the heartbeat zero batch) are reachable state,
+        bounded by the root's fixed pytree structure."""
+        if self.baseline is None:
+            raise RuntimeError("set_baseline() before drift()")
+        now = self.census(collect=collect)
+        out: List[str] = []
+        delta = now.unattributed_bytes - self.baseline.unattributed_bytes
+        if delta > tolerance_bytes:
+            out.append(
+                f"unattributed total {delta:+d} bytes "
+                f"({self.baseline.unattributed_bytes} -> "
+                f"{now.unattributed_bytes})"
+            )
+        buckets = set(now.unattr_by_shape) | set(
+            self.baseline.unattr_by_shape
+        )
+        for k in sorted(buckets):
+            c0, b0 = self.baseline.unattr_by_shape.get(k, (0, 0))
+            c1, b1 = now.unattr_by_shape.get(k, (0, 0))
+            if c1 > c0 and b1 - b0 > tolerance_bytes:
+                out.append(
+                    f"bucket {k}: {c1 - c0:+d} unattributed arrays "
+                    f"({b1 - b0:+d} bytes)"
+                )
+        if out and self.recorder is not None:
+            self.recorder.record(
+                node="mem", term=0, kind="census_drift",
+                drift=list(out),
+            )
+        return out
+
+    def assert_flat(self, tolerance_bytes: int = 0,
+                    collect: bool = True) -> None:
+        """The leak detector's teeth: raise when the census drifted."""
+        drift = self.drift(
+            tolerance_bytes=tolerance_bytes, collect=collect
+        )
+        if drift:
+            raise AssertionError(
+                "device-memory census is not flat vs baseline:\n  "
+                + "\n  ".join(drift)
+            )
+
+    # ---------------------------------------------------------- snapshot
+    def snapshot(self, census: bool = False) -> dict:
+        """The /memory body and the forensics-bundle entry.
+        ``census=True`` takes a fresh census first (metadata-only)."""
+        if census or self.last is None:
+            self.census()
+        return {
+            "census": self.last.to_jsonable() if self.last else None,
+            "baseline": (
+                self.baseline.to_jsonable() if self.baseline else None
+            ),
+            "high_water_bytes": self.high_water_bytes,
+            "high_water_arrays": self.high_water_arrays,
+            "final_drift": self.final_drift,
+            "roots": sorted(self._roots),
+            "donation": (
+                dataclasses.asdict(self.donation)
+                if self.donation is not None else None
+            ),
+        }
+
+    def summary(self) -> dict:
+        """The light /status section."""
+        return {
+            "live_bytes": self.last.total_bytes if self.last else None,
+            "live_arrays": self.last.n_arrays if self.last else None,
+            "high_water_bytes": self.high_water_bytes,
+            "flat": (
+                None if self.baseline is None or self.last is None
+                else self.last.total_bytes <= self.baseline.total_bytes
+            ),
+        }
+
+
+# --------------------------------------------------------------- donation
+@dataclasses.dataclass
+class DonationReport:
+    """Outcome of one donated-call audit.
+
+    ``engaged`` — the backend consumed at least one donated leaf (a
+    backend that IGNORES donation copies everything and deletes
+    nothing). ``honored`` — every donated leaf was consumed. The gap
+    between the two is normal XLA behavior, not a leak: when two
+    outputs CSE into one buffer (steady state: ``last_index'`` equals
+    ``commit_index'``), one donated input goes unused and survives;
+    its buffer frees with the reference, and the census-over-launches
+    pin is what proves no per-launch copy accumulates."""
+
+    honored: bool           # every donated leaf was actually consumed
+    engaged: bool           # at least one leaf was consumed in place
+    backend: str
+    n_donated_leaves: int
+    n_deleted: int
+    detail: str = ""
+
+
+def audit_donation(call: Callable, args: tuple,
+                   donated: Tuple[int, ...] = (0,),
+                   watch: Optional[MemoryWatch] = None) -> DonationReport:
+    """Run ``call(*args)`` once and prove the donated positional args
+    were consumed, not silently copied: after the call the array
+    leaves of each donated operand must report ``is_deleted()``. A
+    backend that ignores donation (older CPU jaxlibs warn and copy)
+    yields ``engaged=False`` — the audit reports the copy instead of
+    pretending. The caller must treat the donated args as consumed
+    either way (that is already the donation contract)."""
+    import jax
+
+    backend = jax.default_backend()
+    donated_leaves: List[Any] = []
+    for i in donated:
+        donated_leaves.extend(
+            leaf for leaf in jax.tree.leaves(args[i])
+            if hasattr(leaf, "is_deleted")
+        )
+    call(*args)
+    deleted = sum(1 for leaf in donated_leaves if leaf.is_deleted())
+    honored = deleted == len(donated_leaves) and donated_leaves != []
+    engaged = deleted > 0
+    if honored:
+        detail = "all donated leaves consumed in place"
+    elif engaged:
+        detail = (
+            f"{len(donated_leaves) - deleted} donated leaves survived "
+            "the call (unused donation — typically an output CSE, see "
+            "DonationReport)"
+        )
+    else:
+        detail = (
+            "no donated leaf was consumed (the backend copied instead "
+            "of donating)"
+        )
+    report = DonationReport(
+        honored=honored, engaged=engaged, backend=backend,
+        n_donated_leaves=len(donated_leaves), n_deleted=deleted,
+        detail=detail,
+    )
+    if watch is not None:
+        watch.donation = report
+        if watch.recorder is not None:
+            watch.recorder.record(
+                node="mem", term=0, kind="donation_audit",
+                honored=honored, engaged=engaged, backend=backend,
+                n_donated_leaves=report.n_donated_leaves,
+                n_deleted=deleted,
+            )
+    return report

@@ -1,4 +1,4 @@
-"""Device-time measurement via ``jax.profiler`` traces.
+"""On-demand ``jax.profiler`` capture + bench device-time measurement.
 
 The SURVEY §5 tracing row: kernel/collective device time, not host wall
 clock. On this rig the distinction is load-bearing — dispatch crosses a
@@ -8,23 +8,186 @@ round-1 number measured the tunnel, not the kernel). A profiler trace
 records the on-device execution span of each compiled module, which is
 exact regardless of dispatch latency.
 
-``device_seconds`` runs one call under a trace and returns the device-side
-duration of the longest compiled module in it (for a bench body that is
-one ``jit`` scan, that IS the program). ``op_breakdown`` aggregates
-per-op device durations from the same trace for kernel-level attribution.
+Bench helpers (the original bench-only role): ``device_seconds`` runs
+one call under a trace and returns the device-side duration of the
+longest compiled module in it (for a bench body that is one ``jit``
+scan, that IS the program). ``op_breakdown`` aggregates per-op device
+durations from the same trace for kernel-level attribution.
+
+On-demand capture (the compile-&-memory-plane promotion):
+
+- :func:`launch_annotation` — a ``jax.profiler.StepTraceAnnotation``
+  the engines wrap around each launch boundary (the fused window, the
+  per-tick replicate, the batched group launch) so a capture segments
+  by launch. It is a nullcontext unless a capture is ACTIVE — the
+  detached cost is one module-bool test per launch, no device traffic.
+- :func:`capture_profile` — capture ``seconds`` of wall time while the
+  engine keeps running (the OpsServer ``/profile?seconds=N`` endpoint),
+  then merge the device trace with the span tracker's Perfetto export
+  (``obs.spans.SpanTracker.to_perfetto``) into ONE timeline artifact —
+  client-op spans and device kernels in the same ui.perfetto.dev view.
+  Destination: explicit argument, else ``RAFT_TPU_PROFILE_DIR``, else a
+  temp dir (the same resolution ladder as ``RAFT_TPU_BUNDLE_DIR``).
+
+Captures are serialized process-wide (``jax.profiler`` allows one
+session); a concurrent request raises :class:`CaptureBusy`.
 """
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import gzip
 import json
+import os
 import shutil
 import tempfile
+import threading
+import time
 from typing import Callable, Optional
 
 import jax
 import numpy as np
+
+PROFILE_FORMAT = "raft_tpu.obs/profile.v1"
+
+#: span-track pids are offset past any plausible device-trace pid so
+#: the two timelines never collide in the merged artifact
+SPAN_PID_OFFSET = 900_000
+
+
+def resolve_profile_dir(profile_dir: Optional[str]) -> Optional[str]:
+    """Destination policy: explicit argument, else the
+    ``RAFT_TPU_PROFILE_DIR`` environment variable, else None (the
+    caller falls back to a temp dir)."""
+    if profile_dir is not None:
+        return profile_dir
+    return os.environ.get("RAFT_TPU_PROFILE_DIR") or None
+
+
+# ----------------------------------------------------- launch annotations
+_capture_active = False
+_capture_lock = threading.Lock()
+#: shared detached context: nullcontext is stateless and reentrant, so
+#: the per-launch detached cost stays one module-bool test + one return
+#: (no allocation on the hot dispatch path)
+_NULL = contextlib.nullcontext()
+
+
+class CaptureBusy(RuntimeError):
+    """A profiler capture is already in flight (one session allowed)."""
+
+
+def capture_active() -> bool:
+    return _capture_active
+
+
+def launch_annotation(name: str, step: int):
+    """A ``StepTraceAnnotation`` while a capture is active, else the
+    shared detached nullcontext (see module docstring)."""
+    if not _capture_active:
+        return _NULL
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+# ------------------------------------------------------ on-demand capture
+def merge_timelines(device_events: list, span_trace: Optional[dict]) -> dict:
+    """One Chrome/Perfetto artifact from a device trace and the span
+    tracker's export. Span tracks are pid-offset (SPAN_PID_OFFSET) so
+    both families keep their own process rows; the device trace rides
+    its real (wall-clock) timebase and the span tracks their virtual
+    clock — the artifact labels both so a reader isn't misled."""
+    evs = list(device_events)
+    n_span = 0
+    if span_trace:
+        for e in span_trace.get("traceEvents", []):
+            e = dict(e)
+            if "pid" in e:
+                e["pid"] = e["pid"] + SPAN_PID_OFFSET
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                nm = e.get("args", {}).get("name", "")
+                e["args"] = {"name": f"{nm} (virtual clock)"}
+            evs.append(e)
+            n_span += 1
+    return {
+        "format": PROFILE_FORMAT,
+        "displayTimeUnit": "ms",
+        "traceEvents": evs,
+        "n_device_events": len(device_events),
+        "n_span_events": n_span,
+    }
+
+
+def capture_profile(
+    seconds: float,
+    spans=None,
+    profile_dir: Optional[str] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    keep_python_frames: bool = False,
+) -> dict:
+    """Capture ``seconds`` of profiler trace while the engine threads
+    keep running, merge with the span export, write the artifact, and
+    return ``{"artifact", "raw_dir", "seconds", "n_device_events",
+    "n_span_events"}``. Raises :class:`CaptureBusy` when a capture is
+    already in flight.
+
+    The merged artifact keeps the kernel/runtime/annotation events and
+    drops the host Python-frame events (names starting with ``$`` —
+    hundreds of thousands per busy second on the CPU tracer, drowning
+    the timeline); ``keep_python_frames=True`` keeps everything, and
+    with a configured destination the raw xplane dump is preserved
+    next to the artifact either way."""
+    global _capture_active
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a profiler capture is already in flight")
+    base = resolve_profile_dir(profile_dir)
+    cleanup_raw = False
+    try:
+        if base is None:
+            base = tempfile.mkdtemp(prefix="raft_tpu_profile_")
+        os.makedirs(base, exist_ok=True)
+        raw = tempfile.mkdtemp(prefix="raw_", dir=base)
+        cleanup_raw = True
+        jax.profiler.start_trace(raw)
+        _capture_active = True
+        try:
+            sleep(max(seconds, 0.0))
+        finally:
+            _capture_active = False
+            # always close the session — a leaked session poisons every
+            # later start_trace (same contract as device_seconds)
+            jax.profiler.stop_trace()
+        device_events = _load_latest_trace(raw)
+        if not keep_python_frames:
+            device_events = [
+                e for e in device_events
+                if not str(e.get("name", "")).startswith("$")
+            ]
+        merged = merge_timelines(
+            device_events,
+            spans.to_perfetto() if spans is not None else None,
+        )
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(base, f"profile_{stamp}.json")
+        with open(path, "w") as fh:
+            json.dump(merged, fh, separators=(",", ":"))
+        keep_raw = resolve_profile_dir(profile_dir) is not None
+        return {
+            "artifact": path,
+            # the raw xplane dump survives only with a configured
+            # destination; on the temp fallback it is deleted below —
+            # never advertise a path that is about to vanish
+            "raw_dir": raw if keep_raw else None,
+            "seconds": seconds,
+            "n_device_events": merged["n_device_events"],
+            "n_span_events": merged["n_span_events"],
+        }
+    finally:
+        if cleanup_raw and resolve_profile_dir(profile_dir) is None:
+            # an env/arg destination keeps the raw xplane dump for
+            # tensorboard; the temp fallback keeps only the artifact
+            shutil.rmtree(raw, ignore_errors=True)
+        _capture_lock.release()
 
 
 def _load_latest_trace(trace_dir: str):

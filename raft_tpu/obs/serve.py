@@ -23,7 +23,19 @@ scrapeable while the engine runs, without locks on the hot path:
               rates, active + recent alerts) as JSON
   /status     the board's composed snapshot: leader map, per-group
               term/commit/applied watermarks, replication lag, queue
-              depths, audit summary, breaker state — JSON
+              depths, audit summary, breaker state — plus ``compile``
+              and ``memory`` summary sections when those planes are
+              attached — JSON
+  /compile    the CompileWatch snapshot (per-program trace/compile
+              tallies, event log, sentinel freeze state + violations)
+  /memory     the MemoryWatch snapshot with a FRESH live-buffer census
+              (metadata-only: no device sync)
+  /profile    ``?seconds=N`` (default 1, clamped to [0.05, 30]):
+              capture a ``jax.profiler`` trace for N wall seconds while
+              the engine keeps running, merge it with the span
+              tracker's Perfetto export, write one timeline artifact
+              (``RAFT_TPU_PROFILE_DIR`` or a temp dir) and return its
+              path; 409 when a capture is already in flight
   ==========  ==========================================================
 
 Thread-safety contract: ``/status`` and ``/healthz`` serve from
@@ -44,6 +56,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 
 class StatusBoard:
@@ -86,6 +99,10 @@ class OpsServer:
         registry=None,
         slo=None,
         auditor=None,
+        compile_watch=None,
+        memory=None,
+        spans=None,
+        profile_dir: Optional[str] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ) -> None:
@@ -93,6 +110,10 @@ class OpsServer:
         self.registry = registry
         self.slo = slo
         self.auditor = auditor
+        self.compile_watch = compile_watch
+        self.memory = memory
+        self.spans = spans
+        self.profile_dir = profile_dir
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -167,13 +188,66 @@ class OpsServer:
                         if (ops.auditor is not None
                                 and "audit" not in snap):
                             snap["audit"] = ops.auditor.summary()
+                        if (ops.compile_watch is not None
+                                and "compile" not in snap):
+                            snap["compile"] = ops.compile_watch.summary()
+                        if (ops.memory is not None
+                                and "memory" not in snap):
+                            snap["memory"] = ops.memory.summary()
                         return json.dumps(snap)
                     self._send(200, self._render_live(_compose))
+                elif path == "/compile":
+                    if ops.compile_watch is None:
+                        self._send(404, json.dumps(
+                            {"error": "no compile watch attached"}))
+                        return
+                    body = self._render_live(
+                        lambda: json.dumps(ops.compile_watch.snapshot())
+                    )
+                    self._send(200, body)
+                elif path == "/memory":
+                    if ops.memory is None:
+                        self._send(404, json.dumps(
+                            {"error": "no memory watch attached"}))
+                        return
+                    body = self._render_live(
+                        lambda: json.dumps(
+                            ops.memory.snapshot(census=True))
+                    )
+                    self._send(200, body)
+                elif path == "/profile":
+                    from raft_tpu.obs import profiling
+
+                    import math
+
+                    try:
+                        seconds = float(
+                            parse_qs(
+                                urlparse(self.path).query
+                            ).get("seconds", ["1"])[0]
+                        )
+                    except ValueError:
+                        seconds = float("nan")
+                    if not math.isfinite(seconds):
+                        self._send(400, json.dumps(
+                            {"error": "seconds must be a finite number"}))
+                        return
+                    seconds = min(max(seconds, 0.05), 30.0)
+                    try:
+                        result = profiling.capture_profile(
+                            seconds, spans=ops.spans,
+                            profile_dir=ops.profile_dir,
+                        )
+                    except profiling.CaptureBusy as ex:
+                        self._send(409, json.dumps({"error": str(ex)}))
+                        return
+                    self._send(200, json.dumps(result))
                 else:
                     self._send(404, json.dumps({
                         "error": f"unknown path {path!r}",
                         "endpoints": ["/metrics", "/healthz", "/slo",
-                                      "/status"],
+                                      "/status", "/compile", "/memory",
+                                      "/profile"],
                     }))
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
@@ -220,7 +294,9 @@ def serve_demo(
     from raft_tpu.config import RaftConfig
     from raft_tpu.multi.engine import MultiEngine
     from raft_tpu.obs.audit import SafetyAuditor
+    from raft_tpu.obs.compile import CompileWatch, RetraceSentinel
     from raft_tpu.obs.events import FlightRecorder
+    from raft_tpu.obs.memory import MemoryWatch
     from raft_tpu.obs.registry import MetricsRegistry
     from raft_tpu.obs.slo import SLObjective, SloTracker
 
@@ -243,14 +319,22 @@ def serve_demo(
     )
     board = StatusBoard()
     eng.status_board = board
+    watch = CompileWatch(
+        recorder=eng.recorder, registry=eng.metrics
+    ).install()
+    RetraceSentinel(watch)
+    memory = MemoryWatch(registry=eng.metrics, recorder=eng.recorder)
+    memory.watch_engine(eng, name="multi")
     eng.seed_leaders()
     server = OpsServer(
         board=board, registry=eng.metrics, slo=eng.slo,
-        auditor=eng.auditor, port=port,
+        auditor=eng.auditor, compile_watch=watch, memory=memory,
+        port=port,
     )
     bound = server.start()
     line = (f"raft_tpu ops endpoint on http://127.0.0.1:{bound} "
-            "(/metrics /healthz /slo /status); Ctrl-C to stop")
+            "(/metrics /healthz /slo /status /compile /memory "
+            "/profile); Ctrl-C to stop")
     print(line, file=out, flush=True)
     t0 = _time.monotonic()
     submitted = 0
@@ -265,14 +349,23 @@ def serve_demo(
                     eng.submit(g, payload[:cfg.entry_bytes])
                     submitted += 1
             eng.run_for(2 * cfg.heartbeat_period)
+            if watch.sentinel is not None and not watch.sentinel.frozen:
+                # warmup over: the demo's program set is built after the
+                # first driven window — freeze so /compile shows the
+                # sentinel armed
+                watch.sentinel.freeze()
+            memory.census()
             _time.sleep(0.02)        # pace the virtual cluster for wall
     except KeyboardInterrupt:
         pass
     finally:
         server.stop()
+        watch.uninstall()
     return {
         "port": bound,
         "submitted": submitted,
         "committed": int(eng.commit_watermark.sum()),
         "violations": eng.auditor.total_violations,
+        "compiles": watch.total_compiles,
+        "compile_violations": len(watch.sentinel.violations),
     }

@@ -57,6 +57,7 @@ from raft_tpu.admission import AdmissionGate, Overloaded
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.state import NO_VOTE, ReplicaState, fold_batch
 from raft_tpu.obs import blackbox
+from raft_tpu.obs import profiling as _profiling
 from raft_tpu.transport.base import Transport, make_transport
 
 FOLLOWER = "follower"
@@ -2652,20 +2653,25 @@ class RaftEngine:
             hp.mark("host_pre")
         member_arg = (jnp.asarray(step_member) if step_member is not None
                       else self._member_arg())
-        if self._dev_ring is not None:
-            self.state, info, self._dev_ring = self.t.replicate(
-                self.state, payload, take, r, term, jnp.asarray(eff),
-                jnp.asarray(self.slow), repair=repair, member=member_arg,
-                repair_floor=floor, floor_prev_term=fpt,
-                term_floor=self._term_floor, ring=self._dev_ring,
-            )
-        else:
-            self.state, info = self.t.replicate(
-                self.state, payload, take, r, term, jnp.asarray(eff),
-                jnp.asarray(self.slow), repair=repair, member=member_arg,
-                repair_floor=floor, floor_prev_term=fpt,
-                term_floor=self._term_floor,
-            )
+        # launch-boundary annotation (obs.profiling): nullcontext
+        # unless an on-demand profiler capture is in flight
+        with _profiling.launch_annotation("leader_tick", self._tick_count):
+            if self._dev_ring is not None:
+                self.state, info, self._dev_ring = self.t.replicate(
+                    self.state, payload, take, r, term, jnp.asarray(eff),
+                    jnp.asarray(self.slow), repair=repair,
+                    member=member_arg,
+                    repair_floor=floor, floor_prev_term=fpt,
+                    term_floor=self._term_floor, ring=self._dev_ring,
+                )
+            else:
+                self.state, info = self.t.replicate(
+                    self.state, payload, take, r, term, jnp.asarray(eff),
+                    jnp.asarray(self.slow), repair=repair,
+                    member=member_arg,
+                    repair_floor=floor, floor_prev_term=fpt,
+                    term_floor=self._term_floor,
+                )
         if hp is not None:
             hp.mark("dispatch")
             hp.sync(self.state, info)

@@ -58,15 +58,18 @@ import numpy as np
 
 from jax import lax
 
+from raft_tpu.obs import profiling
+from raft_tpu.obs.compile import labeled
+
 #: shared staging-slot writer: one donated DUS per staged batch (shape-
 #: cached per (S, B, W) like any jit; process-wide so chaos restarts
-#: never recompile it)
-_STAGE_JIT = jax.jit(
+#: never recompile it — the compile plane's "single.stage" hot path)
+_STAGE_JIT = labeled("single.stage", jax.jit(
     lambda buf, words, slot: lax.dynamic_update_slice(
         buf, words[None], (slot, jnp.int32(0), jnp.int32(0))
     ),
     donate_argnums=(0,),
-)
+))
 
 
 class StagingRing:
@@ -397,13 +400,18 @@ class FusedDriver:
             n_run = min(left, size)
             cnt = np.zeros(size, np.int32)
             cnt[:n_run] = counts[pos:pos + n_run]
-            out = e.t.replicate_fused(
-                e.state, st.buf, start_batch % st.S, jnp.asarray(cnt),
-                n_run, halted, r, term, eff_dev, slow_dev,
-                member=member_arg, repair_floor=floor,
-                floor_prev_term=fpt,
-                ring=e._dev_ring,
-            )
+            # launch-boundary annotation: a nullcontext unless an
+            # on-demand profiler capture is active (obs.profiling)
+            with profiling.launch_annotation(
+                "fused_window", e.fused_launches
+            ):
+                out = e.t.replicate_fused(
+                    e.state, st.buf, start_batch % st.S,
+                    jnp.asarray(cnt), n_run, halted, r, term, eff_dev,
+                    slow_dev, member=member_arg, repair_floor=floor,
+                    floor_prev_term=fpt,
+                    ring=e._dev_ring,
+                )
             if e._dev_ring is not None:
                 (e.state, infos, escaped, ran, halted, e._dev_ring) = out
             else:

@@ -26,22 +26,38 @@ from raft_tpu.core.step import (
     scan_replicate,
     vote_step,
 )
+from raft_tpu.obs.compile import labeled
 
-#: process-wide fused K-tick program cache, keyed
-#: (rows, commit_quorum, member_mode, record): every transport instance
-#: over the same cluster shape shares ONE jitted program per launch
-#: size (jit caches per input shape), so chaos crash-restore cycles —
-#: which build a fresh transport per restart — never recompile the
-#: fused scan. Donation: the state pytree (and the event ring on the
-#: recorded variant) updates in place instead of round-tripping HBM.
-_FUSED_PROGRAMS: dict = {}
+#: process-wide protocol-program cache: every transport instance over
+#: the same cluster shape shares ONE jitted program per entry point
+#: (jit caches per input shape), so chaos crash-restore cycles — which
+#: build a fresh transport per restart — never recompile the fused
+#: scan, the per-tick replicate/vote programs, or the batched drain
+#: scan. (Before the compile plane existed, only the FUSED program was
+#: process-cached; the per-tick programs were per-instance jits whose
+#: crash-restore retraces nothing measured — the RetraceSentinel's
+#: per-seed-rebuild pin is what keeps this cache honest now.) Programs
+#: are wrapped ``obs.compile.labeled`` at cache-store time, so the
+#: compile plane attributes every trace/compile to its program label.
+#: Donation: the state pytree (and the event ring on the recorded
+#: variants) updates in place instead of round-tripping HBM.
+_PROGRAMS: dict = {}
+_COMMS: dict = {}
+
+
+def _comm_for(rows: int) -> SingleDeviceComm:
+    # one stateless comm per cluster size, shared by every cached
+    # program (a fresh comm per program would split jit caches)
+    if rows not in _COMMS:
+        _COMMS[rows] = SingleDeviceComm(rows)
+    return _COMMS[rows]
 
 
 def _fused_program(rows: int, commit_quorum, member_mode: bool,
                    record: bool):
-    key = (rows, commit_quorum, member_mode, record)
-    if key not in _FUSED_PROGRAMS:
-        comm = SingleDeviceComm(rows)
+    key = ("fused", rows, commit_quorum, member_mode, record)
+    if key not in _PROGRAMS:
+        comm = _comm_for(rows)
 
         def fn(state, staging, start_slot, counts, n_run, halted0,
                leader, leader_term, alive, slow, fpt, rf, *rest):
@@ -54,16 +70,50 @@ def _fused_program(rows: int, commit_quorum, member_mode: bool,
             )
 
         ring_arg = 12 + (1 if member_mode else 0)
-        _FUSED_PROGRAMS[key] = jax.jit(
+        _PROGRAMS[key] = labeled("single.fused", jax.jit(
             fn, donate_argnums=(0,) + ((ring_arg,) if record else ()),
-        )
-    return _FUSED_PROGRAMS[key]
+        ))
+    return _PROGRAMS[key]
+
+
+def _replicate_program(rows: int, ec: bool, commit_quorum, rep: bool,
+                       record: bool = False):
+    key = ("replicate", rows, ec, commit_quorum, rep, record)
+    if key not in _PROGRAMS:
+        kw = {"record": True} if record else {}
+        _PROGRAMS[key] = labeled("single.replicate", jax.jit(
+            partial(
+                replicate_step, _comm_for(rows),
+                ec=ec, commit_quorum=commit_quorum, repair=rep, **kw,
+            )
+        ))
+    return _PROGRAMS[key]
+
+
+def _vote_program(rows: int, record: bool = False):
+    key = ("vote", rows, record)
+    if key not in _PROGRAMS:
+        kw = {"record": True} if record else {}
+        _PROGRAMS[key] = labeled("single.vote", jax.jit(
+            partial(vote_step, _comm_for(rows), **kw)
+        ))
+    return _PROGRAMS[key]
+
+
+def _replicate_many_program(rows: int, ec: bool, commit_quorum,
+                            rep: bool):
+    key = ("replicate_many", rows, ec, commit_quorum, rep)
+    if key not in _PROGRAMS:
+        _PROGRAMS[key] = labeled("single.replicate_many", jax.jit(
+            partial(scan_replicate, _comm_for(rows), ec, commit_quorum,
+                    rep)
+        ))
+    return _PROGRAMS[key]
 
 
 class SingleDeviceTransport:
     def __init__(self, cfg: RaftConfig):
         self.cfg = cfg
-        comm = SingleDeviceComm(cfg.rows)
         self._member_mode = cfg.max_replicas is not None
         # two compiled variants per entry point: repair-capable, and the
         # steady-state program with the repair window compiled out (~10%
@@ -72,28 +122,21 @@ class SingleDeviceTransport:
         # no recompile on dispatch toggles).
         reps = (True,) if cfg.ec_enabled else (True, False)
         self._replicate = {
-            rep: jax.jit(
-                partial(
-                    replicate_step, comm,
-                    ec=cfg.ec_enabled, commit_quorum=cfg.commit_quorum,
-                    repair=rep,
-                )
+            rep: _replicate_program(
+                cfg.rows, cfg.ec_enabled, cfg.commit_quorum, rep
             )
             for rep in reps
         }
-        self._vote = jax.jit(partial(vote_step, comm))
+        self._vote = _vote_program(cfg.rows)
         # device-observability (obs.device) variants, built lazily on
         # first recorded call: same protocol programs wrapped with the
         # in-kernel event ring (record=True). Keyed like _replicate.
-        self._comm = comm
+        self._comm = _comm_for(cfg.rows)
         self._replicate_rec: dict = {}
         self._vote_rec = None
         self._replicate_many = {
-            rep: jax.jit(
-                partial(
-                    scan_replicate, comm, cfg.ec_enabled, cfg.commit_quorum,
-                    rep,
-                )
+            rep: _replicate_many_program(
+                cfg.rows, cfg.ec_enabled, cfg.commit_quorum, rep
             )
             for rep in reps
         }
@@ -129,13 +172,9 @@ class SingleDeviceTransport:
             # program — alias like the unrecorded caches do
             key = True if self.cfg.ec_enabled else bool(repair)
             if key not in self._replicate_rec:
-                self._replicate_rec[key] = jax.jit(
-                    partial(
-                        replicate_step, self._comm,
-                        ec=self.cfg.ec_enabled,
-                        commit_quorum=self.cfg.commit_quorum,
-                        repair=key, record=True,
-                    )
+                self._replicate_rec[key] = _replicate_program(
+                    self.cfg.rows, self.cfg.ec_enabled,
+                    self.cfg.commit_quorum, key, record=True,
                 )
             args = (
                 state, client_payload, jnp.int32(client_count),
@@ -220,8 +259,8 @@ class SingleDeviceTransport:
         recorded election-win condition uses."""
         if ring is not None:
             if self._vote_rec is None:
-                self._vote_rec = jax.jit(
-                    partial(vote_step, self._comm, record=True)
+                self._vote_rec = _vote_program(
+                    self.cfg.rows, record=True
                 )
             return self._vote_rec(
                 state, jnp.int32(candidate), jnp.int32(cand_term), alive,
@@ -246,7 +285,7 @@ class SingleDeviceTransport:
         from raft_tpu.core.step_pallas import steady_pipeline_tpu
 
         if not hasattr(self, "_pipeline_jit"):
-            self._pipeline_jit = jax.jit(
+            self._pipeline_jit = labeled("single.pipeline", jax.jit(
                 _partial(
                     steady_pipeline_tpu,
                     commit_quorum=self.cfg.commit_quorum,
@@ -255,7 +294,7 @@ class SingleDeviceTransport:
                 ),
                 donate_argnums=(0,),
                 static_argnames=("allow_turnover",),
-            )
+            ))
         if self._member_mode and member is None:
             member = jnp.ones(self.cfg.rows, bool)
         return self._pipeline_jit(

@@ -58,6 +58,7 @@ from raft_tpu.core.step import (
     group_vote_step,
 )
 from raft_tpu.obs import blackbox
+from raft_tpu.obs.compile import labeled
 
 
 def n_shards_for(n_groups: int, n_devices: int) -> int:
@@ -176,7 +177,9 @@ class GroupMeshTransport:
     def _cached(self, kind: str, record: bool, build):
         key = self._key + (kind, record)
         if key not in _PROGRAMS:
-            _PROGRAMS[key] = build()
+            # labeled at cache-store time: the compile plane attributes
+            # every trace/compile of the family to "group_mesh.<kind>"
+            _PROGRAMS[key] = labeled(f"group_mesh.{kind}", build())
         return _PROGRAMS[key]
 
     def _ring_specs(self):

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.comm import MeshComm, shard_map
 from raft_tpu.obs import blackbox
+from raft_tpu.obs.compile import labeled
 from raft_tpu.core.state import ReplicaState, init_state
 from raft_tpu.core.step import (
     RepInfo,
@@ -45,6 +46,16 @@ from raft_tpu.core.step import (
 
 AXIS = "replica"
 PAYLOAD_AXIS = "pshard"
+
+#: Process-wide mesh + program caches (the group_mesh pattern, extended
+#: to the replica mesh this round): a fresh TpuMeshTransport over the
+#: same device grid used to rebuild every shard_map program — a silent
+#: per-instance retrace of the whole family, which the RetraceSentinel
+#: now counts as a hot-path violation. Instances over the same (device
+#: ids, rows, payload shards, program-shaping config) share ONE Mesh
+#: object and ONE labeled jitted program per entry point.
+_MESHES: dict = {}
+_PROGRAMS: dict = {}
 
 
 class TpuMeshTransport:
@@ -80,7 +91,18 @@ class TpuMeshTransport:
             devices=len(devices),
         )
         grid = np.array(devices[:need]).reshape(cfg.rows, payload_shards)
-        self.mesh = Mesh(grid, (AXIS, PAYLOAD_AXIS))
+        mesh_key = (tuple(d.id for d in grid.flat), cfg.rows,
+                    payload_shards)
+        if mesh_key not in _MESHES:
+            _MESHES[mesh_key] = Mesh(grid, (AXIS, PAYLOAD_AXIS))
+        self.mesh = _MESHES[mesh_key]
+        # everything that shapes a program's CLOSURE (specs, comm,
+        # partial params) — operand shapes re-key inside jit itself
+        self._key = mesh_key + (
+            cfg.ec_enabled, cfg.commit_quorum,
+            cfg.max_replicas is not None,
+            cfg.log_capacity, cfg.shard_words,
+        )
         # The folded payload's lane axis is [R x P x W_local] flattened in
         # that (major-to-minor) order, which is exactly how PartitionSpec
         # splits one dimension over a tuple of mesh axes.
@@ -107,48 +129,58 @@ class TpuMeshTransport:
         self._member_mode = cfg.max_replicas is not None
         mem_spec = (P(),) if self._member_mode else ()
         self._replicate = {
-            rep: jax.jit(
-                shard_map(
-                    partial(
-                        replicate_step, comm,
-                        ec=cfg.ec_enabled, commit_quorum=cfg.commit_quorum,
-                        repair=rep,
-                    ),
-                    mesh=self.mesh,
-                    in_specs=(
-                        state_specs, P(None, lanes), P(), P(), P(), P(), P(),
-                        P(), P(),
-                    ) + mem_spec,
-                    out_specs=(state_specs, info_specs),
-                    check_vma=False,
-                )
+            rep: self._cached(
+                "tpu_mesh.replicate", ("replicate", rep),
+                lambda rep=rep: jax.jit(
+                    shard_map(
+                        partial(
+                            replicate_step, comm,
+                            ec=cfg.ec_enabled,
+                            commit_quorum=cfg.commit_quorum,
+                            repair=rep,
+                        ),
+                        mesh=self.mesh,
+                        in_specs=(
+                            state_specs, P(None, lanes), P(), P(), P(),
+                            P(), P(), P(), P(),
+                        ) + mem_spec,
+                        out_specs=(state_specs, info_specs),
+                        check_vma=False,
+                    )
+                ),
             )
             for rep in reps
         }
-        self._vote = jax.jit(
-            shard_map(
-                partial(vote_step, comm),
-                mesh=self.mesh,
-                in_specs=(state_specs, P(), P(), P()),
-                out_specs=(state_specs, vote_specs),
-                check_vma=False,
-            )
-        )
-        self._replicate_many = {
-            rep: jax.jit(
+        self._vote = self._cached(
+            "tpu_mesh.vote", ("vote",),
+            lambda: jax.jit(
                 shard_map(
-                    partial(
-                        scan_replicate, comm, cfg.ec_enabled,
-                        cfg.commit_quorum, rep,
-                    ),
+                    partial(vote_step, comm),
                     mesh=self.mesh,
-                    in_specs=(
-                        state_specs, P(None, None, lanes),
-                        P(), P(), P(), P(), P(), P(), P(),
-                    ) + mem_spec,
-                    out_specs=(state_specs, info_specs),
+                    in_specs=(state_specs, P(), P(), P()),
+                    out_specs=(state_specs, vote_specs),
                     check_vma=False,
                 )
+            ),
+        )
+        self._replicate_many = {
+            rep: self._cached(
+                "tpu_mesh.replicate_many", ("replicate_many", rep),
+                lambda rep=rep: jax.jit(
+                    shard_map(
+                        partial(
+                            scan_replicate, comm, cfg.ec_enabled,
+                            cfg.commit_quorum, rep,
+                        ),
+                        mesh=self.mesh,
+                        in_specs=(
+                            state_specs, P(None, None, lanes),
+                            P(), P(), P(), P(), P(), P(), P(),
+                        ) + mem_spec,
+                        out_specs=(state_specs, info_specs),
+                        check_vma=False,
+                    )
+                ),
             )
             for rep in reps
         }
@@ -165,16 +197,23 @@ class TpuMeshTransport:
         self._info_specs = info_specs
         self._lanes = lanes
         self._mem_spec = mem_spec
-        self._fused = {}
-        self._recorded = {}
-        #   device-observability (obs.device) program cache: recorded
-        #   variants threading the replicated EventRing through the
-        #   shard_map body (every device computes the identical ring
-        #   from gathered values, so P() specs are exact)
+        #   the recorded (obs.device) variants thread the replicated
+        #   EventRing through the shard_map body (every device computes
+        #   the identical ring from gathered values, so P() specs are
+        #   exact); they ride the same process-wide _PROGRAMS cache
         self._fetch_seq = 0
         #   allgather id for blackbox marks: every cross-process fetch is
         #   a collective that can stall; the journal carries which one
         blackbox.mark("mesh_ready", rows=cfg.rows)
+
+    def _cached(self, label: str, key: tuple, build):
+        """Process-wide program lookup (module docstring): build once
+        per (transport key, program key), wrapped ``obs.compile.labeled``
+        at cache-store time so the compile plane attributes the family."""
+        k = self._key + key
+        if k not in _PROGRAMS:
+            _PROGRAMS[k] = labeled(label, build())
+        return _PROGRAMS[k]
 
     def init(self) -> ReplicaState:
         state = init_state(self.cfg)
@@ -220,10 +259,7 @@ class TpuMeshTransport:
         """shard_map programs that thread ``term_floor`` through, so the
         per-step dispatch inside core.step (one source of truth) can
         route to the per-device fused kernels. Built lazily per
-        (kind, repair[, turnover]) and cached."""
-        key = (kind, rep, allow_turnover)
-        if key in self._fused:
-            return self._fused[key]
+        (kind, repair[, turnover]) and process-cached."""
         cfg = self.cfg
         comm = self._comm
         lanes = self._lanes
@@ -269,20 +305,22 @@ class TpuMeshTransport:
                 )
             win_spec = P(None, None, lanes)
 
-        prog = jax.jit(
-            shard_map(
-                fn,
-                mesh=self.mesh,
-                in_specs=(
-                    self._state_specs, win_spec,
-                    P(), P(), P(), P(), P(), P(), P(),
-                ) + self._mem_spec + (P(),),
-                out_specs=(self._state_specs, self._info_specs),
-                check_vma=False,
-            )
+        return self._cached(
+            f"tpu_mesh.{kind}",
+            ("fused_dispatch", kind, rep, allow_turnover),
+            lambda: jax.jit(
+                shard_map(
+                    fn,
+                    mesh=self.mesh,
+                    in_specs=(
+                        self._state_specs, win_spec,
+                        P(), P(), P(), P(), P(), P(), P(),
+                    ) + self._mem_spec + (P(),),
+                    out_specs=(self._state_specs, self._info_specs),
+                    check_vma=False,
+                )
+            ),
         )
-        self._fused[key] = prog
-        return prog
 
     def _recorded_program(self, kind: str, rep: bool, has_tf: bool):
         """Device-observability variants (obs.device): the same protocol
@@ -293,9 +331,6 @@ class TpuMeshTransport:
         if kind == "replicate" and self.cfg.ec_enabled:
             rep = True   # EC has no repair window: both keys are one
             #   program — alias like the unrecorded caches do
-        key = (kind, rep, has_tf)
-        if key in self._recorded:
-            return self._recorded[key]
         from raft_tpu.obs.device import EventRing
 
         cfg = self.cfg
@@ -332,14 +367,15 @@ class TpuMeshTransport:
             in_specs = (self._state_specs, P(), P(), P(), P(), ring_specs)
             out_specs = (self._state_specs, vote_specs, ring_specs)
 
-        prog = jax.jit(
-            shard_map(
-                fn, mesh=self.mesh, in_specs=in_specs,
-                out_specs=out_specs, check_vma=False,
-            )
+        return self._cached(
+            f"tpu_mesh.{kind}", ("recorded", kind, rep, has_tf),
+            lambda: jax.jit(
+                shard_map(
+                    fn, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                )
+            ),
         )
-        self._recorded[key] = prog
-        return prog
 
     def replicate(
         self, state, client_payload, client_count, leader, leader_term,
@@ -396,11 +432,8 @@ class TpuMeshTransport:
         block on a full-copy cluster, so the ring rides in replicated
         over the replica axis (split over the payload axis when byte
         sharding is on) and the per-device scan body consumes it with
-        no tile at all. Built lazily per record flag and cached with
-        the other fused-dispatch programs."""
-        key = ("fused_scan", record)
-        if key in self._fused:
-            return self._fused[key]
+        no tile at all. Built lazily per record flag and process-cached
+        with the other fused-dispatch programs."""
         cfg = self.cfg
         comm = self._comm
         mm = self._member_mode
@@ -429,23 +462,24 @@ class TpuMeshTransport:
                                    counters=P())
             extra_in = extra_in + (ring_specs,)
             extra_out = (ring_specs,)
-        prog = jax.jit(
-            shard_map(
-                fn,
-                mesh=self.mesh,
-                in_specs=(
-                    self._state_specs, stag_spec,
-                    P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
-                ) + extra_in,
-                out_specs=(
-                    self._state_specs, self._info_specs,
-                ) + flag_specs + extra_out,
-                check_vma=False,
+        return self._cached(
+            "tpu_mesh.fused", ("fused_scan", record),
+            lambda: jax.jit(
+                shard_map(
+                    fn,
+                    mesh=self.mesh,
+                    in_specs=(
+                        self._state_specs, stag_spec,
+                        P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                    ) + extra_in,
+                    out_specs=(
+                        self._state_specs, self._info_specs,
+                    ) + flag_specs + extra_out,
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
             ),
-            donate_argnums=(0,),
         )
-        self._fused[key] = prog
-        return prog
 
     def replicate_fused(
         self, state, staging, start_slot, counts, n_run, halted0,

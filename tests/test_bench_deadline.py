@@ -33,10 +33,17 @@ def test_deadline_object_contract():
 
     dl = bench._Deadline(None)
     assert not dl.expired                         # no budget: never expires
-    assert dl.run("x", lambda: {"v": 1}) == {"v": 1}
+    row = dl.run("x", lambda: {"v": 1})
+    assert row["v"] == 1
+    # round 11: every executed leg carries the compile-&-memory plane
+    # columns (tools/bench_diff.py gates them; docs/PERF.md)
+    assert {"compile_count", "compile_s",
+            "mem_high_water_bytes"} <= set(row)
 
     dl = bench._Deadline(1e-9)
     time.sleep(0.01)
     assert dl.expired
+    # a SKIPPED leg must stay a bare skip marker — "not measured" must
+    # never grow measured-looking columns
     assert dl.run("y", lambda: {"v": 1}) == {"skipped": "deadline"}
     assert dl.skipped == ["y"]

@@ -158,3 +158,43 @@ class TestCli:
         new = self._write(tmp_path, "new.json", _combined(2.4, 1e6))
         assert diff_main([old, new]) == 1                  # 20% > 10%
         assert diff_main([old, new, "--threshold", "0.5"]) == 0
+
+
+class TestCompileMemoryColumns:
+    """ISSUE 11: the compile-&-memory plane columns gate (down), and a
+    leg that NEWLY started recompiling is always reported + gated."""
+
+    def test_newly_recompiling_leg_gates_and_is_named(self):
+        old = {"steady": {"compile_count": 0,
+                          "mem_high_water_bytes": 1000}}
+        new = {"steady": {"compile_count": 2,
+                          "mem_high_water_bytes": 1000}}
+        deltas, regressions = compare_runs(old, new, 0.10)
+        assert [(d.metric, d.status) for d in regressions] == [
+            ("compile_count", "recompiling")
+        ]
+        table = format_table(deltas, 0.10)
+        assert "legs newly recompiling" in table
+        assert "steady" in table
+
+    def test_mem_high_water_gates_down_and_improvement_passes(self):
+        old = {"steady": {"compile_count": 4,
+                          "mem_high_water_bytes": 1000}}
+        worse = {"steady": {"compile_count": 4,
+                            "mem_high_water_bytes": 1500}}
+        better = {"steady": {"compile_count": 0,
+                             "mem_high_water_bytes": 800}}
+        _, reg = compare_runs(old, worse, 0.10)
+        assert [d.metric for d in reg] == ["mem_high_water_bytes"]
+        _, reg = compare_runs(old, better, 0.10)
+        assert reg == []
+
+    def test_old_artifact_without_columns_does_not_gate(self):
+        """BENCH_r01..r05 predate the columns: their absence must read
+        as "not measured", never as a regression."""
+        old = {"steady": {"p50_us": 2.0}}
+        new = {"steady": {"p50_us": 2.0, "compile_count": 7,
+                          "mem_high_water_bytes": 123456}}
+        deltas, reg = compare_runs(old, new, 0.10)
+        assert reg == []
+        assert all(d.metric != "compile_count" for d in deltas)

@@ -1,0 +1,260 @@
+"""The XLA compile plane (round 11): CompileWatch + RetraceSentinel.
+
+Four contracts under test:
+
+1. **Typed compile accounting** — every jit trace/compile fired during
+   a labeled program call is recorded with its program label, arg
+   shapes and elapsed time; cached calls record nothing; counters ride
+   the metrics registry.
+2. **Zero steady-state recompiles** (the PR-8/PR-10 program-cache
+   claims, given teeth) — a fused K=64 torture window and a per-seed
+   engine rebuild (the chaos-runner pattern) incur ZERO hot-path
+   compiles under ``assert_no_recompiles()``.
+3. **Falsifiability** — a deliberately injected shape drift (an
+   off-by-one staging ring) trips the sentinel with a typed
+   ``CompileViolation``; the plane can actually catch the failure it
+   exists for.
+4. **Overhead contract** — detached, the labeled wrappers add no
+   device fetches (fetch-count pin) and chaos seeds 11/22 replay
+   byte-identically with the plane on vs off (shared plain baselines,
+   ``tests/_torture_fingerprints.py``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs.compile import (
+    CompileWatch,
+    RecompileError,
+    RetraceSentinel,
+    labeled,
+)
+from raft_tpu.obs.registry import MetricsRegistry
+from raft_tpu.raft.engine import RaftEngine
+from raft_tpu.transport.device import SingleDeviceTransport
+
+ENTRY = 16
+
+
+def payloads(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, ENTRY, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def mk_engine(fuse_k=1, seed=0, **kw):
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single", fuse_k=fuse_k, seed=seed, **kw,
+    )
+    return RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+
+def drive_pattern(e, seed):
+    """One warmup-shaped drive: elect, drain a backlog (fused when
+    fuse_k > 1), idle heartbeats — the same shape twice compiles
+    nothing the second time, which is exactly what the pins lean on."""
+    e.run_until_leader()
+    seqs = [e.submit(p) for p in payloads(24, seed=seed)]
+    e.run_for(40 * e.cfg.heartbeat_period)
+    e.run_for(10 * e.cfg.heartbeat_period)
+    assert all(e.is_durable(s) for s in seqs)
+
+
+# ------------------------------------------------------------ 1. accounting
+class TestCompileWatch:
+    def test_labeled_program_attribution_and_shapes(self):
+        import jax
+
+        reg = MetricsRegistry()
+        watch = CompileWatch(registry=reg)
+        fn = labeled("single.fused", jax.jit(lambda x: x * 2))
+        with watch:
+            fn(jnp.ones(7))
+        traces = watch.events(program="single.fused", event="trace")
+        assert traces, "first call must record a trace"
+        assert any(
+            "float32[7]" in (r.arg_shapes or []) for r in traces
+        )
+        assert watch.compiles.get("single.fused", 0) >= 1
+        assert reg.counter(
+            "raft_compiles_total", labels=("program",)
+        ).value(program="single.fused") >= 1
+        before = watch.total_traces
+        with watch:
+            fn(jnp.ones(7))          # cached: no events
+        assert watch.total_traces == before
+
+    def test_detached_wrapper_is_passthrough(self):
+        import jax
+
+        base = jax.jit(lambda x: x + 1)
+        fn = labeled("single.vote", base)
+        assert fn.__wrapped__ is base
+        out = fn(jnp.ones(3))        # no watch installed anywhere
+        np.testing.assert_array_equal(np.asarray(out), np.full(3, 2.0))
+
+    def test_snapshot_shape(self):
+        import jax
+
+        watch = CompileWatch()
+        RetraceSentinel(watch)
+        with watch:
+            labeled("p", jax.jit(lambda x: x - 1))(jnp.ones(2))
+        snap = watch.snapshot()
+        assert snap["total_compiles"] >= 1
+        assert "p" in snap["programs"]
+        assert snap["sentinel"]["frozen"] is False
+        assert snap["log"][0]["event"] in ("trace", "lower", "compile")
+
+
+# -------------------------------------------- 2. zero steady-state compiles
+class TestRetraceSentinel:
+    def test_fused_k64_window_zero_steady_compiles(self):
+        """ACCEPTANCE: after one warmup drive, a fused K=64 torture
+        window (drain + idle heartbeats) runs with ZERO hot-path
+        compiles — and fusion genuinely engaged inside the frozen
+        window."""
+        watch = CompileWatch()
+        sentinel = RetraceSentinel(watch)
+        with watch:
+            e = mk_engine(fuse_k=64)
+            drive_pattern(e, seed=1)         # warmup: compiles happen here
+            launches0 = e.fused_launches
+            with sentinel.assert_no_recompiles():
+                seqs = [e.submit(p) for p in payloads(24, seed=2)]
+                e.run_for(40 * e.cfg.heartbeat_period)
+                e.run_for(10 * e.cfg.heartbeat_period)
+            assert all(e.is_durable(s) for s in seqs)
+            assert e.fused_launches > launches0, \
+                "the frozen window must actually ride the fused path"
+
+    def test_per_seed_engine_rebuild_zero_compiles(self):
+        """ACCEPTANCE: the chaos-runner pattern — a fresh transport and
+        engine per seed/crash cycle over the same cluster shape — hits
+        the process-wide program caches instead of retracing (this WAS
+        a silent per-restart retrace before the per-tick programs were
+        promoted to the process cache; the sentinel is what keeps it
+        fixed)."""
+        watch = CompileWatch()
+        sentinel = RetraceSentinel(watch)
+        with watch:
+            e1 = mk_engine(fuse_k=1, seed=3)
+            drive_pattern(e1, seed=3)
+            with sentinel.assert_no_recompiles():
+                e2 = mk_engine(fuse_k=1, seed=3)   # fresh "restart"
+                drive_pattern(e2, seed=3)
+
+    def test_injected_shape_drift_trips_sentinel(self):
+        """FALSIFIABILITY: an off-by-one staging ring (S+1 slots) on
+        the fused hot path is a novel signature — the sentinel must
+        catch exactly this class of silent shape-polymorphic retrace,
+        as a typed violation naming the program."""
+        watch = CompileWatch()
+        sentinel = RetraceSentinel(watch)
+        with watch:
+            e = mk_engine(fuse_k=8)
+            drive_pattern(e, seed=4)
+            d = e._fused_driver
+            S, B, W = d.staging.S, d.staging.B, d.staging.W
+            drifted = jnp.zeros((S + 1, B, W), jnp.int32)  # off-by-one
+            r = e.leader_id
+            with pytest.raises(RecompileError) as ei:
+                with sentinel.assert_no_recompiles():
+                    e.t.replicate_fused(
+                        e.state, drifted, 0,
+                        jnp.zeros(4, jnp.int32), 2, False, r,
+                        int(e.lead_terms[r]), jnp.asarray(e.alive),
+                        jnp.asarray(e.slow),
+                    )
+            assert "single.fused" in str(ei.value)
+            v = sentinel.violations[-1]
+            assert v.program == "single.fused"
+            assert any(
+                f"int32[{S + 1},{B},{W}]" in s
+                for s in (v.arg_shapes or [])
+            )
+
+
+# ------------------------------------------------------ 4. overhead contract
+class TestOverheadContract:
+    def test_plane_adds_no_device_fetches(self):
+        """Fetch-count pin: the compile+memory plane attached (watch
+        installed, engine memory-watched, censuses taken) performs
+        exactly the device fetches of the bare engine — and the
+        committed bytes are identical."""
+        from raft_tpu.core.state import committed_payloads
+        from raft_tpu.obs.memory import MemoryWatch
+
+        def run(with_plane):
+            e = mk_engine(fuse_k=4, seed=7)
+            counts = [0]
+            orig = e._fetch
+
+            def counting(x):
+                counts[0] += 1
+                return orig(x)
+
+            e._fetch = counting
+            watch = mem = None
+            if with_plane:
+                watch = CompileWatch().install()
+                RetraceSentinel(watch)
+                mem = MemoryWatch()
+                mem.watch_engine(e)
+                mem.census()
+            try:
+                drive_pattern(e, seed=7)
+                if mem is not None:
+                    mem.census()
+            finally:
+                if watch is not None:
+                    watch.uninstall()
+            log = [bytes(p) for p in committed_payloads(e.state, 0)]
+            return counts[0], log
+
+        n_bare, log_bare = run(False)
+        n_plane, log_plane = run(True)
+        assert n_plane == n_bare
+        assert log_plane == log_bare
+
+    @pytest.mark.parametrize("seed", [11, 22])
+    def test_chaos_seed_byte_identical_plane_on_vs_off(self, seed):
+        """ACCEPTANCE: chaos seeds 11/22 replay byte-identically with
+        the compile plane armed vs absent (shared plain baselines —
+        the same fingerprints every other plane's neutrality pin
+        compares)."""
+        from raft_tpu.chaos.runner import torture_run
+        from tests._torture_fingerprints import (
+            fingerprint,
+            plain_membership_run,
+        )
+
+        rep = torture_run(seed, phases=4, membership=True,
+                          observe_compile=True)
+        assert fingerprint(rep) == plain_membership_run(seed)
+
+
+# --------------------------------------------------------- chaos integration
+class TestChaosCompilePlane:
+    def test_crash_restore_run_zero_violations_and_stats(self):
+        """A torture run with crash cycles after the warmup freeze:
+        zero sentinel violations (the process caches really absorb the
+        restart rebuilds), the watch saw the warmup compiles, and the
+        bundle-facing snapshots are populated."""
+        from raft_tpu.chaos.runner import torture_run
+
+        rep = torture_run(17, phases=6, observe_compile=True)
+        assert rep.check.verdict == "LINEARIZABLE"
+        assert rep.crashes >= 1, "seed 17 must exercise crash-restore"
+        w = rep.obs.compile
+        assert w.sentinel.frozen
+        assert w.sentinel.violations == []
+        # launches are counted per label even when the whole program
+        # set was already warm (a warm full-suite process compiles
+        # nothing — that is the process caches working)
+        assert w.by_program()["single.replicate"]["launches"] > 0
+        snap = w.snapshot()
+        assert snap["sentinel"]["violations"] == []

@@ -1,0 +1,229 @@
+"""The device-memory plane (round 11): census, leak detector, donation
+audit.
+
+Contracts under test:
+
+1. **Census attribution** — live buffers identity-matched to registered
+   roots bucket under state-leaf labels; the rest land unattributed.
+2. **Leak detector + falsifiability** — the census is FLAT across a
+   chaos crash-restore run and a ``migrate_group`` move; a deliberately
+   held orphan buffer is flagged with its bucket, and released it goes
+   flat again.
+3. **Donation audit** — the fused steady launch's donated state pytree
+   is proven consumed in place (not silently copied) on this backend;
+   an undonated program audits ``honored=False`` (the instrument can
+   tell the difference).
+4. The 8-seed flatness sweep rides the ``slow`` marker (wall-budget
+   rule); tier-1 keeps one crash-restore seed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs.memory import MemoryWatch, audit_donation
+from raft_tpu.obs.registry import MetricsRegistry
+from raft_tpu.raft.engine import RaftEngine
+from raft_tpu.transport.device import SingleDeviceTransport
+
+ENTRY = 16
+
+
+def payloads(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, ENTRY, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def mk_engine(fuse_k=1, seed=0, **kw):
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single", fuse_k=fuse_k, seed=seed, **kw,
+    )
+    return RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+
+# ----------------------------------------------------------- 1. attribution
+class TestCensus:
+    def test_state_leaves_attributed_by_label(self):
+        reg = MetricsRegistry()
+        watch = MemoryWatch(registry=reg)
+        e = mk_engine()
+        watch.watch_engine(e)
+        c = watch.census()
+        state_labels = [k for k in c.by_label if ".state" in k]
+        assert state_labels, "engine state leaves must be labeled"
+        assert c.attributed_bytes > 0
+        assert c.total_bytes >= c.attributed_bytes
+        # the high-water gauges rode the census
+        assert reg.gauge("raft_device_mem_bytes").value() == c.total_bytes
+        assert watch.high_water_bytes >= c.total_bytes
+
+    def test_snapshot_jsonable(self):
+        import json
+
+        watch = MemoryWatch()
+        e = mk_engine()
+        watch.watch_engine(e)
+        snap = watch.snapshot(census=True)
+        json.dumps(snap)             # must be JSON-safe for bundles
+        assert snap["census"]["n_arrays"] > 0
+        assert "roots" in snap
+
+
+# ---------------------------------------------------------- 2. leak detector
+class TestLeakDetector:
+    def test_orphan_buffer_flagged_then_flat(self):
+        """FALSIFIABILITY: a held unattributed buffer is exactly what
+        the detector must flag — and releasing it goes flat again."""
+        watch = MemoryWatch()
+        e = mk_engine()
+        watch.watch_engine(e)
+        watch.set_baseline()
+        assert watch.drift() == []
+        orphan = jnp.zeros((123, 7), jnp.float32)   # a "leak"
+        drift = watch.drift()
+        assert drift, "held orphan buffer must be flagged"
+        assert any("float32[123,7]" in line for line in drift)
+        with pytest.raises(AssertionError):
+            watch.assert_flat()
+        del orphan
+        watch.assert_flat()
+
+    def test_lazy_engine_singletons_are_attributed(self):
+        """The heartbeat zero batch and fused staging ring allocate on
+        first use — AFTER a baseline taken at boot. They are reachable
+        engine state (registered roots), so the census must not read
+        them as leaks."""
+        watch = MemoryWatch()
+        e = mk_engine(fuse_k=4)
+        watch.watch_engine(e)
+        watch.set_baseline()
+        e.run_until_leader()
+        seqs = [e.submit(p) for p in payloads(16, seed=1)]
+        e.run_for(30 * e.cfg.heartbeat_period)
+        assert all(e.is_durable(s) for s in seqs)
+        assert e.fused_launches > 0
+        watch.assert_flat()
+
+    def test_chaos_crash_restore_census_flat(self):
+        """ACCEPTANCE: a torture run with crash-restore cycles returns
+        to its warmup-phase census baseline (verdict taken at quiesce,
+        while the final engine generation is live)."""
+        from raft_tpu.chaos.runner import torture_run
+
+        rep = torture_run(18, phases=6, observe_compile=True)
+        assert rep.check.verdict == "LINEARIZABLE"
+        assert rep.crashes >= 1
+        assert rep.obs.memory.final_drift == []
+        assert rep.obs.memory.baseline is not None
+
+    def test_migrate_group_census_flat(self):
+        """ACCEPTANCE: one ``migrate_group`` move (atomic device slot
+        swap across shards) neither leaks nor drops buffers — the
+        census is flat across the move."""
+        from jax.sharding import Mesh
+
+        from raft_tpu.core.state import GROUP_AXIS, REPLICA_AXIS
+        from raft_tpu.multi.engine import MultiEngine
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 (virtual) devices")
+        mesh = Mesh(
+            np.array(jax.devices()[:2]).reshape(2, 1),
+            (GROUP_AXIS, REPLICA_AXIS),
+        )
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=4,
+            log_capacity=64, transport="mesh_groups", seed=5,
+        )
+        me = MultiEngine(cfg, 4, mesh=mesh)
+        me.seed_leaders()
+        watch = MemoryWatch()
+        watch.watch_engine(me, name="multi")
+        for g in range(4):
+            for p in payloads(4, seed=g):
+                me.submit(g, p)
+        me.run_for(20 * cfg.heartbeat_period)
+        watch.set_baseline()
+        g = 0
+        dst = 1 - me.shard_of(g)
+        summary = me.migrate_group(g, dst)
+        assert summary is not None
+        me.run_for(10 * cfg.heartbeat_period)
+        watch.assert_flat()
+
+    @pytest.mark.slow
+    def test_eight_seed_flatness_sweep(self):
+        """8-seed sweep: every run linearizable, census flat, sentinel
+        clean — and the sweep as a whole exercised crash-restore."""
+        from raft_tpu.chaos.runner import torture_run
+
+        crashes = 0
+        for seed in range(15, 23):
+            rep = torture_run(seed, phases=6, observe_compile=True)
+            assert rep.check.verdict == "LINEARIZABLE", seed
+            assert rep.obs.memory.final_drift == [], seed
+            assert rep.obs.compile.sentinel.violations == [], seed
+            crashes += rep.crashes
+        assert crashes >= 3
+
+
+# ---------------------------------------------------------- 3. donation audit
+class TestDonationAudit:
+    def test_fused_state_donation_proven_in_place(self):
+        """ACCEPTANCE: the fused hot path's donated state pytree is
+        consumed by the launch (donation ENGAGED — leaves provably
+        deleted, the backend did not copy-and-ignore), and the census
+        stays flat over a run of donated launches — the two halves of
+        "donated state buffers are not silently copied". (Full
+        consumption is not asserted leaf-for-leaf: an output CSE can
+        orphan one donated input — see DonationReport.)"""
+        e = mk_engine(fuse_k=8, seed=9)
+        e.run_until_leader()
+        for p in payloads(8, seed=1):
+            e.submit(p)
+        e.run_for(20 * e.cfg.heartbeat_period)
+        d = e._fused_driver
+        d.staging._alloc()
+        r = e.leader_id
+        state_in = e.state
+        watch = MemoryWatch()
+        watch.watch_engine(e)
+
+        def call(state, staging):
+            out = e.t.replicate_fused(
+                state, staging, 0, jnp.zeros(4, jnp.int32), 2, False,
+                r, int(e.lead_terms[r]), jnp.asarray(e.alive),
+                jnp.asarray(e.slow),
+            )
+            e.state = out[0]         # keep the engine coherent
+            return out
+
+        report = audit_donation(
+            call, (state_in, d.staging.buf), donated=(0,), watch=watch,
+        )
+        assert report.n_donated_leaves > 0
+        assert report.engaged, report.detail
+        assert report.n_deleted >= report.n_donated_leaves - 1
+        assert watch.snapshot()["donation"]["engaged"] is True
+        # no copy accumulates across donated launches: census flat
+        # over a sustained fused drive
+        watch.set_baseline()
+        launches0 = e.fused_launches
+        for p in payloads(24, seed=2):
+            e.submit(p)
+        e.run_for(40 * e.cfg.heartbeat_period)
+        assert e.fused_launches > launches0
+        watch.assert_flat()
+
+    def test_undonated_program_audits_not_honored(self):
+        """FALSIFIABILITY: an undonated jit keeps its inputs alive —
+        the audit must say so instead of passing vacuously."""
+        f = jax.jit(lambda x: x + 1)
+        x = jnp.ones(16)
+        report = audit_donation(f, (x,), donated=(0,))
+        assert not report.honored
+        assert report.n_deleted == 0

@@ -142,6 +142,47 @@ def test_explain_without_observability_still_works(tmp_path):
     assert "key" in text
 
 
+def test_explain_flags_retrace_and_census_growth(tmp_path):
+    """ISSUE 11: bundles carry the compile log + memory census, and
+    --explain flags a retrace (hot-path violation) and census growth in
+    its timeline alongside the faults."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.obs.compile import labeled
+    from raft_tpu.obs.forensics import ObsStack, write_bundle
+
+    obs = ObsStack.build(compile_plane=True)
+    try:
+        probe = labeled("single.fused", jax.jit(lambda x: x - 2))
+        probe(jnp.ones(5))
+        obs.compile.sentinel.freeze()
+        probe(jnp.ones(6))                       # post-freeze retrace
+        assert obs.compile.sentinel.violations
+        obs.memory.set_baseline()
+        leak = jnp.zeros((99, 3), jnp.float32)   # census growth
+        obs.memory.final_drift = obs.memory.drift()
+        assert obs.memory.final_drift
+        path = write_bundle(
+            str(tmp_path), kind="torture", seed=1,
+            expected=LINEARIZABLE, verdict=VIOLATION, obs=obs,
+        )
+        del leak
+    finally:
+        obs.close()
+    bundle = load_bundle(path)
+    assert bundle["compile_log"]["sentinel"]["violations"]
+    assert bundle["memory"]["census"]["n_arrays"] > 0
+    text = explain(bundle)
+    assert "RETRACE: post-freeze" in text
+    assert "single.fused" in text
+    assert "CENSUS GREW" in text
+
+    out = tmp_path / "explain.txt"
+    assert obs_main(["--explain", path, "-o", str(out)]) == 0
+    assert "RETRACE" in out.read_text()
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", range(8))
 def test_observed_torture_sweep_matches_plain(seed):

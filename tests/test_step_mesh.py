@@ -229,6 +229,13 @@ def test_engine_on_mesh_routes_fused():
 
     cfg = RaftConfig(n_replicas=3, entry_bytes=8, batch_size=B,
                      log_capacity=512, transport="tpu_mesh", seed=11)
+    # LAST_DISPATCH is a TRACE-time witness; the round-11 process-wide
+    # mesh program cache means a warm test session would reuse an
+    # already-traced program and never set it — clear the cache so this
+    # pin re-traces what it asserts about
+    from raft_tpu.transport import tpu_mesh as tpu_mesh_mod
+
+    tpu_mesh_mod._PROGRAMS.clear()
     t = TpuMeshTransport(cfg, jax.devices()[:3])
     e = RaftEngine(cfg, t)
     e.run_until_leader()

@@ -25,7 +25,12 @@ Gating policy: only well-known metric keys gate (direction matters —
 keys present on one side only are reported as ``added``/``removed`` but
 never gate, and rows skipped by the deadline (``{"skipped":
 "deadline"}``) are reported as ``skipped`` — "not measured" must stay
-distinguishable from "measured and regressed".
+distinguishable from "measured and regressed". The round-11
+compile-&-memory columns gate down (``compile_count``,
+``mem_high_water_bytes``), and a leg whose compile count went 0 -> >0
+is ALWAYS a gated regression with its own ``recompiling`` status plus
+a summary line naming the legs — the "newly started recompiling"
+report the XLA plane exists for (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -57,6 +62,12 @@ GATED_METRICS: Dict[str, str] = {
     "mesh_us_per_group_tick": "down",
     "mesh_entries_per_sec": "up",
     "virtual_commit_p50_s": "down",
+    # compile-&-memory plane columns (round 11): XLA compiles and the
+    # live-buffer high water must never grow past threshold — a leg
+    # that newly starts recompiling (old 0 -> new > 0) is always a
+    # regression, reported with its own "recompiling" status
+    "compile_count": "down",
+    "mem_high_water_bytes": "down",
 }
 
 
@@ -184,9 +195,13 @@ def compare_runs(
                 change = -change
             status = ("regressed" if change > threshold
                       else "improved" if change < -threshold else "ok")
+            if metric == "compile_count" and ov == 0 and nv > 0:
+                # a steady leg that NEWLY started recompiling: always a
+                # gated regression, named so the table says what broke
+                status = "recompiling"
             deltas.append(Delta(leg, metric, ov, nv, change, status, True))
     regressions = [d for d in deltas
-                   if d.gated and d.status == "regressed"]
+                   if d.gated and d.status in ("regressed", "recompiling")]
     return deltas, regressions
 
 
@@ -205,12 +220,20 @@ def format_table(deltas: List[Delta], threshold: float) -> str:
     lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
              for r in rows]
     lines.insert(1, "-" * len(lines[0]))
-    n_reg = sum(1 for d in deltas if d.status == "regressed")
+    n_reg = sum(1 for d in deltas
+                if d.status in ("regressed", "recompiling"))
+    recompiling = sorted({d.leg for d in deltas
+                          if d.status == "recompiling"})
     lines.append(
         f"{n_reg} regression(s) past the {threshold * 100:g}% threshold"
         if n_reg else
         f"no regressions past the {threshold * 100:g}% threshold"
     )
+    if recompiling:
+        lines.append(
+            "legs newly recompiling (compile_count 0 -> >0): "
+            + ", ".join(recompiling)
+        )
     return "\n".join(lines)
 
 
