@@ -1020,11 +1020,20 @@ def bench_overload() -> dict:
 def bench_reconfig() -> dict:
     """Membership-change costs on the VIRTUAL clock (docs/MEMBERSHIP.md):
 
-    - ``wipe_logN`` rows: time-to-promote a WIPED voter back through the
-      full replace ladder (remove -> learner re-admission -> repair /
-      snapshot-install catch-up -> promote) as a function of committed
-      log size — the catch-up half should scale with the log, the
-      config commits should not;
+    - ``wipe_logN`` rows ({64, 256, 1024, 4096} committed entries, the
+      tiered-store ladder): time-to-promote a WIPED voter back through
+      the full replace ladder (remove -> learner re-admission ->
+      chunked snapshot-stream catch-up -> promote), with the archive
+      TIERED (hot tail half the ring; history sealed to RS-coded disk
+      segments) and open-loop foreground writes flowing THROUGHOUT the
+      rejoin. Columns: rejoin time (virtual + wall), seal/spill
+      throughput, catch-up chunk count, and the foreground goodput
+      ratio during catch-up vs a pre-wipe baseline window. The tiered
+      claim under test: rejoin cost is bounded by ring capacity /
+      chunk rate — FLAT in history length (``wipe_ladder.flat_ratio``
+      = rejoin(4096) / rejoin(256), gated <= 1.5 by the acceptance
+      pin) — and catch-up coexists with foreground commits
+      (``catchup_goodput_ratio`` gates >= 0.9 via bench_diff).
     - ``latency_dip`` row: p50/p99 commit latency of steady traffic in a
       baseline window vs DURING a learner-first grow and DURING a
       shrink — the learner phase's whole claim is that the dip is a
@@ -1033,17 +1042,25 @@ def bench_reconfig() -> dict:
     Like the overload leg this measures membership POLICY (virtual
     seconds, deterministic, backend-independent), not device speed; rows
     emit incrementally (``_emit_leg``)."""
+    import tempfile
+
     from raft_tpu.raft import RaftEngine
     from raft_tpu.transport import SingleDeviceTransport
 
     rows = {}
     payload = None
 
-    # -- wipe-replace catch-up vs log size ------------------------------
-    for log_len in (64, 256, 1024):
+    # -- wipe-replace catch-up vs log size (tiered ladder) --------------
+    rejoin_by_len = {}
+    for log_len in (64, 256, 1024, 4096):
         cfg = RaftConfig(
             n_replicas=3, max_replicas=4, entry_bytes=64, batch_size=16,
             log_capacity=256, transport="single", seed=21,
+            tiered_log_dir=tempfile.mkdtemp(prefix="bench_tier_"),
+            tiered_hot_entries=128,   # < capacity: the catch-up stream's
+            #   base reads SEALED segments, so the flat claim covers the
+            #   cold tier, not just RAM
+            segment_entries=64,
         )
         e = RaftEngine(cfg, SingleDeviceTransport(cfg))
         e.run_until_leader()
@@ -1051,12 +1068,33 @@ def bench_reconfig() -> dict:
         s_add = e.add_voter(3)        # row 3 joins (empty) as a voter...
         e.run_until_committed(s_add, limit=4000.0)
         seqs = e.submit_pipelined([payload] * log_len)
-        e.run_until_committed(seqs[-1], limit=20000.0)
+        e.run_until_committed(seqs[-1], limit=80000.0)
+
+        def pump(seconds: float, rate_eps: float) -> float:
+            """Open-loop foreground writes at ``rate_eps`` for
+            ``seconds`` virtual seconds; returns goodput (committed
+            entries per virtual second over the window)."""
+            t0, n0 = e.clock.now, e.committed_total
+            acc = 0.0
+            while e.clock.now < t0 + seconds:
+                acc += rate_eps * cfg.heartbeat_period
+                while acc >= 1.0:
+                    e.submit(payload)
+                    acc -= 1.0
+                e.run_for(cfg.heartbeat_period)
+            dt = e.clock.now - t0
+            return (e.committed_total - n0) / dt if dt > 0 else 0.0
+
+        # foreground at half the ingest capacity (batch per tick)
+        rate = 0.5 * cfg.batch_size / cfg.heartbeat_period
+        goodput_base = pump(120.0, rate)
         e.fail(3)                     # ...then loses its disk entirely
         e.wipe(3)
         t0v, t0w = e.clock.now, time.monotonic()
+        chunks0 = e._shipper.chunks_total
         e.replace(3, 3)
         removed = False
+        n0 = e.committed_total
         while e.clock.now < t0v + 20000.0:
             if not e.member[3]:
                 removed = True        # the removal half committed
@@ -1064,13 +1102,50 @@ def bench_reconfig() -> dict:
                     e.recover(3)      # rejoin under the fresh identity
             if removed and e.member[3]:
                 break                 # ...and the promotion landed
-            e.run_for(4 * cfg.heartbeat_period)
+            pump(4 * cfg.heartbeat_period, rate)
+        rejoin_s = e.clock.now - t0v
+        goodput_catchup = (e.committed_total - n0) / max(rejoin_s, 1e-9)
+        tier = e.store.tier_summary()
+        seal_eps = (
+            tier["entries_sealed"] / tier["seal_wall_s"]
+            if tier["seal_wall_s"] > 0 else None
+        )
+        rejoin_by_len[log_len] = rejoin_s
         rows[f"wipe_log{log_len}"] = _emit_leg(f"reconfig_log{log_len}", {
             "log_entries": log_len,
             "rejoined": bool(removed and e.member[3]),
-            "replace_virtual_s": round(e.clock.now - t0v, 1),
-            "replace_wall_s": round(time.monotonic() - t0w, 2),
+            "rejoin_virtual_s": round(rejoin_s, 1),
+            "rejoin_wall_ms": round(
+                1e3 * (time.monotonic() - t0w), 1
+            ),
             "via_snapshot": log_len > cfg.log_capacity,
+            "catchup_chunks": e._shipper.chunks_total - chunks0,
+            "segments_sealed": tier["segments_sealed"],
+            "entries_sealed": tier["entries_sealed"],
+            "seal_entries_per_sec": (
+                round(seal_eps, 1) if seal_eps is not None else None
+            ),
+            "segment_reconstructs": tier["segment_reconstructs"],
+            "tier_host_bytes": tier["host_bytes"],
+            "goodput_baseline_eps": round(goodput_base, 2),
+            "goodput_catchup_eps": round(goodput_catchup, 2),
+            "catchup_goodput_ratio": round(
+                goodput_catchup / goodput_base, 3
+            ) if goodput_base > 0 else None,
+        })
+    if 256 in rejoin_by_len and 4096 in rejoin_by_len \
+            and rejoin_by_len[256] > 0:
+        rows["wipe_ladder"] = _emit_leg("reconfig_wipe_ladder", {
+            "flat_ratio": round(
+                rejoin_by_len[4096] / rejoin_by_len[256], 3
+            ),
+            "rejoin_s_by_log": {
+                str(k): round(v, 1) for k, v in rejoin_by_len.items()
+            },
+            "note": ("flat_ratio = rejoin(log 4096) / rejoin(log 256), "
+                     "virtual seconds; the tiered-store acceptance pins "
+                     "it <= 1.5 — rejoin cost bounded by ring capacity "
+                     "+ chunk rate, not history length"),
         })
 
     # -- commit-latency dip during grow / shrink ------------------------

@@ -27,7 +27,11 @@ Three independent shedding reasons, checked in order:
 Reads and writes are separate priority lanes: reads occupy no ring
 slots and confirm in batches for free under write load (``submit_read``),
 so the delay controller governs the WRITE lane only; reads refuse only
-at their own depth bound. Every refusal raises ``Overloaded`` with a
+at their own depth bound. A third, background lane —
+``catchup_chunks`` — budgets snapshot-shipping chunks for lapped
+replicas' rejoin streams (``ckpt.ship``): throttled to a trickle while
+the write lane is congested, never refused (deferral, not shedding —
+starving catch-up would be a liveness bug). Every refusal raises ``Overloaded`` with a
 ``retry_after_s`` hint before any state changed — provably no effect,
 which is what lets the torture checker treat shed ops as clean
 failures.
@@ -113,6 +117,10 @@ class AdmissionGate:
         self._first_above: Optional[float] = None
         self.shedding = False
         self.admitted: Dict[str, int] = {"write": 0, "read": 0}
+        self.catchup_throttled = 0
+        #   ticks the catch-up lane was cut to 1 chunk (congestion —
+        #   see catchup_chunks); deferral, not refusal, so it is not a
+        #   ``shed`` reason
         self.shed: Dict[str, int] = {}
         self.depth_high_water = 0
         self.delay_samples: List[float] = []
@@ -238,6 +246,29 @@ class AdmissionGate:
             self.shedding = True
             return "shed_start"
         return None
+
+    # ---------------------------------------------------- catch-up lane
+    def catchup_chunks(self, depth: int, max_chunks: int) -> int:
+        """Chunk budget for this tick's snapshot-shipping lane
+        (``ckpt.ship``): the BACKGROUND lane. Catch-up traffic is never
+        refused outright (a lapped replica must eventually rejoin — a
+        starved stream is a liveness bug), but while the write lane is
+        congested (delay-shedding, or depth at half its bound — the
+        same threshold the fairness check uses) it is throttled to one
+        chunk per tick so foreground commits keep >= 90% of their
+        goodput while a follower streams back in (the wipe_logN bench
+        ladder's coexistence column). An ungated write lane
+        (``max_writes=None``) never throttles."""
+        congested = self.max_writes is not None and (
+            self.shedding or depth >= max(1, self.max_writes // 2)
+        )
+        granted = 1 if congested else max_chunks
+        self.admitted["catchup"] = (
+            self.admitted.get("catchup", 0) + granted
+        )
+        if congested:
+            self.catchup_throttled += 1
+        return granted
 
     # -------------------------------------------------------- read lane
     def admit_read(self, outstanding: int) -> None:

@@ -16,6 +16,7 @@ from raft_tpu.chaos.runner import (
     migration_run,
     overload_run,
     reconfig_run,
+    segment_storage_run,
     torture_run,
     torture_run_multi,
 )
@@ -67,6 +68,15 @@ def main(argv=None) -> int:
                          "commit progress resumes on every moved group "
                          "within the documented window; needs a multi-"
                          "device backend (virtual CPU devices work)")
+    ap.add_argument("--segments", action="store_true",
+                    help="run the deterministic sealed-segment storage "
+                         "nemesis drill (tiered log: torn spill, bit "
+                         "flip, dropped shard against RS-coded cold-"
+                         "tier segments) instead of a torture run; "
+                         "succeeds only if the history checks "
+                         "linearizable, the lapped follower rejoins, "
+                         "AND recovery rode the RS reconstruct path "
+                         "(no segment lost)")
     ap.add_argument("--overload-recovery", type=float, default=None,
                     metavar="MULT",
                     help="run the deterministic overload-and-recover "
@@ -143,8 +153,35 @@ def main(argv=None) -> int:
                            or args.reconfig
                            or args.overload_recovery is not None):
         ap.error("--migration is a standalone sharded-multi drill")
+    if args.segments and (args.multi or args.broken or args.overload
+                          or args.reconfig or args.migration
+                          or args.overload_recovery is not None):
+        ap.error("--segments is a standalone single-engine drill")
 
     ok = True
+    if args.segments:
+        for seed in range(args.seed, args.seed + args.sweep):
+            rep = segment_storage_run(
+                seed, step_budget=args.step_budget,
+                observe=args.observe, bundle_dir=args.bundle_dir,
+                blackbox_dir=args.blackbox_dir,
+            )
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "rejoined": rep.rejoined,
+                "recovered_via_rs": rep.recovered_via_rs,
+                "faults": rep.faults,
+                "tier": rep.tier,
+                "chunks_shipped": rep.chunks_shipped,
+                "ops": rep.ops,
+            }), flush=True)
+            ok = ok and (
+                rep.verdict == "LINEARIZABLE" and rep.rejoined
+                and rep.recovered_via_rs
+            )
+        return 0 if ok else 1
     if args.migration:
         for seed in range(args.seed, args.seed + args.sweep):
             rep = migration_run(

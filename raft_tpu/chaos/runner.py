@@ -70,7 +70,7 @@ from raft_tpu.chaos.checker import (
 )
 from raft_tpu.chaos.history import DELETE, READ, WRITE, History, OpRecord
 from raft_tpu.chaos.nemesis import MembershipView, Nemesis, NemesisAction
-from raft_tpu.chaos.storage import MirroredStore
+from raft_tpu.chaos.storage import MirroredStore, SegmentNemesis
 from raft_tpu.chaos.transport import ChaosTransport
 from raft_tpu.config import RaftConfig
 from raft_tpu.obs import blackbox
@@ -1866,6 +1866,184 @@ def _reconfig_run_impl(
         availability_window_s=availability_window_s,
         availability_ok=availability_ok,
         repro=repro, bundle_path=bundle_path, obs=run.obs,
+    )
+
+
+# --------------------------------------------- segment-nemesis drill
+@dataclasses.dataclass
+class SegmentReport:
+    """Result of :func:`segment_storage_run` — the tiered-store
+    acceptance drill: sealed segments are corrupted (torn spill, bit
+    flip, dropped shard — within the keep-k rule) while a ring-lapped
+    follower's only rejoin material lives in them. The claim under
+    test: recovery rides the RS reconstruct path (``reconstructs`` > 0,
+    never a silent garbage load), the chunked stream completes the
+    rejoin, and the client history stays LINEARIZABLE throughout."""
+
+    seed: int
+    check: CheckResult
+    ops: int
+    op_counts: Dict[str, int]
+    faults: List[str]            # injected segment faults, as applied
+    tier: Dict[str, int]         # final TieredStore stats
+    chunks_shipped: int          # incremental-install chunks to rejoin
+    rejoined: bool               # the lapped follower caught back up
+    repro: str
+    bundle_path: Optional[str] = None
+    obs: Optional[ObsStack] = None
+
+    @property
+    def verdict(self) -> str:
+        return self.check.verdict
+
+    @property
+    def recovered_via_rs(self) -> bool:
+        return self.tier.get("segment_reconstructs", 0) > 0 \
+            and self.tier.get("segments_lost", 0) == 0
+
+    def summary(self) -> str:
+        line = (
+            f"seed {self.seed}: {self.verdict} over {self.ops} ops, "
+            f"faults [{', '.join(self.faults)}], "
+            f"{self.tier.get('segment_reconstructs', 0)} RS "
+            f"reconstructs, {self.chunks_shipped} chunks, "
+            f"rejoined={self.rejoined}"
+        )
+        if self.verdict != LINEARIZABLE or not self.rejoined:
+            line += f"\n  REPRO: {self.repro}"
+        return line
+
+
+def segment_storage_run(
+    seed: int, *args, blackbox_dir: Optional[str] = None, **kwargs,
+) -> SegmentReport:
+    """Journaled front door for :func:`_segment_storage_run_impl`
+    (see its docstring for the drill script)."""
+    with blackbox.journal_for(f"segments_seed{seed}", blackbox_dir):
+        blackbox.mark("segment_storage_run", seed=seed)
+        return _segment_storage_run_impl(seed, *args, **kwargs)
+
+
+def _segment_storage_run_impl(
+    seed: int,
+    catchup_limit_s: float = 600.0,
+    step_budget: int = 500_000,
+    observe: bool = False,
+    bundle_dir: Optional[str] = None,
+) -> SegmentReport:
+    """The sealed-segment storage nemesis, scripted (no random schedule
+    — the fault set is the point, like ``reconfig_run``):
+
+    1. Client traffic builds KV state; a follower dies.
+    2. Filler commits lap the ring AND spill past the (deliberately
+       small, ``log_capacity // 2``) hot tail, so part of the dead
+       follower's future catch-up range exists ONLY as sealed RS-coded
+       segments on disk.
+    3. The nemesis corrupts sealed shards — one torn spill, one bit
+       flip, one dropped shard, seeded placement under the keep-k rule.
+    4. The follower recovers: its rejoin streams chunks whose bytes
+       must come back through CRC rejection + RS reconstruct (a store
+       that loaded a corrupted shard would install garbage the KV
+       differential and the checker would catch).
+    5. Quiesce, close the history, check linearizability; client
+       traffic keeps flowing through every phase.
+    """
+    cfg = dataclasses.replace(
+        _default_cfg(seed),
+        tiered_log_dir=tempfile.mkdtemp(prefix="raft_segdrill_"),
+        tiered_hot_entries=_default_cfg(seed).log_capacity // 2,
+        segment_entries=_default_cfg(seed).log_capacity // 4,
+    )
+    run = _SingleTorture(
+        seed, 0, 2, 3, 30.0, cfg, None, None, observe=observe,
+    )
+    e = run.engine
+    store = e.store
+    slice_s = 2 * run.cfg.heartbeat_period
+    faults: List[str] = []
+
+    def drive(seconds: float) -> None:
+        t_end = run.now() + seconds
+        while run.now() < t_end:
+            run._invoke_idle()
+            run.drive(slice_s)
+            run._poll_all()
+
+    drive(30.0)                                     # baseline KV traffic
+    victim = next(
+        r for r in range(cfg.n_replicas) if r != e.leader_id
+    )
+    e.fail(victim)
+    blackbox.mark("segment_victim_down", victim=victim)
+    # lap the ring and spill sealed segments into the catch-up range:
+    # zero payloads decode as KV no-ops, so the checker's world is
+    # untouched while the log (and the cold tier) grows
+    filler = bytes(cfg.entry_bytes)
+    target = 3 * cfg.log_capacity
+    while e.commit_watermark < target:
+        for _ in range(2 * cfg.batch_size):
+            e.submit(filler)
+        drive(2 * slice_s)
+    assert store.stats["segments_sealed"] > 0, \
+        "drill misconfigured: nothing sealed"
+    nem = SegmentNemesis(store)
+    srng = random.Random(f"segments:{seed}")
+    # The rejoin stream installs from the ring-fitting tail base
+    # (wm - capacity + 1) and hands off to the ring-served repair
+    # window at the horizon — so the segment reads happen on the FIRST
+    # chunks. Put the corruption exactly there (the hot tail is
+    # deliberately smaller than the ring, so that base is sealed); one
+    # more fault lands anywhere for kind coverage — the crash-restore
+    # leg below sweeps the whole checkpoint span through the store
+    # regardless.
+    path_lo = e.commit_watermark - cfg.log_capacity + 1
+    path = (path_lo, path_lo + 2 * cfg.batch_size)
+    # data_only on the on-path faults: a parity-shard fault recovers
+    # through the systematic stitch (no decode), and this drill's pass
+    # condition is precisely that the RS decode engaged
+    for kind, rng_range in (("flip_bit", path), ("drop_shard", path),
+                            ("torn_spill", None)):
+        desc = nem.inject(
+            srng, kind, within=rng_range,
+            data_only=rng_range is not None,
+        )
+        if desc is not None:
+            faults.append(desc)
+            blackbox.mark("segment_fault", fault=desc)
+    wm_down = e.commit_watermark
+    chunks0 = e._shipper.chunks_total
+    e.recover(victim)
+    end = run.now() + catchup_limit_s
+    while run.now() < end:
+        drive(slice_s)
+        if int(e._fetch(e.state.match_index)[victim]) >= wm_down:
+            break
+    rejoined = int(e._fetch(e.state.match_index)[victim]) >= wm_down
+    chunks = e._shipper.chunks_total - chunks0
+    # Crash-restore leg: checkpoint assembly reads the WHOLE checkpoint
+    # span (2x ring capacity) through the store — most of it sealed
+    # here — so every faulted segment on disk must come back through
+    # CRC rejection + RS reconstruct or the restored cluster would
+    # restart from garbage (the post-restore reads and the checker
+    # would catch it).
+    run._crash_restart("none")
+    e = run.engine
+    drive(30.0)
+    tier = dict(store.stats)
+    run.quiesce()
+    run.history.close()
+    check = check_history(run.history, step_budget=step_budget)
+    repro = f"python -m raft_tpu.chaos --segments --seed {seed}"
+    bundle_path = _maybe_bundle(
+        "segments", run, check, LINEARIZABLE, repro, faults, bundle_dir,
+        extra={"faults": faults, "tier": tier, "rejoined": rejoined},
+        force_unexpected=not rejoined,
+    )
+    return SegmentReport(
+        seed=seed, check=check, ops=len(run.history),
+        op_counts=run.history.counts(), faults=faults, tier=tier,
+        chunks_shipped=chunks, rejoined=rejoined, repro=repro,
+        bundle_path=bundle_path, obs=run.obs,
     )
 
 

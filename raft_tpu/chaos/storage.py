@@ -45,7 +45,7 @@ from __future__ import annotations
 import os
 import random
 import zlib
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from raft_tpu.ckpt import EngineCheckpoint
 
@@ -245,3 +245,112 @@ class MirroredStore:
                     f.write(_REC.pack(int(q), int(t), int(v)))
                 f.flush()
                 os.fsync(f.fileno())
+
+
+class SegmentNemesis:
+    """Fault injectors against a ``ckpt.tiered.TieredStore``'s sealed
+    shard files — the cold tier's analogue of the mirror faults above.
+
+    Fault vocabulary (each names a distinct real-world storage failure):
+
+    - ``torn_spill``  — truncate one shard file mid-bytes, sidecar left
+      stale (a crash mid-spill that `os.replace`'d anyway, or a
+      filesystem that lost the tail): the CRC must reject the shard.
+    - ``flip_bit``    — flip one random payload bit in one shard file
+      (bit rot): the CRC must reject; the segment reconstructs from the
+      surviving shards through the RS decode.
+    - ``drop_shard``  — delete one shard file + sidecar outright (a
+      lost object / dead disk sector).
+
+    Keep-k rule (the storage analogue of keep-a-majority-alive): the
+    nemesis never reduces a segment below k healthy shards — below
+    that the data is genuinely unrecoverable and the store's documented
+    behavior is an archive gap, not recovery. Fault bookkeeping is per
+    segment row, so composed faults across rounds stay within budget.
+
+    Every injection clears the store's decoded-segment cache: the next
+    read must hit the disk files, or a warm cache would vacuously pass
+    the recovery assertion.
+    """
+
+    KINDS = ("torn_spill", "flip_bit", "drop_shard")
+
+    def __init__(self, store):
+        self.store = store
+        self._faulted: Dict[Tuple[int, int], Set[int]] = {}
+
+    def _pick(self, rng: random.Random,
+              within: Optional[Tuple[int, int]] = None,
+              data_only: bool = False,
+              ) -> Optional[Tuple[int, int, int]]:
+        """(lo, hi, shard row) of a faultable shard, or None when no
+        segment has fault budget left. ``within`` restricts candidates
+        to segments overlapping that index range — the drill uses it to
+        place faults squarely on a rejoining follower's catch-up path
+        (a fault on a segment nothing reads proves nothing).
+        ``data_only`` restricts the row choice to systematic DATA
+        shards (rows 0..k-1): a parity-only fault recovers through the
+        systematic stitch with no decode, so a drill asserting the RS
+        reconstruct path engaged must corrupt data rows."""
+        code = self.store.io.code
+        segs = [
+            (lo, hi) for (lo, hi) in self.store._sealed
+            if len(self._faulted.get((lo, hi), ())) < code.m
+            and (within is None
+                 or (lo <= within[1] and hi >= within[0]))
+            and (not data_only or any(
+                r not in self._faulted.get((lo, hi), set())
+                for r in range(code.k)
+            ))
+        ]
+        if not segs:
+            return None
+        lo, hi = segs[rng.randrange(len(segs))]
+        rows = [
+            r for r in range(code.k if data_only else code.n)
+            if r not in self._faulted.get((lo, hi), set())
+        ]
+        return lo, hi, rows[rng.randrange(len(rows))]
+
+    def inject(self, rng: random.Random,
+               kind: Optional[str] = None,
+               within: Optional[Tuple[int, int]] = None,
+               data_only: bool = False) -> Optional[str]:
+        """Apply one fault (seeded choice when ``kind`` is None);
+        returns a human-readable description, or None when no sealed
+        segment (overlapping ``within``, if given) can absorb a fault
+        under the keep-k rule."""
+        got = self._pick(rng, within, data_only)
+        if got is None:
+            return None
+        lo, hi, row = got
+        kind = kind or rng.choice(self.KINDS)
+        io = self.store.io
+        name = io.name(lo, hi)
+        p = io.shard_path(name, row)
+        if kind == "torn_spill":
+            with open(p, "rb") as f:
+                blob = f.read()
+            keep = rng.randrange(max(1, len(blob) // 2), len(blob))
+            with open(p, "wb") as f:
+                f.write(blob[:keep])
+        elif kind == "flip_bit":
+            with open(p, "rb") as f:
+                blob = bytearray(f.read())
+            pos = rng.randrange(len(blob))
+            blob[pos] ^= 1 << rng.randrange(8)
+            with open(p, "wb") as f:
+                f.write(bytes(blob))
+        elif kind == "drop_shard":
+            for path in (p, io._crc_path(p)):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        else:
+            raise ValueError(f"unknown segment fault {kind!r}")
+        self._faulted.setdefault((lo, hi), set()).add(row)
+        # force the next read through the faulted files
+        self.store._cache.clear()
+        self.store._cache_order.clear()
+        return f"{kind}(seg=[{lo},{hi}], shard={row})"

@@ -1,3 +1,4 @@
+from raft_tpu.ckpt.ship import SnapshotShipper
 from raft_tpu.ckpt.snapshot import (
     CheckpointStore,
     EngineCheckpoint,
@@ -5,12 +6,17 @@ from raft_tpu.ckpt.snapshot import (
     install_snapshot,
     install_snapshot_all,
 )
+from raft_tpu.ckpt.tiered import SegmentCorrupt, SegmentIO, TieredStore
 from raft_tpu.ckpt.votelog import VoteLog, merge_restored
 
 __all__ = [
     "CheckpointStore",
     "EngineCheckpoint",
+    "SegmentCorrupt",
+    "SegmentIO",
     "Snapshot",
+    "SnapshotShipper",
+    "TieredStore",
     "VoteLog",
     "install_snapshot",
     "install_snapshot_all",

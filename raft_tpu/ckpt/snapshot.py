@@ -185,7 +185,9 @@ class CheckpointStore:
     committed log survives here even after the device ring laps it, so a
     long-dead replica can be re-seeded. (In a multi-host deployment each
     host would persist its own replica's feed; in this single-process
-    engine one store serves the cluster.)
+    engine one store serves the cluster.) Retention is ``max_entries``
+    in RAM; the ``ckpt.tiered.TieredStore`` subclass seals the same
+    horizon into RS-coded on-disk segments instead of evicting it.
     """
 
     def __init__(self, entry_bytes: int, max_entries: Optional[int] = None):
@@ -245,8 +247,16 @@ class CheckpointStore:
         self._drop_dead_spans()
 
     def _drop_dead_spans(self) -> None:
+        self._drop_spans_below(self._first)
+
+    def _drop_spans_below(self, floor: int) -> None:
+        """Drop span blocks that lie WHOLLY below ``floor`` (a block
+        straddling it stays — its compacted prefix is hidden by the
+        caller's floor guard). Shared by the retention sweep and the
+        tiered store's seal-time hot-tier eviction (``ckpt.tiered``,
+        whose floor is the sealed boundary, not the compaction floor)."""
         while self._span_los and \
-                self._spans[self._span_los[0]][0] < self._first:
+                self._spans[self._span_los[0]][0] < floor:
             del self._spans[self._span_los.pop(0)]
 
     def _span_entry(self, idx: int) -> Optional[Tuple[bytes, int]]:
@@ -281,6 +291,16 @@ class CheckpointStore:
         never archived (a hole), not compacted."""
         return self._first
 
+    @property
+    def checkpoint_floor(self) -> int:
+        """First index ``save_checkpoint`` should consider including.
+        For the plain in-RAM store this is just the compaction floor; the
+        tiered store overrides it so checkpoints stay O(ring capacity)
+        even though its coverage reaches arbitrarily deep into sealed
+        segments (deep history restores from the segment tier's own
+        files, not from a checkpoint that would grow with history)."""
+        return self._first
+
     def set_floor(self, first: int) -> None:
         """Raise the compaction floor explicitly (never lowers). The
         restore path uses this to record that history below a restored
@@ -300,13 +320,17 @@ class CheckpointStore:
             self.get(i) is not None for i in range(lo, hi + 1)
         )
 
-    def covered_lo(self, hi: int) -> int:
-        """Smallest ``lo`` such that [lo, hi] is contiguously archived
-        (``hi + 1`` when even ``hi`` itself is missing)."""
+    def covered_lo(self, hi: int, floor: int = 1) -> int:
+        """Smallest ``lo >= floor`` such that [lo, hi] is contiguously
+        archived (``hi + 1`` when even ``hi`` itself is missing).
+        ``floor`` bounds the walk: a caller that will clamp the result
+        anyway (``save_checkpoint`` at the checkpoint floor) must not
+        page the tiered store's ENTIRE sealed history through the
+        segment cache just to discard it."""
         if self.get(hi) is None:
             return hi + 1
         lo = hi
-        while lo - 1 >= 1 and self.get(lo - 1) is not None:
+        while lo - 1 >= floor and self.get(lo - 1) is not None:
             lo -= 1
         return lo
 

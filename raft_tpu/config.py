@@ -145,6 +145,47 @@ class RaftConfig:
     # still admitted. Only applies to submits that carry a client id.
     admission_fair_share: bool = True
 
+    # --- tiered log + incremental snapshot shipping (ckpt.tiered /
+    # ckpt.ship; ROADMAP item 6, docs/PERF.md "Tiered log") ---
+    # tiered_log_dir: root directory for sealed segments. None = the
+    #   legacy in-RAM CheckpointStore archive (bounded at 2x ring
+    #   capacity — history past that is EVICTED). Set = the archive
+    #   seals committed-and-applied history into RS-coded on-disk
+    #   segments with CRC sidecars: RAM stays bounded by the hot tail
+    #   while coverage (apply replay, snapshot backfill) reaches the
+    #   whole history. Env override ``RAFT_TPU_TIERED_DIR`` (read at
+    #   engine construction) so chaos/bench harnesses can flip the tier
+    #   without config edits; each engine seals under its own fresh
+    #   subdirectory (segments are an engine-lifetime cache of durable
+    #   state — a restore rebuilds its archive from the checkpoint).
+    tiered_log_dir: Optional[str] = None
+    # Entries per sealed segment (the seal/spill granularity). None =
+    # half the ring capacity.
+    segment_entries: Optional[int] = None
+    # Hot-tail entries kept in RAM before sealing. None = 2x ring
+    # capacity (the plain store's retention bound, so flipping the tier
+    # on changes WHERE history lives, not how much stays hot — the
+    # chaos byte-identity pin rides this default). Smaller values make
+    # rejoin catch-up stream from the cold tier — the segment-nemesis
+    # drill sets log_capacity // 2 so a corrupted segment sits squarely
+    # on the rejoin path.
+    tiered_hot_entries: Optional[int] = None
+    # The segment tier's RS(k+m, k) code — independent of the cluster's
+    # replication-side EC config: this code protects FILES on one
+    # host's disk (bit rot, torn spills, a lost shard), not replicas.
+    segment_rs_k: int = 4
+    segment_rs_m: int = 2
+    # Incremental snapshot shipping: a ring-lapped replica's catch-up
+    # is streamed in chunks of this many entries (None = batch_size),
+    # at most catchup_max_chunks_per_tick chunks per leader tick — and
+    # the admission gate's catch-up lane cuts that to 1 while the write
+    # lane is congested (docs/MEMBERSHIP.md wipe runbook), so rejoin
+    # traffic coexists with foreground commits instead of stalling
+    # them. Rejoin cost is thereby bounded by ring capacity / chunk
+    # rate — flat in history length (the wipe_logN bench ladder).
+    catchup_chunk_entries: Optional[int] = None
+    catchup_max_chunks_per_tick: int = 4
+
     # --- K-tick steady-state fusion (ROADMAP item 2) ---
     # Ticks per fused launch: when > 1, the engine fuses runs of
     # consecutive steady-state leader ticks — heartbeat emission,
@@ -251,6 +292,19 @@ class RaftConfig:
             )
         if self.mirror_exchange_timeout_s <= 0:
             raise ValueError("mirror_exchange_timeout_s must be > 0")
+        if self.segment_entries is not None and self.segment_entries < 1:
+            raise ValueError("segment_entries must be >= 1 (or None)")
+        if self.tiered_hot_entries is not None and self.tiered_hot_entries < 1:
+            raise ValueError("tiered_hot_entries must be >= 1 (or None)")
+        if self.segment_rs_k < 1 or self.segment_rs_m < 1:
+            # m >= 1: an unprotected cold tier would turn any single
+            # shard fault into silent history loss
+            raise ValueError("segment_rs_k and segment_rs_m must be >= 1")
+        if self.catchup_chunk_entries is not None \
+                and self.catchup_chunk_entries < 1:
+            raise ValueError("catchup_chunk_entries must be >= 1 (or None)")
+        if self.catchup_max_chunks_per_tick < 1:
+            raise ValueError("catchup_max_chunks_per_tick must be >= 1")
         if self.shard_bytes % 4:
             # device payload storage is packed as int32 lanes (core.state
             # layout); each replica's per-entry bytes must fill whole words

@@ -350,9 +350,46 @@ class MultiEngine:
         #   (``_evict_group_history``). At G=256+ the previous
         #   unbounded-by-design scope was a real memory leak.
         self._archive_floor = np.ones(n_groups, np.int64)
-        #   first archived index still retained, per group (1 = full
-        #   history). ``register_apply(replay=True)`` can only replay
-        #   from here and says so loudly.
+        #   first archived index still retained IN RAM, per group (1 =
+        #   full history). Without the tier, ``register_apply(
+        #   replay=True)`` can only replay from here and says so loudly;
+        #   with it, sealed segments keep the swept history readable.
+        tiered_root = (
+            os.environ.get("RAFT_TPU_TIERED_DIR", "")
+            or cfg.tiered_log_dir
+        )
+        if tiered_root:
+            # Per-group cold tier at G>=256 shapes: ONE shared
+            # SegmentIO (one directory, one RS code) with group-tagged
+            # segment names — per-group overhead is an empty list, not
+            # a directory or codec instance. The retention sweep seals
+            # instead of dropping (``_evict_group_history``), so the
+            # RAM bound stays exactly the group-shard round's while
+            # full-history replay keeps working at any depth.
+            import tempfile
+
+            from raft_tpu.ckpt import SegmentIO
+
+            os.makedirs(tiered_root, exist_ok=True)
+            self._tier_io: Optional[SegmentIO] = SegmentIO(
+                tempfile.mkdtemp(prefix="gtier_", dir=tiered_root),
+                k=cfg.segment_rs_k, m=cfg.segment_rs_m,
+            )
+        else:
+            self._tier_io = None
+        self._group_segments: List[List[Tuple[int, int]]] = [
+            [] for _ in range(n_groups)
+        ]
+        self._tier_cache: Dict[Tuple[int, int], np.ndarray] = {}
+        self._tier_cache_order: List[Tuple[int, int]] = []
+        self._tier_lost: set = set()
+        #   (g, lo) of segments that failed below k shards: report the
+        #   loss once instead of re-reading n files per index read
+        self.tier_stats: Dict[str, int] = {
+            "segments_sealed": 0, "entries_sealed": 0,
+            "segment_loads": 0, "segment_reconstructs": 0,
+            "segments_lost": 0,
+        }
         self.submit_time: List[Dict[int, float]] = [{} for _ in range(n_groups)]
         self.commit_time: List[Dict[int, float]] = [{} for _ in range(n_groups)]
         #   Per-group bounded stamp dicts, the single engine's eviction
@@ -965,6 +1002,14 @@ class MultiEngine:
                  "burn_rate": a.burn_rate}
                 for a in self.slo.active_alerts()
             ]
+        if self._tier_io is not None:
+            snap["tiered"] = {
+                "groups_with_segments": sum(
+                    1 for segs in self._group_segments if segs
+                ),
+                "cache_bytes": self._tier_host_bytes(),
+                **self.tier_stats,
+            }
         if self.auditor is not None:
             snap["audit"] = self.auditor.summary()
         return snap
@@ -1725,7 +1770,13 @@ class MultiEngine:
         """Archive retention sweep: keep the last ``2 * log_capacity``
         committed payloads of group ``g`` (the CheckpointStore horizon),
         never past the apply stream's cursor — a registered apply
-        callback must always find ``applied_index + 1`` archived."""
+        callback must always find ``applied_index + 1`` archived.
+
+        With the tiered archive configured (``cfg.tiered_log_dir`` /
+        ``RAFT_TPU_TIERED_DIR``) the swept range is SEALED — RS-coded
+        and spilled as one group-tagged segment — before the RAM copies
+        drop, so the same sweep that bounds memory at G=256+ now keeps
+        the full history readable (``_archive_get``)."""
         floor = int(self._archive_floor[g])
         keep_from = int(self.commit_watermark[g]) - self._commit_stamp_cap + 1
         if self._apply_fns[g]:
@@ -1733,9 +1784,72 @@ class MultiEngine:
         if keep_from <= floor:
             return
         arch = self._archive[g]
+        if self._tier_io is not None:
+            lo, hi = floor, keep_from - 1
+            if all(i in arch for i in range(lo, hi + 1)):
+                ents = np.frombuffer(
+                    b"".join(arch[i] for i in range(lo, hi + 1)), np.uint8
+                ).reshape(hi - lo + 1, self.cfg.entry_bytes)
+                self._tier_io.seal(
+                    lo, hi, ents, np.zeros(hi - lo + 1, np.int32),
+                    prefix=f"g{g}-",
+                )
+                self._group_segments[g].append((lo, hi))
+                self.tier_stats["segments_sealed"] += 1
+                self.tier_stats["entries_sealed"] += hi - lo + 1
+            # a hole (an index never archived) cannot seal as one
+            # contiguous segment: the range is dropped exactly as the
+            # untiered sweep would — bounded RAM wins over best-effort
+            # cold coverage, and replay refusals already say so
         for idx in range(floor, keep_from):
             arch.pop(idx, None)
         self._archive_floor[g] = keep_from
+
+    def _archive_get(self, g: int, idx: int) -> Optional[bytes]:
+        """Group ``g``'s committed payload at ``idx`` — RAM archive
+        first, sealed segments below the floor (CRC-checked shard
+        files; a corrupt data shard reconstructs through the RS
+        decode). None = never archived or swept without a tier."""
+        got = self._archive[g].get(idx)
+        if got is not None or self._tier_io is None:
+            return got
+        import bisect
+
+        segs = self._group_segments[g]
+        i = bisect.bisect_right(segs, (idx, 1 << 62)) - 1
+        if i < 0:
+            return None
+        lo, hi = segs[i]
+        if not (lo <= idx <= hi):
+            return None
+        key = (g, lo)
+        if key in self._tier_lost:
+            return None
+        ents = self._tier_cache.get(key)
+        if ents is None:
+            from raft_tpu.ckpt import SegmentCorrupt
+
+            try:
+                ents, _terms, reconstructed = self._tier_io.load(
+                    lo, hi, self.cfg.entry_bytes, prefix=f"g{g}-"
+                )
+            except SegmentCorrupt:
+                self.tier_stats["segments_lost"] += 1
+                self._tier_lost.add(key)
+                return None
+            self.tier_stats["segment_loads"] += 1
+            if reconstructed:
+                self.tier_stats["segment_reconstructs"] += 1
+            self._tier_cache[key] = ents
+            self._tier_cache_order.append(key)
+            while len(self._tier_cache_order) > 2:
+                self._tier_cache.pop(self._tier_cache_order.pop(0), None)
+        return ents[idx - lo].tobytes()
+
+    def _tier_host_bytes(self) -> int:
+        """RAM held by the decoded segment cache (the MemoryWatch
+        host-attribution root for the multi engine's cold tier)."""
+        return sum(e.nbytes for e in self._tier_cache.values())
 
     # ---------------------------------------------------- state machine
     def register_apply(
@@ -1751,17 +1865,27 @@ class MultiEngine:
         Returns the first index the callback will have seen."""
         if replay:
             floor = int(self._archive_floor[g])
-            if floor > 1:
+            covered = 1 if self._group_segments[g] \
+                and self._group_segments[g][0][0] == 1 else floor
+            if floor > 1 and covered > 1:
                 raise ValueError(
                     f"group {g}: archived history starts at index "
                     f"{floor} (retention horizon "
                     f"{self._commit_stamp_cap} entries swept the "
-                    "prefix); replay=True needs the full history — "
-                    "rebuild from a snapshot, then register without "
-                    "replay"
+                    "prefix, and no sealed tier covers it); "
+                    "replay=True needs the full history — rebuild "
+                    "from a snapshot, then register without replay"
                 )
             for idx in range(1, int(self.commit_watermark[g]) + 1):
-                fn(idx, self._archive[g][idx])
+                payload = self._archive_get(g, idx)
+                if payload is None:
+                    raise ValueError(
+                        f"group {g}: committed entry {idx} is not "
+                        "recoverable from the archive or sealed tier "
+                        "(corrupt segment below k shards?); cannot "
+                        "replay"
+                    )
+                fn(idx, payload)
             start = 1
         else:
             start = int(self.commit_watermark[g]) + 1

@@ -71,6 +71,13 @@ class MemoryCensus:
     by_shape: Dict[str, Tuple[int, int]]    # dtype[shape] -> (count, bytes)
     unattr_by_shape: Dict[str, Tuple[int, int]]
     attributed_bytes: int
+    host_by_label: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #   HOST-side buffers a registered host root accounts for (label ->
+    #   bytes): sealed-segment hot tails and decoded-segment caches
+    #   (``ckpt.tiered``) live in numpy/bytes, invisible to
+    #   ``jax.live_arrays()`` — without this section the tiered store's
+    #   RAM would be exactly the unattributed growth the leak detector
+    #   exists to flag, reported by nothing.
 
     @property
     def unattributed_bytes(self) -> int:
@@ -82,6 +89,7 @@ class MemoryCensus:
             "n_arrays": self.n_arrays,
             "attributed_bytes": self.attributed_bytes,
             "unattributed_bytes": self.unattributed_bytes,
+            "host_by_label": dict(sorted(self.host_by_label.items())),
             "by_label": {
                 k: {"count": c, "bytes": b}
                 for k, (c, b) in sorted(self.by_label.items())
@@ -104,6 +112,7 @@ class MemoryWatch:
         self.registry = registry
         self.recorder = recorder
         self._roots: Dict[str, Callable[[], Any]] = {}
+        self._host_roots: Dict[str, Callable[[], Optional[int]]] = {}
         self.baseline: Optional[MemoryCensus] = None
         self.last: Optional[MemoryCensus] = None
         self.high_water_bytes = 0
@@ -120,6 +129,16 @@ class MemoryWatch:
         ``getter`` returning ``None`` skips the root (a crashed
         engine)."""
         self._roots[name] = getter
+
+    def register_host_root(self, name: str,
+                           nbytes: Callable[[], Optional[int]]) -> None:
+        """Account a HOST-side buffer population under ``name``:
+        ``nbytes()`` returns the bytes it currently holds (None skips —
+        a collected engine). Host roots appear in the census's
+        ``host_by_label`` section and the ``raft_host_mem_bytes`` gauge
+        — the tiered store's sealed-segment buffers land here as a
+        labeled root instead of invisible numpy allocations."""
+        self._host_roots[name] = nbytes
 
     def watch_engine(self, engine, name: str = "engine") -> None:
         """Register an engine's device-resident roots under ``name``:
@@ -170,6 +189,22 @@ class MemoryWatch:
         self.register_root(f"{name}.state", state_getter)
         self.register_root(f"{name}.ring", ring_getter)
 
+        # tiered-store HOST buffers (ckpt.tiered): the sealed hot tail
+        # and decoded-segment cache are numpy/bytes — never in
+        # jax.live_arrays() — so they get their own labeled host root
+        # instead of growing unattributed and unreported
+        def sealed_bytes():
+            e = ref()
+            if e is None:
+                return None
+            store = getattr(e, "store", None)
+            if store is not None and hasattr(store, "host_bytes"):
+                return store.host_bytes()
+            tier = getattr(e, "_tier_host_bytes", None)
+            return tier() if tier is not None else None
+
+        self.register_host_root(f"{name}.store.sealed", sealed_bytes)
+
     # ------------------------------------------------------------ census
     def census(self, collect: bool = False) -> MemoryCensus:
         """Take a census (see module docstring). ``collect=True`` runs
@@ -217,12 +252,21 @@ class MemoryWatch:
                 uc = unattr.setdefault(shape_key, [0, 0])
                 uc[0] += 1
                 uc[1] += nbytes
+        host_by_label: Dict[str, int] = {}
+        for hname, nbytes in self._host_roots.items():
+            try:
+                b = nbytes()
+            except Exception:
+                b = None
+            if b is not None:
+                host_by_label[hname] = int(b)
         census = MemoryCensus(
             total_bytes=total, n_arrays=n,
             by_label={k: (c, b) for k, (c, b) in by_label.items()},
             by_shape={k: (c, b) for k, (c, b) in by_shape.items()},
             unattr_by_shape={k: (c, b) for k, (c, b) in unattr.items()},
             attributed_bytes=attributed,
+            host_by_label=host_by_label,
         )
         self.last = census
         self.high_water_bytes = max(self.high_water_bytes, total)
@@ -248,6 +292,13 @@ class MemoryWatch:
                     "live bytes attributed to a registered root",
                     ("root",),
                 ).set_max(b, root=root)
+            for hname, b in host_by_label.items():
+                self.registry.gauge(
+                    "raft_host_mem_bytes",
+                    "host bytes attributed to a registered host root "
+                    "(tiered-store hot tail + segment cache)",
+                    ("root",),
+                ).set(b, root=hname)
         return census
 
     # ----------------------------------------------------- leak detector
@@ -323,6 +374,7 @@ class MemoryWatch:
             "high_water_arrays": self.high_water_arrays,
             "final_drift": self.final_drift,
             "roots": sorted(self._roots),
+            "host_roots": sorted(self._host_roots),
             "donation": (
                 dataclasses.asdict(self.donation)
                 if self.donation is not None else None
@@ -334,6 +386,10 @@ class MemoryWatch:
         return {
             "live_bytes": self.last.total_bytes if self.last else None,
             "live_arrays": self.last.n_arrays if self.last else None,
+            "host_bytes": (
+                sum(self.last.host_by_label.values())
+                if self.last and self.last.host_by_label else None
+            ),
             "high_water_bytes": self.high_water_bytes,
             "flat": (
                 None if self.baseline is None or self.last is None

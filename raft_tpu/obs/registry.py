@@ -20,9 +20,12 @@ raft_repair_rounds_total                  counter  group
 raft_sheds_total                          counter  group, reason
 raft_commits_total                        counter  group
 raft_snapshot_installs_total              counter  group
+raft_snapshot_chunks_total                counter  group
+raft_segments_sealed_total                counter  group
 raft_commit_latency_seconds               histogram group
 raft_queue_depth_high_water               gauge    group
 raft_term                                 gauge    group
+raft_host_mem_bytes                       gauge    root
 ========================================  =======  =======================
 
 Determinism contract: pure host arithmetic, no rng, no device traffic.

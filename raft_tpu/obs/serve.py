@@ -25,7 +25,9 @@ scrapeable while the engine runs, without locks on the hot path:
               term/commit/applied watermarks, replication lag, queue
               depths, audit summary, breaker state — plus ``compile``
               and ``memory`` summary sections when those planes are
-              attached — JSON
+              attached, and ``tiered``/``catchup`` sections (seal
+              tallies, RS reconstructs, live snapshot-chunk streams)
+              when the tiered log store is configured — JSON
   /compile    the CompileWatch snapshot (per-program trace/compile
               tallies, event log, sentinel freeze state + violations)
   /memory     the MemoryWatch snapshot with a FRESH live-buffer census

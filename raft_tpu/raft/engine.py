@@ -352,17 +352,61 @@ class RaftEngine:
         #   modes, entries move from here into the checkpoint store when
         #   they commit. Bounded by ring backpressure:
         #   leader_last - commit <= log_capacity entries.
-        from raft_tpu.ckpt import CheckpointStore
+        from raft_tpu.ckpt import CheckpointStore, SnapshotShipper
 
-        self.store = CheckpointStore(
-            cfg.entry_bytes, max_entries=2 * cfg.log_capacity
+        tiered_root = (
+            os.environ.get("RAFT_TPU_TIERED_DIR", "") or cfg.tiered_log_dir
         )
+        if tiered_root:
+            # Tiered archive (ckpt.tiered, ROADMAP item 6): hot tail in
+            # RAM, sealed RS-coded segments on disk — coverage reaches
+            # the whole history while RAM stays bounded. Each engine
+            # seals under its own fresh subdirectory: segments are an
+            # engine-lifetime cache of durable state (a restore rebuilds
+            # its archive from the checkpoint, not the old generation's
+            # segment files). Env override mirrors RAFT_TPU_FUSE_K so
+            # chaos/torture runs flip the tier without config edits —
+            # replays are pinned byte-identical either way.
+            import tempfile
+
+            from raft_tpu.ckpt import TieredStore
+
+            os.makedirs(tiered_root, exist_ok=True)
+            hot = cfg.tiered_hot_entries or 2 * cfg.log_capacity
+            self.store: CheckpointStore = TieredStore(
+                cfg.entry_bytes,
+                root=tempfile.mkdtemp(prefix="tier_", dir=tiered_root),
+                hot_entries=hot,
+                segment_entries=min(hot, (
+                    cfg.segment_entries
+                    or max(1, cfg.log_capacity // 2)
+                )),
+                rs_k=cfg.segment_rs_k,
+                rs_m=cfg.segment_rs_m,
+                on_seal=self._note_seal,
+                checkpoint_span=2 * cfg.log_capacity,
+            )
+        else:
+            self.store = CheckpointStore(
+                cfg.entry_bytes, max_entries=2 * cfg.log_capacity
+            )
         #   Host archive of the committed log (term + bytes per entry) —
         #   the "persistent data" the reference comments but never writes
         #   (main.go:18-21). Snapshot-installs for ring-lapped replicas are
         #   served from it (raft_tpu.ckpt). Both snapshot consumers clamp
-        #   their range to the last log_capacity entries, so the store
-        #   compacts beyond 2x that instead of growing without bound.
+        #   their range to the last log_capacity entries, so the plain
+        #   store compacts beyond 2x that instead of growing without
+        #   bound; the tiered store seals the same horizon to disk
+        #   instead, keeping full-history coverage at bounded RAM.
+        self._tiered_store = self.store if tiered_root else None
+        #   non-None when the archive is tiered: the apply-cursor seal
+        #   ceiling and the /status tier section key off it
+        self._shipper = SnapshotShipper(
+            cfg.catchup_chunk_entries or cfg.batch_size
+        )
+        #   Incremental snapshot shipping (ckpt.ship): lapped replicas
+        #   catch up in admission-budgeted chunks per leader tick
+        #   instead of one monolithic install — see _stream_snapshot.
         self._lasts_snapshot = None   # see _pre_lasts
         self._match_snapshot = None
         #   cached (match_index, match_term) host pair for
@@ -553,6 +597,14 @@ class RaftEngine:
             return
         labels.setdefault("group", "0")
         self.metrics.counter(name, help_, tuple(labels)).inc(**labels)
+
+    def _note_seal(self, n_entries: int) -> None:
+        """Tiered-store seal callback: one segment of ``n_entries``
+        committed entries was RS-coded and spilled to disk."""
+        self._metric_inc(
+            "raft_segments_sealed_total",
+            "sealed cold-tier segments spilled to disk",
+        )
 
     # ------------------------------------------- device observability plane
     def attach_device_obs(self, obs=None, capacity: int = 4096):
@@ -2154,6 +2206,12 @@ class RaftEngine:
             snap["shedding"] = bool(
                 getattr(self.admission, "shedding", False)
             )
+        if self._tiered_store is not None:
+            # tiered-store section: seal/spill tallies, host bytes, RS
+            # reconstructs — plus the shipper's live catch-up streams
+            snap["tiered"] = self._tiered_store.tier_summary()
+        if self._shipper.streams or self._shipper.chunks_total:
+            snap["catchup"] = self._shipper.summary()
         if self.auditor is not None:
             snap["audit"] = self.auditor.summary()
         return snap
@@ -3047,26 +3105,82 @@ class RaftEngine:
                     idx, int(terms[idx - mlo]), payload, self.clock.now
                 )
 
-    def _try_install_snapshot(self, replica: int, lo: int, hi: int) -> bool:
-        """Install the committed range [lo, hi] (clamped to one ring
-        capacity) into ``replica`` from the checkpoint store; False when the
-        store does not cover it (the replica keeps waiting)."""
+    def _catchup_budget(self) -> int:
+        """Chunks the catch-up lane may ship this tick: the admission
+        gate's background-lane decision (throttled to 1 while the write
+        lane is congested), or the configured maximum when admission is
+        disabled."""
+        mx = self.cfg.catchup_max_chunks_per_tick
+        if self.admission is None:
+            return mx
+        return self.admission.catchup_chunks(len(self._queue), mx)
+
+    def _stream_snapshot(self, replica: int, lo: int, hi: int) -> Optional[int]:
+        """Ship this tick's budget of snapshot chunks toward installing
+        the committed range [lo, hi] (clamped to one ring capacity) into
+        ``replica`` from the checkpoint store. Returns the index the
+        replica is installed through after this tick (None when nothing
+        could ship — store gap, or range empty). Incremental install
+        (ckpt.ship): each chunk advances the replica's device match, so
+        the stream RESUMES from the last acked chunk across kills,
+        leader changes and restarts — and the admission gate's catch-up
+        lane throttles it under foreground load instead of letting one
+        rejoining replica stall commits."""
         from raft_tpu.ckpt import install_snapshot
 
         lo = max(lo, hi - self.state.capacity + 1, 1)
-        if hi < lo or not self.store.covers(lo, hi):
-            return False
-        self.state = install_snapshot(
-            self.state, replica, self.store.snapshot(lo, hi),
-            self.leader_term, self.cfg.batch_size, self._code,
+        if hi < lo:
+            return None
+        streaming = self._shipper.is_streaming(replica)
+        prev_next = (
+            self._shipper.streams[replica].next if streaming else None
         )
-        # Only [lo, hi] was written; slots below keep whatever they held.
-        self._ring_floor[replica] = max(self._ring_floor[replica], lo)
-        self._lasts_snapshot = None   # last_index changed outside a step
-        self._match_snapshot = None   # ...and so did match_index
-        self.nodelog(replica, f"snapshot installed to {hi}")
-        self._metric_inc("raft_snapshot_installs_total")
-        return True
+        raise_floor = not streaming
+        chunks = self._shipper.plan(
+            replica, lo, hi, self._catchup_budget()
+        )
+        if prev_next is not None and chunks and chunks[0][0] > prev_next:
+            # the ring-tail clamp overtook the acked cursor mid-stream
+            # (a throttled stream chasing a moving watermark): indices
+            # [prev_next, new cursor) were SKIPPED, not installed —
+            # the validity floor must rise past the gap or donor/read
+            # checks would trust lap-stale slots above the old base
+            raise_floor = True
+        reached = None
+        for clo, chi in chunks:
+            if not self.store.covers(clo, chi):
+                break      # archive gap: the replica keeps waiting
+            self.state = install_snapshot(
+                self.state, replica, self.store.snapshot(clo, chi),
+                self.leader_term, self.cfg.batch_size, self._code,
+            )
+            if raise_floor:
+                # Only [clo, ...] onward is being written; slots below
+                # this stream segment's start keep whatever they held
+                # (junk, for a lapped ring). Later contiguous chunks
+                # extend the valid range upward, so the floor rises
+                # once per (re)based stream.
+                self._ring_floor[replica] = max(
+                    self._ring_floor[replica], clo
+                )
+                raise_floor = False
+            self._shipper.acked(replica, chi)
+            self._metric_inc(
+                "raft_snapshot_chunks_total",
+                "incremental snapshot-install chunks shipped",
+            )
+            reached = chi
+        if reached is not None:
+            self._lasts_snapshot = None  # last_index moved outside a step
+            self._match_snapshot = None  # ...and so did match_index
+            self.nodelog(replica, f"snapshot chunk installed to {reached}")
+            if reached >= hi:
+                self._metric_inc("raft_snapshot_installs_total")
+                self._shipper.finish(replica)
+                self.nodelog(
+                    replica, f"snapshot stream complete at {hi}"
+                )
+        return reached
 
     def _snapshot_heal(self, leader: int, info) -> None:
         """Snapshot-install for ring-lapped replicas (plain replication).
@@ -3076,9 +3190,12 @@ class RaftEngine:
         wrapped bytes would corrupt). Such a replica's verified match stays
         pinned while everyone else progresses; after two stalled ticks
         (one leadership-change transient is forgiven — matches reset per
-        term and re-verify via the repair window within a tick), install a
-        snapshot of the committed prefix from the checkpoint store, then
-        let the repair window cover (snapshot, leader_last]."""
+        term and re-verify via the repair window within a tick), STREAM a
+        snapshot of the committed prefix from the checkpoint store —
+        ``_stream_snapshot`` ships an admission-budgeted number of chunks
+        per tick, resuming from the device match cursor — until the
+        replica is back inside the repair window's reach, which then
+        covers (snapshot, leader_last]."""
         cap = self.state.capacity
         match = self._effective_match(int(self.lead_terms[leader]), info.match)
         leader_last = int(self._fetch(self.state.last_index)[leader])
@@ -3091,19 +3208,24 @@ class RaftEngine:
                     or not (self.member[p] or self.learner[p])
                     or not self.connectivity[leader, p]):
                 # learners heal exactly like members: snapshot install is
-                # how a wiped/fresh learner rejoins from nothing
+                # how a wiped/fresh learner rejoins from nothing. A dead
+                # replica KEEPS its stream — resume-on-recover is the
+                # kill-mid-stream contract — but a deconfigured row's is
+                # abandoned.
                 self._match_stall[p] = 0
+                if not (self.member[p] or self.learner[p]):
+                    self._shipper.finish(p)
                 continue
             if int(match[p]) + 1 >= horizon:
                 self._match_stall[p] = 0
+                self._shipper.finish(p)
                 continue
             self._match_stall[p] += 1
             if self._match_stall[p] < 2:
                 continue
-            if self._try_install_snapshot(
+            self._stream_snapshot(
                 p, int(match[p]) + 1, self.commit_watermark
-            ):
-                self._match_stall[p] = 0
+            )
 
     def _ec_heal(self, leader: int, info) -> None:
         """Two-phase repair for erasure-coded logs.
@@ -3168,10 +3290,13 @@ class RaftEngine:
                     self.nodelog(p, f"healed by reconstruction to {hi_rec}")
                 except ValueError:
                     # Below every donor's ring horizon: reconstruction would
-                    # decode lapped slots into garbage. Install a snapshot
+                    # decode lapped slots into garbage. Stream a snapshot
                     # of the committed prefix from the checkpoint store
-                    # instead (the EC InstallSnapshot proper).
-                    if not self._try_install_snapshot(p, lo, hi_rec):
+                    # instead (the EC InstallSnapshot proper) — chunked
+                    # like the plain path; the uncommitted-suffix re-serve
+                    # below waits until the stream completes.
+                    reached = self._stream_snapshot(p, lo, hi_rec)
+                    if reached is None or reached < hi_rec:
                         continue
                 lo = hi_rec + 1
             if lo <= leader_last:
@@ -3365,6 +3490,12 @@ class RaftEngine:
         if not self._apply_fns:
             self.applied_index = max(self.applied_index, self.commit_watermark)
         self._apply_fns.append((fn, start))
+        if self._tiered_store is not None:
+            # with apply consumers registered, the tiered store may only
+            # seal history the apply stream has consumed ("committed,
+            # below the apply cursor") — the hot path never pays a
+            # segment read for the next apply index
+            self._tiered_store.apply_cursor = self.applied_index
         return lo
 
     def _drain_apply(self) -> None:
@@ -3400,6 +3531,8 @@ class RaftEngine:
                         err = err if err is not None else ex
             if err is not None:
                 raise err
+        if self._tiered_store is not None and self._apply_fns:
+            self._tiered_store.apply_cursor = self.applied_index
 
     def _backfill_archive(self, idx: int, quiet: bool = False) -> bool:
         """Try to fill an archive gap at committed index ``idx`` from the
@@ -3514,7 +3647,17 @@ class RaftEngine:
         from raft_tpu.ckpt import EngineCheckpoint, Snapshot
 
         hi = self.commit_watermark
-        lo = self.store.covered_lo(hi)
+        # checkpoint_floor, not first: the tiered store's coverage
+        # reaches arbitrarily deep into sealed segments, but checkpoints
+        # must stay O(ring capacity) — and byte-identical to an untiered
+        # engine's (the chaos determinism pin). Deep history restores
+        # from the segment tier, not from a checkpoint that would grow
+        # with it. For the plain store the two floors coincide. The
+        # floor also BOUNDS the coverage walk (covered_lo pages segments
+        # through the decode cache — an unbounded walk would read the
+        # whole cold tier per checkpoint just to clamp it away).
+        floor = max(1, self.store.checkpoint_floor)
+        lo = self.store.covered_lo(hi, floor)
         # An interior archive hole (the EC archive path gives up when
         # donors are short; later ranges archive fine) would make the
         # contiguous coverage start ABOVE the hole — snapshotting just
@@ -3522,9 +3665,8 @@ class RaftEngine:
         # Probe downward first (holes are often transient: donors may have
         # recovered), then refuse loudly if committed entries above the
         # compaction floor are still missing.
-        floor = max(1, self.store.first)
         while lo > floor and self._backfill_archive(lo - 1, quiet=True):
-            lo = self.store.covered_lo(hi)
+            lo = self.store.covered_lo(hi, floor)
         if hi == 0:  # nothing committed yet: empty snapshot
             snap = Snapshot(
                 1, 0,

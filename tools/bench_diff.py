@@ -68,6 +68,17 @@ GATED_METRICS: Dict[str, str] = {
     # regression, reported with its own "recompiling" status
     "compile_count": "down",
     "mem_high_water_bytes": "down",
+    # tiered-log wipe ladder (round 12): rejoin time gates DOWN and the
+    # foreground-goodput coexistence ratio gates UP per wipe_logN row;
+    # the ladder's flatness ratio (rejoin at log 4096 / log 256) gates
+    # DOWN so rejoin cost can never quietly grow back into scaling
+    # with history length. rejoin_wall_ms and seal_entries_per_sec are
+    # reported but NOT gated: wall numbers on shared CI boxes are too
+    # noisy for a 10% tripwire (the virtual-clock columns carry the
+    # gate).
+    "rejoin_virtual_s": "down",
+    "flat_ratio": "down",
+    "catchup_goodput_ratio": "up",
 }
 
 
