@@ -948,6 +948,195 @@ def bench_read_index() -> dict:
     }
 
 
+# ------------------------------------------------- read scale-out sweep
+def bench_read_scale() -> dict:
+    """Read scale-out (docs/READS.md): a 90%-read mix over Zipf-skewed
+    keys, one row per read class, reporting wall reads/s and per-read
+    wall p50/p99. ``read_index`` pays one dedicated confirmation round
+    per read (the pre-lease baseline); ``lease`` serves locally with
+    ZERO rounds (round-count asserted, not assumed); ``follower`` and
+    ``session`` ride a Router over a 4-group MultiEngine — follower
+    reads spread lease-certified serves across all replicas, session
+    reads never contact a leader at all. The lease row's
+    ``speedup_vs_read_index`` is the acceptance column (>= 5x at this
+    mix); all four rows emit incrementally under the deadline
+    discipline and gate through tools/bench_diff.py (reads/s up,
+    p50/p99 down)."""
+    from raft_tpu.multi import MultiEngine, ReadSession, Router
+    from raft_tpu.raft import RaftEngine
+    from raft_tpu.transport import SingleDeviceTransport
+
+    N_OPS = 1200
+    WRITE_EVERY = 10              # 90% reads / 10% writes
+    ZIPF_S = 1.2
+    N_KEYS = 64
+
+    def zipf_keys(seed: int) -> list:
+        rng = np.random.default_rng(seed)
+        ranks = np.minimum(rng.zipf(ZIPF_S, N_OPS), N_KEYS) - 1
+        return [b"k%03d" % int(r) for r in ranks]
+
+    def single_row(lease: bool):
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=64, batch_size=64,
+            log_capacity=1 << 11, transport="single", seed=7,
+            prevote=lease, read_lease=lease,
+        )
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+        e.run_until_leader()
+        payload = bytes(cfg.entry_bytes)
+        seqs = [e.submit(payload) for _ in range(16)]
+        e.run_until_committed(seqs[-1])     # warm + first-term commit
+        e.read_linearizable()               # warm the read program
+        rounds = [0]
+        orig = e.t.replicate
+
+        def counting(*a, **k):
+            rounds[0] += 1
+            return orig(*a, **k)
+
+        e.t.replicate = counting
+        lat: list = []
+        pending: list = []
+        t_all = time.perf_counter()
+        for i in range(N_OPS):
+            if i % WRITE_EVERY == 0:
+                pending.append(e.submit(payload))
+                if len(pending) >= 16:
+                    e.run_until_committed(pending[-1])
+                    pending.clear()
+            else:
+                t0 = time.perf_counter()
+                e.read_linearizable()
+                lat.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t_all
+        e.t.replicate = orig
+        n_reads = len(lat)
+        lat_us = np.asarray(lat) * 1e6
+        row = {
+            "reads": n_reads,
+            "write_fraction": round(1.0 / WRITE_EVERY, 3),
+            # (no key distribution: the single engine's read index is
+            # keyless — Zipf skew applies to the router rows below)
+            "reads_per_sec": round(n_reads / wall, 1),
+            "read_p50_us": round(float(np.percentile(lat_us, 50)), 2),
+            "read_p99_us": round(float(np.percentile(lat_us, 99)), 2),
+            "read_rounds": rounds[0] - _commit_rounds[0],
+        }
+        return row, e
+
+    # round accounting for the write traffic inside the window: reads'
+    # extra rounds = total rounds - the rounds the same write schedule
+    # costs with NO reads at all (measured once below)
+    _commit_rounds = [0]
+
+    def write_only_rounds() -> int:
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=64, batch_size=64,
+            log_capacity=1 << 11, transport="single", seed=7,
+        )
+        e = RaftEngine(cfg, SingleDeviceTransport(cfg))
+        e.run_until_leader()
+        payload = bytes(cfg.entry_bytes)
+        seqs = [e.submit(payload) for _ in range(16)]
+        e.run_until_committed(seqs[-1])
+        calls = [0]
+        orig = e.t.replicate
+
+        def counting(*a, **k):
+            calls[0] += 1
+            return orig(*a, **k)
+
+        e.t.replicate = counting
+        pending = []
+        for i in range(N_OPS):
+            if i % WRITE_EVERY == 0:
+                pending.append(e.submit(payload))
+                if len(pending) >= 16:
+                    e.run_until_committed(pending[-1])
+                    pending.clear()
+        e.t.replicate = orig
+        return calls[0]
+
+    _commit_rounds[0] = write_only_rounds()
+    rows = {}
+    base_row, _ = single_row(lease=False)
+    base_row["read_rounds_extra"] = base_row.pop("read_rounds")
+    rows["read_index"] = _emit_leg("read_scale_read_index", base_row)
+    lease_row, eng = single_row(lease=True)
+    extra = lease_row.pop("read_rounds")
+    lease_row["read_rounds_extra"] = extra
+    lease_row["lease_serves"] = eng.read_class_counts.get("lease", 0)
+    lease_row["speedup_vs_read_index"] = round(
+        lease_row["reads_per_sec"] / max(base_row["reads_per_sec"], 1e-9),
+        2,
+    )
+    assert extra == 0, (
+        f"lease reads paid {extra} replication rounds (must be 0)"
+    )
+    rows["lease"] = _emit_leg("read_scale_lease", lease_row)
+
+    # ---- router rows: follower spread + session tokens --------------
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=64, batch_size=64,
+        log_capacity=1 << 11, transport="single", seed=7,
+        prevote=True, read_lease=True,
+    )
+    eng = MultiEngine(cfg, 4)
+    eng.seed_leaders()
+    router = Router(eng)
+    keys = zipf_keys(1)
+    payload = bytes(cfg.entry_bytes)
+    for g in range(4):
+        for _ in range(32):
+            eng.submit(g, payload)
+    eng.run_for(20.0)
+    for mode in ("follower", "session"):
+        session = ReadSession()
+        served_by: dict = {}
+        lat = []
+        t_all = time.perf_counter()
+        w = 0
+        for i, key in enumerate(keys):
+            if i % WRITE_EVERY == 0:
+                g, _ = router.submit(key, payload)
+                w += 1
+                if w % 16 == 0:
+                    eng.run_for(3 * cfg.heartbeat_period)
+                continue
+            t0 = time.perf_counter()
+            if mode == "session":
+                router.read_session(key, session)
+            else:
+                g, r, _, _cls = router.read_any(key)
+                served_by[r] = served_by.get(r, 0) + 1
+            lat.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t_all
+        lat_us = np.asarray(lat) * 1e6
+        row = {
+            "reads": len(lat),
+            "groups": 4,
+            "write_fraction": round(1.0 / WRITE_EVERY, 3),
+            "zipf_s": ZIPF_S,
+            "reads_per_sec": round(len(lat) / wall, 1),
+            "read_p50_us": round(float(np.percentile(lat_us, 50)), 2),
+            "read_p99_us": round(float(np.percentile(lat_us, 99)), 2),
+        }
+        if mode == "follower":
+            row["served_by_replica"] = {
+                str(r): n for r, n in sorted(served_by.items())
+            }
+            row["replicas_serving"] = len(served_by)
+        rows[mode] = _emit_leg(f"read_scale_{mode}", row)
+    rows["classes"] = {
+        "by_class": {
+            cls: sum(cc.get(cls, 0) for cc in eng.read_class_counts)
+            for cls in ("lease", "follower", "session", "read_index")
+        },
+    }
+    return rows
+
+
 # ------------------------------------------------------ overload sweep
 def bench_overload() -> dict:
     """Offered-load sweep (docs/OVERLOAD.md): open-loop Poisson arrivals
@@ -2142,6 +2331,7 @@ def main(argv=None) -> None:
         ("c5_storm", bench_storm),
         ("mesh1_per_device", lambda: bench_mesh1(rng)),
         ("read_index", bench_read_index),
+        ("read_scale", bench_read_scale),
         ("client_chunk", bench_client_latency),
         ("attribution", bench_attribution),
         ("fusion", bench_fusion),

@@ -75,6 +75,10 @@ class AdmissionReport:
     shedding: bool                   # delay controller currently refusing
     queue_delay_p50_s: float         # over observed head-of-queue sojourns
     queue_delay_p99_s: float
+    read_classes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #   reads SERVED through the lane, by class (lease / read_index /
+    #   follower / session — docs/READS.md): how much of the read load
+    #   the zero-round paths absorbed vs what still paid a quorum round
 
     @property
     def total_shed(self) -> int:
@@ -117,6 +121,7 @@ class AdmissionGate:
         self._first_above: Optional[float] = None
         self.shedding = False
         self.admitted: Dict[str, int] = {"write": 0, "read": 0}
+        self.read_classes: Dict[str, int] = {}
         self.catchup_throttled = 0
         #   ticks the catch-up lane was cut to 1 chunk (congestion —
         #   see catchup_chunks); deferral, not refusal, so it is not a
@@ -285,6 +290,13 @@ class AdmissionGate:
             )
         self.admitted["read"] += 1
 
+    def note_read_class(self, cls: str) -> None:
+        """A read admitted through the lane was SERVED under ``cls``
+        (the engine reports at serve time — lease and session serves
+        never pay a quorum round, so the per-class split is the lane's
+        capacity story, not just telemetry)."""
+        self.read_classes[cls] = self.read_classes.get(cls, 0) + 1
+
     # ------------------------------------------------------------ report
     def report(self, queue_depth: int = 0) -> AdmissionReport:
         import numpy as np
@@ -304,4 +316,5 @@ class AdmissionGate:
             shedding=self.shedding,
             queue_delay_p50_s=p50,
             queue_delay_p99_s=p99,
+            read_classes=dict(self.read_classes),
         )

@@ -15,6 +15,7 @@ import sys
 from raft_tpu.chaos.runner import (
     migration_run,
     overload_run,
+    reads_run,
     reconfig_run,
     segment_storage_run,
     torture_run,
@@ -77,6 +78,24 @@ def main(argv=None) -> int:
                          "linearizable, the lapped follower rejoins, "
                          "AND recovery rode the RS reconstruct path "
                          "(no segment lost)")
+    ap.add_argument("--reads", action="store_true",
+                    help="run the deterministic read scale-out drill "
+                         "(leader leases under traffic, clock-skew "
+                         "churn across the drift band, leader kill "
+                         "with lease resumption, session reads, and "
+                         "the scripted stale-probe scenario) instead "
+                         "of a torture run; succeeds only if EVERY "
+                         "read class passes its own consistency model "
+                         "(per-class verdicts, docs/READS.md) and the "
+                         "stale probe was refused; with --broken "
+                         "lease_skew, succeeds only if the stale "
+                         "serve was CAUGHT")
+    ap.add_argument("--read-plane", action="store_true",
+                    help="arm the read scale-out plane on a torture "
+                         "run: leader leases (prevote implied) plus "
+                         "the clock-skew nemesis drawing rates inside "
+                         "the configured drift band, composed with "
+                         "the other fault planes")
     ap.add_argument("--overload-recovery", type=float, default=None,
                     metavar="MULT",
                     help="run the deterministic overload-and-recover "
@@ -85,7 +104,9 @@ def main(argv=None) -> int:
                          "checks linearizable, the queue bound held, "
                          "AND goodput recovered inside the documented "
                          "window")
-    ap.add_argument("--broken", choices=["dirty_reads", "commit_rewind"],
+    ap.add_argument("--broken",
+                    choices=["dirty_reads", "commit_rewind",
+                             "lease_skew"],
                     default=None,
                     help="deliberately broken variant; the run SUCCEEDS "
                          "(exit 0) only if the harness catches it — "
@@ -93,9 +114,12 @@ def main(argv=None) -> int:
                          "checker, commit_rewind (acked commits lost by "
                          "a lying storage layer; usually invisible to "
                          "the checker) must trip the ONLINE safety "
-                         "auditor during the run (--audit is implied). "
-                         "A passing broken run means the harness lost "
-                         "its teeth")
+                         "auditor during the run (--audit is implied), "
+                         "lease_skew (leader leases that ignore the "
+                         "clock-drift bound; needs --reads) must serve "
+                         "a stale read the per-class checker and/or "
+                         "auditor catch. A passing broken run means "
+                         "the harness lost its teeth")
     ap.add_argument("--audit", action="store_true",
                     help="attach the ONLINE safety plane: the "
                          "obs.audit.SafetyAuditor invariant checks "
@@ -146,6 +170,11 @@ def main(argv=None) -> int:
     if args.membership and args.multi:
         ap.error("--membership applies to the single-engine runner only "
                  "(MultiEngine is fixed-membership by design)")
+    if args.read_plane and args.multi:
+        ap.error("--read-plane applies to the single-engine runner "
+                 "only (the multi engine has no PreVote yet — its "
+                 "lease plane is exercised by the Router tests and "
+                 "bench, not the torture nemesis)")
     if args.reconfig and (args.multi or args.broken or args.overload
                           or args.overload_recovery is not None):
         ap.error("--reconfig is a standalone single-engine drill")
@@ -157,8 +186,50 @@ def main(argv=None) -> int:
                           or args.reconfig or args.migration
                           or args.overload_recovery is not None):
         ap.error("--segments is a standalone single-engine drill")
+    if args.broken == "lease_skew" and not args.reads:
+        ap.error("--broken lease_skew applies to the --reads drill")
+    if args.reads and (args.multi or args.overload or args.reconfig
+                       or args.migration or args.segments
+                       or args.membership
+                       or args.broken not in (None, "lease_skew")
+                       or args.overload_recovery is not None):
+        ap.error("--reads is a standalone single-engine drill "
+                 "(--broken lease_skew is its one composition)")
 
     ok = True
+    if args.reads:
+        for seed in range(args.seed, args.seed + args.sweep):
+            rep = reads_run(
+                seed, broken=args.broken,
+                step_budget=args.step_budget,
+                observe=True, bundle_dir=args.bundle_dir,
+                blackbox_dir=args.blackbox_dir,
+            )
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "per_class": {c: r.verdict
+                              for c, r in rep.per_class.items()},
+                "lease_serves": rep.lease_serves,
+                "read_index_serves": rep.read_index_serves,
+                "session_serves": rep.session_serves,
+                "refused_stale": rep.refused_stale,
+                "stale_served": rep.stale_served,
+                "audit_violations": rep.audit_violations,
+                "ops": rep.ops,
+            }), flush=True)
+            if args.broken == "lease_skew":
+                # the flag's contract: a caught stale serve IS success
+                ok = ok and rep.caught
+            else:
+                ok = ok and (
+                    rep.verdict == "LINEARIZABLE"
+                    and rep.refused_stale >= 1
+                    and rep.lease_serves > 0
+                    and rep.session_serves > 0
+                )
+        return 0 if ok else 1
     if args.segments:
         for seed in range(args.seed, args.seed + args.sweep):
             rep = segment_storage_run(
@@ -283,6 +354,7 @@ def main(argv=None) -> int:
                 crash=not args.no_crash, msg_faults=not args.no_msg,
                 storage_faults=not args.no_storage, broken=args.broken,
                 overload=args.overload, membership=args.membership,
+                reads=args.read_plane,
                 step_budget=args.step_budget,
                 observe=args.observe,
                 observe_device=args.observe_device,

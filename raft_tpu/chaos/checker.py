@@ -246,3 +246,123 @@ def check_history(
             detail=f"step budget ({step_budget}) exhausted",
         )
     return CheckResult(LINEARIZABLE, total)
+
+
+# ------------------------------------------------- per-read-class grading
+#: read classes whose contract IS linearizability: their reads enter the
+#: Wing–Gong search together with every write/delete. ``session`` reads
+#: deliberately do not — their contract is the weaker session model
+#: below, and grading them as linearizable would either fail correct
+#: runs (session reads may be stale) or, worse, grade them against
+#: nothing at all.
+LINEARIZABLE_READ_CLASSES = ("read_index", "lease", "follower")
+SESSION_CLASS = "session"
+
+
+def read_class_of(rec: OpRecord) -> Optional[str]:
+    """The class a read was SERVED under (recorded by the harness on
+    the OpRecord); non-reads return None, unlabeled reads default to
+    ``read_index`` — the legacy single-class world."""
+    if rec.op != READ:
+        return None
+    return getattr(rec, "read_class", None) or "read_index"
+
+
+def check_read_classes(
+    history,
+    step_budget: int = 500_000,
+) -> Dict[str, CheckResult]:
+    """Grade each read class present in ``history`` against ITS OWN
+    consistency model (docs/READS.md matrix) — weaker classes get their
+    own verdicts, not a free pass, and stronger classes are not blamed
+    for a weaker class's staleness:
+
+    - ``read_index`` / ``lease`` / ``follower``: linearizability of the
+      write history plus that class's reads (one Wing–Gong search per
+      class, budget shared);
+    - ``session``: per-(client, key) MONOTONE READS over the recorded
+      serve indices, READ-YOUR-WRITES against the recorded session
+      floor (``ryw_floor`` — the client's token at invoke time), and
+      read-committed value justification (a returned value must have
+      been written to that key by an op invoked before the read
+      completed, or be the initial absence).
+
+    Returns class -> :class:`CheckResult`; classes absent from the
+    history are absent from the result."""
+    ops = history.ops if isinstance(history, History) else list(history)
+    present = {c for rec in ops
+               for c in (read_class_of(rec),) if c is not None}
+    results: Dict[str, CheckResult] = {}
+    base = [rec for rec in ops if rec.op != READ]
+    spent = 0
+    for cls in [c for c in LINEARIZABLE_READ_CLASSES if c in present]:
+        sub = base + [rec for rec in ops if read_class_of(rec) == cls]
+        res = check_history(sub, step_budget=max(step_budget - spent, 1))
+        spent += res.steps
+        results[cls] = res
+    if SESSION_CLASS in present:
+        results[SESSION_CLASS] = _check_session(
+            [rec for rec in ops
+             if rec.op != READ or read_class_of(rec) == SESSION_CLASS]
+        )
+    return results
+
+
+def _check_session(ops: List[OpRecord]) -> CheckResult:
+    """The session model: completed session reads carry the harness's
+    recorded ``serve_index`` (the applied index the value was read at)
+    and ``ryw_floor`` (the client's session token when the read was
+    invoked). Violations: a value never written to the key before the
+    read completed (read-uncommitted), a serve below the client's own
+    floor (read-your-writes broken), or a serve below an index the same
+    client already observed for that key (monotone-reads inversion)."""
+    written: Dict[bytes, List[Tuple[float, Optional[bytes]]]] = {}
+    for rec in ops:
+        if rec.op != READ and rec.status != FAIL:
+            written.setdefault(rec.key, []).append(
+                (rec.invoke_t, None if rec.op == DELETE else rec.value)
+            )
+    hwm: Dict[Tuple[int, bytes], int] = {}
+    steps = 0
+    for rec in ops:
+        if rec.op != READ or rec.status != OK:
+            continue
+        steps += 1
+        if rec.value is not None:
+            # time-bounded justification: only a write INVOKED before
+            # this read completed can explain the value — a value some
+            # client writes later must not retroactively launder an
+            # earlier dirty serve
+            t_end = (rec.complete_t if rec.complete_t is not None
+                     else _INF)
+            if not any(v == rec.value and t_inv <= t_end
+                       for t_inv, v in written.get(rec.key, ())):
+                return CheckResult(
+                    VIOLATION, steps, key=rec.key,
+                    detail=f"session read of {rec.value!r} on key "
+                           f"{rec.key!r}: value was never written "
+                           "before the read completed",
+                )
+        idx = getattr(rec, "serve_index", None)
+        if idx is None:
+            continue            # value-only record: nothing more to grade
+        floor = getattr(rec, "ryw_floor", 0)
+        if idx < floor:
+            return CheckResult(
+                VIOLATION, steps, key=rec.key,
+                detail=f"client {rec.client} session read served at "
+                       f"index {idx} below its own token floor {floor} "
+                       "(read-your-writes broken)",
+            )
+        mkey = (rec.client, rec.key)
+        if idx < hwm.get(mkey, 0):
+            return CheckResult(
+                VIOLATION, steps, key=rec.key,
+                detail=f"client {rec.client} session read served at "
+                       f"index {idx} after already observing "
+                       f"{hwm[mkey]} (monotone-reads inversion)",
+            )
+        hwm[mkey] = max(hwm.get(mkey, 0), idx)
+    return CheckResult(LINEARIZABLE, steps,
+                       detail="session model (monotone + RYW + "
+                              "read-committed)")

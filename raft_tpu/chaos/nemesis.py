@@ -23,6 +23,14 @@ three fault planes:
   rate into open-loop client traffic; admission-shed arrivals are
   recorded as sound no-effect failures, so the linearizability verdict
   must stay ACCEPT through the storm (docs/OVERLOAD.md).
+- **clock-skew plane** (opt-in, ``allow_clock=True`` — off by default
+  for the same replay reason) — per-replica LEASE-clock rate skew
+  inside the configured drift band ``[1/clock_drift_bound,
+  clock_drift_bound]``: the exact envelope the leader-lease safety
+  math claims to absorb (raft.lease). A correct lease plane stays
+  linearizable across every draw in the band; the
+  ``broken="lease_skew"`` variant (drift bound ignored) is what a
+  stale read looks like when the claim is false (docs/READS.md).
 - **membership plane** (opt-in, ``allow_membership=True`` — off by
   default for the same replay reason) — seeded reconfiguration under
   fire: grow (learner-then-promote ``add_server``), shrink, removal of
@@ -80,8 +88,14 @@ class NemesisAction:
     storage: str = "none"                   # kind == "crash_restart"
     rate_mult: float = 0.0                  # kind == "overload_on"
     spare: int = 0                          # kind == "mem_replace"
+    rate: float = 1.0                       # kind == "skew_on" (lease
+    #                                         clock rate, local s/true s)
 
     def describe(self) -> str:
+        if self.kind == "skew_on":
+            return f"skew_on({self.replica}, rate={self.rate:.3f})"
+        if self.kind == "skew_off":
+            return f"skew_off({self.replica})"
         if self.kind == "msg_on":
             return (f"msg_on(drop={self.drop:.2f}, dup={self.dup:.2f}, "
                     f"delay={self.delay:.2f})")
@@ -111,6 +125,7 @@ class Nemesis:
         "partition", "heal", "plan", "msg_on", "msg_off",
         "crash_restart", "overload_on", "overload_off",
         "mem_grow", "mem_shrink", "mem_remove_leader", "mem_replace",
+        "skew_on", "skew_off",
         "none",
     )
 
@@ -123,6 +138,8 @@ class Nemesis:
         allow_storage: bool = True,
         allow_overload: bool = False,
         allow_membership: bool = False,
+        allow_clock: bool = False,
+        clock_drift_bound: float = 2.0,
     ):
         self.rng = random.Random(f"nemesis:{seed}")
         self.n_rows = n_rows
@@ -131,6 +148,13 @@ class Nemesis:
         self.allow_storage = allow_storage
         self.allow_overload = allow_overload
         self.allow_membership = allow_membership
+        self.allow_clock = allow_clock
+        self.clock_drift_bound = clock_drift_bound
+        #   skew_on draws lease-clock rates inside the drift band the
+        #   lease plane's config CLAIMS to absorb — the adversary probes
+        #   exactly the assumption, never outside it (outside it the
+        #   deployment lied about its clocks, which is what the
+        #   broken="lease_skew" variant models instead)
         #   off by default: adding kinds to the choice pool perturbs the
         #   decision stream, and existing pinned seeds must replay
         #   byte-identically
@@ -187,6 +211,8 @@ class Nemesis:
         if self.allow_membership and membership is not None:
             kinds += ["mem_grow", "mem_shrink", "mem_remove_leader",
                       "mem_replace"]
+        if self.allow_clock:
+            kinds += ["skew_on", "skew_off"]
         kind = rng.choice(kinds)
         dead = sum(1 for r in members if not alive[r])
         victim = rng.randrange(self.n_rows)
@@ -245,6 +271,20 @@ class Nemesis:
         elif kind == "overload_off" and self.overload_window:
             self.overload_window = False
             act = NemesisAction("overload_off")
+        elif kind == "skew_on" and self.allow_clock:
+            # lease-clock rate inside the configured drift band (log-
+            # uniform so slow and fast clocks are equally likely; the
+            # band EDGES are the interesting draws and stay reachable)
+            import math
+
+            lo = math.log(1.0 / self.clock_drift_bound)
+            hi = math.log(self.clock_drift_bound)
+            act = NemesisAction(
+                "skew_on", victim,
+                rate=math.exp(rng.uniform(lo, hi)),
+            )
+        elif kind == "skew_off" and self.allow_clock:
+            act = NemesisAction("skew_off", victim)
         elif kind.startswith("mem_") and membership is not None:
             act = self._membership_action(
                 kind, membership, alive, partitioned

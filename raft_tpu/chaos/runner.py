@@ -65,8 +65,10 @@ from typing import Dict, List, Optional, Tuple
 from raft_tpu.admission import Overloaded
 from raft_tpu.chaos.checker import (
     LINEARIZABLE,
+    VIOLATION,
     CheckResult,
     check_history,
+    check_read_classes,
 )
 from raft_tpu.chaos.history import DELETE, READ, WRITE, History, OpRecord
 from raft_tpu.chaos.nemesis import MembershipView, Nemesis, NemesisAction
@@ -170,6 +172,13 @@ def _membership_cfg(base: RaftConfig) -> RaftConfig:
     headroom over the 3-voter start, so grow / replace always have a
     row to admit."""
     return dataclasses.replace(base, max_replicas=5)
+
+
+def _reads_cfg(base: RaftConfig) -> RaftConfig:
+    """Arm a torture config for the read scale-out plane: leader
+    leases (which REQUIRE prevote — the §9.6 stickiness the lease
+    safety argument rests on) under the default 2x drift bound."""
+    return dataclasses.replace(base, prevote=True, read_lease=True)
 
 
 #: admission-flavored refusal reasons: a span whose refusal trail hit
@@ -522,6 +531,7 @@ def torture_run(
     broken: Optional[str] = None,
     overload: bool = False,
     membership: bool = False,
+    reads: bool = False,
     step_budget: int = 500_000,
     observe: bool = False,
     observe_device: bool = False,
@@ -563,12 +573,15 @@ def torture_run(
     base = _overload_cfg(seed) if overload else _default_cfg(seed)
     if membership and cfg is None:
         base = _membership_cfg(base)
+    if reads and cfg is None:
+        base = _reads_cfg(base)
     with blackbox.journal_for(f"torture_seed{seed}", blackbox_dir):
         blackbox.mark("torture_run", seed=seed, phases=phases,
                       clients=clients, keys=keys)
         run = _SingleTorture(
             seed, phases, clients, keys, phase_s,
             cfg or base, workdir, broken, membership=membership,
+            reads=reads,
             observe=observe, observe_device=observe_device, audit=audit,
             observe_compile=observe_compile,
         )
@@ -576,6 +589,8 @@ def torture_run(
             seed, run.cfg.rows, allow_crash=crash, allow_msg=msg_faults,
             allow_storage=storage_faults, allow_overload=overload,
             allow_membership=membership,
+            allow_clock=reads,
+            clock_drift_bound=run.cfg.clock_drift_bound,
         )
         run.run_phases(nemesis)
         blackbox.mark("check_history", ops=len(run.history),
@@ -595,6 +610,8 @@ def torture_run(
         flags.append("--overload")
     if membership:
         flags.append("--membership")
+    if reads:
+        flags.append("--read-plane")
     if audit:
         flags.append("--audit")
     if observe_compile:
@@ -655,6 +672,7 @@ def _maybe_bundle(
 class _SingleTorture(_TortureBase):
     def __init__(self, seed, phases, clients, keys, phase_s, cfg,
                  workdir, broken, membership: bool = False,
+                 reads: bool = False,
                  observe: bool = False, observe_device: bool = False,
                  audit: bool = False, observe_compile: bool = False):
         super().__init__(seed, phases, clients, keys, phase_s,
@@ -665,6 +683,17 @@ class _SingleTorture(_TortureBase):
         self.cfg = cfg
         self.broken = broken
         self.membership = membership
+        self.reads = reads or cfg.read_lease
+        #   read scale-out plane: lease-class serves come from the
+        #   harness's VERSIONED applied store at the index the engine
+        #   returned (_value_at) — a stale leader's frozen commit view
+        #   then really serves stale bytes, exactly as its local state
+        #   machine would in a deployment; the shared in-process KV
+        #   would otherwise mask the staleness the skew nemesis exists
+        #   to produce.
+        self._vidx: Dict[bytes, List[int]] = {}
+        self._vval: Dict[bytes, List[Optional[bytes]]] = {}
+        self._vmax = 0
         self.membership_ops: Dict[str, int] = {}
         self._wipe_rejoin: set = set()
         #   rows awaiting recovery after a wipe-replace: a wiped row must
@@ -700,8 +729,14 @@ class _SingleTorture(_TortureBase):
         )
         if self.obs is not None:
             self.obs.attach(self.engine)
+        if self.broken == "lease_skew" and self.engine.lease is not None:
+            # the deliberately broken plane: drift bound ignored (a
+            # deployment that assumed perfect clocks) — re-armed on
+            # every boot so crash-restore cycles stay broken
+            self.engine.lease.ignore_drift = True
         self.kv = ReplicatedKV(self.engine)
         self._register_audit_apply()
+        self._register_version_feed()
         self.engine.run_until_leader()
 
     def _register_audit_apply(self) -> None:
@@ -721,6 +756,38 @@ class _SingleTorture(_TortureBase):
                 auditor.note_apply(key, idx, value)
 
         self.engine.register_apply(_feed)
+
+    def _register_version_feed(self) -> None:
+        """With the read plane armed, keep a per-key VERSIONED applied
+        store (idx -> value lists): lease-class reads serve from it at
+        the engine's returned index (_value_at). Idempotent on replay
+        (committed idx -> value is stable across crash-restore), so one
+        version map spans the whole run like the auditor."""
+        if not self.reads:
+            return
+        from raft_tpu.examples.kv import decode_op
+
+        def _feed(idx: int, payload: bytes) -> None:
+            if idx <= self._vmax:
+                return
+            self._vmax = idx
+            op, key, value = decode_op(payload)
+            if op:
+                self._vidx.setdefault(key, []).append(idx)
+                self._vval.setdefault(key, []).append(value)
+
+        self.engine.register_apply(_feed, replay=True)
+
+    def _value_at(self, key: bytes, idx: int) -> Optional[bytes]:
+        """The key's applied value as of log index ``idx`` — what a
+        replica whose state machine stopped at ``idx`` would serve."""
+        import bisect
+
+        vi = self._vidx.get(key)
+        if not vi:
+            return None
+        i = bisect.bisect_right(vi, idx)
+        return self._vval[key][i - 1] if i else None
 
     def _restart(self) -> None:
         from raft_tpu.examples.kv import ReplicatedKV
@@ -753,8 +820,11 @@ class _SingleTorture(_TortureBase):
         # carry virtual time forward: a restart must not rewind the
         # history clock (heap entries armed below t0 simply fire "now")
         self.engine.clock.now = t0
+        if self.broken == "lease_skew" and self.engine.lease is not None:
+            self.engine.lease.ignore_drift = True
         self.kv = ReplicatedKV(self.engine, replay=True)
         self._register_audit_apply()
+        self._register_version_feed()
         if self._msg_params is not None:
             self.chaos_t.set_message_faults(*self._msg_params)
         self.partitioned = False
@@ -889,6 +959,13 @@ class _SingleTorture(_TortureBase):
             try:
                 with self._ambient_span(cl.rec):
                     cl.ticket = self.engine.submit_read()
+                cl.rec.read_class = self.engine.read_ticket_class(
+                    cl.ticket
+                )
+                #   the served class (lease = zero-round local serve,
+                #   read_index = quorum-confirmed) rides the OpRecord so
+                #   the checker can grade each class against its own
+                #   model (chaos.checker.check_read_classes)
             except (LinearizableReadRefused, Overloaded):
                 # refused before any effect (read-lane admission refuses
                 # before minting a ticket)
@@ -933,7 +1010,14 @@ class _SingleTorture(_TortureBase):
                 cl.ticket = ("applied", idx)
             if self.kv.last_applied < idx:
                 return
-            value = self.kv.get(rec.key)
+            if self.reads and getattr(rec, "read_class", None) == "lease":
+                # a lease serve reads the LEADER'S OWN applied view at
+                # the index its lease certified — the versioned store
+                # makes a stale frozen index really serve stale bytes
+                # (see __init__; this is the skew nemesis's teeth)
+                value = self._value_at(rec.key, idx)
+            else:
+                value = self.kv.get(rec.key)
             self._audit_read(cl.cid, rec.key, value)
             rec.ok(self.history.stamp(self.now()), value)
             cl.rec, cl.ticket = None, None
@@ -974,6 +1058,10 @@ class _SingleTorture(_TortureBase):
             self.set_overload_rate(act.rate_mult)
         elif act.kind == "overload_off":
             self._ol_rate = 0.0
+        elif act.kind == "skew_on":
+            e.set_lease_rate(act.replica, act.rate)
+        elif act.kind == "skew_off":
+            e.set_lease_rate(act.replica, 1.0)
         elif act.kind == "mem_grow":
             self._mem_op("grow", lambda: e.add_server(act.replica))
         elif act.kind == "mem_shrink":
@@ -2194,4 +2282,287 @@ def migration_run(
         n_shards=e.n_shards, repro=repro,
         commit_digest=run.commit_digest(), bundle_path=bundle_path,
         obs=run.obs,
+    )
+
+
+# ------------------------------------------------- read scale-out drill
+@dataclasses.dataclass
+class ReadsReport:
+    """Result of :func:`reads_run` — the read scale-out acceptance
+    drill (docs/READS.md): lease churn + leader kill + clock-skew
+    nemesis composed, with PER-READ-CLASS verdicts
+    (``chaos.checker.check_read_classes``) instead of one blanket
+    linearizability grade. The deterministic stale-probe phase is the
+    falsifiability core: a partitioned, clock-skewed old leader is
+    probed after a rival committed — the correct plane must REFUSE
+    (``refused_stale``), the ``broken="lease_skew"`` variant serves
+    the stale bytes and must be CAUGHT (lease-class VIOLATION offline,
+    ``read_monotone`` online)."""
+
+    seed: int
+    per_class: Dict[str, CheckResult]
+    ops: int
+    op_counts: Dict[str, int]
+    lease_serves: int
+    read_index_serves: int
+    session_serves: int
+    refused_stale: int
+    stale_served: int           # broken-plane stale serves observed
+    leader_kills: int
+    skew_log: List[str]
+    audit_violations: Optional[int]
+    repro: str
+    broken: Optional[str] = None
+    bundle_path: Optional[str] = None
+    obs: Optional[ObsStack] = None
+
+    @property
+    def verdict(self) -> str:
+        """Worst per-class verdict (every class must hold its own
+        contract for the drill to pass)."""
+        verdicts = [c.verdict for c in self.per_class.values()]
+        if VIOLATION in verdicts:
+            return VIOLATION
+        if any(v != LINEARIZABLE for v in verdicts):
+            return "UNDETERMINED"
+        return LINEARIZABLE
+
+    @property
+    def caught(self) -> bool:
+        """Broken-variant success: the stale serve happened AND at
+        least one detector (offline per-class checker, online
+        auditor) flagged it."""
+        offline = self.per_class.get("lease") is not None and \
+            self.per_class["lease"].verdict == VIOLATION
+        online = bool(self.audit_violations)
+        return self.stale_served > 0 and (offline or online)
+
+    def summary(self) -> str:
+        cls = {c: r.verdict for c, r in self.per_class.items()}
+        return (
+            f"seed={self.seed} classes={cls} lease={self.lease_serves} "
+            f"read_index={self.read_index_serves} "
+            f"session={self.session_serves} "
+            f"refused_stale={self.refused_stale} "
+            f"stale_served={self.stale_served} ops={self.ops}"
+        )
+
+
+def reads_run(
+    seed: int,
+    broken: Optional[str] = None,
+    clients: int = 3,
+    keys: int = 4,
+    step_budget: int = 500_000,
+    observe: bool = True,
+    bundle_dir: Optional[str] = None,
+    blackbox_dir: Optional[str] = None,
+) -> ReadsReport:
+    """The deterministic read scale-out drill (``--reads``): leader
+    leases under write traffic, clock-skew churn across the configured
+    drift band, a leader kill with lease resumption, session reads on
+    commit-index tokens, and the scripted STALE-PROBE scenario —
+    partition the (slow-clocked) leader away, let the majority elect
+    and commit past it, then probe the old leader's lease read. The
+    correct plane provably refuses (its lease expired before the rival
+    could exist); ``broken="lease_skew"`` (drift bound ignored) still
+    holds the lease on its slow clock, serves the frozen — now stale —
+    state, and must be caught by the per-class checker and the online
+    auditor. Success therefore means the OPPOSITE thing per variant,
+    exactly like ``--broken dirty_reads``."""
+    if broken not in (None, "lease_skew"):
+        raise ValueError(f"unknown reads_run broken variant {broken!r}")
+    cfg = _reads_cfg(_default_cfg(seed))
+    with blackbox.journal_for(f"reads_seed{seed}", blackbox_dir):
+        blackbox.mark("reads_run", seed=seed, broken=broken or "")
+        run = _SingleTorture(
+            seed, 0, clients, keys, 30.0, cfg, None, broken,
+            reads=True, observe=observe, audit=True,
+        )
+        e = run.engine
+        drift = cfg.clock_drift_bound
+        skew_log: List[str] = []
+        refused_stale = 0
+        stale_served = 0
+        leader_kills = 0
+        session_cid = 900
+        session_floor = [0]
+
+        def session_read(key: bytes) -> None:
+            """One session-consistent read: serve from applied state
+            gated on the client's commit-index token, no leader
+            contact (the single-engine twin of Router.read_session)."""
+            rec = run.history.invoke(
+                session_cid, READ, key, None, run.now()
+            )
+            rec.read_class = "session"
+            rec.ryw_floor = session_floor[0]
+            idx = int(e.applied_index)
+            if idx < session_floor[0]:
+                # the apply stream lags the token (ReadLagging's
+                # single-engine analogue): typed refusal, no effect
+                rec.fail(run.history.stamp(run.now()))
+                return
+            value = run._value_at(key, idx)
+            rec.serve_index = idx
+            session_floor[0] = max(session_floor[0], idx)
+            run._audit_read(session_cid, key, value)
+            rec.ok(run.history.stamp(run.now()), value)
+            e._note_read_served("session", 0.0)
+
+        def drive(seconds: float) -> None:
+            t_end = run.now() + seconds
+            i = 0
+            while run.now() < t_end:
+                run._invoke_idle()
+                run.drive(2 * cfg.heartbeat_period)
+                run._poll_all()
+                session_read(run.keys[i % len(run.keys)])
+                i += 1
+
+        # ---- phase 1: leases under traffic --------------------------
+        drive(60.0)
+        blackbox.mark("reads_warmup",
+                      classes=dict(e.read_class_counts))
+        # ---- phase 2: skew churn across the drift band --------------
+        for rate in (1.0 / drift, drift, 1.0):
+            lead = e.leader_id
+            if lead is not None:
+                e.set_lease_rate(lead, rate)
+                skew_log.append(f"t={run.now():.1f} "
+                                f"skew(Server{lead}, {rate:.3f})")
+            drive(30.0)
+        # ---- phase 3: leader kill; lease must resume ----------------
+        lead = (e.leader_id if e.leader_id is not None
+                else e.run_until_leader())
+        e.fail(lead)
+        leader_kills += 1
+        skew_log.append(f"t={run.now():.1f} kill(Server{lead})")
+        e.run_until_leader()
+        e.recover(lead)
+        drive(45.0)
+        # ---- phase 4: the stale probe (falsifiability core) ---------
+        lead = (e.leader_id if e.leader_id is not None
+                else e.run_until_leader())
+        slow_rate = 1.0 / drift        # slowest clock INSIDE the band
+        e.set_lease_rate(lead, slow_rate)
+        skew_log.append(f"t={run.now():.1f} "
+                        f"skew(Server{lead}, {slow_rate:.3f})")
+        probe_key = run.keys[0]
+        w_old = b"stale-old"
+        rec = run.history.invoke(901, WRITE, probe_key, w_old, run.now())
+        s1 = run.kv.set(probe_key, w_old)
+        e.run_until_committed(s1)
+        rec.ok(run.history.stamp(run.now()))
+        others = [p for p in range(cfg.rows)
+                  if e.member[p] and p != lead]
+        e.partition([[lead], others])
+        run.partitioned = True
+        blackbox.mark("stale_probe_partition", leader=lead,
+                      t_virtual=round(run.now(), 3))
+        # §9.6 stickiness must elapse before any rival can be elected —
+        # which is exactly why a correct lease (duration f0/drift on a
+        # clock no slower than 1/drift) has expired by then
+        e.run_for(cfg.follower_timeout[0] + 0.5)
+        for cand in others:
+            e.force_campaign(cand)
+            if e.leader_id == cand:
+                break
+        assert e.leader_id in others, \
+            "stale-probe majority election did not land"
+        w_new = b"stale-new"
+        rec = run.history.invoke(901, WRITE, probe_key, w_new, run.now())
+        s2 = run.kv.set(probe_key, w_new)
+        e.run_until_committed(s2, limit=120.0)
+        rec.ok(run.history.stamp(run.now()))
+        # the probe CLIENT first observes the new value through the new
+        # leader (arming the auditor's monotone watermark), then probes
+        # the old one
+        probe_cid = 902
+        rec = run.history.invoke(probe_cid, READ, probe_key, None,
+                                 run.now())
+        tk = e.submit_read()
+        rec.read_class = e.read_ticket_class(tk)
+        idx = None
+        for _ in range(200):
+            idx = e.read_confirmed(tk)
+            if idx is not None:
+                break
+            e.step_event()
+        assert idx is not None and run.kv.last_applied >= idx
+        fresh = run.kv.get(probe_key)
+        run._audit_read(probe_cid, probe_key, fresh)
+        rec.ok(run.history.stamp(run.now()), fresh)
+        # ---- the probe itself ---------------------------------------
+        from raft_tpu.raft.engine import LinearizableReadRefused
+
+        rec = run.history.invoke(probe_cid, READ, probe_key, None,
+                                 run.now())
+        try:
+            tk = e.submit_read(r=lead)
+        except LinearizableReadRefused:
+            # the CORRECT plane lands here: its lease expired before
+            # the rival could be elected, and the classic fallback's
+            # quorum check refuses from the minority side
+            refused_stale += 1
+            rec.fail(run.history.stamp(run.now()))
+        else:
+            cls = e.read_ticket_class(tk)
+            rec.read_class = cls
+            pidx = e.read_confirmed(tk)
+            assert pidx is not None, \
+                "old-leader ticket neither served nor refused"
+            value = run._value_at(probe_key, pidx)
+            if cls == "lease" and value != fresh:
+                stale_served += 1
+                skew_log.append(
+                    f"t={run.now():.1f} STALE lease serve at idx "
+                    f"{pidx}: {value!r} (fresh {fresh!r})"
+                )
+            run._audit_read(probe_cid, probe_key, value)
+            rec.ok(run.history.stamp(run.now()), value)
+        blackbox.mark("stale_probe_done", refused=refused_stale,
+                      served_stale=stale_served)
+        e.heal_partition()
+        run.partitioned = False
+        e.set_lease_rate(lead, 1.0)    # un-skew the probed row
+        drive(30.0)
+        run.quiesce()
+        run.history.close()
+        blackbox.mark("check_history", ops=len(run.history))
+        per_class = check_read_classes(
+            run.history, step_budget=step_budget
+        )
+        blackbox.mark("check_done", verdicts={
+            c: r.verdict for c, r in per_class.items()
+        })
+    repro = (
+        f"python -m raft_tpu.chaos --reads --seed {seed}"
+        + (f" --broken {broken}" if broken else "")
+    )
+    worst = next(
+        (r for r in per_class.values() if r.verdict != LINEARIZABLE),
+        CheckResult(LINEARIZABLE, 0),
+    )
+    expected = VIOLATION if broken else LINEARIZABLE
+    bundle_path = _maybe_bundle(
+        "reads", run, worst, expected, repro, skew_log, bundle_dir,
+        extra={"refused_stale": refused_stale,
+               "stale_served": stale_served,
+               "classes": dict(e.read_class_counts)},
+    )
+    aud = (run.obs.audit.total_violations
+           if run.obs is not None and run.obs.audit is not None
+           else None)
+    counts = e.read_class_counts
+    return ReadsReport(
+        seed=seed, per_class=per_class, ops=len(run.history),
+        op_counts=run.history.counts(),
+        lease_serves=counts.get("lease", 0),
+        read_index_serves=counts.get("read_index", 0),
+        session_serves=counts.get("session", 0),
+        refused_stale=refused_stale, stale_served=stale_served,
+        leader_kills=leader_kills, skew_log=skew_log,
+        audit_violations=aud, repro=repro, broken=broken,
+        bundle_path=bundle_path, obs=run.obs,
     )

@@ -186,6 +186,32 @@ class RaftConfig:
     catchup_chunk_entries: Optional[int] = None
     catchup_max_chunks_per_tick: int = 4
 
+    # --- read scale-out (raft.lease / multi.router; docs/READS.md) ---
+    # read_lease: leader leases (dissertation §6.4.1). Every successful
+    #   quorum round doubles as a lease grant; while the lease is valid
+    #   (bounded by follower_timeout[0] / clock_drift_bound on the
+    #   leader's OWN clock) linearizable reads serve locally with ZERO
+    #   replication rounds, falling back to classic ReadIndex when the
+    #   lease is stale. REQUIRES prevote: the safety argument rests on
+    #   §9.6 leader stickiness (no voter grants a rival within the
+    #   minimum election timeout of hearing the leader — raft.lease has
+    #   the full argument). Off by default: the legacy read path is
+    #   byte-identical with the plane off.
+    read_lease: bool = False
+    # Assumed worst-case clock-RATE error between any replica's clock
+    # and true time. The lease duration divides by it, so any actual
+    # skew inside [1/bound, bound] is provably absorbed; the chaos
+    # clock-skew nemesis drives exactly that band, and the
+    # broken="lease_skew" variant (which ignores the bound) is what a
+    # stale read looks like when a deployment lies about its clocks.
+    clock_drift_bound: float = 2.0
+    # Follower/session read staleness bound (entries): a replica whose
+    # replication cursor lags the leader-confirmed read index by more
+    # than this is skipped for follower-served reads (typed
+    # ``ReadLagging`` refusal, never a silent redial loop). None =
+    # 2 * batch_size (one in-flight window of slack).
+    session_max_lag: Optional[int] = None
+
     # --- K-tick steady-state fusion (ROADMAP item 2) ---
     # Ticks per fused launch: when > 1, the engine fuses runs of
     # consecutive steady-state leader ticks — heartbeat emission,
@@ -305,6 +331,19 @@ class RaftConfig:
             raise ValueError("catchup_chunk_entries must be >= 1 (or None)")
         if self.catchup_max_chunks_per_tick < 1:
             raise ValueError("catchup_max_chunks_per_tick must be >= 1")
+        if self.clock_drift_bound < 1.0:
+            raise ValueError("clock_drift_bound must be >= 1.0")
+        if self.read_lease and not self.prevote:
+            # the lease safety argument IS §9.6 leader stickiness: a
+            # voter that heard the leader within the minimum election
+            # timeout refuses rival (pre-)votes, so no rival can exist
+            # inside a drift-bounded lease. Without prevote a disruptive
+            # candidacy could depose mid-lease and a local serve would
+            # be a stale read — refuse the configuration loudly.
+            raise ValueError("read_lease requires prevote=True "
+                             "(leases rest on §9.6 leader stickiness)")
+        if self.session_max_lag is not None and self.session_max_lag < 1:
+            raise ValueError("session_max_lag must be >= 1 (or None)")
         if self.shard_bytes % 4:
             # device payload storage is packed as int32 lanes (core.state
             # layout); each replica's per-entry bytes must fill whole words
@@ -340,6 +379,18 @@ class RaftConfig:
     @property
     def ec_enabled(self) -> bool:
         return self.rs_k is not None
+
+    @property
+    def session_lag(self) -> int:
+        """Resolved follower/session staleness bound (entries)."""
+        return (self.session_max_lag if self.session_max_lag is not None
+                else 2 * self.batch_size)
+
+    @property
+    def lease_duration_s(self) -> float:
+        """Local-clock lease validity window: the §9.6 stickiness
+        window divided by the assumed worst-case clock-rate error."""
+        return self.follower_timeout[0] / self.clock_drift_bound
 
     @property
     def shard_bytes(self) -> int:

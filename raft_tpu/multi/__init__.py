@@ -9,16 +9,19 @@ from raft_tpu.multi.engine import (
     GROUP_AXIS_TRANSPORTS,
     MultiEngine,
     NotLeader,
+    ReadLagging,
     UnsupportedGroupTransport,
     UnsupportedMembership,
 )
 from raft_tpu.multi.rebalancer import Rebalancer
-from raft_tpu.multi.router import Router
+from raft_tpu.multi.router import ReadSession, Router
 
 __all__ = [
     "GROUP_AXIS_TRANSPORTS",
     "MultiEngine",
     "NotLeader",
+    "ReadLagging",
+    "ReadSession",
     "Rebalancer",
     "Router",
     "UnsupportedGroupTransport",

@@ -95,6 +95,30 @@ class NotLeader(Exception):
         self.partial: Optional[list] = None
 
 
+class ReadLagging(Exception):
+    """A follower/session read could not be served within the staleness
+    bound (docs/READS.md): the chosen replica's replication cursor (or
+    the group's apply cursor, for session reads) has not passed the
+    required index. A TYPED refusal, not a silent redial loop — the
+    caller decides whether to pick another replica, fall back to the
+    leader, or surface the refusal. ``replica`` is None for session
+    reads (the apply stream itself lags); ``lag`` is entries short;
+    ``retry_after_s`` hints one replication round."""
+
+    def __init__(self, group: int, replica: Optional[int], lag: int,
+                 retry_after_s: float = 0.0):
+        which = ("apply stream" if replica is None
+                 else f"replica {replica}")
+        super().__init__(
+            f"group {group}: {which} lags the required read index by "
+            f"{lag} entries"
+        )
+        self.group = group
+        self.replica = replica
+        self.lag = lag
+        self.retry_after_s = retry_after_s
+
+
 class UnsupportedMembership(ValueError):
     """MultiEngine runs FIXED membership only: live reconfiguration
     (``max_replicas`` headroom, learners, ``add_server``/``replace``) is
@@ -411,6 +435,35 @@ class MultiEngine:
         ]
         self.applied_index = np.zeros(n_groups, np.int64)
 
+        # ---- read scale-out plane (docs/READS.md; off by default) ----
+        self.lease = None
+        if cfg.read_lease:
+            from raft_tpu.raft.lease import LeaseTable
+
+            # Per-(group, leader-row) leases keyed (g, r). NOTE: the
+            # multi engine has no PreVote implementation, so its lease
+            # plane assumes no disruptive candidacies inside the
+            # stickiness window — the chaos multi runner never arms
+            # read_lease; the Router/bench consumers drive elections
+            # only through seed_leaders/rebalance (which this engine
+            # gates on §5.4.1 up-to-dateness, not injected storms).
+            self.lease = LeaseTable(
+                cfg.follower_timeout[0], cfg.clock_drift_bound
+            )
+        self._row_commit = np.zeros((n_groups, R), np.int64)
+        self._lease_ok_term = np.full((n_groups, R), -1, np.int64)
+        self._match_host = np.zeros((n_groups, R), np.int64)
+        #   per-row verified-match mirror for follower-read staleness
+        #   decisions; maintained ONLY when the read plane is armed
+        #   (the extra per-round host fetch must cost nothing on the
+        #   default path — the zero-extra-syncs pins ride that)
+        self._track_match = (
+            cfg.read_lease or cfg.session_max_lag is not None
+        )
+        self.read_class_counts: List[Dict[str, int]] = [
+            {} for _ in range(n_groups)
+        ]
+
         self._q: List[Tuple[float, int, str, int, int]] = []
         #   (t, tiebreak, kind, group, replica)
         self._seq_events = 0
@@ -639,8 +692,137 @@ class MultiEngine:
             raise NotLeader(g, f"group {g} leader deposed during confirmation")
         self.terms[g][eff] = np.maximum(self.terms[g][eff], term)
         self._advance_commit(g, r, int(commits[g]))
+        self._lease_renew(g, r, term, eff, int(max_terms[g]))
+        if self._track_match:
+            # the confirmation round carries every row's verified match
+            # — feed the follower-read staleness mirror here too, so a
+            # pure-read workload (no leader ticks between reads) still
+            # warms the replica spread
+            self._match_host[g] = np.asarray(
+                self._last_info.match
+            )[self._slot[g]]
         self._reset_heard_timers(g, r)
         return read_idx
+
+    # -------------------------------------------------- read scale-out
+    def _lease_renew(self, g: int, r: int, term: int, eff,
+                     max_term: int) -> None:
+        """A quorum round sourced at (g, r) completed: renew the lease
+        when the round reached a replica majority and surfaced no
+        higher term (raft.lease has the safety argument). Guarded
+        no-op with the plane off."""
+        if self.lease is None or max_term > term:
+            return
+        if int(eff.sum()) <= self.cfg.n_replicas // 2:
+            return
+        self.lease.grant((g, r), term, self.clock.now)
+
+    def lease_read_index(self, g: int) -> Optional[int]:
+        """Zero-round local read index for group ``g``'s routed leader,
+        or None when the lease cannot serve (plane off, stale lease,
+        higher term seen, no current-term commit yet)."""
+        if self.lease is None:
+            return None
+        r = self.leader_id[g]
+        if r is None or self.roles[g][r] != LEADER or not self.alive[g, r]:
+            return None
+        term = int(self.lead_terms[g, r])
+        if int(self.terms[g, r]) > term:
+            return None
+        if int(self._lease_ok_term[g, r]) != term:
+            return None
+        if not self.lease.valid((g, r), term, self.clock.now):
+            return None
+        return int(self._row_commit[g, r])
+
+    def certified_read_index(self, g: int) -> Tuple[int, str]:
+        """Leader-certified read index for group ``g``: the lease fast
+        path (zero rounds) when valid, else one classic ReadIndex
+        quorum round. Returns ``(index, certification)`` with
+        certification ``"lease"`` or ``"read_index"``; raises
+        ``NotLeader`` exactly like ``read_index``."""
+        idx = self.lease_read_index(g)
+        if idx is not None:
+            return idx, "lease"
+        return self.read_index(g), "read_index"
+
+    def follower_read_index(self, g: int, r: int) -> Tuple[int, str]:
+        """Follower-served ReadIndex (dissertation §6.4 follower
+        reads): the LEADER certifies the read index — lease fast path
+        or one quorum round, once per call, never per follower — and
+        follower ``r`` may serve at it only when its verified
+        replication cursor has passed the index (``ReadLagging``
+        otherwise, with the lag). The served class is ``"follower"``
+        unless ``r`` IS the certifying leader (then the certification
+        class passes through). Read throughput becomes O(replicas):
+        every live caught-up row is a serve target while the leader
+        pays at most one certification round per call (zero under a
+        valid lease)."""
+        idx, cert = self.certified_read_index(g)
+        lead = self.leader_id[g]
+        if r == lead:
+            return idx, cert
+        if not self.alive[g, r]:
+            raise ReadLagging(g, r, lag=idx,
+                              retry_after_s=self.cfg.heartbeat_period)
+        match = int(self._match_host[g, r])
+        if match < idx:
+            raise ReadLagging(g, r, lag=idx - match,
+                              retry_after_s=self.cfg.heartbeat_period)
+        return idx, "follower"
+
+    def session_read_index(self, g: int, floor: int) -> int:
+        """Session-consistent read index: serve from the group's
+        APPLIED state with NO leader contact at all, provided the apply
+        cursor has passed the client's session token ``floor`` (the
+        commit-index watermark the client last observed — monotone
+        reads / read-your-writes, docs/READS.md). ``ReadLagging`` with
+        ``replica=None`` when the apply stream itself lags the token."""
+        idx = int(self.applied_index[g])
+        if idx < floor:
+            raise ReadLagging(g, None, lag=floor - idx,
+                              retry_after_s=self.cfg.heartbeat_period)
+        return idx
+
+    def replica_lag(self, g: int, r: int, idx: int) -> int:
+        """Entries replica ``(g, r)``'s verified replication cursor
+        lags ``idx`` (0 = the row may serve a read certified at
+        ``idx``). The certifying leader never lags its own
+        certification; a dead row lags by the whole index.
+
+        The match mirror is maintained lazily: a config that never
+        armed the read plane (``read_lease`` / ``session_max_lag``
+        both unset) pays no per-round fetch until the FIRST follower
+        read asks — from that round on the mirror updates (one extra
+        host fetch per round), and until it warms, non-leader rows
+        conservatively read as lagging (serves fall back to the
+        leader rather than trusting a zero)."""
+        if r == self.leader_id[g]:
+            return 0
+        if not self._track_match:
+            self._track_match = True
+        if not self.alive[g, r]:
+            return idx
+        return max(0, idx - int(self._match_host[g, r]))
+
+    def note_read_class(self, g: int, cls: str) -> None:
+        """One read SERVED on group ``g`` under ``cls``: host counter,
+        ``raft_reads_total{class,group}``, per-class SLO digest. The
+        serving layer (Router) calls this once per served read —
+        certification alone is not a serve."""
+        cc = self.read_class_counts[g]
+        cc[cls] = cc.get(cls, 0) + 1
+        self._metric_inc(g, "raft_reads_total", "reads served by class",
+                         **{"class": cls})
+        if self.slo is not None:
+            self.slo.observe(f"read_{cls}", 0.0, self.clock.now, group=g)
+
+    def set_lease_rate(self, g: int, r: int, rate: float) -> None:
+        """Clock-skew injection surface: (g, r)'s lease clock runs at
+        ``rate`` local seconds per true second. No-op without the
+        lease plane."""
+        if self.lease is not None:
+            self.lease.set_rate((g, r), rate)
 
     # ------------------------------------------------- leadership placement
     def seed_leaders(self) -> None:
@@ -841,6 +1023,8 @@ class MultiEngine:
         if self.leader_id[g] == r:
             self.leader_id[g] = None
         self.roles[g][r] = FOLLOWER
+        if self.lease is not None:
+            self.lease.break_((g, r))
         self.nodelog(g, r, "killed")
 
     def recover(self, g: int, r: int) -> None:
@@ -996,6 +1180,22 @@ class MultiEngine:
             },
             "migrations": self.migrations,
         }
+        if self.lease is not None or any(self.read_class_counts):
+            by_class: Dict[str, int] = {}
+            for cc in self.read_class_counts:
+                for cls, cnt in cc.items():
+                    by_class[cls] = by_class.get(cls, 0) + cnt
+            reads: dict = {"by_class": by_class}
+            if self.lease is not None:
+                reads["lease"] = {
+                    "grants": self.lease.grants,
+                    "duration_s": self.lease.effective_duration_s,
+                    "valid_groups": sum(
+                        1 for g in range(self.G)
+                        if self.lease_read_index(g) is not None
+                    ),
+                }
+            snap["reads"] = reads
         if self.slo is not None:
             snap["slo_alerts"] = [
                 {"slo": a.slo, "group": a.group, "severity": a.severity,
@@ -1205,6 +1405,9 @@ class MultiEngine:
         self.terms[g, r] = max_term
         if self.leader_id[g] == r:
             self.leader_id[g] = None
+        if self.lease is not None:
+            # hygiene: lease_read_index already refuses on role/term
+            self.lease.break_((g, r))
         self.nodelog(g, r, "step down to follower")
         self._arm_follower(g, r)
 
@@ -1499,6 +1702,7 @@ class MultiEngine:
                     qpos[g] += frontier
                     lasts[g] += frontier
                 self._advance_commit(g, r, int(ci[j, g]), at_last=lasts[g])
+                self._lease_renew(g, r, term, eff, int(mt[j, g]))
                 self._reset_heard_timers(g, r)
                 last_exec = escaped_now or j == n - 1
                 if last_exec:
@@ -1511,6 +1715,10 @@ class MultiEngine:
         for g, r in ticks:
             if qpos[g]:
                 self._queue[g] = self._queue[g][qpos[g]:]
+            if self._track_match and not done[g]:
+                # fused eligibility proved every row caught up; the
+                # window left them matching the leader's booked tail
+                self._match_host[g][:] = lasts[g]
 
     def _nodelog_at(self, g: int, r: int, msg: str, commit: int,
                     last: int, kind: Optional[str] = None) -> str:
@@ -1582,6 +1790,10 @@ class MultiEngine:
             return
         max_terms, commits = self._replicate_round(active)
         frontier = np.asarray(self._last_info.frontier_len)[self._slot]
+        match_all = (np.asarray(self._last_info.match)[self._slot]
+                     if self._track_match else None)
+        #   follower-read staleness mirror: one extra host fetch per
+        #   round, paid ONLY with the read plane armed (_track_match)
         lasts = None
         for g, (r, term, take, _) in active.items():
             if int(max_terms[g]) > term:
@@ -1601,6 +1813,9 @@ class MultiEngine:
                     self._uncommitted[g][idx] = (p, term)
                 self._queue[g] = self._queue[g][ingested:]
             self._advance_commit(g, r, int(commits[g]))
+            self._lease_renew(g, r, term, e, int(max_terms[g]))
+            if match_all is not None:
+                self._match_host[g] = match_all[g]
             self._reset_heard_timers(g, r)
             self._push(self.clock.now + cfg.heartbeat_period, "l", g, r)
         if overflow:
@@ -1635,9 +1850,21 @@ class MultiEngine:
         (``_nodelog_at`` — no device fetch mid-booking) instead of
         fetching state; everything else is identical by construction
         (one body, not two copies)."""
+        if commit > self._row_commit[g, leader]:
+            # the leader's OWN commit view (lease reads serve at this,
+            # never the global watermark — see RaftEngine._row_commit)
+            self._row_commit[g, leader] = commit
         wm = int(self.commit_watermark[g])
         if commit <= wm:
             return
+        if (self.roles[g][leader] == LEADER
+                and int(self.terms[g, leader])
+                == int(self.lead_terms[g, leader])):
+            # §6.4 fresh-leader gate: a watermark advance riding the
+            # leader's own round committed a current-term entry
+            self._lease_ok_term[g, leader] = int(
+                self.lead_terms[g, leader]
+            )
         self.committed_total[g] += commit - wm
         for idx in range(wm + 1, commit + 1):
             seq = self._seq_at_index[g].get(idx)

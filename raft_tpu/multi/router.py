@@ -37,7 +37,30 @@ from raft_tpu.admission import (
     Overloaded,
     RetryBudget,
 )
-from raft_tpu.multi.engine import MultiEngine, NotLeader
+from raft_tpu.multi.engine import MultiEngine, NotLeader, ReadLagging
+
+
+class ReadSession:
+    """Client-side session token: per-group commit-index floors
+    (docs/READS.md). Carried by a client across requests, it buys
+    MONOTONE READS and READ-YOUR-WRITES from any sufficiently
+    caught-up replica with zero leader contact: a serve below the
+    floor is refused (``ReadLagging``), a serve at/above it raises the
+    floor. The token is just integers — serializable, shardable, and
+    exactly the per-(client, key) watermark bookkeeping the online
+    auditor (``obs.audit``) maintains server-side to falsify it."""
+
+    def __init__(self) -> None:
+        self.floor: Dict[int, int] = {}
+
+    def observe(self, group: int, index: int) -> None:
+        """The client observed state at ``index`` (a served read, or a
+        write it saw acknowledged durable): the floor only rises."""
+        if index > self.floor.get(group, 0):
+            self.floor[group] = index
+
+    def to_jsonable(self) -> dict:
+        return {str(g): int(i) for g, i in self.floor.items()}
 
 
 class Router:
@@ -93,6 +116,9 @@ class Router:
             for g in range(engine.G)
         ]
         self._breaker_states = ["closed"] * engine.G
+        self._rr: Dict[int, int] = {}
+        #   per-group round-robin cursor for read_any's serve-target
+        #   spread (host-only state; reads are stateless server-side)
 
     def _breaker_transition(self, g: int):
         """Breaker open/half_open/close transitions into the engine's
@@ -317,3 +343,140 @@ class Router:
                 g, lambda g=g: self.engine.read_index(g)
             )
         return [(g, per_group[g]) for g in groups]
+
+    # ------------------------------------------------ read scale-out
+    def _read_breaker_gate(self, g: int) -> None:
+        """Reads honor the same per-group breaker the write discipline
+        trips: a group refusing repeatedly fast-fails its reads too
+        instead of piling load onto a struggling leader."""
+        if not self.drive:
+            return
+        breaker = self.breakers[g]
+        if not breaker.allow(self.engine.clock.now):
+            sp = self.spans.current if self.spans is not None else None
+            if sp is not None:
+                sp.refusal_reasons.append("circuit_open")
+                sp.annotate("circuit_open", self.engine.clock.now,
+                            group=g)
+            raise CircuitOpen(
+                breaker.retry_after(self.engine.clock.now), g
+            )
+
+    def read_any(
+        self, key: bytes, replica: Optional[int] = None,
+    ) -> Tuple[int, int, int, str]:
+        """Linearizable read spread across the key's group replicas:
+        the LEADER certifies the read index once — zero rounds under a
+        valid lease, one quorum round otherwise — and the serve target
+        round-robins over the group's live, caught-up rows, turning
+        read throughput from O(leaders) into O(replicas)
+        (docs/READS.md). Returns ``(group, replica, index, class)``;
+        the value must be served from state applied to >= index.
+
+        Staleness discipline: a row whose verified replication cursor
+        lags the certified index beyond ``cfg.session_lag`` is SKIPPED;
+        rows inside the bound but not yet at the index are skipped too
+        (they cannot serve AT the index). When no row qualifies — the
+        certifying leader always does, so this means leadership moved
+        mid-call — the smallest-lag ``ReadLagging`` surfaces, typed,
+        instead of a silent redial loop. ``replica`` pins the serve
+        target: its ``ReadLagging`` propagates to the caller verbatim
+        (the tested refusal path alongside NotLeader / CircuitOpen)."""
+        g = self.group_of(key)
+        eng = self.engine
+        self._read_breaker_gate(g)
+        # certify ONCE per call — the rounds it cost (0 under a valid
+        # lease, 1 classic) is the whole read's replication cost, and
+        # the span records exactly that
+        idx, cert = self._with_leader(
+            g, lambda: eng.certified_read_index(g)
+        )
+        rounds = 0 if cert == "lease" else 1
+        lead = eng.leader_id[g]
+        if replica is not None:
+            # pinned serve target: its staleness refusal surfaces
+            # verbatim (typed, never a silent redial loop)
+            if replica == lead:
+                cls = cert
+            else:
+                lag = (idx if not eng.alive[g, replica]
+                       else eng.replica_lag(g, replica, idx))
+                if lag > 0:
+                    raise ReadLagging(
+                        g, replica, lag,
+                        retry_after_s=eng.cfg.heartbeat_period,
+                    )
+                cls = "follower"
+            eng.note_read_class(g, cls)
+            self._note_read_span(g, idx, cls, rounds)
+            return g, replica, idx, cls
+        n = eng.cfg.n_replicas
+        max_lag = eng.cfg.session_lag
+        start = self._rr.get(g, 0)
+        self._rr[g] = (start + 1) % n
+        best: Optional[ReadLagging] = None
+        for k in range(n):
+            r = (start + k) % n
+            if not eng.alive[g, r]:
+                continue
+            lag = eng.replica_lag(g, r, idx)
+            if lag == 0:
+                cls = cert if r == lead else "follower"
+                eng.note_read_class(g, cls)
+                self._note_read_span(g, idx, cls, rounds)
+                return g, r, idx, cls
+            if lag <= max_lag and (best is None or lag < best.lag):
+                best = ReadLagging(
+                    g, r, lag, retry_after_s=eng.cfg.heartbeat_period
+                )
+        if best is not None:
+            raise best
+        # not even the certifying leader qualified: leadership moved
+        # between certification and the serve scan — a NotLeader redial
+        # situation, not a staleness one (ReadLagging's replica=None
+        # form is reserved for session apply-stream lag)
+        raise NotLeader(
+            g, f"group {g}: leadership moved mid-read (no replica "
+               f"qualifies for certified index {idx})"
+        )
+
+    def read_session(
+        self, key: bytes, session: ReadSession,
+    ) -> Tuple[int, int]:
+        """Session-consistent read: serve the key's group from APPLIED
+        state with NO leader contact at all, gated only on the group's
+        apply cursor having passed the client's session floor (monotone
+        reads / read-your-writes — docs/READS.md read-class matrix).
+        Returns ``(group, index)`` and raises the session floor to the
+        served index; ``ReadLagging`` (``replica=None``) when the apply
+        stream lags the token."""
+        g = self.group_of(key)
+        eng = self.engine
+        self._read_breaker_gate(g)
+        idx = eng.session_read_index(g, session.floor.get(g, 0))
+        session.observe(g, idx)
+        eng.note_read_class(g, "session")
+        self._note_read_span(g, idx, "session", rounds=0)
+        return g, idx
+
+    def note_write_observed(
+        self, session: ReadSession, group: int,
+    ) -> None:
+        """Fold a durably-acknowledged write into the session token:
+        the group's commit watermark at observation time bounds the
+        write's index from above, so a floor at the watermark buys
+        read-your-writes for it."""
+        session.observe(group, int(self.engine.commit_watermark[group]))
+
+    def _note_read_span(self, g: int, idx: int, cls: str,
+                        rounds: int) -> None:
+        """``rounds`` is the replication rounds THIS read actually
+        paid end to end: 0 for lease/session serves and for follower
+        serves certified by a valid lease, 1 when certification ran a
+        classic ReadIndex round."""
+        if self.spans is None or self.spans.current is None:
+            return
+        self.spans.note_read_served(
+            cls, self.engine.clock.now, index=idx, rounds=rounds,
+            group=g,
+        )

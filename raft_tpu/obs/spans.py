@@ -57,6 +57,13 @@ class Span:
     redials: int = 0                 # leadership redials (router)
     queue_delay_s: Optional[float] = None     # submit -> ingest
     replication_rounds: Optional[int] = None  # ingest -> commit, in ticks
+    #   for reads, the rounds the serve paid END TO END: 0 = fully
+    #   local (lease serve, session serve, follower serve certified by
+    #   a valid lease), 1 = a dedicated ReadIndex confirmation round
+    read_class: Optional[str] = None
+    #   served read class (docs/READS.md matrix): "lease" |
+    #   "read_index" | "follower" | "session"; None for writes and
+    #   never-served reads
     refusal_reasons: List[str] = dataclasses.field(default_factory=list)
     annotations: List[Tuple[float, str, Dict[str, Any]]] = \
         dataclasses.field(default_factory=list)
@@ -176,10 +183,34 @@ class SpanTracker:
         sp.annotate("ticket", t, ticket=ticket)
         self._by_ticket[ticket] = sp
 
-    def note_read_confirmed(self, ticket: int, idx: int, t: float) -> None:
+    def note_read_confirmed(self, ticket: int, idx: int, t: float,
+                            cls: Optional[str] = None,
+                            rounds: Optional[int] = None) -> None:
         sp = self._by_ticket.pop(ticket, None)
         if sp is not None:
-            sp.annotate("confirmed", t, read_index=idx)
+            if cls is not None:
+                sp.read_class = cls
+            if rounds is not None:
+                sp.replication_rounds = rounds
+            sp.annotate("confirmed", t, read_index=idx, read_class=cls)
+
+    def note_read_served(self, cls: str, t: float,
+                         index: Optional[int] = None,
+                         rounds: Optional[int] = None,
+                         group: Optional[int] = None) -> None:
+        """The current span's read was SERVED under class ``cls``
+        (docs/READS.md): stamps the class and the replication rounds
+        the read paid end to end — ``rounds=0`` is the span-verified
+        zero-round contract (lease and session serves always; follower
+        serves when their certification rode a valid lease)."""
+        sp = self.current
+        if sp is None:
+            return
+        sp.read_class = cls
+        if rounds is not None:
+            sp.replication_rounds = rounds
+        sp.annotate("served", t, read_class=cls, index=index,
+                    rounds=rounds, group=group)
 
     def note_read_refused(self, ticket: Optional[int], reason: str,
                           t: float) -> None:
@@ -233,6 +264,7 @@ class SpanTracker:
                     "redials": sp.redials,
                     "queue_delay_s": sp.queue_delay_s,
                     "replication_rounds": sp.replication_rounds,
+                    "read_class": sp.read_class,
                     "refusals": sp.refusal_reasons,
                 },
             })

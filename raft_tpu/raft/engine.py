@@ -471,6 +471,34 @@ class RaftEngine:
             from raft_tpu.raft.steady import FusedDriver
 
             self._fused_driver = FusedDriver(self)
+        self.lease = None
+        if cfg.read_lease:
+            from raft_tpu.raft.lease import LeaseTable
+
+            # Leader leases (raft.lease; docs/READS.md): every quorum
+            # round grants, and a valid lease serves linearizable reads
+            # locally with zero replication rounds. VOLATILE by design:
+            # a restored engine starts with no grants.
+            self.lease = LeaseTable(
+                cfg.follower_timeout[0], cfg.clock_drift_bound
+            )
+        self._row_commit = np.zeros(n, np.int64)
+        #   Per-row mirror of the commit index each row's OWN rounds
+        #   last reported — a stale split-brain leader's entry freezes
+        #   at partition time while the global commit_watermark follows
+        #   the majority. Lease reads serve at THIS index (the leader's
+        #   local knowledge), which is exactly what makes the clock-skew
+        #   falsifiability story honest: a broken lease serves a frozen
+        #   index as if it were fresh.
+        self._lease_ok_term = np.full(n, -1, np.int64)
+        #   §6.4's "leader must have committed an entry in its term"
+        #   gate: lease serves only once a watermark advance rode one of
+        #   r's own rounds in its current lead term (Leader Completeness
+        #   then puts every previously-acked write below _row_commit[r]).
+        self.read_class_counts: Dict[str, int] = {}
+        #   served reads by class (lease / read_index / ...): the
+        #   /status ``reads`` section and the raft_reads_total{class}
+        #   counter's host-side twin (always maintained — plain ints).
         self.admission = AdmissionGate.from_config(cfg, self.clock)
         #   Bounded admission (raft_tpu.admission; None = legacy
         #   unbounded): submit/submit_read arrivals pass the gate before
@@ -886,6 +914,10 @@ class RaftEngine:
         self._persist_votes()   # adopt the term durably before acting on it
         if self.leader_id == r:
             self.leader_id = None
+        if self.lease is not None:
+            # hygiene, not load-bearing: lease_read_index already
+            # refuses on the role/term checks this step-down just broke
+            self.lease.break_(r)
         self.nodelog(r, "step down to follower")
         self._metric_inc("raft_term_adoptions_total")
         self._arm_follower(r)
@@ -1296,18 +1328,28 @@ class RaftEngine:
                 raise
         if r is None:
             r = self.leader_id
+        lease_idx = None
         try:
             if r is None or self.roles[r] != LEADER or not self.alive[r]:
                 raise LinearizableReadRefused("not a live leader")
             if int(self.terms[r]) > int(self.lead_terms[r]):
                 self._step_down_leader(r, int(self.terms[r]))
                 raise LinearizableReadRefused("deposed (higher term seen)")
-            voters = self._voter_reach(r)
-            if int(voters.sum()) <= int(self.member.sum()) // 2:
-                raise LinearizableReadRefused(
-                    f"quorum unreachable ({int(voters.sum())} of "
-                    f"{int(self.member.sum())} members)"
-                )
+            # lease fast path BEFORE the reach check: the lease's whole
+            # point is serving with no knowledge of the cluster beyond
+            # the drift-bounded clock — a real lease-holding leader does
+            # not know it is partitioned, and the simulation must not
+            # leak the fault masks into a path a deployment could not
+            # consult (the quorum check below is the CLASSIC path's
+            # simulation-framing shortcut; see read_linearizable)
+            lease_idx = self.lease_read_index(r)
+            if lease_idx is None:
+                voters = self._voter_reach(r)
+                if int(voters.sum()) <= int(self.member.sum()) // 2:
+                    raise LinearizableReadRefused(
+                        f"quorum unreachable ({int(voters.sum())} of "
+                        f"{int(self.member.sum())} members)"
+                    )
         except LinearizableReadRefused as ex:
             if self.spans is not None:
                 self.spans.note_read_refused(None, str(ex), self.clock.now)
@@ -1315,10 +1357,22 @@ class RaftEngine:
         tk = self._next_read_ticket
         self._next_read_ticket += 1
         bind = (r, int(self.lead_terms[r]))
-        self._reads[tk] = [
-            r, self.commit_watermark, bind[1], "pending", self.clock.now,
-        ]
-        self._read_buckets.setdefault(bind, set()).add(tk)
+        if lease_idx is not None:
+            # zero-round lease serve (docs/READS.md): the ticket is
+            # minted already confirmed at r's OWN commit view — a pure
+            # host receipt; no replication round will ever touch it, so
+            # it joins no (row, term) confirmation bucket. The poll
+            # contract is unchanged: read_confirmed returns the index
+            # on the very next call.
+            self._reads[tk] = [
+                r, lease_idx, bind[1], "ready", self.clock.now, "lease",
+            ]
+        else:
+            self._reads[tk] = [
+                r, self.commit_watermark, bind[1], "pending",
+                self.clock.now, "read_index",
+            ]
+            self._read_buckets.setdefault(bind, set()).add(tk)
         n_evict = len(self._reads) - self.READ_TICKET_CAP
         if n_evict > 0:
             # abandoned-ticket bound: tickets are poll-once, so a client
@@ -1337,6 +1391,17 @@ class RaftEngine:
         if self.spans is not None:
             self.spans.note_read_ticket(tk, self.clock.now)
         return tk
+
+    def read_ticket_class(self, ticket: int) -> Optional[str]:
+        """Served class of an outstanding ticket ("lease" for a
+        zero-round local serve, "read_index" otherwise); None once the
+        ticket was consumed/evicted. Lets a caller that must serve a
+        lease read from the LEADER'S OWN applied view (not the global
+        state) tell the two apart — the chaos harness's honesty hook."""
+        rec = self._reads.get(ticket)
+        if rec is None:
+            return None
+        return rec[5] if len(rec) > 5 else "read_index"
 
     def _drop_read_ticket(self, ticket: int) -> None:
         """Remove a ticket from the queue AND its (row, term) bucket."""
@@ -1369,15 +1434,20 @@ class RaftEngine:
             raise KeyError(f"unknown or already-consumed ticket {ticket}")
         row, idx, tterm, st = rec[:4]
         if st == "ready":
+            cls = rec[5] if len(rec) > 5 else "read_index"
             self._drop_read_ticket(ticket)
             if self.spans is not None:
-                self.spans.note_read_confirmed(ticket, idx, self.clock.now)
+                self.spans.note_read_confirmed(
+                    ticket, idx, self.clock.now, cls=cls,
+                    rounds=0 if cls == "lease" else None,
+                )
             if self.slo is not None:
                 # read latency = ticket mint -> confirmation (rec[4] is
                 # the mint time; the serve itself is applied-state local)
                 self.slo.observe(
                     "read", self.clock.now - rec[4], self.clock.now
                 )
+            self._note_read_served(cls, self.clock.now - rec[4])
             return idx
         if (self.roles[row] != LEADER or not self.alive[row]
                 or int(self.lead_terms[row]) != tterm
@@ -1393,12 +1463,73 @@ class RaftEngine:
             )
         return None
 
+    def _lease_renew(self, r: int, term: int, eff, max_term: int) -> None:
+        """A quorum round sourced at ``r`` completed: renew its leader
+        lease when the round is lease-grade evidence — it reached a
+        member MAJORITY (the same voters whose §9.6 stickiness clocks
+        this very round resets), surfaced no higher term, and no
+        membership change is in flight (the quorum-overlap argument is
+        only clean over a settled configuration). Guarded no-op with the
+        lease plane off."""
+        if self.lease is None or max_term > term:
+            return
+        if int((eff & self.member).sum()) <= int(self.member.sum()) // 2:
+            return
+        if (self._pending_config is not None or self._staged_config
+                or self._config_seqs or self.learner.any()):
+            return
+        self.lease.grant(r, term, self.clock.now)
+
+    def lease_read_index(self, r: int) -> Optional[int]:
+        """Zero-round local read index for row ``r``, or None when the
+        lease cannot serve (plane off, lease expired/absent, higher
+        term seen, membership in flight, or no current-term commit yet
+        — §6.4's fresh-leader gate). Callers have already established
+        ``r`` is a live leader. The index returned is ``r``'s OWN
+        commit view (``_row_commit``), never the global watermark."""
+        if self.lease is None:
+            return None
+        term = int(self.lead_terms[r])
+        if int(self.terms[r]) > term:
+            return None
+        if (self._pending_config is not None or self._staged_config
+                or self._config_seqs or self.learner.any()):
+            return None
+        if int(self._lease_ok_term[r]) != term:
+            return None
+        if not self.lease.valid(r, term, self.clock.now):
+            return None
+        return int(self._row_commit[r])
+
+    def set_lease_rate(self, r: int, rate: float) -> None:
+        """Clock-skew injection surface (chaos nemesis): row ``r``'s
+        lease clock runs at ``rate`` local seconds per true second.
+        No-op without the lease plane."""
+        if self.lease is not None:
+            self.lease.set_rate(r, rate)
+
+    def _note_read_served(self, cls: str, latency_s: float) -> None:
+        """One read served under class ``cls`` (lease / read_index):
+        host counter + ``raft_reads_total{class}`` + the per-class SLO
+        latency digest. Pure host arithmetic, determinism-neutral."""
+        self.read_class_counts[cls] = (
+            self.read_class_counts.get(cls, 0) + 1
+        )
+        self._metric_inc("raft_reads_total", "reads served by class",
+                         **{"class": cls})
+        if self.admission is not None:
+            self.admission.note_read_class(cls)
+        if self.slo is not None:
+            self.slo.observe(f"read_{cls}", latency_s, self.clock.now)
+
     def _confirm_reads(self, r: int, term: int, eff, max_term: int) -> None:
         """A quorum round sourced at ``r`` just completed: it confirms
         leadership for every read queued on ``r`` IN THIS TERM when it
         reached a member majority and surfaced no higher term — §6.4's
         confirmation, shared by every round flavor (write tick,
-        pipelined chunk, explicit read round).
+        pipelined chunk, explicit read round). The same evidence renews
+        ``r``'s leader lease (``_lease_renew`` — zero-round reads ride
+        every round the write path already pays for).
 
         Pending tickets are indexed by their (row, term) binding, so the
         sweep pops exactly the confirming bucket — O(confirmed), not a
@@ -1406,6 +1537,7 @@ class RaftEngine:
         in OTHER buckets need no visit: a dead binding is detected
         lazily by ``read_confirmed``'s own predicate, and total volume
         stays bounded by the FIFO eviction cap."""
+        self._lease_renew(r, term, eff, max_term)
         if not self._reads:
             return
         # quorum is counted over reachable VOTERS: the replication reach
@@ -1462,6 +1594,18 @@ class RaftEngine:
         if int(self.terms[r]) > term:
             self._step_down_leader(r, int(self.terms[r]))
             raise LinearizableReadRefused("deposed (higher term seen)")
+        lease_idx = self.lease_read_index(r)
+        if lease_idx is not None:
+            # leader-lease fast path (docs/READS.md): ZERO replication
+            # rounds, no device dispatch — the lease's drift-bounded
+            # validity IS the leadership confirmation. Falls through to
+            # the classic round below whenever the lease is stale.
+            if self.spans is not None:
+                self.spans.note_read_served(
+                    "lease", self.clock.now, index=lease_idx, rounds=0,
+                )
+            self._note_read_served("lease", 0.0)
+            return lease_idx
         read_index = self.commit_watermark
         eff = self._reach(r)
         # (b) first — it needs no device round and a minority-side leader
@@ -1487,6 +1631,11 @@ class RaftEngine:
         self._advance_commit(r, int(info.commit_index))
         self._confirm_reads(r, term, eff, max_term)  # the round is shared
         self._reset_heard_timers(r)
+        if self.spans is not None:
+            self.spans.note_read_served(
+                "read_index", self.clock.now, index=read_index, rounds=1,
+            )
+        self._note_read_served("read_index", 0.0)
         return read_index
 
     def _empty_round(self, r: int, term: int, eff) -> "RepInfo":
@@ -1866,6 +2015,8 @@ class RaftEngine:
         if self.leader_id == r:
             self.leader_id = None
         self.roles[r] = FOLLOWER
+        if self.lease is not None:
+            self.lease.break_(r)   # a dead row's grant is dead evidence
         self.nodelog(r, "killed")
 
     def recover(self, r: int) -> None:
@@ -2206,6 +2357,13 @@ class RaftEngine:
             snap["shedding"] = bool(
                 getattr(self.admission, "shedding", False)
             )
+        if self.lease is not None or self.read_class_counts:
+            reads = {"by_class": dict(self.read_class_counts)}
+            if self.lease is not None and lead is not None:
+                reads["lease"] = self.lease.summary(
+                    lead, int(self.lead_terms[lead]), self.clock.now
+                )
+            snap["reads"] = reads
         if self._tiered_store is not None:
             # tiered-store section: seal/spill tallies, host bytes, RS
             # reconstructs — plus the shipper's live catch-up streams
@@ -2938,8 +3096,20 @@ class RaftEngine:
     def _advance_commit(self, r: int, commit: int) -> None:
         """Host bookkeeping for a device-reported commit advance: stamp
         durable seqs, archive to the checkpoint store, prune buffers."""
+        if commit > self._row_commit[r]:
+            # r's own view of its commit index — maintained for EVERY
+            # round (even no-advance ones) so the lease read plane
+            # serves the leader's local knowledge, never the global
+            # watermark a partitioned stale leader could not possess
+            self._row_commit[r] = commit
         if commit <= self.commit_watermark:
             return
+        if (self.roles[r] == LEADER
+                and int(self.terms[r]) == int(self.lead_terms[r])):
+            # a watermark advance riding r's own round commits a
+            # CURRENT-term entry (§5.4.2: only current-term entries
+            # commit directly) — the §6.4 lease-serve precondition
+            self._lease_ok_term[r] = int(self.lead_terms[r])
         old_wm = self.commit_watermark
         slo_lat = [] if self.slo is not None else None
         now = self.clock.now

@@ -571,7 +571,15 @@ class _WindowBook:
             e._steady = bool((match[others] >= new_last).all())
         if not self.confirmed and max_term <= term:
             e._confirm_reads(r, term, self.eff, max_term)
+            #   _confirm_reads also renews the leader lease; later
+            #   fused ticks renew explicitly below so the lease clock
+            #   advances tick by tick exactly as the unfused path's
+            #   per-tick confirmation would drive it — fusion and
+            #   zero-round lease reads compose instead of the window
+            #   aging the lease by K ticks at once
             self.confirmed = True
+        elif max_term <= term:
+            e._lease_renew(r, term, self.eff, max_term)
         e._reset_heard_timers(r)
         self.g += 1
         if escape or self.g == self._n_ticks:
@@ -629,6 +637,11 @@ class _WindowBook:
                 new_last - n + 1, chunk, term, t_j, pick=1
             )
             e.auditor.note_commit(commit, t_j)
+        if commit > e._row_commit[r]:
+            e._row_commit[r] = commit
+        e._lease_ok_term[r] = term
+        #   the fused batch commit IS a current-term watermark advance
+        #   riding r's own round — mirror _advance_commit's lease gate
         e.commit_watermark = commit
         e._nodelog_at(r, f"commit index changed to {commit}",
                       commit, new_last, kind="commit")

@@ -1,0 +1,591 @@
+"""Read scale-out plane (docs/READS.md): leader leases, follower
+ReadIndex, session reads — and their falsifiability story.
+
+Coverage map:
+
+- ``LeaseTable`` math: the drift-bounded validity window, the safe skew
+  band ``[1/drift, drift]``, the broken ignore-drift widening.
+- Engine lease serves: zero replication rounds (counted, not assumed),
+  span-verified class + rounds, fallback to classic ReadIndex on
+  expiry, the §6.4 fresh-leader gate, /status + metrics surfaces.
+- K-tick fusion composing with leases (byte-identical commit stamps
+  fused vs unfused, lease serves landing mid-fusion).
+- Multi/Router: lease-certified follower reads spreading across
+  replicas, typed ``ReadLagging`` (tested alongside NotLeader /
+  CircuitOpen), session tokens (monotone + read-your-writes).
+- The per-read-class checker: each class graded against ITS model.
+- The ``--reads`` drill: correct plane refuses the stale probe and
+  every class passes; ``broken="lease_skew"`` serves a stale read and
+  is CAUGHT (offline per-class VIOLATION + online auditor).
+
+Wall budget (README "Testing strategy"): the multi-seed sweeps ride the
+``slow`` marker; tier-1 keeps one pinned drill pair and one pinned
+torture seed.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.raft import RaftEngine
+from raft_tpu.raft.lease import LeaseTable
+from raft_tpu.transport import SingleDeviceTransport
+
+ENTRY = 16
+
+
+def mk(**kw):
+    defaults = dict(
+        n_replicas=3, entry_bytes=ENTRY, batch_size=4, log_capacity=64,
+        transport="single", prevote=True, read_lease=True,
+    )
+    defaults.update(kw)
+    cfg = RaftConfig(**defaults)
+    return RaftEngine(cfg, SingleDeviceTransport(cfg))
+
+
+def commit_some(e, n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    seqs = [e.submit(rng.integers(0, 256, ENTRY, np.uint8).tobytes())
+            for _ in range(n)]
+    e.run_until_committed(seqs[-1])
+    return seqs
+
+
+def counting_replicate(e):
+    calls = [0]
+    orig = e.t.replicate
+
+    def counting(*a, **k):
+        calls[0] += 1
+        return orig(*a, **k)
+
+    e.t.replicate = counting
+    return calls
+
+
+# ---------------------------------------------------------- LeaseTable
+class TestLeaseTable:
+    def test_grant_valid_expire(self):
+        lt = LeaseTable(10.0, 2.0)           # effective 5 s local
+        lt.grant(0, term=3, now=100.0)
+        assert lt.valid(0, 3, 100.0)
+        assert lt.valid(0, 3, 104.9)
+        assert not lt.valid(0, 3, 105.0)     # strict boundary
+        assert lt.remaining_s(0, 3, 102.0) == pytest.approx(3.0)
+
+    def test_term_mismatch_and_break(self):
+        lt = LeaseTable(10.0, 2.0)
+        lt.grant(0, 3, 0.0)
+        assert not lt.valid(0, 4, 0.1)       # a different term's grant
+        lt.break_(0)
+        assert not lt.valid(0, 3, 0.1)
+        lt.grant(1, 1, 0.0)
+        lt.break_()                          # break all
+        assert not lt.valid(1, 1, 0.1)
+
+    def test_slow_clock_inside_band_is_safe(self):
+        """The safety inequality: local elapsed < f0/drift at rate >=
+        1/drift implies TRUE elapsed < f0 — the lease always dies
+        before the stickiness window does."""
+        f0, drift = 10.0, 2.0
+        lt = LeaseTable(f0, drift)
+        lt.set_rate(0, 1.0 / drift)          # slowest clock in the band
+        lt.grant(0, 1, 0.0)
+        # last true instant the lease is valid: local = true * 0.5 < 5
+        assert lt.valid(0, 1, 9.99)
+        assert not lt.valid(0, 1, 10.0)      # true elapsed f0: expired
+        # a fast clock only shortens the lease (safe, less available)
+        lt.set_rate(1, drift)
+        lt.grant(1, 1, 0.0)
+        assert not lt.valid(1, 1, 2.5)
+
+    def test_ignore_drift_is_the_broken_plane(self):
+        f0, drift = 10.0, 2.0
+        lt = LeaseTable(f0, drift)
+        lt.ignore_drift = True
+        lt.set_rate(0, 1.0 / drift)
+        lt.grant(0, 1, 0.0)
+        # valid until TRUE elapsed f0/rate = 20 — past the stickiness
+        # window [f0, 20): exactly the stale-serve hazard
+        assert lt.valid(0, 1, 15.0)
+        assert not lt.valid(0, 1, 20.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            LeaseTable(10.0, 0.5)
+        with pytest.raises(ValueError):
+            LeaseTable(0.0, 2.0)
+        lt = LeaseTable(10.0, 2.0)
+        with pytest.raises(ValueError):
+            lt.set_rate(0, 0.0)
+
+
+class TestConfigValidation:
+    def test_read_lease_requires_prevote(self):
+        with pytest.raises(ValueError, match="prevote"):
+            RaftConfig(read_lease=True)
+
+    def test_drift_bound_floor(self):
+        with pytest.raises(ValueError, match="clock_drift_bound"):
+            RaftConfig(clock_drift_bound=0.9)
+
+    def test_session_lag_resolution(self):
+        cfg = RaftConfig(prevote=True, read_lease=True)
+        assert cfg.session_lag == 2 * cfg.batch_size
+        assert RaftConfig(session_max_lag=7).session_lag == 7
+        with pytest.raises(ValueError, match="session_max_lag"):
+            RaftConfig(session_max_lag=0)
+        assert cfg.lease_duration_s == pytest.approx(
+            cfg.follower_timeout[0] / cfg.clock_drift_bound
+        )
+
+
+# ------------------------------------------------------- engine leases
+class TestEngineLease:
+    def test_lease_read_zero_rounds_span_verified(self):
+        from raft_tpu.obs.spans import SpanTracker
+
+        e = mk(seed=51)
+        e.spans = SpanTracker()
+        e.run_until_leader()
+        commit_some(e)
+        calls = counting_replicate(e)
+        sp = e.spans.begin("read", e.clock.now, client=1)
+        e.spans.current = sp
+        idx = e.read_linearizable()
+        e.spans.current = None
+        assert calls[0] == 0, "lease read paid a replication round"
+        assert idx == e.commit_watermark
+        assert sp.read_class == "lease"
+        assert sp.replication_rounds == 0      # the span-verified claim
+        # ticket path: minted pre-confirmed, class visible, zero rounds
+        tk = e.submit_read()
+        assert e.read_ticket_class(tk) == "lease"
+        assert e.read_confirmed(tk) == idx
+        assert calls[0] == 0
+        assert e.read_class_counts["lease"] >= 2
+
+    def test_without_lease_reads_pay_a_round(self):
+        e = mk(read_lease=False)
+        e.run_until_leader()
+        commit_some(e)
+        calls = counting_replicate(e)
+        e.read_linearizable()
+        assert calls[0] == 1
+        assert e.read_class_counts == {"read_index": 1}
+
+    def test_fresh_leader_gate_no_current_term_commit(self):
+        """§6.4: a leader that has not committed in ITS term must not
+        lease-serve — the classic round runs instead."""
+        e = mk(seed=52)
+        lead = e.run_until_leader()
+        commit_some(e)
+        e.fail(lead)
+        e.run_until_leader()
+        e.recover(lead)
+        # heartbeats renew the new leader's lease but nothing committed
+        # in the new term yet: the fast path must decline
+        e.run_for(4 * e.cfg.heartbeat_period)
+        calls = counting_replicate(e)
+        e.read_linearizable()
+        assert calls[0] == 1, "lease served before a current-term commit"
+        # after one commit in the new term the fast path opens
+        commit_some(e, n=2, seed=9)
+        calls[0] = 0
+        e.read_linearizable()
+        assert calls[0] == 0
+
+    def test_partitioned_leader_lease_expires_then_refuses(self):
+        from raft_tpu.raft.engine import LinearizableReadRefused
+
+        e = mk(seed=53)
+        lead = e.run_until_leader()
+        commit_some(e)
+        others = [r for r in range(3) if r != lead]
+        e.partition([[lead], others])
+        # inside the lease window the minority leader still serves —
+        # SAFE: §9.6 stickiness means no rival can exist yet
+        assert e.read_linearizable(lead) == e.commit_watermark
+        # past the window the lease is gone and the classic fallback's
+        # quorum check refuses
+        e.run_for(e.cfg.follower_timeout[0] + 1.0)
+        with pytest.raises(LinearizableReadRefused):
+            e.read_linearizable(lead)
+
+    def test_status_and_metrics_surfaces(self):
+        from raft_tpu.obs.registry import MetricsRegistry, parse_prometheus
+
+        e = mk(seed=54, admission_max_reads=64)
+        e.metrics = MetricsRegistry()
+        e.run_until_leader()
+        commit_some(e)
+        e.read_linearizable()
+        tk = e.submit_read()
+        e.read_confirmed(tk)
+        snap = e._status_snapshot()
+        assert snap["reads"]["by_class"]["lease"] >= 2
+        assert snap["reads"]["lease"]["valid"] is True
+        text = e.metrics.to_prometheus()
+        parsed = parse_prometheus(text)
+        assert any(
+            dict(labels).get("class") == "lease" and value >= 2
+            for labels, value in parsed["raft_reads_total"].items()
+        )
+        # admission read-lane accounting carries the class split
+        assert e.admission.report().read_classes["lease"] >= 2
+
+    def test_restart_drops_the_lease(self, tmp_path):
+        """Lease state is volatile by design: a restored engine must
+        not serve locally until it re-earns a quorum round + a
+        current-term commit."""
+        e = mk(seed=55)
+        e.run_until_leader()
+        commit_some(e)
+        assert e.lease_read_index(e.leader_id) is not None
+        path = str(tmp_path / "ckpt.npz")
+        e.save_checkpoint(path)
+        cfg = e.cfg
+        t = SingleDeviceTransport(cfg)
+        e2 = RaftEngine.restore(cfg, path, t)
+        assert all(
+            e2.lease_read_index(r) is None for r in range(cfg.rows)
+        )
+
+
+# ------------------------------------------------------ fusion compose
+class TestFusedCompose:
+    def test_lease_reads_and_fusion_byte_identity(self):
+        """fuse_k > 1 with the lease plane on: commit stamps stay
+        byte-identical to the unfused run, and lease reads serve with
+        zero rounds right after fused windows ran."""
+        def run(fuse_k):
+            e = mk(seed=56, log_capacity=128,
+                   **({"fuse_k": fuse_k} if fuse_k else {}))
+            e.run_until_leader()
+            rng = np.random.default_rng(3)
+            seqs = []
+            for _ in range(5):
+                for _ in range(12):
+                    seqs.append(e.submit(
+                        rng.integers(0, 256, ENTRY, np.uint8).tobytes()
+                    ))
+                e.run_for(20 * e.cfg.heartbeat_period)
+            e.run_until_committed(seqs[-1])
+            return e
+
+        e1 = run(None)
+        e8 = run(8)
+        assert e8.fused_ticks > 0, "fusion never engaged"
+        assert e1.commit_time == e8.commit_time
+        assert e1.commit_watermark == e8.commit_watermark
+        # the fused run's lease is live: zero-round serve right now
+        calls = counting_replicate(e8)
+        assert e8.read_linearizable() == e8.commit_watermark
+        assert calls[0] == 0
+
+
+# --------------------------------------------------- multi + router
+class TestMultiReads:
+    def _stack(self, seed=7, groups=4):
+        from raft_tpu.multi import MultiEngine, Router
+
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=4,
+            log_capacity=64, transport="single", seed=seed,
+            prevote=True, read_lease=True,
+        )
+        eng = MultiEngine(cfg, groups)
+        eng.seed_leaders()
+        for g in range(groups):
+            for _ in range(6):
+                eng.submit(g, bytes(ENTRY))
+        eng.run_for(30.0)
+        return eng, Router(eng)
+
+    def test_certified_lease_zero_rounds(self):
+        eng, _ = self._stack()
+        g = 0
+        calls = [0]
+        orig = eng._replicate_round
+
+        def counting(active):
+            calls[0] += 1
+            return orig(active)
+
+        eng._replicate_round = counting
+        idx, cls = eng.certified_read_index(g)
+        assert cls == "lease" and calls[0] == 0
+        assert idx == int(eng.commit_watermark[g])
+
+    def test_read_any_spreads_over_replicas(self):
+        eng, router = self._stack()
+        served = set()
+        for _ in range(9):
+            g, r, idx, cls = router.read_any(b"key-a")
+            assert idx == int(eng.commit_watermark[g])
+            assert cls in ("lease", "follower", "read_index")
+            served.add(r)
+        assert served == {0, 1, 2}, "read load did not spread"
+        by_class = {}
+        for cc in eng.read_class_counts:
+            for c, n in cc.items():
+                by_class[c] = by_class.get(c, 0) + n
+        assert by_class.get("follower", 0) > 0
+
+    def test_default_config_follower_reads_warm_up_lazily(self):
+        """A config that never armed the read plane still gets the
+        replica spread: the match mirror arms itself on the first
+        follower-read use (one conservative leader-served call), and
+        spreads from the next certification round on."""
+        from raft_tpu.multi import MultiEngine, Router
+
+        cfg = RaftConfig(
+            n_replicas=3, entry_bytes=ENTRY, batch_size=4,
+            log_capacity=64, transport="single", seed=12,
+        )
+        eng = MultiEngine(cfg, 2)
+        eng.seed_leaders()
+        for g in range(2):
+            for _ in range(6):
+                eng.submit(g, bytes(ENTRY))
+        eng.run_for(30.0)
+        assert not eng._track_match
+        router = Router(eng)
+        served = set()
+        for _ in range(9):
+            _, r, _, _ = router.read_any(b"key-a")
+            served.add(r)
+        assert eng._track_match
+        assert served == {0, 1, 2}, "lazy arming never spread the load"
+
+    def test_pinned_lagging_replica_raises_read_lagging(self):
+        from raft_tpu.multi import ReadLagging
+
+        eng, router = self._stack(seed=8)
+        g = router.group_of(b"key-a")
+        lead = eng.leader_id[g]
+        laggard = next(r for r in range(3) if r != lead)
+        eng.set_slow(g, laggard, True)
+        for _ in range(4):
+            eng.submit(g, bytes(ENTRY))
+        eng.run_for(10.0)          # commits land; the slow row lags
+        with pytest.raises(ReadLagging) as ei:
+            router.read_any(b"key-a", replica=laggard)
+        assert ei.value.group == g and ei.value.replica == laggard
+        assert ei.value.lag > 0
+        # unpinned reads keep serving (skip the laggard)
+        g2, r2, idx, cls = router.read_any(b"key-a")
+        assert r2 != laggard
+
+    def test_read_any_honors_breaker(self):
+        from raft_tpu.admission import CircuitOpen
+
+        eng, router = self._stack(seed=9)
+        g = router.group_of(b"key-a")
+        # trip the breaker by hand: repeated failures past threshold
+        for _ in range(12):
+            router.breakers[g].on_failure(eng.clock.now)
+        with pytest.raises(CircuitOpen):
+            router.read_any(b"key-a")
+        with pytest.raises(CircuitOpen):
+            router.read_session(b"key-a", __import__(
+                "raft_tpu.multi", fromlist=["ReadSession"]
+            ).ReadSession())
+
+    def test_session_tokens_monotone_and_lagging(self):
+        from raft_tpu.examples.kv import encode_op
+        from raft_tpu.multi import ReadLagging, ReadSession
+
+        eng, router = self._stack(seed=10)
+        g = router.group_of(b"key-a")
+        eng.register_apply(g, lambda i, p: None)
+        payload = encode_op(ENTRY, 1, b"key-a", b"v1")
+        eng.submit(g, payload)
+        eng.run_for(10.0)
+        sess = ReadSession()
+        g1, idx1 = router.read_session(b"key-a", sess)
+        assert g1 == g and sess.floor[g] == idx1
+        # read-your-writes: fold the durable write's watermark in
+        router.note_write_observed(sess, g)
+        assert sess.floor[g] >= idx1
+        g2, idx2 = router.read_session(b"key-a", sess)
+        assert idx2 >= idx1                    # monotone
+        # a floor from "the future" (another replica's session) lags
+        sess.floor[g] = int(eng.applied_index[g]) + 100
+        with pytest.raises(ReadLagging) as ei:
+            router.read_session(b"key-a", sess)
+        assert ei.value.replica is None and ei.value.lag == 100
+
+
+# -------------------------------------------------- per-class checker
+class TestReadClassChecker:
+    def _rec(self, client, op, key, value, t0, t1, status="ok",
+             cls=None, serve_index=None, ryw_floor=None):
+        from raft_tpu.chaos.history import OpRecord
+
+        rec = OpRecord(client, op, key, value, invoke_t=t0)
+        if status == "ok":
+            rec.ok(t1, value if op == "read" else None)
+        elif status == "fail":
+            rec.fail(t1)
+        else:
+            rec.info()
+        if cls is not None:
+            rec.read_class = cls
+        if serve_index is not None:
+            rec.serve_index = serve_index
+        if ryw_floor is not None:
+            rec.ryw_floor = ryw_floor
+        return rec
+
+    def test_stale_lease_read_blames_only_its_class(self):
+        from raft_tpu.chaos.checker import check_read_classes
+
+        k = b"k"
+        ops = [
+            self._rec(1, "write", k, b"old", 1.0, 2.0),
+            self._rec(1, "write", k, b"new", 3.0, 4.0),
+            # a LEASE read of the old value after "new" completed
+            self._rec(2, "read", k, b"old", 5.0, 6.0, cls="lease"),
+            # a fresh read_index read stays clean
+            self._rec(3, "read", k, b"new", 7.0, 8.0, cls="read_index"),
+            # session read of the old value: allowed by ITS model
+            self._rec(4, "read", k, b"old", 9.0, 10.0, cls="session",
+                      serve_index=1, ryw_floor=0),
+        ]
+        out = check_read_classes(ops)
+        assert out["lease"].verdict == "VIOLATION"
+        assert out["read_index"].verdict == "LINEARIZABLE"
+        assert out["session"].verdict == "LINEARIZABLE"
+
+    def test_session_model_violations(self):
+        from raft_tpu.chaos.checker import check_read_classes
+
+        k = b"k"
+        w = [self._rec(1, "write", k, b"v1", 1.0, 2.0),
+             self._rec(1, "write", k, b"v2", 3.0, 4.0)]
+        # monotone inversion: same client observes 5 then 2
+        mono = w + [
+            self._rec(2, "read", k, b"v2", 5.0, 6.0, cls="session",
+                      serve_index=5, ryw_floor=0),
+            self._rec(2, "read", k, b"v1", 7.0, 8.0, cls="session",
+                      serve_index=2, ryw_floor=0),
+        ]
+        assert check_read_classes(mono)["session"].verdict == "VIOLATION"
+        # read-your-writes: served below the client's own token
+        ryw = w + [
+            self._rec(2, "read", k, b"v1", 5.0, 6.0, cls="session",
+                      serve_index=2, ryw_floor=4),
+        ]
+        assert check_read_classes(ryw)["session"].verdict == "VIOLATION"
+        # read-committed: a value never written anywhere
+        dirty = w + [
+            self._rec(2, "read", k, b"ghost", 5.0, 6.0, cls="session",
+                      serve_index=9),
+        ]
+        assert check_read_classes(dirty)["session"].verdict == "VIOLATION"
+        # ...and a LATER write must not retroactively launder an
+        # earlier dirty serve (the justification is time-bounded)
+        laundered = w + [
+            self._rec(2, "read", k, b"v9", 5.0, 6.0, cls="session",
+                      serve_index=9),
+            self._rec(1, "write", k, b"v9", 7.0, 8.0),
+        ]
+        assert (check_read_classes(laundered)["session"].verdict
+                == "VIOLATION")
+        # clean session history passes
+        ok = w + [
+            self._rec(2, "read", k, b"v1", 5.0, 6.0, cls="session",
+                      serve_index=2, ryw_floor=1),
+            self._rec(2, "read", k, b"v2", 7.0, 8.0, cls="session",
+                      serve_index=4, ryw_floor=2),
+        ]
+        assert check_read_classes(ok)["session"].verdict == "LINEARIZABLE"
+
+    def test_unlabeled_reads_default_to_read_index(self):
+        from raft_tpu.chaos.checker import check_read_classes
+
+        k = b"k"
+        ops = [
+            self._rec(1, "write", k, b"v", 1.0, 2.0),
+            self._rec(2, "read", k, b"v", 3.0, 4.0),
+        ]
+        out = check_read_classes(ops)
+        assert set(out) == {"read_index"}
+        assert out["read_index"].verdict == "LINEARIZABLE"
+
+
+# ------------------------------------------------------- chaos drills
+class TestReadsDrill:
+    def test_correct_plane_all_classes_pass_and_stale_refused(self):
+        from raft_tpu.chaos.runner import reads_run
+
+        rep = reads_run(3)
+        assert rep.verdict == "LINEARIZABLE", rep.summary()
+        assert set(rep.per_class) == {"lease", "read_index", "session"}
+        assert rep.refused_stale >= 1, "stale probe was not refused"
+        assert rep.stale_served == 0
+        assert rep.lease_serves > 0 and rep.session_serves > 0
+        assert not rep.audit_violations
+
+    def test_broken_lease_skew_is_caught(self):
+        """THE falsifiability pin: a lease plane that ignores the drift
+        bound serves a provably stale read under the same scripted
+        scenario — and both detectors flag it."""
+        from raft_tpu.chaos.runner import reads_run
+
+        rep = reads_run(3, broken="lease_skew")
+        assert rep.stale_served >= 1, "broken plane never served stale"
+        assert rep.per_class["lease"].verdict == "VIOLATION"
+        assert rep.audit_violations, "online auditor missed the serve"
+        assert rep.caught
+
+    def test_reads_torture_pinned_seed(self):
+        """Lease plane + clock-skew nemesis composed with the default
+        fault planes: the correct plane must stay LINEARIZABLE."""
+        from raft_tpu.chaos.runner import torture_run
+
+        rep = torture_run(5, phases=4, reads=True)
+        assert rep.verdict == "LINEARIZABLE", rep.summary()
+
+    @pytest.mark.slow
+    def test_reads_torture_sweep(self):
+        from raft_tpu.chaos.runner import torture_run
+
+        for seed in range(6):
+            rep = torture_run(seed, phases=6, reads=True)
+            assert rep.verdict == "LINEARIZABLE", rep.summary()
+
+    @pytest.mark.slow
+    def test_reads_drill_sweep(self):
+        from raft_tpu.chaos.runner import reads_run
+
+        for seed in (0, 1, 2):
+            rep = reads_run(seed)
+            assert rep.verdict == "LINEARIZABLE", rep.summary()
+            assert rep.refused_stale >= 1
+            rep_b = reads_run(seed, broken="lease_skew")
+            assert rep_b.caught, rep_b.summary()
+
+
+# ------------------------------------------------------ bench plumbing
+class TestBenchGates:
+    def test_read_scale_metrics_gate_directions(self):
+        from tools.bench_diff import compare_runs
+
+        old = {"read_scale_lease": {
+            "reads_per_sec": 1000.0, "read_p50_us": 10.0,
+            "read_p99_us": 20.0, "speedup_vs_read_index": 50.0,
+        }}
+        regressed = {"read_scale_lease": {
+            "reads_per_sec": 500.0, "read_p50_us": 30.0,
+            "read_p99_us": 60.0, "speedup_vs_read_index": 10.0,
+        }}
+        _, regs = compare_runs(old, regressed, 0.10)
+        assert {d.metric for d in regs} == {
+            "reads_per_sec", "read_p50_us", "read_p99_us",
+            "speedup_vs_read_index",
+        }
+        _, regs2 = compare_runs(old, old, 0.10)
+        assert not regs2

@@ -79,6 +79,15 @@ GATED_METRICS: Dict[str, str] = {
     "rejoin_virtual_s": "down",
     "flat_ratio": "down",
     "catchup_goodput_ratio": "up",
+    # read scale-out (round 13): per-class read throughput gates UP and
+    # the per-read wall latency percentiles gate DOWN on every
+    # read_scale_* row; the lease row's speedup over the ReadIndex-only
+    # baseline gates UP so the zero-round win can't silently regress
+    # back into per-read confirmation rounds.
+    "reads_per_sec": "up",
+    "read_p50_us": "down",
+    "read_p99_us": "down",
+    "speedup_vs_read_index": "up",
 }
 
 
