@@ -49,7 +49,16 @@ class Overloaded(Exception):
     after ``retry_after_s`` (with jittered backoff and a retry budget:
     ``admission.retry``). ``reason`` is one of ``depth`` / ``delay`` /
     ``fair_share`` / ``read_depth`` / ``circuit_open``; ``group`` is
-    set when a multi-Raft group's queue refused."""
+    set when a multi-Raft group's queue refused.
+
+    At the wire (``raft_tpu.net``, docs/NETWORK.md) this contract IS
+    the backpressure protocol: the ingest server converts every
+    ``Overloaded`` into a ``REFUSED`` frame carrying the same reason
+    and ``retry_after_s`` verbatim, written before anything queues
+    anywhere, and adds exactly one wire-only reason of its own
+    (``wire_backlog``: the server's bounded coalesce buffer). A wire
+    client floors its backoff at ``min(retry_after_s, max_backoff_s)``
+    — the ``Backoff.delay`` hint semantics, unchanged."""
 
     def __init__(self, reason: str, retry_after_s: float,
                  detail: str = "", group: Optional[int] = None):

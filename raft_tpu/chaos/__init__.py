@@ -21,8 +21,11 @@ actually observe).
   set (mirrored checkpoints + vote WAL) the storage faults target.
 - ``chaos.runner``    — ``torture_run`` / ``torture_run_multi``: the
   end-to-end loop, reported with a one-line seed repro; plus the
-  deterministic ``overload_run`` (anti-metastability) and
-  ``reconfig_run`` (reconfiguration availability) drills.
+  deterministic ``overload_run`` (anti-metastability),
+  ``reconfig_run`` (reconfiguration availability) and ``wire_run``
+  (torture traffic over a real loopback TCP server — the
+  ``raft_tpu.net`` serving tier with leader-kill and overload
+  composed, docs/NETWORK.md) drills.
 
 Opt-in nemesis planes (existing seeds replay byte-identically with
 them off): ``overload`` (open-loop arrival storms, round 8) and
@@ -45,11 +48,13 @@ from raft_tpu.chaos.runner import (
     OverloadReport,
     ReconfigReport,
     TortureReport,
+    WireReport,
     overload_run,
     poisson,
     reconfig_run,
     torture_run,
     torture_run_multi,
+    wire_run,
 )
 from raft_tpu.chaos.storage import MirroredStore
 from raft_tpu.chaos.transport import ChaosTransport
@@ -68,11 +73,13 @@ __all__ = [
     "OverloadReport",
     "ReconfigReport",
     "TortureReport",
+    "WireReport",
     "overload_run",
     "poisson",
     "reconfig_run",
     "torture_run",
     "torture_run_multi",
+    "wire_run",
     "MirroredStore",
     "ChaosTransport",
 ]

@@ -20,6 +20,7 @@ from raft_tpu.chaos.runner import (
     segment_storage_run,
     torture_run,
     torture_run_multi,
+    wire_run,
 )
 
 
@@ -90,6 +91,16 @@ def main(argv=None) -> int:
                          "stale probe was refused; with --broken "
                          "lease_skew, succeeds only if the stale "
                          "serve was CAUGHT")
+    ap.add_argument("--wire", action="store_true",
+                    help="run the wire-plane drill (docs/NETWORK.md): "
+                         "torture traffic driven through a REAL "
+                         "loopback asyncio TCP server instead of "
+                         "in-process calls, with the leader-kill and "
+                         "overload nemeses composed; succeeds only if "
+                         "every read class holds its contract, the "
+                         "admission gate's typed refusals surfaced as "
+                         "wire backpressure (shed >= 1), and clients "
+                         "rode NOT_LEADER frames through the election")
     ap.add_argument("--read-plane", action="store_true",
                     help="arm the read scale-out plane on a torture "
                          "run: leader leases (prevote implied) plus "
@@ -195,8 +206,41 @@ def main(argv=None) -> int:
                        or args.overload_recovery is not None):
         ap.error("--reads is a standalone single-engine drill "
                  "(--broken lease_skew is its one composition)")
+    if args.wire and (args.multi or args.broken or args.overload
+                      or args.reconfig or args.migration
+                      or args.segments or args.membership or args.reads
+                      or args.overload_recovery is not None):
+        ap.error("--wire is a standalone drill (its leader-kill and "
+                 "overload nemeses are built in)")
 
     ok = True
+    if args.wire:
+        for seed in range(args.seed, args.seed + args.sweep):
+            rep = wire_run(
+                seed, clients=args.clients, keys=args.keys,
+                step_budget=args.step_budget,
+                blackbox_dir=args.blackbox_dir,
+            )
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "per_class": {c: r.verdict
+                              for c, r in rep.per_class.items()},
+                "ops": rep.ops,
+                "op_counts": rep.op_counts,
+                "shed_writes": rep.shed_writes,
+                "not_leader_frames": rep.not_leader_frames,
+                "wire_refusals": rep.wire_refusals,
+                "leader_kills": rep.leader_kills,
+                "net": rep.net,
+            }), flush=True)
+            ok = ok and (
+                rep.verdict == "LINEARIZABLE"
+                and rep.shed_writes >= 1
+                and rep.not_leader_frames >= 1
+            )
+        return 0 if ok else 1
     if args.reads:
         for seed in range(args.seed, args.seed + args.sweep):
             rep = reads_run(

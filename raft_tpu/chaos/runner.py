@@ -2566,3 +2566,255 @@ def reads_run(
         audit_violations=aud, repro=repro, broken=broken,
         bundle_path=bundle_path, obs=run.obs,
     )
+
+
+# ------------------------------------------------------- the wire drill
+@dataclasses.dataclass
+class WireReport:
+    """Result of :func:`wire_run` — torture traffic driven through a
+    REAL loopback TCP server (``raft_tpu.net``) instead of in-process
+    calls, with the leader-kill and overload nemeses composed. Ops are
+    recorded in the same ``History`` the in-process runners use and
+    graded per read class (``check_read_classes``), so the wire tier
+    earns the same verdict currency as everything else: LINEARIZABLE
+    or it does not ship."""
+
+    seed: int
+    per_class: Dict[str, "CheckResult"]
+    ops: int
+    op_counts: Dict[str, int]
+    wire_refusals: Dict[str, int]
+    shed_writes: int             # open-loop arrivals typed-refused
+    not_leader_frames: int       # NOT_LEADER wire frames observed
+    leader_kills: int
+    net: dict                    # final server ``net`` stats section
+    read_classes: Dict[str, int]
+    repro: str
+
+    @property
+    def verdict(self) -> str:
+        verdicts = [c.verdict for c in self.per_class.values()]
+        if VIOLATION in verdicts:
+            return VIOLATION
+        if any(v != LINEARIZABLE for v in verdicts):
+            return "UNDETERMINED"
+        return LINEARIZABLE
+
+    def summary(self) -> str:
+        cls = {c: r.verdict for c, r in self.per_class.items()}
+        return (
+            f"seed={self.seed} classes={cls} ops={self.ops} "
+            f"shed={self.shed_writes} "
+            f"not_leader={self.not_leader_frames} "
+            f"kills={self.leader_kills} "
+            f"conns={self.net.get('connections')} "
+            f"bytes_in={self.net.get('bytes_in')}"
+        )
+
+
+def wire_run(
+    seed: int,
+    clients: int = 4,
+    keys: int = 4,
+    ops_per_phase: int = 10,
+    groups: int = 2,
+    step_budget: int = 500_000,
+    blackbox_dir: Optional[str] = None,
+) -> WireReport:
+    """The deterministic wire-plane drill (``--wire``): a sharded
+    Router stack served over a REAL loopback asyncio TCP server, with
+    torture traffic arriving as wire frames. Three phases, nemeses
+    composed:
+
+    1. steady traffic — ``clients`` wire clients (own connections, own
+       session tokens) running mixed writes / linearizable reads /
+       session reads;
+    2. LEADER KILL on the hottest group mid-traffic — clients ride
+       ``NOT_LEADER`` wire refusals + backoff through the election,
+       the row recovers after;
+    3. OVERLOAD — an open-loop burst of one-shot writers (retry-free
+       connections) past the admission depth bound: the gate's typed
+       refusals surface as ``REFUSED`` wire frames, recorded ``fail``
+       (provably no effect — the wire preserves the contract the
+       checker leans on).
+
+    Every client op is recorded in the shared ``History`` on the
+    engine's virtual clock (the asyncio loop and the engine share one
+    thread, so host execution order is real-time order — the same
+    soundness argument the in-process runners make) and graded with
+    ``check_read_classes``; the drill passes only if every class holds
+    its contract, a shed happened, and NOT_LEADER frames were ridden
+    through. No real-clock sleeps beyond the client's millisecond-scale
+    jittered backoff — the run is event-driven end to end."""
+    import asyncio
+
+    from raft_tpu.examples.kv_sharded import ShardedKV
+    from raft_tpu.multi.engine import MultiEngine
+    from raft_tpu.multi.router import Router
+    from raft_tpu.net import (
+        IngestServer,
+        RouterBackend,
+        WireClient,
+        WireDisconnected,
+        WireRefused,
+    )
+    from raft_tpu.net.client import WireError
+
+    cfg = dataclasses.replace(
+        _default_cfg(seed),
+        admission_max_writes=8,
+        admission_max_reads=64,
+    )
+    eng = MultiEngine(cfg, groups)
+    router = Router(eng, drive=False)
+    skv = ShardedKV(eng, router)
+    eng.seed_leaders()
+    history = History()
+    key_pool = [f"wk{i}".encode() for i in range(keys)]
+    rng = random.Random(f"wire:{seed}")
+    leader_kills = 0
+    shed_writes = 0
+
+    def _g(key: bytes) -> int:
+        return router.group_of(key)
+
+    async def client_ops(wc: WireClient, cid: int, n: int) -> None:
+        """One serial client: the §6.3 discipline over the wire."""
+        crng = random.Random(f"wire:{seed}:{cid}")
+        for i in range(n):
+            key = key_pool[crng.randrange(len(key_pool))]
+            p = crng.random()
+            if p < 0.6:
+                value = f"c{cid}v{i}-{crng.randrange(1 << 20)}".encode()
+                rec = history.invoke(cid, WRITE, key, value,
+                                     eng.clock.now)
+                try:
+                    await wc.submit(key, value)
+                except WireRefused:
+                    # typed refusal: provably nothing queued — FAIL is
+                    # sound (the gate/NotLeader contract over the wire)
+                    rec.fail(history.stamp(eng.clock.now))
+                except (WireDisconnected, WireError, ConnectionError):
+                    rec.info()      # outcome unknown: may still commit
+                else:
+                    rec.ok(history.stamp(eng.clock.now))
+            else:
+                cls = "session" if p > 0.85 else "linearizable"
+                rec = history.invoke(cid, READ, key, None,
+                                     eng.clock.now)
+                if cls == "session":
+                    rec.ryw_floor = wc.session.floor.get(_g(key), 0)
+                try:
+                    out = await wc.read(key, cls=cls)
+                except (WireRefused, WireError, WireDisconnected,
+                        ConnectionError):
+                    # an unserved read has no effect, whatever killed it
+                    rec.fail(history.stamp(eng.clock.now))
+                else:
+                    rec.read_class = out.cls
+                    rec.serve_index = out.index
+                    rec.ok(history.stamp(eng.clock.now), out.value)
+
+    async def flood(port: int, n: int) -> int:
+        """Open-loop one-shot writers: no retries, unique client ids —
+        the overload nemesis at the wire."""
+        wc = await WireClient(
+            "127.0.0.1", port, pool=1, retries=0,
+            rng=random.Random(f"wire-flood:{seed}"),
+        ).connect()
+        shed = 0
+        async def one(j: int) -> None:
+            nonlocal shed
+            key = key_pool[j % len(key_pool)]
+            value = f"flood{j}-{rng.randrange(1 << 20)}".encode()
+            rec = history.invoke(1000 + j, WRITE, key, value,
+                                 eng.clock.now)
+            try:
+                await wc.submit(key, value)
+            except WireRefused:
+                shed += 1
+                rec.fail(history.stamp(eng.clock.now))
+            except (WireDisconnected, WireError, ConnectionError):
+                rec.info()
+            else:
+                rec.ok(history.stamp(eng.clock.now))
+        await asyncio.gather(*[one(j) for j in range(n)])
+        await wc.close()
+        return shed
+
+    async def main() -> dict:
+        nonlocal leader_kills, shed_writes
+        server = IngestServer(
+            RouterBackend(router, skv),
+            drive_quantum_s=2 * cfg.heartbeat_period,
+        )
+        port = await server.start()
+        blackbox.mark("wire_serving", port=port)
+        wcs = [
+            await WireClient(
+                "127.0.0.1", port, pool=1, retries=48,
+                rng=random.Random(f"wire:{seed}:conn{cid}"),
+            ).connect()
+            for cid in range(clients)
+        ]
+        # ---- phase 1: steady wire traffic ---------------------------
+        await asyncio.gather(*[
+            client_ops(wc, cid, ops_per_phase)
+            for cid, wc in enumerate(wcs)
+        ])
+        blackbox.mark("wire_steady_done", ops=len(history))
+        # ---- phase 2: leader kill mid-traffic -----------------------
+        hot = _g(key_pool[0])
+        lead = eng.leader_id[hot]
+        if lead is None:
+            lead = eng.run_until_leader(hot)
+        eng.fail(hot, lead)
+        leader_kills += 1
+        blackbox.mark("wire_leader_kill", group=hot, row=lead)
+        await asyncio.gather(*[
+            client_ops(wc, cid, ops_per_phase)
+            for cid, wc in enumerate(wcs)
+        ])
+        eng.recover(hot, lead)
+        blackbox.mark("wire_kill_ridden", ops=len(history))
+        # ---- phase 3: open-loop overload burst ----------------------
+        shed_writes = await flood(port, 3 * cfg.admission_max_writes)
+        await asyncio.gather(*[
+            client_ops(wc, cid, ops_per_phase)
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- quiesce ------------------------------------------------
+        for wc in wcs:
+            await wc.close()
+        stats = server.stats()
+        nl = sum(wc.stats["not_leader"] for wc in wcs)
+        await server.stop()
+        return {"net": stats, "not_leader": nl}
+
+    with blackbox.journal_for(f"wire_seed{seed}", blackbox_dir):
+        blackbox.mark("wire_run", seed=seed)
+        out = asyncio.run(main())
+        history.close()
+        blackbox.mark("check_history", ops=len(history))
+        per_class = check_read_classes(history, step_budget=step_budget)
+        blackbox.mark("check_done", verdicts={
+            c: r.verdict for c, r in per_class.items()
+        })
+    counts: Dict[str, int] = {}
+    for rec in history.ops:
+        c = getattr(rec, "read_class", None)
+        if c:
+            counts[c] = counts.get(c, 0) + 1
+    return WireReport(
+        seed=seed,
+        per_class=per_class,
+        ops=len(history),
+        op_counts=history.counts(),
+        wire_refusals=dict(out["net"].get("refusals", {})),
+        shed_writes=shed_writes,
+        not_leader_frames=out["not_leader"],
+        leader_kills=leader_kills,
+        net=out["net"],
+        read_classes=counts,
+        repro=f"python -m raft_tpu.chaos --wire --seed {seed}",
+    )

@@ -62,6 +62,19 @@ class ReadSession:
     def to_jsonable(self) -> dict:
         return {str(g): int(i) for g, i in self.floor.items()}
 
+    @classmethod
+    def from_floors(cls, floors) -> "ReadSession":
+        """Rebuild a session from serialized floors (``to_jsonable``
+        output, or the plain ``{group: index}`` dict the wire protocol
+        carries in HELLO frames — docs/NETWORK.md): the token really is
+        just integers, so a client handing its floors to a fresh
+        connection, process, or host keeps monotone reads and
+        read-your-writes across the move."""
+        s = cls()
+        for g, idx in (floors or {}).items():
+            s.observe(int(g), int(idx))
+        return s
+
 
 class Router:
     """Key -> group routing + per-group refusal/retry discipline.

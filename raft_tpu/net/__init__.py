@@ -1,0 +1,30 @@
+"""The data-plane serving tier (docs/NETWORK.md).
+
+Everything before this package reached the engines as in-process Python
+calls; ``raft_tpu.net`` is the wire those calls arrive on — a stdlib
+asyncio TCP server speaking a length-prefixed binary protocol
+(``protocol``), an ingest loop that coalesces concurrent requests into
+batches pre-packed into the device ``StagingRing`` on the network side
+of the host/device wall (``server``), and a pooled async client that
+reuses the ``admission.retry`` overload discipline (``client``).
+"""
+
+from raft_tpu.net.client import WireClient, WireDisconnected, WireRefused
+from raft_tpu.net.protocol import (
+    FrameDecoder,
+    FrameTooLarge,
+    ProtocolError,
+)
+from raft_tpu.net.server import EngineBackend, IngestServer, RouterBackend
+
+__all__ = [
+    "EngineBackend",
+    "FrameDecoder",
+    "FrameTooLarge",
+    "IngestServer",
+    "ProtocolError",
+    "RouterBackend",
+    "WireClient",
+    "WireDisconnected",
+    "WireRefused",
+]

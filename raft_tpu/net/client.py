@@ -1,0 +1,444 @@
+"""Async wire client: pooled connections, pipelining, retry discipline.
+
+The client-side half of docs/NETWORK.md. One :class:`WireClient` holds
+a pool of TCP connections to an ingest server; requests round-robin
+over the pool and pipeline freely (many in flight per connection,
+completed by ``req_id``), so a single client object can drive an
+open-loop workload.
+
+The overload discipline is the same three pieces the in-process Router
+composes (``raft_tpu.admission.retry``), because the wire changes the
+transport, not the failure economics:
+
+- ``REFUSED`` frames back off with full jitter FLOORED by the server's
+  ``retry_after_s`` hint capped at ``max_backoff_s`` — the cap is the
+  client's unit adapter: servers hint in their own clock (the virtual
+  clock, for the test/bench deployments), and a client that trusts the
+  magnitude blindly would sleep wall-seconds for virtual-seconds.
+- a ``RetryBudget`` caps sustained retry traffic at a fraction of
+  goodput; an exhausted budget surfaces the refusal instead of feeding
+  the storm.
+- ``NOT_LEADER`` frames redial: when the hint names an address the
+  client knows (``addr_map``), the next attempt goes there; otherwise
+  the same server is retried after a backoff (it fronts the whole
+  replica set in the single-process deployments).
+
+Session tokens: the client carries ``ReadSession`` floors
+(``session``), sends them in ``HELLO`` on every (re)connect, and folds
+the floor returned on each ``OK``/``VALUE`` back in — so a client that
+reconnects (or a new client handed the token) keeps monotone reads and
+read-your-writes across connections.
+
+Failure semantics: a connection loss with a SUBMIT in flight raises
+:class:`WireDisconnected` — the write's outcome is UNKNOWN (it may
+commit) and the client will not silently retry it into a duplicate.
+Reads are effect-free and reconnect-retry freely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Dict, List, Optional
+
+from raft_tpu.admission.retry import Backoff, RetryBudget
+from raft_tpu.multi.router import ReadSession
+from raft_tpu.net import protocol as P
+
+
+class WireRefused(Exception):
+    """The server refused the op past the client's retry discipline
+    (retries exhausted, or the retry budget ran dry). ``reason`` is the
+    server's last typed refusal reason; the op took NO effect."""
+
+    def __init__(self, reason: str, retry_after_s: float,
+                 attempts: int):
+        super().__init__(
+            f"refused after {attempts} attempt(s): {reason} "
+            f"(retry after {retry_after_s:g}s)"
+        )
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.attempts = attempts
+
+
+class WireDisconnected(Exception):
+    """The connection died with the op in flight. For a SUBMIT the
+    outcome is UNKNOWN (record it ``info``, never ``fail``)."""
+
+
+class WireError(Exception):
+    """The server answered ``ERROR`` (protocol violation, or a write
+    whose outcome it could not resolve within its op timeout)."""
+
+
+class SubmitResult:
+    __slots__ = ("group", "seq", "floor", "attempts")
+
+    def __init__(self, group, seq, floor, attempts):
+        self.group = group
+        self.seq = seq
+        self.floor = floor
+        self.attempts = attempts
+
+
+class ReadResult:
+    __slots__ = ("group", "index", "cls", "value", "attempts")
+
+    def __init__(self, group, index, cls, value, attempts):
+        self.group = group
+        self.index = index
+        self.cls = cls
+        self.value = value
+        self.attempts = attempts
+
+
+class BatchResult:
+    """One SUBMIT_BATCH resolution: ``accepted`` entries are DURABLE,
+    ``shed`` were typed-refused at ingest (no effect). ``floors`` are
+    the commit watermarks of the groups the batch touched (already
+    folded into the client session)."""
+
+    __slots__ = ("accepted", "shed", "floors")
+
+    def __init__(self, accepted, shed, floors):
+        self.accepted = accepted
+        self.shed = shed
+        self.floors = floors
+
+
+class _PoolConn:
+    """One pooled connection: writer + a reader task dispatching
+    response frames to per-request futures by ``req_id``."""
+
+    def __init__(self, client: "WireClient"):
+        self.client = client
+        self.reader = None
+        self.writer = None
+        self.pending: Dict[int, asyncio.Future] = {}
+        self.open = False
+        self.welcome: Optional[tuple] = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def connect(self, host: str, port: int) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            host, port
+        )
+        self.open = True
+        self._task = asyncio.get_running_loop().create_task(self._read())
+        # HELLO carries the session floors (reconnect-and-resume)
+        fut = self._expect_welcome()
+        self.writer.write(P.encode_hello(self.client.session.floor))
+        await self.writer.drain()
+        self.welcome = await fut
+        self.client.stats["connects"] += 1
+
+    def _expect_welcome(self) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self.pending[-1] = fut           # WELCOME has no req_id
+        return fut
+
+    async def _read(self) -> None:
+        decoder = P.FrameDecoder(self.client.max_frame_bytes)
+        try:
+            while True:
+                data = await self.reader.read(1 << 16)
+                if not data:
+                    break
+                for kind, payload in decoder.feed(data):
+                    self._dispatch(kind, payload)
+        except (ConnectionError, P.ProtocolError):
+            pass
+        finally:
+            self.open = False
+            for fut in self.pending.values():
+                if not fut.done():
+                    fut.set_exception(WireDisconnected(
+                        "connection lost with ops in flight"
+                    ))
+            self.pending.clear()
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+    def _dispatch(self, kind: int, payload: bytes) -> None:
+        if kind == P.WELCOME:
+            fut = self.pending.pop(-1, None)
+            if fut is not None and not fut.done():
+                fut.set_result(P.decode_welcome(payload))
+            return
+        if kind == P.OK:
+            req_id, group, seq, floor = P.decode_ok(payload)
+            self.client.session.observe(group, floor)
+            result = ("ok", (group, seq, floor))
+        elif kind == P.VALUE:
+            req_id, group, index, cls, value = P.decode_value(payload)
+            self.client.session.observe(group, index)
+            result = ("value", (group, index, cls, value))
+        elif kind == P.OK_BATCH:
+            req_id, accepted, shed, floors = P.decode_ok_batch(payload)
+            for g, idx in floors.items():
+                self.client.session.observe(g, idx)
+            result = ("ok_batch", (accepted, shed, floors))
+        elif kind == P.REFUSED:
+            req_id, reason, retry_after = P.decode_refused(payload)
+            result = ("refused", (reason, retry_after))
+        elif kind == P.NOT_LEADER:
+            req_id, group, hint = P.decode_not_leader(payload)
+            result = ("not_leader", (group, hint))
+        elif kind == P.ERROR:
+            req_id, message = P.decode_error(payload)
+            if req_id == 0:
+                return                   # connection-level: _read ends
+            result = ("error", message)
+        else:
+            return
+        fut = self.pending.pop(req_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(result)
+
+    async def request(self, req_id: int, frame: bytes):
+        fut = asyncio.get_running_loop().create_future()
+        self.pending[req_id] = fut
+        try:
+            self.writer.write(frame)
+            await self.writer.drain()
+        except (ConnectionError, RuntimeError):
+            self.pending.pop(req_id, None)
+            self.open = False
+            raise WireDisconnected("connection lost on send")
+        return await fut
+
+    def close(self) -> None:
+        self.open = False
+        if self._task is not None:
+            self._task.cancel()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class WireClient:
+    """Pooled async client (module docstring).
+
+    ``addr_map`` maps leader-hint strings (``"replica:N"`` or
+    addresses) to ``(host, port)`` targets for the redial path; without
+    it a ``NOT_LEADER`` retries the same server after a backoff."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        pool: int = 1,
+        session: Optional[ReadSession] = None,
+        retries: int = 8,
+        base_backoff_s: float = 0.002,
+        max_backoff_s: float = 0.05,
+        budget: Optional[RetryBudget] = None,
+        addr_map: Optional[Dict[str, tuple]] = None,
+        max_frame_bytes: int = P.MAX_FRAME_BYTES,
+        rng: Optional[random.Random] = None,
+        sleep=None,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.pool_size = max(1, pool)
+        self.session = session if session is not None else ReadSession()
+        self.retries = retries
+        self.backoff = Backoff(
+            base_s=base_backoff_s, max_s=max_backoff_s,
+            rng=rng if rng is not None else random.Random(0),
+        )
+        self.budget = budget if budget is not None else RetryBudget()
+        self.addr_map = addr_map or {}
+        self.max_frame_bytes = max_frame_bytes
+        self._sleep = sleep if sleep is not None else asyncio.sleep
+        self._conns: List[Optional[_PoolConn]] = [None] * self.pool_size
+        self._rr = 0
+        self._next_req_id = 1
+        self.entry_bytes: Optional[int] = None
+        self.groups: Optional[int] = None
+        self.stats = {
+            "connects": 0, "retries": 0, "sheds": 0, "not_leader": 0,
+            "redials": 0, "budget_denied": 0,
+        }
+        self.last_delays: List[float] = []
+        #   backoff delays actually honored, newest last (bounded) —
+        #   how tests assert the retry_after_s floor without clocks
+
+    # ----------------------------------------------------------- lifecycle
+    async def connect(self) -> "WireClient":
+        for i in range(self.pool_size):
+            await self._ensure_conn(i)
+        return self
+
+    async def _ensure_conn(self, i: int) -> _PoolConn:
+        conn = self._conns[i]
+        if conn is not None and conn.open:
+            return conn
+        conn = _PoolConn(self)
+        await conn.connect(self.host, self.port)
+        self._conns[i] = conn
+        if conn.welcome is not None:
+            self.entry_bytes, self.groups = conn.welcome
+        return conn
+
+    async def close(self) -> None:
+        for conn in self._conns:
+            if conn is not None:
+                conn.close()
+        self._conns = [None] * self.pool_size
+        await asyncio.sleep(0)
+
+    async def _pick(self) -> _PoolConn:
+        self._rr = (self._rr + 1) % self.pool_size
+        return await self._ensure_conn(self._rr)
+
+    # ------------------------------------------------------------ requests
+    async def submit(self, key: bytes, value: bytes) -> SubmitResult:
+        """One durable write. Retries typed refusals under the backoff
+        + budget discipline; raises :class:`WireRefused` past it,
+        :class:`WireDisconnected` on a mid-flight connection loss (the
+        write may still commit — never auto-resubmitted), and
+        :class:`WireError` when the server could not resolve the
+        outcome."""
+        return await self._with_retries(
+            lambda req_id: P.encode_submit(
+                req_id, key, value,
+                max_frame_bytes=self.max_frame_bytes,
+            ),
+            self._parse_submit,
+            reconnect_retry=False,
+        )
+
+    async def submit_many(self, items) -> BatchResult:
+        """Many writes in ONE frame (the batched-ingest amortization —
+        docs/NETWORK.md). Single attempt, no retry wrapper: per-entry
+        refusals come back AS data (``BatchResult.shed``), because a
+        partially-admitted batch must not be resubmitted whole. Raises
+        :class:`WireDisconnected` on a mid-flight connection loss (the
+        admitted part may still commit)."""
+        req_id = self._next_req_id
+        self._next_req_id += 1
+        try:
+            conn = await self._pick()
+        except OSError as ex:
+            # connect failure before anything was sent: typed, so
+            # callers handle one exception family for conn loss
+            raise WireDisconnected(
+                f"cannot connect to {self.host}:{self.port}: {ex}"
+            )
+        tag, body = await conn.request(req_id, P.encode_submit_batch(
+            req_id, items, max_frame_bytes=self.max_frame_bytes,
+        ))
+        if tag == "ok_batch":
+            accepted, shed, floors = body
+            self.budget.on_success()
+            if shed:
+                self.stats["sheds"] += shed
+            return BatchResult(accepted, shed, floors)
+        if tag == "error":
+            raise WireError(body)
+        if tag == "refused":
+            # the whole frame was refused before ingest (wire_backlog:
+            # the server's bounded coalesce buffer) — nothing queued
+            reason, retry_after = body
+            self.stats["sheds"] += 1
+            raise WireRefused(reason, retry_after, 1)
+        raise WireRefused("batch_unresolved", 0.0, 1)
+
+    async def read(self, key: bytes,
+                   cls: str = "linearizable") -> ReadResult:
+        """One read under ``cls`` (``linearizable`` / ``any`` /
+        ``session`` — the served class comes back on the result).
+        Reads are effect-free, so connection losses reconnect-retry."""
+        return await self._with_retries(
+            lambda req_id: P.encode_read(
+                req_id, cls, key, max_frame_bytes=self.max_frame_bytes,
+            ),
+            self._parse_read,
+            reconnect_retry=True,
+        )
+
+    @staticmethod
+    def _parse_submit(tag: str, body, attempts: int):
+        if tag != "ok":
+            return None
+        group, seq, floor = body
+        return SubmitResult(group, seq, floor, attempts)
+
+    @staticmethod
+    def _parse_read(tag: str, body, attempts: int):
+        if tag != "value":
+            return None
+        group, index, cls, value = body
+        return ReadResult(group, index, cls, value, attempts)
+
+    async def _with_retries(self, build, parse, reconnect_retry: bool):
+        last_reason, last_hint = "unknown", 0.0
+        attempt = 0
+        while True:
+            attempt += 1
+            req_id = self._next_req_id
+            self._next_req_id += 1
+            try:
+                conn = await self._pick()
+            except OSError as ex:
+                # connect failure: NOTHING was sent, so retrying is
+                # safe even for writes — a refused dial (server
+                # restarting, redial target not up yet) rides the
+                # same backoff instead of leaking a raw OSError
+                if attempt <= self.retries:
+                    self.stats["retries"] += 1
+                    await self._sleep(self.backoff.delay(attempt - 1))
+                    continue
+                raise WireDisconnected(
+                    f"cannot connect to {self.host}:{self.port}: {ex}"
+                )
+            try:
+                tag, body = await conn.request(req_id, build(req_id))
+            except WireDisconnected:
+                if reconnect_retry and attempt <= self.retries:
+                    continue
+                raise
+            out = parse(tag, body, attempt)
+            if out is not None:
+                self.budget.on_success()
+                return out
+            if tag == "error":
+                raise WireError(body)
+            if tag == "refused":
+                last_reason, last_hint = body
+                self.stats["sheds"] += 1
+            elif tag == "not_leader":
+                group, hint = body
+                last_reason, last_hint = "not_leader", 0.0
+                self.stats["not_leader"] += 1
+                target = self.addr_map.get(hint)
+                if target is not None and target != (self.host,
+                                                     self.port):
+                    # leader-hint redial: repoint the pool (closing
+                    # the old conns — an orphaned socket per redial
+                    # would leak across a flappy election)
+                    self.host, self.port = target
+                    for old in self._conns:
+                        if old is not None:
+                            old.close()
+                    self._conns = [None] * self.pool_size
+                    self.stats["redials"] += 1
+            if attempt > self.retries:
+                raise WireRefused(last_reason, last_hint, attempt)
+            if not self.budget.try_spend():
+                self.stats["budget_denied"] += 1
+                raise WireRefused(last_reason, last_hint, attempt)
+            self.stats["retries"] += 1
+            delay = self.backoff.delay(
+                attempt - 1, last_hint if last_hint > 0 else None
+            )
+            if len(self.last_delays) >= 256:
+                del self.last_delays[:128]
+            self.last_delays.append(delay)
+            await self._sleep(delay)

@@ -1,0 +1,399 @@
+"""Length-prefixed binary wire protocol for the data plane.
+
+One frame = an 8-byte header (``!HBBI``: magic, protocol version, kind,
+payload length) followed by ``length`` payload bytes. The framing is
+deliberately dumb: no compression, no TLVs, no varints — a reader can
+always tell "incomplete" (wait for more bytes) from "corrupt" (bad
+magic/version: the stream can never resynchronize, close it) from
+"hostile" (a length past the configured bound: refuse before buffering,
+the oversized-payload backstop). docs/NETWORK.md carries the full
+frame table and the backpressure contract.
+
+Request frames carry a client-chosen ``req_id`` (u64) echoed verbatim
+on exactly one response frame, so responses pipeline back out of order
+over one connection while the client completes them by id.
+
+Read classes ride the wire twice: the REQUEST class is what the client
+asked for (``linearizable`` / ``any`` / ``session``), the SERVED class
+on the response is what certification actually cost (``read_index`` /
+``lease`` / ``follower`` / ``session`` — the docs/READS.md matrix), so
+a wire client sees the same per-class accounting the in-process Router
+reports.
+
+Session tokens (``multi.router.ReadSession`` floors) are plain
+``(group, index)`` pairs: a client sends its floors in ``HELLO`` (the
+reconnect-and-resume carry), and every ``OK``/``VALUE`` response
+returns the one floor it raised, so the client-side token stays current
+without a dedicated token round-trip.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+MAGIC = 0x5254          # "RT"
+VERSION = 1
+
+#: default frame-size bound: anything longer is refused BEFORE it is
+#: buffered (FrameTooLarge) — the wire's oversized-payload backstop
+MAX_FRAME_BYTES = 1 << 20
+
+# ------------------------------------------------------------- kinds
+HELLO = 1        # client -> server: session floors (reconnect carry)
+WELCOME = 2      # server -> client: entry_bytes, group count
+SUBMIT = 3       # client -> server: one write
+READ = 4         # client -> server: one read (request class below)
+OK = 5           # server -> client: submit acked DURABLE
+VALUE = 6        # server -> client: read served
+REFUSED = 7      # server -> client: typed backpressure (no effect)
+NOT_LEADER = 8   # server -> client: no routed leader; hint attached
+ERROR = 9        # server -> client: protocol violation (conn closes)
+SUBMIT_BATCH = 10  # client -> server: many writes, ONE frame
+OK_BATCH = 11      # server -> client: batch acked (admitted part durable)
+
+KIND_NAMES = {
+    HELLO: "hello", WELCOME: "welcome", SUBMIT: "submit", READ: "read",
+    OK: "ok", VALUE: "value", REFUSED: "refused",
+    NOT_LEADER: "not_leader", ERROR: "error",
+    SUBMIT_BATCH: "submit_batch", OK_BATCH: "ok_batch",
+}
+
+#: request-side read classes (what the client ASKS for)
+READ_CLASSES = {"linearizable": 0, "any": 1, "session": 2}
+READ_CLASS_NAMES = {v: k for k, v in READ_CLASSES.items()}
+
+#: response-side served classes (what certification actually COST —
+#: all four docs/READS.md classes are representable on the wire)
+SERVED_CLASSES = {"read_index": 0, "lease": 1, "follower": 2,
+                  "session": 3}
+SERVED_CLASS_NAMES = {v: k for k, v in SERVED_CLASSES.items()}
+
+_HEADER = struct.Struct("!HBBI")
+
+
+class ProtocolError(Exception):
+    """The byte stream violates the protocol (bad magic/version,
+    malformed payload). Unrecoverable for the connection: framing
+    carries no resync marker, so the only safe action is to close."""
+
+
+class FrameTooLarge(ProtocolError):
+    """A header announced a payload past the configured bound. Raised
+    BEFORE the payload is buffered — a hostile length can never make
+    the server allocate it."""
+
+
+# ----------------------------------------------------------- framing
+def encode_frame(kind: int, payload: bytes,
+                 max_frame_bytes: int = MAX_FRAME_BYTES) -> bytes:
+    if len(payload) > max_frame_bytes:
+        raise FrameTooLarge(
+            f"{KIND_NAMES.get(kind, kind)} payload {len(payload)} B "
+            f"exceeds the {max_frame_bytes} B frame bound"
+        )
+    return _HEADER.pack(MAGIC, VERSION, kind, len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental frame parser. ``feed`` returns every COMPLETE frame
+    the new bytes finished; a torn tail (header or payload cut mid-way)
+    stays buffered until more bytes arrive — ``pending`` exposes how
+    many are waiting, so a connection teardown can tell "clean close"
+    from "died mid-frame"."""
+
+    def __init__(self, max_frame_bytes: int = MAX_FRAME_BYTES):
+        self.max_frame_bytes = max_frame_bytes
+        self._buf = bytearray()
+        self.frames_in = 0
+
+    @property
+    def pending(self) -> int:
+        return len(self._buf)
+
+    def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
+        self._buf.extend(data)
+        out: List[Tuple[int, bytes]] = []
+        while True:
+            if len(self._buf) < _HEADER.size:
+                return out
+            magic, version, kind, length = _HEADER.unpack_from(self._buf)
+            if magic != MAGIC:
+                raise ProtocolError(
+                    f"bad magic 0x{magic:04x} (expected 0x{MAGIC:04x})"
+                )
+            if version != VERSION:
+                raise ProtocolError(
+                    f"unsupported protocol version {version}"
+                )
+            if length > self.max_frame_bytes:
+                raise FrameTooLarge(
+                    f"frame announces {length} B payload "
+                    f"(bound {self.max_frame_bytes} B)"
+                )
+            if len(self._buf) < _HEADER.size + length:
+                return out                      # torn: wait for bytes
+            payload = bytes(self._buf[_HEADER.size:_HEADER.size + length])
+            del self._buf[:_HEADER.size + length]
+            self.frames_in += 1
+            out.append((kind, payload))
+
+
+# ----------------------------------------------- payload pack helpers
+def _pb16(b: bytes) -> bytes:
+    if len(b) > 0xFFFF:
+        raise ProtocolError(f"field of {len(b)} B exceeds u16 length")
+    return struct.pack("!H", len(b)) + b
+
+
+def _ub16(buf: bytes, off: int) -> Tuple[bytes, int]:
+    _need(buf, off, 2)       # a payload cut AT the prefix must raise
+    (n,) = struct.unpack_from("!H", buf, off)   # ProtocolError, never
+    off += 2                                    # a bare struct.error
+    if off + n > len(buf):
+        raise ProtocolError("truncated u16-length field")
+    return buf[off:off + n], off + n
+
+
+def _pb32(b: bytes) -> bytes:
+    return struct.pack("!I", len(b)) + b
+
+
+def _ub32(buf: bytes, off: int) -> Tuple[bytes, int]:
+    _need(buf, off, 4)
+    (n,) = struct.unpack_from("!I", buf, off)
+    off += 4
+    if off + n > len(buf):
+        raise ProtocolError("truncated u32-length field")
+    return buf[off:off + n], off + n
+
+
+def _need(buf: bytes, off: int, n: int) -> None:
+    if off + n > len(buf):
+        raise ProtocolError("truncated frame payload")
+
+
+# ------------------------------------------------------------- HELLO
+def encode_hello(floors: Optional[Dict[int, int]] = None,
+                 **kw) -> bytes:
+    floors = floors or {}
+    body = struct.pack("!H", len(floors))
+    for g, idx in sorted(floors.items()):
+        body += struct.pack("!IQ", g, idx)
+    return encode_frame(HELLO, body, **kw)
+
+
+def decode_hello(payload: bytes) -> Dict[int, int]:
+    _need(payload, 0, 2)
+    (n,) = struct.unpack_from("!H", payload)
+    floors: Dict[int, int] = {}
+    off = 2
+    for _ in range(n):
+        _need(payload, off, 12)
+        g, idx = struct.unpack_from("!IQ", payload, off)
+        floors[g] = idx
+        off += 12
+    return floors
+
+
+# ----------------------------------------------------------- WELCOME
+def encode_welcome(entry_bytes: int, groups: int, **kw) -> bytes:
+    return encode_frame(
+        WELCOME, struct.pack("!II", entry_bytes, groups), **kw
+    )
+
+
+def decode_welcome(payload: bytes) -> Tuple[int, int]:
+    _need(payload, 0, 8)
+    return struct.unpack_from("!II", payload)
+
+
+# ------------------------------------------------------------ SUBMIT
+def encode_submit(req_id: int, key: bytes, value: bytes, **kw) -> bytes:
+    return encode_frame(
+        SUBMIT, struct.pack("!Q", req_id) + _pb16(key) + _pb32(value),
+        **kw,
+    )
+
+
+def decode_submit(payload: bytes) -> Tuple[int, bytes, bytes]:
+    _need(payload, 0, 8)
+    (req_id,) = struct.unpack_from("!Q", payload)
+    key, off = _ub16(payload, 8)
+    value, _ = _ub32(payload, off)
+    return req_id, key, value
+
+
+# ------------------------------------------------------ SUBMIT_BATCH
+def encode_submit_batch(req_id: int, items, **kw) -> bytes:
+    """Many writes in ONE frame — the client-side half of the batched
+    ingest amortization (the macro bench's goodput mechanism: framing
+    and event-loop costs amortize over the batch exactly as the fused
+    K-tick scan amortizes device launches). Per-entry outcomes are
+    summarized, not itemized: use single ``SUBMIT`` frames when every
+    op needs its own verdict (the chaos drill does)."""
+    body = struct.pack("!QH", req_id, len(items))
+    for key, value in items:
+        body += _pb16(key) + _pb32(value)
+    return encode_frame(SUBMIT_BATCH, body, **kw)
+
+
+def decode_submit_batch(payload: bytes):
+    _need(payload, 0, 10)
+    req_id, n = struct.unpack_from("!QH", payload)
+    off = 10
+    items = []
+    for _ in range(n):
+        key, off = _ub16(payload, off)
+        value, off = _ub32(payload, off)
+        items.append((key, value))
+    return req_id, items
+
+
+def encode_ok_batch(req_id: int, accepted: int, shed: int,
+                    floors: Dict[int, int], **kw) -> bytes:
+    """Batch resolution: every ADMITTED entry is durable; ``shed``
+    entries were typed-refused at ingest (no effect, per-reason tallies
+    ride the server's net section). ``floors`` carries the commit
+    watermark of every group the batch touched — the session raise."""
+    body = struct.pack("!QIIH", req_id, accepted, shed, len(floors))
+    for g, idx in sorted(floors.items()):
+        body += struct.pack("!IQ", g, idx)
+    return encode_frame(OK_BATCH, body, **kw)
+
+
+def decode_ok_batch(payload: bytes):
+    _need(payload, 0, 18)
+    req_id, accepted, shed, n = struct.unpack_from("!QIIH", payload)
+    off = 18
+    floors: Dict[int, int] = {}
+    for _ in range(n):
+        _need(payload, off, 12)
+        g, idx = struct.unpack_from("!IQ", payload, off)
+        floors[g] = idx
+        off += 12
+    return req_id, accepted, shed, floors
+
+
+# -------------------------------------------------------------- READ
+def encode_read(req_id: int, cls: str, key: bytes, **kw) -> bytes:
+    code = READ_CLASSES.get(cls)
+    if code is None:
+        raise ProtocolError(f"unknown read class {cls!r}")
+    return encode_frame(
+        READ, struct.pack("!QB", req_id, code) + _pb16(key), **kw
+    )
+
+
+def decode_read(payload: bytes) -> Tuple[int, str, bytes]:
+    _need(payload, 0, 9)
+    req_id, code = struct.unpack_from("!QB", payload)
+    cls = READ_CLASS_NAMES.get(code)
+    if cls is None:
+        raise ProtocolError(f"unknown read-class code {code}")
+    key, _ = _ub16(payload, 9)
+    return req_id, cls, key
+
+
+# ---------------------------------------------------------------- OK
+def encode_ok(req_id: int, group: int, seq: int, floor: int,
+              **kw) -> bytes:
+    """Submit acknowledged DURABLE. ``floor`` is the group's commit
+    watermark at ack time — the session-token raise that buys
+    read-your-writes for this write (``Router.note_write_observed``'s
+    wire twin)."""
+    return encode_frame(
+        OK, struct.pack("!QIQQ", req_id, group, seq, floor), **kw
+    )
+
+
+def decode_ok(payload: bytes) -> Tuple[int, int, int, int]:
+    _need(payload, 0, 28)
+    return struct.unpack_from("!QIQQ", payload)
+
+
+# ------------------------------------------------------------- VALUE
+def encode_value(req_id: int, group: int, index: int, served_cls: str,
+                 value: Optional[bytes], **kw) -> bytes:
+    code = SERVED_CLASSES.get(served_cls)
+    if code is None:
+        raise ProtocolError(f"unknown served class {served_cls!r}")
+    body = struct.pack(
+        "!QIQBB", req_id, group, index, code,
+        0 if value is None else 1,
+    )
+    if value is not None:
+        body += _pb32(value)
+    return encode_frame(VALUE, body, **kw)
+
+
+def decode_value(
+    payload: bytes,
+) -> Tuple[int, int, int, str, Optional[bytes]]:
+    _need(payload, 0, 22)
+    req_id, group, index, code, has = struct.unpack_from("!QIQBB",
+                                                         payload)
+    cls = SERVED_CLASS_NAMES.get(code)
+    if cls is None:
+        raise ProtocolError(f"unknown served-class code {code}")
+    value = _ub32(payload, 22)[0] if has else None
+    return req_id, group, index, cls, value
+
+
+# ----------------------------------------------------------- REFUSED
+def encode_refused(req_id: int, reason: str, retry_after_s: float,
+                   **kw) -> bytes:
+    """Typed backpressure: the op provably took NO effect (the
+    admission gate's contract, surfaced at the wire). ``retry_after_s``
+    is the server-clock hint a well-behaved client floors its backoff
+    at (``admission.retry.Backoff.delay``)."""
+    return encode_frame(
+        REFUSED,
+        struct.pack("!Qd", req_id, retry_after_s)
+        + _pb16(reason.encode()),
+        **kw,
+    )
+
+
+def decode_refused(payload: bytes) -> Tuple[int, str, float]:
+    _need(payload, 0, 16)
+    req_id, retry_after = struct.unpack_from("!Qd", payload)
+    reason, _ = _ub16(payload, 16)
+    return req_id, reason.decode(), retry_after
+
+
+# -------------------------------------------------------- NOT_LEADER
+def encode_not_leader(req_id: int, group: int, hint: str = "",
+                      **kw) -> bytes:
+    """No routed leader for the op's group (or leadership moved
+    mid-op). ``hint`` names where to redial — an address when the
+    server knows one, else the replica row (``"replica:N"``) — and may
+    be empty mid-election."""
+    return encode_frame(
+        NOT_LEADER,
+        struct.pack("!QI", req_id, group) + _pb16(hint.encode()),
+        **kw,
+    )
+
+
+def decode_not_leader(payload: bytes) -> Tuple[int, int, str]:
+    _need(payload, 0, 12)
+    req_id, group = struct.unpack_from("!QI", payload)
+    hint, _ = _ub16(payload, 12)
+    return req_id, group, hint.decode()
+
+
+# ------------------------------------------------------------- ERROR
+def encode_error(req_id: int, message: str, **kw) -> bytes:
+    """Protocol violation or unexpected server failure; ``req_id`` 0
+    when the error is connection-level (the server closes after)."""
+    return encode_frame(
+        ERROR, struct.pack("!Q", req_id) + _pb16(message.encode()), **kw
+    )
+
+
+def decode_error(payload: bytes) -> Tuple[int, str]:
+    _need(payload, 0, 8)
+    (req_id,) = struct.unpack_from("!Q", payload)
+    message, _ = _ub16(payload, 8)
+    return req_id, message.decode()

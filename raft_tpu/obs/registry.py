@@ -22,6 +22,9 @@ raft_commits_total                        counter  group
 raft_snapshot_installs_total              counter  group
 raft_snapshot_chunks_total                counter  group
 raft_segments_sealed_total                counter  group
+raft_net_requests_total                   counter  kind
+raft_net_bytes_total                      counter  dir
+raft_net_refusals_total                   counter  reason
 raft_commit_latency_seconds               histogram group
 raft_queue_depth_high_water               gauge    group
 raft_term                                 gauge    group

@@ -25,9 +25,13 @@ scrapeable while the engine runs, without locks on the hot path:
               term/commit/applied watermarks, replication lag, queue
               depths, audit summary, breaker state — plus ``compile``
               and ``memory`` summary sections when those planes are
-              attached, and ``tiered``/``catchup`` sections (seal
+              attached, ``tiered``/``catchup`` sections (seal
               tallies, RS reconstructs, live snapshot-chunk streams)
-              when the tiered log store is configured — JSON
+              when the tiered log store is configured, and a ``net``
+              section (connections, draining, in-flight frames,
+              bytes in/out, per-reason wire refusals, staged-ingest
+              split) when a ``raft_tpu.net.IngestServer`` publishes
+              to the same board — JSON
   /compile    the CompileWatch snapshot (per-program trace/compile
               tallies, event log, sentinel freeze state + violations)
   /memory     the MemoryWatch snapshot with a FRESH live-buffer census

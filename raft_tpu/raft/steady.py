@@ -91,6 +91,17 @@ class StagingRing:
         self.buf = None          # jnp i32[S, B, W], allocated lazily
         self.consumed = 0        # entries popped since reset
         self.staged = 0          # absolute batches staged since reset
+        self.stage_events = 0    # lifetime FULL-batch pack-and-copy
+        #   count (never reset): every host->device full-batch copy
+        #   this ring ever paid (top_up). The wire tier's staged-ingest
+        #   proof reads it per pump phase (net.server): full batches
+        #   staged on the NETWORK side of the wall vs on the tick path
+        #   must split all/nothing.
+        self.stage_tail_events = 0
+        #   window-tail packs (stage_tail): the fused window's trailing
+        #   PARTIAL batch is staged at launch planning by design — one
+        #   per window at most, never per request — so it is counted
+        #   apart from the full-batch contract above.
 
     def _alloc(self) -> None:
         if self.buf is None:
@@ -139,6 +150,7 @@ class StagingRing:
         self.buf = _STAGE_JIT(
             self.buf, words, jnp.int32(self.staged % self.S)
         )
+        self.stage_tail_events += 1
 
     def top_up(self, queue: List, entry_bytes: int,
                max_new: Optional[int] = None) -> int:
@@ -174,6 +186,7 @@ class StagingRing:
             )
             self.staged += 1
             staged_new += 1
+            self.stage_events += 1
         return staged_new
 
 

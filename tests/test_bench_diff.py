@@ -127,6 +127,35 @@ class TestCompare:
         deltas, reg = compare_runs(old, new, 0.10)
         assert reg == [] and all(not d.gated for d in deltas)
 
+    def test_macro_leg_gates(self):
+        """The round-14 macro (wire) columns: e2e latency gates DOWN,
+        the batched-ingest amortization ratio gates UP, and shed_rate
+        is deliberately ungated (at 2x capacity shedding is the
+        designed behavior, not a regression axis)."""
+        old = {"macro_wire": {
+            "e2e_p50_ms": 10.0, "e2e_p99_ms": 20.0,
+            "wire_goodput_ratio": 0.85, "shed_rate": 0.0,
+        }}
+        worse = {"macro_wire": {
+            "e2e_p50_ms": 15.0, "e2e_p99_ms": 30.0,
+            "wire_goodput_ratio": 0.60, "shed_rate": 0.9,
+        }}
+        _, reg = compare_runs(old, worse, 0.10)
+        assert {(d.metric, d.status) for d in reg} == {
+            ("e2e_p50_ms", "regressed"),
+            ("e2e_p99_ms", "regressed"),
+            ("wire_goodput_ratio", "regressed"),
+        }
+        # shed_rate moved 0 -> 0.9 and did not gate
+        assert all(d.metric != "shed_rate" for d in reg)
+        # and improvements never gate
+        better = {"macro_wire": {
+            "e2e_p50_ms": 5.0, "e2e_p99_ms": 9.0,
+            "wire_goodput_ratio": 1.0, "shed_rate": 0.0,
+        }}
+        _, reg2 = compare_runs(old, better, 0.10)
+        assert reg2 == []
+
     def test_format_table_mentions_threshold(self):
         deltas, _ = compare_runs(self._legs(2.0, 1e6),
                                  self._legs(2.5, 1e6), 0.10)

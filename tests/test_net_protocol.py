@@ -1,0 +1,185 @@
+"""Wire protocol codec: framing, round-trips, torn/oversized frames.
+
+Pure host-side tests (no sockets, no engine): the frame format and its
+failure modes are the contract docs/NETWORK.md documents — a reader
+must always be able to tell "incomplete" (wait) from "corrupt" (close)
+from "hostile" (refuse before buffering).
+"""
+
+import pytest
+
+from raft_tpu.net import protocol as P
+
+
+def _roundtrip(frame: bytes):
+    dec = P.FrameDecoder()
+    frames = dec.feed(frame)
+    assert len(frames) == 1
+    assert dec.pending == 0
+    return frames[0]
+
+
+class TestRoundTrips:
+    def test_hello(self):
+        kind, payload = _roundtrip(P.encode_hello({0: 7, 3: 123}))
+        assert kind == P.HELLO
+        assert P.decode_hello(payload) == {0: 7, 3: 123}
+
+    def test_hello_empty(self):
+        kind, payload = _roundtrip(P.encode_hello({}))
+        assert P.decode_hello(payload) == {}
+
+    def test_welcome(self):
+        kind, payload = _roundtrip(P.encode_welcome(256, 16))
+        assert kind == P.WELCOME
+        assert P.decode_welcome(payload) == (256, 16)
+
+    def test_submit(self):
+        kind, payload = _roundtrip(
+            P.encode_submit(42, b"key", b"\x00value\xff")
+        )
+        assert kind == P.SUBMIT
+        assert P.decode_submit(payload) == (42, b"key", b"\x00value\xff")
+
+    def test_submit_batch(self):
+        items = [(b"a", b"1"), (b"b", bytes(64)), (b"", b"")]
+        kind, payload = _roundtrip(P.encode_submit_batch(9, items))
+        assert kind == P.SUBMIT_BATCH
+        assert P.decode_submit_batch(payload) == (9, items)
+
+    def test_ok_batch(self):
+        kind, payload = _roundtrip(
+            P.encode_ok_batch(9, 61, 3, {0: 10, 2: 44})
+        )
+        assert kind == P.OK_BATCH
+        assert P.decode_ok_batch(payload) == (9, 61, 3, {0: 10, 2: 44})
+
+    @pytest.mark.parametrize("cls", ["linearizable", "any", "session"])
+    def test_read_request_classes(self, cls):
+        kind, payload = _roundtrip(P.encode_read(7, cls, b"k"))
+        assert kind == P.READ
+        assert P.decode_read(payload) == (7, cls, b"k")
+
+    def test_read_unknown_class_refused(self):
+        with pytest.raises(P.ProtocolError):
+            P.encode_read(7, "eventual", b"k")
+
+    def test_ok(self):
+        kind, payload = _roundtrip(P.encode_ok(5, 2, 999, 998))
+        assert kind == P.OK
+        assert P.decode_ok(payload) == (5, 2, 999, 998)
+
+    @pytest.mark.parametrize(
+        "cls", ["read_index", "lease", "follower", "session"]
+    )
+    def test_value_all_four_served_classes(self, cls):
+        # all four docs/READS.md serve classes are representable
+        kind, payload = _roundtrip(
+            P.encode_value(5, 1, 77, cls, b"v")
+        )
+        assert kind == P.VALUE
+        assert P.decode_value(payload) == (5, 1, 77, cls, b"v")
+
+    def test_value_absent_key(self):
+        _, payload = _roundtrip(P.encode_value(5, 0, 3, "lease", None))
+        assert P.decode_value(payload) == (5, 0, 3, "lease", None)
+
+    def test_refused(self):
+        _, payload = _roundtrip(P.encode_refused(8, "depth", 2.5))
+        req_id, reason, after = P.decode_refused(payload)
+        assert (req_id, reason) == (8, "depth")
+        assert after == pytest.approx(2.5)
+
+    def test_not_leader(self):
+        _, payload = _roundtrip(P.encode_not_leader(3, 6, "replica:2"))
+        assert P.decode_not_leader(payload) == (3, 6, "replica:2")
+
+    def test_not_leader_empty_hint(self):
+        _, payload = _roundtrip(P.encode_not_leader(3, 6))
+        assert P.decode_not_leader(payload) == (3, 6, "")
+
+    def test_error(self):
+        _, payload = _roundtrip(P.encode_error(0, "bad frame"))
+        assert P.decode_error(payload) == (0, "bad frame")
+
+
+class TestFraming:
+    def test_byte_by_byte_incremental_decode(self):
+        frames = (P.encode_submit(1, b"k", b"v")
+                  + P.encode_read(2, "session", b"k")
+                  + P.encode_ok(1, 0, 5, 5))
+        dec = P.FrameDecoder()
+        out = []
+        for i in range(len(frames)):
+            out.extend(dec.feed(frames[i:i + 1]))
+        assert [k for k, _ in out] == [P.SUBMIT, P.READ, P.OK]
+        assert dec.pending == 0
+
+    def test_many_frames_one_feed(self):
+        blob = b"".join(P.encode_ok(i, 0, i, i) for i in range(10))
+        out = P.FrameDecoder().feed(blob)
+        assert [P.decode_ok(p)[0] for _, p in out] == list(range(10))
+
+    def test_torn_frame_waits_never_emits(self):
+        frame = P.encode_submit(1, b"key", b"value")
+        dec = P.FrameDecoder()
+        assert dec.feed(frame[:-1]) == []
+        assert dec.pending == len(frame) - 1    # died mid-frame: torn
+        # the remaining byte completes it — no bytes were dropped
+        (kind, payload), = dec.feed(frame[-1:])
+        assert P.decode_submit(payload) == (1, b"key", b"value")
+
+    def test_torn_header_waits(self):
+        dec = P.FrameDecoder()
+        assert dec.feed(b"\x52") == []          # half a magic
+        assert dec.pending == 1
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(P.ProtocolError, match="magic"):
+            P.FrameDecoder().feed(b"\x00\x00\x01\x01\x00\x00\x00\x00")
+
+    def test_bad_version_rejected(self):
+        frame = bytearray(P.encode_ok(1, 0, 1, 1))
+        frame[2] = 99                           # version byte
+        with pytest.raises(P.ProtocolError, match="version"):
+            P.FrameDecoder().feed(bytes(frame))
+
+    def test_oversized_announced_length_refused_before_buffering(self):
+        # a hostile header claiming a huge payload is refused from the
+        # HEADER alone — the payload bytes never arrive, never buffer
+        hdr = P._HEADER.pack(P.MAGIC, P.VERSION, P.SUBMIT, 1 << 30)
+        with pytest.raises(P.FrameTooLarge):
+            P.FrameDecoder(max_frame_bytes=1024).feed(hdr)
+
+    def test_encode_respects_frame_bound(self):
+        with pytest.raises(P.FrameTooLarge):
+            P.encode_submit(1, b"k", bytes(2048),
+                            max_frame_bytes=1024)
+
+    def test_truncated_payload_field_rejected(self):
+        # a complete FRAME whose inner length field runs past the
+        # payload is a protocol error, not an index crash
+        _, payload = _roundtrip(P.encode_submit(1, b"key", b"value"))
+        with pytest.raises(P.ProtocolError):
+            P.decode_submit(payload[:-3])
+
+    def test_truncation_at_the_length_prefix_is_typed(self):
+        # cut exactly AT a u16/u32 length prefix: must be the typed
+        # ProtocolError the server's handler catches, never a bare
+        # struct.error that would kill the reader task unhandled
+        import struct
+
+        with pytest.raises(P.ProtocolError):
+            P.decode_submit(struct.pack("!Q", 42))     # ends at key len
+        with pytest.raises(P.ProtocolError):
+            P.decode_submit(struct.pack("!Q", 42) + b"\x00\x03key")
+        with pytest.raises(P.ProtocolError):
+            P.decode_submit_batch(struct.pack("!QH", 1, 2)
+                                  + b"\x00\x01k")      # torn mid-item
+
+    def test_garbage_after_valid_frame_rejected(self):
+        dec = P.FrameDecoder()
+        ok = P.encode_ok(1, 0, 1, 1)
+        assert len(dec.feed(ok)) == 1
+        with pytest.raises(P.ProtocolError):
+            dec.feed(b"\xde\xad\xbe\xef\x00\x00\x00\x00")

@@ -1,0 +1,441 @@
+"""The wire tier end to end: loopback server, client discipline,
+staged ingest, backpressure, session carry, and the chaos pin.
+
+Wall-budget note (README "Testing strategy"): everything here is
+event-driven over loopback — the only real-clock waits are the
+client's millisecond-scale jittered backoffs — and the whole file
+targets well under the ~15 s network-suite budget.
+"""
+
+import asyncio
+
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.examples.kv import ReplicatedKV
+from raft_tpu.net import (
+    EngineBackend,
+    IngestServer,
+    RouterBackend,
+    WireClient,
+    WireRefused,
+)
+from raft_tpu.net.client import WireDisconnected
+from raft_tpu.raft import RaftEngine
+
+
+def _engine_cfg(**kw):
+    base = dict(
+        n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=256,
+        transport="single", seed=0,
+    )
+    base.update(kw)
+    return RaftConfig(**base)
+
+
+def _serve(backend, scenario, **server_kw):
+    """Boot a server, run ``scenario(server, port)``, tear down."""
+    async def main():
+        srv = IngestServer(backend, **server_kw)
+        port = await srv.start()
+        try:
+            return await scenario(srv, port)
+        finally:
+            await srv.stop()
+    return asyncio.run(main())
+
+
+# ------------------------------------------------------------ end to end
+class TestEndToEnd:
+    def test_submit_then_reads_all_classes(self):
+        e = RaftEngine(_engine_cfg(admission_max_writes=64,
+                                   admission_max_reads=64))
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port).connect()
+            assert (c.entry_bytes, c.groups) == (e.cfg.entry_bytes, 1)
+            r = await c.submit(b"k1", b"v1")
+            assert e.is_durable(r.seq)
+            lin = await c.read(b"k1")
+            assert lin.value == b"v1"
+            assert lin.cls in ("read_index", "lease")
+            ses = await c.read(b"k1", cls="session")
+            assert ses.value == b"v1"
+            assert ses.cls == "session"
+            # the session token rose through the OK/VALUE floors
+            assert c.session.floor[0] >= r.seq
+            await c.close()
+            return srv.stats()
+
+        stats = _serve(EngineBackend(e, kv), scenario)
+        assert stats["requests_total"] == {
+            "hello": 1, "submit": 1, "read": 2,
+        }
+        assert stats["responses_total"] == 3
+        assert stats["refusals"] == {}
+        assert stats["bytes_in"] > 0 and stats["bytes_out"] > 0
+
+    def test_missing_key_reads_none(self):
+        e = RaftEngine(_engine_cfg())
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port).connect()
+            out = await c.read(b"ghost")
+            await c.close()
+            return out
+
+        out = _serve(EngineBackend(e, kv), scenario)
+        assert out.value is None
+
+    def test_router_backend_routes_groups_and_batches(self):
+        from raft_tpu.examples.kv_sharded import ShardedKV
+        from raft_tpu.multi.engine import MultiEngine
+        from raft_tpu.multi.router import Router
+
+        cfg = _engine_cfg(admission_max_writes=8)
+        eng = MultiEngine(cfg, 4)
+        router = Router(eng, drive=False)
+        skv = ShardedKV(eng, router)
+        eng.seed_leaders()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port).connect()
+            outs = await asyncio.gather(*[
+                c.submit(b"k%d" % i, b"v%d" % i) for i in range(8)
+            ])
+            assert {o.group for o in outs} == {
+                router.group_of(b"k%d" % i) for i in range(8)
+            }
+            # one SUBMIT_BATCH frame: admission per entry, sheds AS
+            # data, admitted part durable on ack
+            batch = await c.submit_many(
+                [(b"k0", b"b%d" % i) for i in range(3 * 8)]
+            )
+            assert batch.accepted + batch.shed == 24
+            assert batch.shed > 0          # past the depth bound
+            g0 = router.group_of(b"k0")
+            assert batch.floors[g0] >= 1
+            out = await c.read(b"k1")
+            assert out.value == b"v1"
+            await c.close()
+            return srv.stats()
+
+        stats = _serve(RouterBackend(router, skv), scenario)
+        assert stats["requests_total"]["submit_batch"] == 1
+        assert stats["refusals"].get("depth", 0) > 0
+
+    def test_drive_true_router_rejected(self):
+        from raft_tpu.multi.engine import MultiEngine
+        from raft_tpu.multi.router import Router
+
+        eng = MultiEngine(_engine_cfg(), 2)
+        with pytest.raises(ValueError, match="drive=False"):
+            RouterBackend(Router(eng))
+
+
+# -------------------------------------------------------- staged ingest
+class TestStagedIngest:
+    def test_wire_batches_enter_tick_loop_pre_packed(self):
+        """THE staged-ingest pin (ISSUE 14 acceptance): wire-delivered
+        batches land in the ``StagingRing`` device layout during the
+        pump's INGEST phase — the network side of the host/device wall
+        — and the fused tick loop consumes them by ring index with
+        ZERO full-batch re-packs on the tick path (the per-window
+        partial tail is the one by-design launch-planning pack, and it
+        is counted separately)."""
+        cfg = _engine_cfg(fuse_k=8, prevote=True)
+        e = RaftEngine(cfg)
+        e.run_until_leader()
+        payload = bytes(cfg.entry_bytes)
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, pool=2).connect()
+            outs = await asyncio.gather(
+                *[c.submit(b"", payload) for _ in range(64)]
+            )
+            assert len(outs) == 64
+            await c.close()
+            return srv.stats()
+
+        stats = _serve(
+            EngineBackend(e),
+            scenario,
+            drive_quantum_s=cfg.fuse_k * cfg.heartbeat_period,
+        )
+        # every full batch was pre-packed on the wire side of the wall
+        assert stats["wire_staged_batches"] > 0
+        assert stats["tick_staged_batches"] == 0
+        # and the fused scan really consumed them (this is not a
+        # degenerate no-fusion run)
+        assert e.fused_launches > 0
+        assert e.fused_ticks >= 2 * e.fused_launches
+        # accounting closes: wire full batches + window tails cover
+        # all 16 batches of ingested payload
+        assert (stats["wire_staged_batches"]
+                + stats["tick_tail_batches"]) >= 64 // cfg.batch_size
+
+
+# --------------------------------------------------------- backpressure
+class TestBackpressure:
+    def test_refusals_typed_and_retry_after_honored(self):
+        """A saturated gate refuses at the wire BEFORE queueing, and
+        the client's backoff honors the server hint: every retry delay
+        is floored at min(retry_after_s, max_backoff_s) — the Backoff
+        contract carried over the wire."""
+        cfg = _engine_cfg(admission_max_writes=2)
+        e = RaftEngine(cfg)
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        max_backoff = 0.02
+
+        async def scenario(srv, port):
+            c = await WireClient(
+                "127.0.0.1", port, retries=12,
+                base_backoff_s=0.001, max_backoff_s=max_backoff,
+            ).connect()
+            outs = await asyncio.gather(
+                *[c.submit(b"k", b"v%d" % i) for i in range(12)],
+                return_exceptions=True,
+            )
+            await c.close()
+            ok = [o for o in outs if not isinstance(o, Exception)]
+            assert all(isinstance(o, WireRefused) for o in outs
+                       if isinstance(o, Exception))
+            return srv.stats(), ok, list(c.last_delays), c.stats
+
+        stats, ok, delays, cstats = _serve(EngineBackend(e, kv),
+                                           scenario)
+        assert stats["refusals"].get("depth", 0) > 0
+        assert len(ok) >= 1                  # the queue drains; some land
+        assert cstats["retries"] > 0
+        # the depth hint (heartbeat_period, virtual) caps at the
+        # client's max_backoff — every honored delay sits at the floor
+        floor = min(cfg.heartbeat_period, max_backoff)
+        assert delays and all(d >= floor - 1e-9 for d in delays)
+
+    def test_wire_backlog_bound_refuses_never_queues(self):
+        e = RaftEngine(_engine_cfg())
+        e.run_until_leader()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, retries=0).connect()
+            outs = await asyncio.gather(
+                *[c.submit(b"", bytes(e.cfg.entry_bytes))
+                  for _ in range(12)],
+                return_exceptions=True,
+            )
+            refused = [o for o in outs if isinstance(o, WireRefused)]
+            assert refused and all(
+                o.reason == "wire_backlog" for o in refused
+            )
+            await c.close()
+            return srv.stats()
+
+        stats = _serve(EngineBackend(e), scenario, max_pending=2)
+        assert stats["refusals"]["wire_backlog"] >= 1
+        # refused arrivals never entered any queue
+        assert stats["awaiting_writes"] == 0
+
+    def test_unknown_frame_kind_closes_connection(self):
+        """A kind the server does not speak is a protocol violation:
+        connection-level ERROR, typed refusal counted, stream CLOSED —
+        the peer cannot keep streaming at a desynced server."""
+        from raft_tpu.net import protocol as P
+
+        e = RaftEngine(_engine_cfg())
+        e.run_until_leader()
+
+        async def scenario(srv, port):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port
+            )
+            writer.write(P.encode_frame(99, b""))
+            await writer.drain()
+            # the server answers ERROR then closes; EOF proves it
+            data = await asyncio.wait_for(reader.read(1 << 16), 5)
+            frames = P.FrameDecoder().feed(data)
+            assert frames and frames[0][0] == P.ERROR
+            assert await asyncio.wait_for(reader.read(1 << 16), 5) == b""
+            writer.close()
+            return srv.stats()
+
+        stats = _serve(EngineBackend(e), scenario)
+        assert stats["refusals"]["protocol_error"] == 1
+
+    def test_oversized_frame_refused_and_connection_closed(self):
+        e = RaftEngine(_engine_cfg())
+        e.run_until_leader()
+
+        async def scenario(srv, port):
+            c = await WireClient(
+                "127.0.0.1", port, max_frame_bytes=1 << 20,
+            ).connect()
+            with pytest.raises(WireDisconnected):
+                await c.submit(b"k", bytes(8192))
+            await c.close()
+            return srv.stats()
+
+        stats = _serve(EngineBackend(e), scenario,
+                       max_frame_bytes=1024)
+        assert stats["refusals"]["protocol_error"] == 1
+
+
+# ------------------------------------------------------- session tokens
+class TestSessionCarry:
+    def test_reconnect_and_resume_carries_token(self):
+        """The reconnect-and-resume pin: a session token minted on one
+        connection buys monotone reads / RYW on the NEXT connection —
+        the HELLO floors are adopted server-side, and a doctored
+        too-high floor is refused typed (the apply stream really is
+        gated on the token)."""
+        from raft_tpu.multi.router import ReadSession
+
+        e = RaftEngine(_engine_cfg(admission_max_writes=64))
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        backend = EngineBackend(e, kv)
+
+        async def scenario(srv, port):
+            c1 = await WireClient("127.0.0.1", port).connect()
+            r = await c1.submit(b"sk", b"sv1")
+            s1 = await c1.read(b"sk", cls="session")
+            assert s1.value == b"sv1"
+            token = dict(c1.session.floor)
+            assert token[0] >= r.seq
+            await c1.close()
+
+            # a NEW connection carrying the old token resumes: the
+            # serve index can never fall below the carried floor
+            c2 = await WireClient(
+                "127.0.0.1", port,
+                session=ReadSession.from_floors(token),
+            ).connect()
+            s2 = await c2.read(b"sk", cls="session")
+            assert s2.index >= token[0]
+            assert s2.value == b"sv1"
+            await c2.close()
+
+            # a floor claiming the future is REFUSED (read_lagging),
+            # not silently served stale
+            c3 = await WireClient(
+                "127.0.0.1", port, retries=0,
+                session=ReadSession.from_floors({0: 10_000}),
+            ).connect()
+            with pytest.raises(WireRefused) as ei:
+                await c3.read(b"sk", cls="session")
+            assert ei.value.reason == "read_lagging"
+            await c3.close()
+            return srv.stats()
+
+        stats = _serve(backend, scenario)
+        assert stats["refusals"]["read_lagging"] == 1
+
+
+# ------------------------------------------------------- obs + /status
+class TestObservability:
+    def test_net_status_section_and_counters(self):
+        from raft_tpu.obs.registry import MetricsRegistry
+        from raft_tpu.obs.serve import StatusBoard
+
+        e = RaftEngine(_engine_cfg())
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        reg = MetricsRegistry()
+        board = StatusBoard()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port).connect()
+            await c.submit(b"k", b"v")
+            await c.read(b"k")
+            await c.close()
+            return None
+
+        _serve(EngineBackend(e, kv), scenario,
+               registry=reg, status_board=board)
+        net = board.compose()["net"]
+        assert net["requests_total"]["submit"] == 1
+        assert net["bytes_in"] > 0 and net["bytes_out"] > 0
+        assert net["draining"] is True          # post-stop publish
+        req = reg.counter("raft_net_requests_total",
+                          "wire requests by frame kind", ("kind",))
+        assert req.value(kind="submit") == 1
+        assert req.value(kind="read") == 1
+        by = reg.counter("raft_net_bytes_total",
+                         "wire bytes by direction", ("dir",))
+        assert by.value(dir="in") > 0
+        assert by.value(dir="out") > 0
+
+    def test_spans_annotate_wire_ops(self):
+        from raft_tpu.obs.spans import SpanTracker
+
+        e = RaftEngine(_engine_cfg())
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        spans = SpanTracker()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port).connect()
+            await c.submit(b"k", b"v")
+            await c.read(b"k")
+            await c.close()
+
+        _serve(EngineBackend(e, kv), scenario, spans=spans)
+        wire = [sp for sp in spans.spans
+                if sp.op.startswith("wire_")]
+        assert len(wire) == 2
+        for sp in wire:
+            assert sp.terminal and sp.state == "ok"
+            names = {name for _, name, _ in sp.annotations}
+            # queue-vs-wire time is reconstructable: receipt, the
+            # ingest batch boundary, and the response all stamped
+            assert {"wire_recv", "wire_ingest", "wire_sent"} <= names
+
+
+# ------------------------------------------------------------ chaos pin
+class TestWireChaos:
+    def test_wire_drill_pinned_seed(self):
+        """Tier-1 pin (ISSUE 14): torture traffic through a REAL
+        loopback server — leader-kill and overload nemeses composed —
+        must check LINEARIZABLE per read class, with the gate's typed
+        refusals actually surfacing as wire backpressure and clients
+        riding NOT_LEADER through the election."""
+        from raft_tpu.chaos.runner import wire_run
+
+        rep = wire_run(7)
+        assert rep.verdict == "LINEARIZABLE"
+        assert rep.shed_writes >= 1
+        assert rep.not_leader_frames >= 1
+        assert rep.leader_kills == 1
+        assert rep.wire_refusals.get("depth", 0) >= 1
+        assert rep.op_counts.get("ok", 0) > 50
+
+    def test_chaos_seeds_replay_byte_identically_wire_plane_off(self):
+        """The other half of the acceptance pin: the wire plane is
+        strictly additive — after real wire traffic has run in this
+        process, a plain chaos seed still replays byte-identically to
+        the session-shared baseline."""
+        from raft_tpu.chaos.runner import torture_run
+        from tests._torture_fingerprints import (
+            fingerprint,
+            plain_membership_run,
+        )
+
+        # make sure the wire plane has actually been exercised in this
+        # process first (any earlier test in this file does, but the
+        # pin must not depend on test ordering)
+        e = RaftEngine(_engine_cfg())
+        e.run_until_leader()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port).connect()
+            await c.submit(b"", bytes(e.cfg.entry_bytes))
+            await c.close()
+
+        _serve(EngineBackend(e), scenario)
+        assert fingerprint(
+            torture_run(11, phases=4, membership=True)
+        ) == plain_membership_run(11)

@@ -88,6 +88,17 @@ GATED_METRICS: Dict[str, str] = {
     "read_p50_us": "down",
     "read_p99_us": "down",
     "speedup_vs_read_index": "up",
+    # macro (wire) leg (round 14): end-to-end service latency gates
+    # DOWN and the batched-ingest amortization ratio (wire goodput /
+    # in-process Router.submit goodput, same shape same box) gates UP;
+    # goodput_eps above already gates the absolute throughput on every
+    # macro row. shed_rate is deliberately REPORTED UNGATED: the
+    # leader-kill row runs at 2x capacity where shedding is the
+    # designed behavior, and its level is workload-shaped, not a
+    # regression axis.
+    "e2e_p50_ms": "down",
+    "e2e_p99_ms": "down",
+    "wire_goodput_ratio": "up",
 }
 
 
