@@ -1402,6 +1402,16 @@ def bench_macro() -> dict:
       ingest amortization claim (acceptance: >= 0.70; measured ~1.0 on
       this box, because the tick loop, not the wire, is the
       bottleneck — exactly what the batching is for).
+    - ``macro_wire_traced`` — the wire trace plane's overhead and the
+      pump-phase attribution (ISSUE 15): the SAME batched shape run as
+      a bracketed untraced / traced / untraced trio, reporting
+      ``tracing_overhead_ratio`` (traced / mean-of-brackets goodput;
+      acceptance: >= 0.95, i.e. tracing costs <= 5%), the
+      ``PumpProfiler`` per-phase µs/iteration split with its coverage
+      (phases tile the pump iteration by construction; acceptance
+      >= 0.90), and the coalesce-batch-size / frame-queue-age
+      percentiles — the measured table behind "the tick loop, not the
+      wire, is the bottleneck" (docs/PERF.md).
     - ``macro_leader_kill`` — "p99 under leader kill at 2x capacity"
       as ONE reproducible row: single-op open-loop arrivals paced at
       2x the measured in-process capacity, Zipf(1.2) key skew, 15%
@@ -1535,6 +1545,107 @@ def bench_macro() -> dict:
     wire_row_out = asyncio.run(wire_row())
     rows["wire"] = _emit_leg("macro_wire", wire_row_out)
     wire_eps = wire_row_out["goodput_eps"]
+
+    # ---- row 2b: tracing overhead + pump attribution, bracketed --------
+    def wire_window(traced: bool, n_entries: int):
+        """One wire goodput window at the row-2 shape; ``traced=True``
+        arms the FULL trace plane (client spans + ctx propagation,
+        server span adoption, pump profiler, registry) so the overhead
+        number charges everything the plane costs."""
+        eng = fresh_stack()
+        backend = RouterBackend(Router(eng, drive=False))
+        srv_kw: dict = {}
+        cli_kw: dict = {}
+        plane: dict = {}
+        if traced:
+            from raft_tpu.obs.hostprof import PumpProfiler
+            from raft_tpu.obs.registry import MetricsRegistry
+            from raft_tpu.obs.spans import SpanTracker
+
+            sspans = SpanTracker()
+            cspans = SpanTracker()
+            reg = MetricsRegistry()
+            pump = PumpProfiler(registry=reg)
+            eng.spans = sspans
+            srv_kw = dict(spans=sspans, registry=reg, pump=pump)
+            cli_kw = dict(spans=cspans)
+            plane = {"sspans": sspans, "cspans": cspans}
+
+        async def run():
+            srv = IngestServer(backend,
+                               drive_quantum_s=cfg.heartbeat_period,
+                               **srv_kw)
+            port = await srv.start()
+            cs = [await WireClient("127.0.0.1", port,
+                                   **cli_kw).connect()
+                  for _ in range(CONNS)]
+            t0 = time.perf_counter()
+
+            async def worker(c, share):
+                acked = 0
+                for j in range(max(share // B, 1)):
+                    items = [(keys[(j * B + i) % len(keys)], payload)
+                             for i in range(B)]
+                    r = await c.submit_many(items)
+                    acked += r.accepted
+                return acked
+
+            acked = sum(await asyncio.gather(
+                *[worker(c, n_entries // CONNS) for c in cs]
+            ))
+            wall = time.perf_counter() - t0
+            for c in cs:
+                await c.close()
+            stats = srv.stats()
+            await srv.stop()
+            return acked, wall, stats
+
+        acked, wall, stats = asyncio.run(run())
+        extras = {}
+        if traced:
+            extras = {
+                "pump": stats.get("pump") or {},
+                "client_spans": len(plane["cspans"].spans),
+                "server_spans": len(plane["sspans"].spans),
+            }
+        return acked / wall, extras
+
+    N2 = N // 2
+    # one throwaway warm window (the first window after a stack swap
+    # runs measurably cold), then ALTERNATING off/on brackets: single
+    # ~0.2 s loopback windows vary +-15% on a shared box, so the ratio
+    # is a mean-of-3 vs mean-of-2 — the same bracketing discipline the
+    # attribution leg uses
+    wire_window(False, N2)
+    off1, _ = wire_window(False, N2)
+    on1, tr = wire_window(True, N2)
+    off2, _ = wire_window(False, N2)
+    on2, _ = wire_window(True, N2)
+    off3, _ = wire_window(False, N2)
+    traced_eps = (on1 + on2) / 2.0
+    untraced_eps = (off1 + off2 + off3) / 3.0
+    pump = tr["pump"]
+    cb, qa = pump.get("coalesce_batch", {}), pump.get("queue_age_us", {})
+    rows["wire_traced"] = _emit_leg("macro_wire_traced", {
+        "entries": N2,
+        "connections": CONNS,
+        "wire_batch": B,
+        "traced_goodput_eps": round(traced_eps, 1),
+        "untraced_goodput_eps": round(untraced_eps, 1),
+        "tracing_overhead_ratio": round(traced_eps / untraced_eps, 4),
+        #   >= 0.95 acceptance: the whole trace plane (spans both
+        #   sides, 17 B/frame context, pump profiler, registry) costs
+        #   <= 5% of wire goodput at the headline shape
+        "pump_iters": pump.get("iters"),
+        "pump_coverage": pump.get("coverage"),
+        "pump_us_per_iter": pump.get("us_per_iter"),
+        "coalesce_batch_p50": cb.get("p50"),
+        "coalesce_batch_p99": cb.get("p99"),
+        "queue_age_p50_us": qa.get("p50"),
+        "queue_age_p99_us": qa.get("p99"),
+        "client_spans": tr["client_spans"],
+        "server_spans": tr["server_spans"],
+    })
 
     # ---- row 3: leader kill at 2x capacity, open-loop ------------------
     eng = fresh_stack()

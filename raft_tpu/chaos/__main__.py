@@ -220,6 +220,7 @@ def main(argv=None) -> int:
                 seed, clients=args.clients, keys=args.keys,
                 step_budget=args.step_budget,
                 blackbox_dir=args.blackbox_dir,
+                bundle_dir=args.bundle_dir,
             )
             print(rep.summary())
             print(json.dumps({
@@ -234,6 +235,11 @@ def main(argv=None) -> int:
                 "wire_refusals": rep.wire_refusals,
                 "leader_kills": rep.leader_kills,
                 "net": rep.net,
+                "commit_digest": rep.commit_digest,
+                "traced": rep.traced,
+                "client_spans": rep.client_spans,
+                "server_spans": rep.server_spans,
+                "bundle": rep.bundle_path,
             }), flush=True)
             ok = ok and (
                 rep.verdict == "LINEARIZABLE"

@@ -1409,16 +1409,7 @@ class _MultiTorture(_TortureBase):
         return self.engine.is_durable(g, seq)
 
     def commit_digest(self) -> str:
-        crc = 0
-        for g in range(self.engine.G):
-            wm = int(self.engine.commit_watermark[g])
-            crc = zlib.crc32(f"g{g}:wm:{wm}".encode(), crc)
-            arch = self.engine._archive[g]
-            for idx in sorted(i for i in arch if i <= wm):
-                crc = zlib.crc32(
-                    arch[idx], zlib.crc32(f"{idx}".encode(), crc)
-                )
-        return f"{crc:08x}"
+        return multi_commit_digest(self.engine)
 
     def invoke(self, cl: _Client) -> None:
         from raft_tpu.multi.engine import NotLeader
@@ -2568,6 +2559,23 @@ def reads_run(
     )
 
 
+def multi_commit_digest(engine) -> str:
+    """CRC over every group's committed archive tail + watermark — the
+    MultiEngine commit fingerprint (the single definition the multi
+    open-loop runner and the wire drill both report, so their
+    byte-identity pins compare the same quantity)."""
+    crc = 0
+    for g in range(engine.G):
+        wm = int(engine.commit_watermark[g])
+        crc = zlib.crc32(f"g{g}:wm:{wm}".encode(), crc)
+        arch = engine._archive[g]
+        for idx in sorted(i for i in arch if i <= wm):
+            crc = zlib.crc32(
+                arch[idx], zlib.crc32(f"{idx}".encode(), crc)
+            )
+    return f"{crc:08x}"
+
+
 # ------------------------------------------------------- the wire drill
 @dataclasses.dataclass
 class WireReport:
@@ -2590,6 +2598,14 @@ class WireReport:
     net: dict                    # final server ``net`` stats section
     read_classes: Dict[str, int]
     repro: str
+    commit_digest: str = ""      # multi_commit_digest at quiesce
+    traced: bool = False         # the wire trace plane was armed
+    client_spans: int = 0        # client-side span count (traced runs)
+    server_spans: int = 0        # server-side wire-op span count
+    pump: Optional[dict] = None  # PumpProfiler.stats() (traced runs)
+    bundle_path: Optional[str] = None
+    #   one bundle carrying BOTH span tables (spans + client_spans)
+    #   when a bundle_dir was configured — the joined --explain input
 
     @property
     def verdict(self) -> str:
@@ -2620,6 +2636,8 @@ def wire_run(
     groups: int = 2,
     step_budget: int = 500_000,
     blackbox_dir: Optional[str] = None,
+    trace: bool = True,
+    bundle_dir: Optional[str] = None,
 ) -> WireReport:
     """The deterministic wire-plane drill (``--wire``): a sharded
     Router stack served over a REAL loopback asyncio TCP server, with
@@ -2645,7 +2663,21 @@ def wire_run(
     ``check_read_classes``; the drill passes only if every class holds
     its contract, a shed happened, and NOT_LEADER frames were ridden
     through. No real-clock sleeps beyond the client's millisecond-scale
-    jittered backoff — the run is event-driven end to end."""
+    jittered backoff — the run is event-driven end to end.
+
+    ``trace=True`` (the default — the drill RUNS traced, ISSUE 15)
+    arms the full wire trace plane: one client-side span per op
+    (attempts/backoffs/redials), server-side wire spans adopting the
+    propagated context, the pump-phase profiler, and the net metrics
+    registry — all strictly additive (the determinism pin compares
+    trace on vs off on a serial deterministic scenario; the drill's
+    own asyncio/TCP interleaving is outside the seeded-replay domain,
+    which is exactly why its verdict currency is the history checker,
+    not replay identity). With a ``bundle_dir`` (argument or
+    ``RAFT_TPU_BUNDLE_DIR``), a traced run writes one repro bundle
+    carrying BOTH span tables — the ``--explain`` joined-forensics
+    input — regardless of verdict (the artifact is the point of the
+    traced drill, not a failure symptom)."""
     import asyncio
 
     from raft_tpu.examples.kv_sharded import ShardedKV
@@ -2674,6 +2706,27 @@ def wire_run(
     rng = random.Random(f"wire:{seed}")
     leader_kills = 0
     shed_writes = 0
+
+    # -- the wire trace plane (strictly additive; trace=False is the
+    # -- byte-compatible PR-14 drill) ---------------------------------
+    client_spans = server_spans = pump = registry = None
+    if trace:
+        from raft_tpu.obs.hostprof import PumpProfiler
+        from raft_tpu.obs.registry import MetricsRegistry
+        from raft_tpu.obs.spans import SpanTracker
+
+        client_spans = SpanTracker()
+        server_spans = SpanTracker()
+        registry = MetricsRegistry()
+        pump = PumpProfiler(registry=registry)
+        # the engines' own causal hooks chain onto the server wire
+        # spans (ambient binding across the pump's dispatch)
+        eng.spans = server_spans
+
+    def _clock() -> float:
+        # both sides' spans stamp the SAME virtual clock (one thread),
+        # so the joined timeline is one consistent time axis
+        return eng.clock.now
 
     def _g(key: bytes) -> int:
         return router.group_of(key)
@@ -2721,6 +2774,7 @@ def wire_run(
         wc = await WireClient(
             "127.0.0.1", port, pool=1, retries=0,
             rng=random.Random(f"wire-flood:{seed}"),
+            spans=client_spans, clock=_clock, trace_node=1001,
         ).connect()
         shed = 0
         async def one(j: int) -> None:
@@ -2747,6 +2801,7 @@ def wire_run(
         server = IngestServer(
             RouterBackend(router, skv),
             drive_quantum_s=2 * cfg.heartbeat_period,
+            spans=server_spans, registry=registry, pump=pump,
         )
         port = await server.start()
         blackbox.mark("wire_serving", port=port)
@@ -2754,6 +2809,7 @@ def wire_run(
             await WireClient(
                 "127.0.0.1", port, pool=1, retries=48,
                 rng=random.Random(f"wire:{seed}:conn{cid}"),
+                spans=client_spans, clock=_clock, trace_node=cid + 1,
             ).connect()
             for cid in range(clients)
         ]
@@ -2805,7 +2861,7 @@ def wire_run(
         c = getattr(rec, "read_class", None)
         if c:
             counts[c] = counts.get(c, 0) + 1
-    return WireReport(
+    rep = WireReport(
         seed=seed,
         per_class=per_class,
         ops=len(history),
@@ -2816,5 +2872,41 @@ def wire_run(
         leader_kills=leader_kills,
         net=out["net"],
         read_classes=counts,
-        repro=f"python -m raft_tpu.chaos --wire --seed {seed}",
+        repro=f"python -m raft_tpu.chaos --wire --seed {seed}"
+              + ("" if trace else " (untraced)"),
+        commit_digest=multi_commit_digest(eng),
+        traced=trace,
+        client_spans=len(client_spans) if client_spans else 0,
+        server_spans=(
+            sum(1 for sp in server_spans.spans
+                if sp.op.startswith("wire_"))
+            if server_spans else 0
+        ),
+        pump=out["net"].get("pump"),
     )
+    dest = resolve_bundle_dir(bundle_dir)
+    if trace and dest is not None:
+        # the traced drill's artifact: BOTH span tables in one bundle
+        # (plus the op history and faults-free context) — what the
+        # joined --explain consumes; written on every verdict because
+        # the cross-process trace IS the deliverable here
+        try:
+            rep.bundle_path = write_bundle(
+                dest,
+                kind="wire",
+                seed=seed,
+                expected=LINEARIZABLE,
+                verdict=rep.verdict,
+                repro=rep.repro,
+                config=cfg,
+                history=history,
+                spans=server_spans,
+                client_spans=client_spans,
+                extra={"side": "server+client", "net": rep.net,
+                       "commit_digest": rep.commit_digest},
+            )
+        except OSError as ex:       # an unwritable dir must not eat
+            import sys              # the report it was meant to save
+
+            print(f"wire bundle not written: {ex}", file=sys.stderr)
+    return rep

@@ -33,12 +33,30 @@ Failure semantics: a connection loss with a SUBMIT in flight raises
 :class:`WireDisconnected` — the write's outcome is UNKNOWN (it may
 commit) and the client will not silently retry it into a duplicate.
 Reads are effect-free and reconnect-retry freely.
+
+Tracing (ISSUE 15, docs/OBSERVABILITY.md "Wire plane"): with a
+``SpanTracker`` attached (``spans=``), the client opens ONE span per
+op — the whole retry saga: every attempt, backoff wait, refusal and
+leader-hint redial is an annotation on that one span, and exactly one
+terminal state closes it (``ok`` / ``shed`` on a typed refusal past
+the discipline / ``failed`` on a server ERROR or an effect-free read
+loss / ``info`` on a mid-flight write disconnect — outcome unknown).
+The span's ``wire_trace`` id is minted deterministically
+(``trace_node`` << 32 | local span id — no rng, the determinism
+contract) and propagated in the trace context of every frame sent on a
+connection that negotiated ``CAP_TRACE`` in the HELLO/WELCOME
+capability handshake; against a pre-trace server the handshake yields
+no capability and every op frame stays byte-identical to the pre-trace
+protocol. ``clock=`` supplies the span timestamp source (the chaos
+drill passes the engine's virtual clock so both sides' artifacts share
+one timeline; default ``time.monotonic``).
 """
 
 from __future__ import annotations
 
 import asyncio
 import random
+import time
 from typing import Dict, List, Optional
 
 from raft_tpu.admission.retry import Backoff, RetryBudget
@@ -64,7 +82,14 @@ class WireRefused(Exception):
 
 class WireDisconnected(Exception):
     """The connection died with the op in flight. For a SUBMIT the
-    outcome is UNKNOWN (record it ``info``, never ``fail``)."""
+    outcome is UNKNOWN (record it ``info``, never ``fail``) — UNLESS
+    ``sent`` is False: a pure connect failure provably sent nothing,
+    so the op had no effect (span state ``failed``, and retrying it is
+    always safe)."""
+
+    def __init__(self, message: str, sent: bool = True):
+        super().__init__(message)
+        self.sent = sent
 
 
 class WireError(Exception):
@@ -118,6 +143,7 @@ class _PoolConn:
         self.pending: Dict[int, asyncio.Future] = {}
         self.open = False
         self.welcome: Optional[tuple] = None
+        self.caps = 0            # negotiated capability intersection
         self._task: Optional[asyncio.Task] = None
 
     async def connect(self, host: str, port: int) -> None:
@@ -126,11 +152,21 @@ class _PoolConn:
         )
         self.open = True
         self._task = asyncio.get_running_loop().create_task(self._read())
-        # HELLO carries the session floors (reconnect-and-resume)
+        # HELLO carries the session floors (reconnect-and-resume) and —
+        # when tracing is armed — the CAP_TRACE advertisement; an
+        # un-instrumented client emits the pre-capability HELLO
+        # byte-for-byte
         fut = self._expect_welcome()
-        self.writer.write(P.encode_hello(self.client.session.floor))
+        caps = P.CAP_TRACE if self.client.spans is not None else 0
+        self.writer.write(P.encode_hello(self.client.session.floor,
+                                         caps=caps))
         await self.writer.drain()
-        self.welcome = await fut
+        entry_bytes, groups, server_caps = await fut
+        self.welcome = (entry_bytes, groups)
+        # trace contexts flow only when BOTH sides speak them: a
+        # pre-trace server echoes nothing and every subsequent frame
+        # stays byte-identical to the pre-trace protocol
+        self.caps = caps & server_caps
         self.client.stats["connects"] += 1
 
     def _expect_welcome(self) -> asyncio.Future:
@@ -163,35 +199,39 @@ class _PoolConn:
                 pass
 
     def _dispatch(self, kind: int, payload: bytes) -> None:
+        kind, ctx, payload = P.split_trace(kind, payload)
+        #   the echoed trace context (trace id, SERVER span id, final
+        #   sampling bit) rides along to the retry loop so the client
+        #   span can record which server span answered each attempt
         if kind == P.WELCOME:
             fut = self.pending.pop(-1, None)
             if fut is not None and not fut.done():
-                fut.set_result(P.decode_welcome(payload))
+                fut.set_result(P.decode_welcome_caps(payload))
             return
         if kind == P.OK:
             req_id, group, seq, floor = P.decode_ok(payload)
             self.client.session.observe(group, floor)
-            result = ("ok", (group, seq, floor))
+            result = ("ok", (group, seq, floor), ctx)
         elif kind == P.VALUE:
             req_id, group, index, cls, value = P.decode_value(payload)
             self.client.session.observe(group, index)
-            result = ("value", (group, index, cls, value))
+            result = ("value", (group, index, cls, value), ctx)
         elif kind == P.OK_BATCH:
             req_id, accepted, shed, floors = P.decode_ok_batch(payload)
             for g, idx in floors.items():
                 self.client.session.observe(g, idx)
-            result = ("ok_batch", (accepted, shed, floors))
+            result = ("ok_batch", (accepted, shed, floors), ctx)
         elif kind == P.REFUSED:
             req_id, reason, retry_after = P.decode_refused(payload)
-            result = ("refused", (reason, retry_after))
+            result = ("refused", (reason, retry_after), ctx)
         elif kind == P.NOT_LEADER:
             req_id, group, hint = P.decode_not_leader(payload)
-            result = ("not_leader", (group, hint))
+            result = ("not_leader", (group, hint), ctx)
         elif kind == P.ERROR:
             req_id, message = P.decode_error(payload)
             if req_id == 0:
                 return                   # connection-level: _read ends
-            result = ("error", message)
+            result = ("error", message, ctx)
         else:
             return
         fut = self.pending.pop(req_id, None)
@@ -225,7 +265,15 @@ class WireClient:
 
     ``addr_map`` maps leader-hint strings (``"replica:N"`` or
     addresses) to ``(host, port)`` targets for the redial path; without
-    it a ``NOT_LEADER`` retries the same server after a backoff."""
+    it a ``NOT_LEADER`` retries the same server after a backoff.
+
+    ``spans``/``clock``/``trace_node`` arm the client side of the wire
+    trace plane (module docstring): one span per op on ``spans``,
+    timestamped by ``clock``, trace ids minted under ``trace_node``
+    (default: a process-wide instance counter — deterministic, no
+    rng)."""
+
+    _next_node = 0
 
     def __init__(
         self,
@@ -242,6 +290,9 @@ class WireClient:
         max_frame_bytes: int = P.MAX_FRAME_BYTES,
         rng: Optional[random.Random] = None,
         sleep=None,
+        spans=None,
+        clock=None,
+        trace_node: Optional[int] = None,
     ) -> None:
         self.host = host
         self.port = port
@@ -256,6 +307,12 @@ class WireClient:
         self.addr_map = addr_map or {}
         self.max_frame_bytes = max_frame_bytes
         self._sleep = sleep if sleep is not None else asyncio.sleep
+        self.spans = spans
+        self._clock = clock
+        if trace_node is None:
+            WireClient._next_node += 1
+            trace_node = WireClient._next_node
+        self.trace_node = int(trace_node) & 0xFFFFFFFF
         self._conns: List[Optional[_PoolConn]] = [None] * self.pool_size
         self._rr = 0
         self._next_req_id = 1
@@ -268,6 +325,41 @@ class WireClient:
         self.last_delays: List[float] = []
         #   backoff delays actually honored, newest last (bounded) —
         #   how tests assert the retry_after_s floor without clocks
+
+    # ------------------------------------------------------------- tracing
+    def _now(self) -> float:
+        return self._clock() if self._clock is not None \
+            else time.monotonic()
+
+    def _begin_span(self, op: str, key: bytes):
+        """One client span per op (None when tracing is off). The
+        cross-process trace id is deterministic: node << 32 | the local
+        span id — unique across clients without an rng draw."""
+        if self.spans is None:
+            return None
+        sp = self.spans.begin(op, self._now(), client=self.trace_node,
+                              key=key)
+        sp.wire_trace = (self.trace_node << 32) | (sp.trace_id
+                                                   & 0xFFFFFFFF)
+        return sp
+
+    def _ctx(self, sp, conn: _PoolConn):
+        """The trace context for one frame — only on a connection that
+        negotiated CAP_TRACE (otherwise None: the frame encodes
+        byte-identically to the pre-trace protocol)."""
+        if sp is None or not (conn.caps & P.CAP_TRACE):
+            return None
+        return (sp.wire_trace, sp.wire_trace, sp.sampled)
+
+    def _finish_at(self, sp, state: str, **fields) -> None:
+        if sp is not None and not sp.terminal:
+            sp.finish(state, self._now(), **fields)
+
+    @staticmethod
+    def _sid(rctx) -> Optional[int]:
+        """The answering server's span id from an echoed context (0 =
+        the server had no span to join — annotate nothing)."""
+        return rctx[1] if rctx is not None and rctx[1] else None
 
     # ----------------------------------------------------------- lifecycle
     async def connect(self) -> "WireClient":
@@ -305,14 +397,45 @@ class WireClient:
         write may still commit — never auto-resubmitted), and
         :class:`WireError` when the server could not resolve the
         outcome."""
-        return await self._with_retries(
-            lambda req_id: P.encode_submit(
-                req_id, key, value,
-                max_frame_bytes=self.max_frame_bytes,
-            ),
-            self._parse_submit,
-            reconnect_retry=False,
-        )
+        sp = self._begin_span("client_submit", key)
+        try:
+            out = await self._with_retries(
+                lambda req_id, trace: P.encode_submit(
+                    req_id, key, value,
+                    max_frame_bytes=self.max_frame_bytes, trace=trace,
+                ),
+                self._parse_submit,
+                reconnect_retry=False,
+                sp=sp,
+            )
+        except WireRefused as ex:
+            self._finish_at(sp, "shed", reason=ex.reason,
+                            attempts=ex.attempts)
+            raise
+        except WireDisconnected as ex:
+            # outcome UNKNOWN (the write may still commit) only if a
+            # frame may have left the client; a pure connect failure
+            # provably had no effect
+            self._finish_at(sp, "info" if ex.sent else "failed")
+            raise
+        except WireError:
+            self._finish_at(sp, "failed")
+            raise
+        except asyncio.CancelledError:
+            self._finish_at(sp, "info")      # shutdown mid-op: unknown
+            raise
+        except BaseException:
+            # anything else (e.g. FrameTooLarge when the trace context
+            # pushes a near-bound payload over) raised before a frame
+            # left: the span still closes exactly once
+            self._finish_at(sp, "failed")
+            raise
+        if sp is not None:
+            sp.group = out.group
+            sp.annotate("floor", self._now(), group=out.group,
+                        floor=out.floor)     # the session-token carry
+        self._finish_at(sp, "ok", attempts=out.attempts, seq=out.seq)
+        return out
 
     async def submit_many(self, items) -> BatchResult:
         """Many writes in ONE frame (the batched-ingest amortization —
@@ -321,6 +444,7 @@ class WireClient:
         partially-admitted batch must not be resubmitted whole. Raises
         :class:`WireDisconnected` on a mid-flight connection loss (the
         admitted part may still commit)."""
+        sp = self._begin_span("client_submit_batch", b"")
         req_id = self._next_req_id
         self._next_req_id += 1
         try:
@@ -328,26 +452,59 @@ class WireClient:
         except OSError as ex:
             # connect failure before anything was sent: typed, so
             # callers handle one exception family for conn loss
+            self._finish_at(sp, "failed")
             raise WireDisconnected(
-                f"cannot connect to {self.host}:{self.port}: {ex}"
+                f"cannot connect to {self.host}:{self.port}: {ex}",
+                sent=False,
             )
-        tag, body = await conn.request(req_id, P.encode_submit_batch(
-            req_id, items, max_frame_bytes=self.max_frame_bytes,
-        ))
+        if sp is not None:
+            sp.annotate("attempt", self._now(), n=1, entries=len(items))
+        try:
+            tag, body, rctx = await conn.request(
+                req_id, P.encode_submit_batch(
+                    req_id, items,
+                    max_frame_bytes=self.max_frame_bytes,
+                    trace=self._ctx(sp, conn),
+                ))
+        except WireDisconnected as ex:
+            # admitted part may commit — unless nothing was ever sent
+            self._finish_at(sp, "info" if ex.sent else "failed")
+            raise
+        except asyncio.CancelledError:
+            self._finish_at(sp, "info")
+            raise
+        except BaseException:
+            self._finish_at(sp, "failed")    # e.g. encode failure
+            raise
         if tag == "ok_batch":
             accepted, shed, floors = body
             self.budget.on_success()
             if shed:
                 self.stats["sheds"] += shed
+            if sp is not None:
+                for g, idx in sorted(floors.items()):
+                    sp.annotate("floor", self._now(), group=g,
+                                floor=idx)
+                if rctx is not None:
+                    sp.annotate("response", self._now(), tag=tag,
+                                server_span=self._sid(rctx))
+            self._finish_at(sp, "ok", accepted=accepted, shed=shed)
             return BatchResult(accepted, shed, floors)
         if tag == "error":
+            if sp is not None and rctx is not None:
+                sp.annotate("server_error", self._now(),
+                            server_span=self._sid(rctx))
+            self._finish_at(sp, "failed")
             raise WireError(body)
         if tag == "refused":
             # the whole frame was refused before ingest (wire_backlog:
             # the server's bounded coalesce buffer) — nothing queued
             reason, retry_after = body
             self.stats["sheds"] += 1
+            self._finish_at(sp, "shed", reason=reason, attempts=1)
             raise WireRefused(reason, retry_after, 1)
+        self._finish_at(sp, "shed", reason="batch_unresolved",
+                        attempts=1)
         raise WireRefused("batch_unresolved", 0.0, 1)
 
     async def read(self, key: bytes,
@@ -355,13 +512,35 @@ class WireClient:
         """One read under ``cls`` (``linearizable`` / ``any`` /
         ``session`` — the served class comes back on the result).
         Reads are effect-free, so connection losses reconnect-retry."""
-        return await self._with_retries(
-            lambda req_id: P.encode_read(
-                req_id, cls, key, max_frame_bytes=self.max_frame_bytes,
-            ),
-            self._parse_read,
-            reconnect_retry=True,
-        )
+        sp = self._begin_span("client_read", key)
+        try:
+            out = await self._with_retries(
+                lambda req_id, trace: P.encode_read(
+                    req_id, cls, key,
+                    max_frame_bytes=self.max_frame_bytes, trace=trace,
+                ),
+                self._parse_read,
+                reconnect_retry=True,
+                sp=sp,
+            )
+        except WireRefused as ex:
+            self._finish_at(sp, "shed", reason=ex.reason,
+                            attempts=ex.attempts)
+            raise
+        except BaseException:
+            # an unserved read is provably effect-free whatever killed
+            # it (disconnect, server error, cancellation, encode
+            # failure) — one terminal, always
+            self._finish_at(sp, "failed")
+            raise
+        if sp is not None:
+            sp.group = out.group
+            sp.read_class = out.cls
+            sp.annotate("floor", self._now(), group=out.group,
+                        floor=out.index)
+        self._finish_at(sp, "ok", attempts=out.attempts,
+                        read_class=out.cls, index=out.index)
+        return out
 
     @staticmethod
     def _parse_submit(tag: str, body, attempts: int):
@@ -377,7 +556,8 @@ class WireClient:
         group, index, cls, value = body
         return ReadResult(group, index, cls, value, attempts)
 
-    async def _with_retries(self, build, parse, reconnect_retry: bool):
+    async def _with_retries(self, build, parse, reconnect_retry: bool,
+                            sp=None):
         last_reason, last_hint = "unknown", 0.0
         attempt = 0
         while True:
@@ -393,30 +573,62 @@ class WireClient:
                 # same backoff instead of leaking a raw OSError
                 if attempt <= self.retries:
                     self.stats["retries"] += 1
-                    await self._sleep(self.backoff.delay(attempt - 1))
+                    delay = self.backoff.delay(attempt - 1)
+                    if sp is not None:
+                        sp.retries += 1
+                        sp.annotate("backoff", self._now(),
+                                    delay_s=delay,
+                                    cause="connect_failed")
+                    await self._sleep(delay)
                     continue
                 raise WireDisconnected(
-                    f"cannot connect to {self.host}:{self.port}: {ex}"
+                    f"cannot connect to {self.host}:{self.port}: {ex}",
+                    sent=False,
                 )
+            if sp is not None:
+                sp.annotate("attempt", self._now(), n=attempt)
             try:
-                tag, body = await conn.request(req_id, build(req_id))
+                tag, body, rctx = await conn.request(
+                    req_id, build(req_id, self._ctx(sp, conn))
+                )
             except WireDisconnected:
                 if reconnect_retry and attempt <= self.retries:
+                    if sp is not None:
+                        sp.annotate("reconnect", self._now(), n=attempt)
                     continue
                 raise
             out = parse(tag, body, attempt)
             if out is not None:
                 self.budget.on_success()
+                if sp is not None and rctx is not None:
+                    sp.annotate("response", self._now(), tag=tag,
+                                server_span=self._sid(rctx))
                 return out
             if tag == "error":
+                if sp is not None and rctx is not None:
+                    # the ERROR-answering server span must still be
+                    # joinable in the forensics timeline
+                    sp.annotate("server_error", self._now(),
+                                server_span=self._sid(rctx))
                 raise WireError(body)
             if tag == "refused":
                 last_reason, last_hint = body
                 self.stats["sheds"] += 1
+                if sp is not None:
+                    sp.refusal_reasons.append(last_reason)
+                    sp.annotate("refused", self._now(),
+                                reason=last_reason,
+                                retry_after_s=last_hint,
+                                server_span=self._sid(rctx))
             elif tag == "not_leader":
                 group, hint = body
                 last_reason, last_hint = "not_leader", 0.0
                 self.stats["not_leader"] += 1
+                if sp is not None:
+                    sp.refusal_reasons.append("not_leader")
+                    sp.annotate("not_leader", self._now(), group=group,
+                                hint=hint,
+                                server_span=self._sid(rctx))
                 target = self.addr_map.get(hint)
                 if target is not None and target != (self.host,
                                                      self.port):
@@ -429,6 +641,9 @@ class WireClient:
                             old.close()
                     self._conns = [None] * self.pool_size
                     self.stats["redials"] += 1
+                    if sp is not None:
+                        sp.redials += 1
+                        sp.annotate("redial", self._now(), target=hint)
             if attempt > self.retries:
                 raise WireRefused(last_reason, last_hint, attempt)
             if not self.budget.try_spend():
@@ -441,4 +656,7 @@ class WireClient:
             if len(self.last_delays) >= 256:
                 del self.last_delays[:128]
             self.last_delays.append(delay)
+            if sp is not None:
+                sp.retries += 1
+                sp.annotate("backoff", self._now(), delay_s=delay)
             await self._sleep(delay)

@@ -25,6 +25,19 @@ Session tokens (``multi.router.ReadSession`` floors) are plain
 reconnect-and-resume carry), and every ``OK``/``VALUE`` response
 returns the one floor it raised, so the client-side token stays current
 without a dedicated token round-trip.
+
+Trace context (Dapper-style propagation, docs/OBSERVABILITY.md "Wire
+plane"): any frame may carry a compact 17-byte context — trace id
+(u64), parent span id (u64), flags (u8, bit 0 = sampled) — flagged by
+the ``TRACE_FLAG`` high bit on the kind byte and prepended to the
+payload. The context is NEGOTIATED, never assumed: a client advertises
+``CAP_TRACE`` in a capability byte appended to ``HELLO``, the server
+echoes the intersection on ``WELCOME``, and only then do either side's
+frames carry contexts. The capability byte is strictly additive — a
+HELLO/WELCOME without it is byte-identical to the pre-capability
+encoding, and both decoders ignore trailing bytes they do not speak —
+so pre-trace peers interoperate byte-for-byte (pinned by
+tests/test_net_protocol.py::TestCapabilityCompat).
 """
 
 from __future__ import annotations
@@ -59,6 +72,42 @@ KIND_NAMES = {
     SUBMIT_BATCH: "submit_batch", OK_BATCH: "ok_batch",
 }
 
+#: high bit on the kind byte: the payload starts with a 17-byte trace
+#: context. Only legal after BOTH sides advertised ``CAP_TRACE`` — a
+#: pre-trace peer sees an unknown kind and closes, which is why the
+#: capability handshake gates every flagged frame.
+TRACE_FLAG = 0x80
+
+#: capability bits (client: appended to HELLO; server: the echoed
+#: intersection appended to WELCOME). Absent byte = no capabilities —
+#: byte-identical to the pre-capability frames.
+CAP_TRACE = 0x01
+
+_TRACE_CTX = struct.Struct("!QQB")
+TRACE_CTX_BYTES = _TRACE_CTX.size        # 17
+
+
+def encode_trace(trace_id: int, span_id: int, sampled: bool) -> bytes:
+    return _TRACE_CTX.pack(trace_id, span_id, 1 if sampled else 0)
+
+
+def split_trace(
+    kind: int, payload: bytes
+) -> Tuple[int, Optional[Tuple[int, int, bool]], bytes]:
+    """Strip a frame's trace context, if flagged: returns
+    ``(base_kind, (trace_id, span_id, sampled) | None, payload)``.
+    A flagged frame too short to hold the context is corrupt."""
+    if not kind & TRACE_FLAG:
+        return kind, None, payload
+    if len(payload) < TRACE_CTX_BYTES:
+        raise ProtocolError(
+            f"traced frame payload {len(payload)} B cannot hold the "
+            f"{TRACE_CTX_BYTES} B trace context"
+        )
+    tid, sid, flags = _TRACE_CTX.unpack_from(payload)
+    return (kind & ~TRACE_FLAG, (tid, sid, bool(flags & 1)),
+            payload[TRACE_CTX_BYTES:])
+
 #: request-side read classes (what the client ASKS for)
 READ_CLASSES = {"linearizable": 0, "any": 1, "session": 2}
 READ_CLASS_NAMES = {v: k for k, v in READ_CLASSES.items()}
@@ -86,11 +135,21 @@ class FrameTooLarge(ProtocolError):
 
 # ----------------------------------------------------------- framing
 def encode_frame(kind: int, payload: bytes,
-                 max_frame_bytes: int = MAX_FRAME_BYTES) -> bytes:
+                 max_frame_bytes: int = MAX_FRAME_BYTES,
+                 trace: Optional[Tuple[int, int, bool]] = None) -> bytes:
+    """One frame. ``trace=(trace_id, span_id, sampled)`` prepends the
+    17-byte trace context and sets ``TRACE_FLAG`` on the kind byte —
+    legal only on a connection that negotiated ``CAP_TRACE`` (callers
+    gate; an unflagged encode is byte-identical to the pre-trace
+    protocol)."""
+    if trace is not None:
+        payload = encode_trace(*trace) + payload
+        kind |= TRACE_FLAG
     if len(payload) > max_frame_bytes:
         raise FrameTooLarge(
-            f"{KIND_NAMES.get(kind, kind)} payload {len(payload)} B "
-            f"exceeds the {max_frame_bytes} B frame bound"
+            f"{KIND_NAMES.get(kind & ~TRACE_FLAG, kind)} payload "
+            f"{len(payload)} B exceeds the {max_frame_bytes} B frame "
+            f"bound"
         )
     return _HEADER.pack(MAGIC, VERSION, kind, len(payload)) + payload
 
@@ -175,11 +234,17 @@ def _need(buf: bytes, off: int, n: int) -> None:
 
 # ------------------------------------------------------------- HELLO
 def encode_hello(floors: Optional[Dict[int, int]] = None,
-                 **kw) -> bytes:
+                 caps: int = 0, **kw) -> bytes:
+    """``caps=0`` (the default) emits the pre-capability encoding
+    byte-for-byte; a nonzero ``caps`` appends one capability byte that
+    pre-trace decoders provably ignore (``decode_hello`` reads exactly
+    the floor table it was told about)."""
     floors = floors or {}
     body = struct.pack("!H", len(floors))
     for g, idx in sorted(floors.items()):
         body += struct.pack("!IQ", g, idx)
+    if caps:
+        body += struct.pack("!B", caps)
     return encode_frame(HELLO, body, **kw)
 
 
@@ -196,16 +261,38 @@ def decode_hello(payload: bytes) -> Dict[int, int]:
     return floors
 
 
+def decode_hello_caps(payload: bytes) -> Tuple[Dict[int, int], int]:
+    """(floors, capability bits) — an absent trailing byte (a pre-
+    capability peer) decodes as caps 0, never as an error."""
+    floors = decode_hello(payload)
+    off = 2 + 12 * len(floors)
+    caps = payload[off] if off < len(payload) else 0
+    return floors, caps
+
+
 # ----------------------------------------------------------- WELCOME
-def encode_welcome(entry_bytes: int, groups: int, **kw) -> bytes:
-    return encode_frame(
-        WELCOME, struct.pack("!II", entry_bytes, groups), **kw
-    )
+def encode_welcome(entry_bytes: int, groups: int, caps: int = 0,
+                   **kw) -> bytes:
+    """``caps`` is the server's echo of the INTERSECTION of advertised
+    capabilities — appended only when nonzero, so the reply to a
+    capability-less HELLO is byte-identical to the pre-capability
+    WELCOME (the compat pin's contract)."""
+    body = struct.pack("!II", entry_bytes, groups)
+    if caps:
+        body += struct.pack("!B", caps)
+    return encode_frame(WELCOME, body, **kw)
 
 
 def decode_welcome(payload: bytes) -> Tuple[int, int]:
     _need(payload, 0, 8)
     return struct.unpack_from("!II", payload)
+
+
+def decode_welcome_caps(payload: bytes) -> Tuple[int, int, int]:
+    """(entry_bytes, groups, capability bits); absent byte = 0."""
+    entry_bytes, groups = decode_welcome(payload)
+    caps = payload[8] if len(payload) > 8 else 0
+    return entry_bytes, groups, caps
 
 
 # ------------------------------------------------------------ SUBMIT

@@ -47,11 +47,30 @@ counters in the attached registry, a ``net`` section published to the
 ``wire_recv``/``wire_ingest``/``wire_sent``, bound as the ambient span
 across the backend dispatch so the engine's own ingest/commit hooks
 chain onto it (queue-vs-wire time in the Perfetto export).
+
+Cross-process tracing (ISSUE 15, docs/OBSERVABILITY.md "Wire plane"):
+a client that negotiated ``CAP_TRACE`` in the HELLO/WELCOME capability
+handshake sends each request with a 17-byte trace context — the server
+ADOPTS it (the wire-op span's ``wire_trace``/``parent_span``/
+``sampled`` come from the context, so the server span is a child of
+the client op and the two sides' tables join on the trace id) and
+echoes the context on every response so the client learns the server
+span id. Without the negotiated bit nothing changes: frames are
+byte-identical to the pre-trace protocol (the compat pin).
+
+Pump attribution: an attached ``obs.hostprof.PumpProfiler`` tiles
+every pump iteration into boundary-marked phases (coalesce / ingest /
+drive / sweep / flush, with reader-task read_decode accumulated
+alongside), feeds the ``raft_net_pump_phase_seconds{phase}`` /
+coalesce-batch / frame-queue-age distributions, and surfaces as the
+``pump`` block of the ``net`` /status section. Pure host bookkeeping:
+zero extra device syncs attached or detached (the PR-6 contract).
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, List, Optional, Tuple
 
 from raft_tpu.admission.gate import Overloaded
@@ -289,6 +308,7 @@ class _Conn:
         self.writer = writer
         self.decoder = P.FrameDecoder(max_frame_bytes)
         self.session: Dict[int, int] = {}
+        self.caps = 0            # negotiated capability intersection
         self.bytes_in = 0
         self.bytes_out = 0
         self.open = True
@@ -316,9 +336,10 @@ class _Conn:
 
 class _Req:
     __slots__ = ("conn", "kind", "req_id", "key", "value", "cls",
-                 "span", "t_in")
+                 "span", "t_in", "trace", "t_wall")
 
-    def __init__(self, conn, kind, req_id, key, value=None, cls=None):
+    def __init__(self, conn, kind, req_id, key, value=None, cls=None,
+                 trace=None):
         self.conn = conn
         self.kind = kind
         self.req_id = req_id
@@ -327,6 +348,8 @@ class _Req:
         self.cls = cls
         self.span = None
         self.t_in = 0.0
+        self.trace = trace       # (trace_id, parent span_id, sampled)
+        self.t_wall = 0.0        # wall arrival stamp (pump profiler)
 
 
 class _Batch:
@@ -334,7 +357,7 @@ class _Batch:
     ADMITTED entry is durable (refused entries resolved at ingest)."""
 
     __slots__ = ("conn", "req_id", "t_in", "remaining", "accepted",
-                 "shed", "groups", "span")
+                 "shed", "groups", "span", "trace")
 
     def __init__(self, req: _Req):
         self.conn = req.conn
@@ -345,6 +368,7 @@ class _Batch:
         self.shed = 0
         self.groups: set = set()
         self.span = req.span
+        self.trace = req.trace
 
 
 class IngestServer:
@@ -364,6 +388,7 @@ class IngestServer:
         registry=None,
         status_board=None,
         spans=None,
+        pump=None,
     ) -> None:
         self.backend = backend
         self.host = host
@@ -387,6 +412,10 @@ class IngestServer:
         self.registry = registry
         self.status_board = status_board
         self.spans = spans
+        self.pump = pump
+        #   obs.hostprof.PumpProfiler — pump-phase attribution + the
+        #   coalesce/queue-age distributions (None = detached: every
+        #   profiled site costs one None check)
 
         self._server: Optional[asyncio.base_events.Server] = None
         self._pump_task: Optional[asyncio.Task] = None
@@ -402,6 +431,10 @@ class IngestServer:
         self.requests_total: Dict[str, int] = {}
         self.refusals: Dict[str, int] = {}
         self.responses_total = 0
+        self.pump_iters = 0
+        #   monotone pump-iteration counter — stamped into each traced
+        #   op's wire_ingest annotation (ingest-batch attribution: the
+        #   joined timeline can say WHICH coalesced batch carried an op)
         self.wire_staged_batches = 0
         self.tick_staged_batches = 0
         self.tick_tail_batches = 0
@@ -467,6 +500,8 @@ class IngestServer:
                     break
                 conn.bytes_in += len(data)
                 self._count_bytes("in", len(data))
+                t0 = (time.perf_counter() if self.pump is not None
+                      else 0.0)
                 try:
                     frames = conn.decoder.feed(data)
                 except P.ProtocolError as ex:
@@ -479,6 +514,8 @@ class IngestServer:
                     break
                 for kind, payload in frames:
                     self._on_frame(conn, kind, payload)
+                if self.pump is not None:
+                    self.pump.note_read_decode(time.perf_counter() - t0)
                 self._wakeup.set()
                 if not conn.open:
                     # a frame handler declared the stream unrecoverable
@@ -504,26 +541,44 @@ class IngestServer:
 
     def _on_frame(self, conn: _Conn, kind: int, payload: bytes) -> None:
         try:
+            kind, trace, payload = P.split_trace(kind, payload)
             if kind == P.HELLO:
                 # reconnect-and-resume: adopt the client's session
-                # floors for this connection
-                for g, idx in P.decode_hello(payload).items():
+                # floors for this connection — and negotiate
+                # capabilities: WELCOME echoes the INTERSECTION of what
+                # the client advertised and what we speak, appended
+                # only when nonzero (a capability-less HELLO gets the
+                # byte-identical pre-capability WELCOME — the compat
+                # contract)
+                floors, caps = P.decode_hello_caps(payload)
+                for g, idx in floors.items():
                     conn.observe_floor(g, idx)
+                # a server with no SpanTracker cannot honor the trace
+                # capability (it would echo contexts it never
+                # recorded, handing clients bogus join hints) — so it
+                # does not advertise it
+                conn.caps = caps & (P.CAP_TRACE if self.spans is not None
+                                    else 0)
                 entry_bytes, groups = self.backend.meta()
-                self._send(conn, P.encode_welcome(entry_bytes, groups))
+                self._send(conn, P.encode_welcome(
+                    entry_bytes, groups, caps=conn.caps
+                ))
                 self._count_request("hello")
                 return
             if kind == P.SUBMIT:
                 req_id, key, value = P.decode_submit(payload)
-                req = _Req(conn, kind, req_id, key, value=value)
+                req = _Req(conn, kind, req_id, key, value=value,
+                           trace=trace)
                 self._count_request("submit")
             elif kind == P.SUBMIT_BATCH:
                 req_id, items = P.decode_submit_batch(payload)
-                req = _Req(conn, kind, req_id, b"", value=items)
+                req = _Req(conn, kind, req_id, b"", value=items,
+                           trace=trace)
                 self._count_request("submit_batch")
             elif kind == P.READ:
                 req_id, cls, key = P.decode_read(payload)
-                req = _Req(conn, kind, req_id, key, cls=cls)
+                req = _Req(conn, kind, req_id, key, cls=cls,
+                           trace=trace)
                 self._count_request("read")
             else:
                 # a kind we do not speak means the peer is desynced or
@@ -547,11 +602,23 @@ class IngestServer:
             self._refuse(req, "wire_backlog", self.drive_quantum_s)
             return
         req.t_in = self.backend.now()
+        if self.pump is not None:
+            req.t_wall = time.perf_counter()
         if self.spans is not None:
             req.span = self.spans.begin(
                 "wire_" + P.KIND_NAMES[kind], req.t_in,
                 client=f"conn{conn.cid}", key=req.key,
             )
+            # the span's wire-visible id folds in the listening port so
+            # a redial saga joining spans from TWO servers can tell
+            # them apart (local span counters both start at 1)
+            req.span.span_id = (self.port << 32) | (req.span.trace_id
+                                                    & 0xFFFFFFFF)
+            # adopt the remote parent: the client op's trace id becomes
+            # the join key, its span id the parent, its sampling bit
+            # the head decision (the root decided — tail policy still
+            # upgrades on a bad outcome)
+            self.spans.adopt(req.span, req.trace)
             req.span.annotate("wire_recv", req.t_in)
         self._pending.append(req)
 
@@ -567,9 +634,24 @@ class IngestServer:
                     await self._wakeup.wait()
                     continue
             batch, self._pending = self._pending, []
+            self.pump_iters += 1
+            pump = self.pump
+            if pump is not None:
+                # the boundary-marked iteration bracket: coalesce /
+                # ingest / drive / sweep tile to iter_end's flush
+                # residue (obs.hostprof.PumpProfiler)
+                pump.iter_begin()
+                if batch:
+                    pump.observe_batch(len(batch))
+                    now_w = time.perf_counter()
+                    for req in batch:
+                        pump.observe_age(now_w - req.t_wall)
+                pump.mark("coalesce")
             try:
                 if batch:
                     self._ingest(batch)
+                if pump is not None:
+                    pump.mark("ingest")
                 # the tick loop's side of the wall: one drive quantum
                 s0 = self.backend.staging_stats()
                 self.backend.drive(self.drive_quantum_s)
@@ -577,7 +659,11 @@ class IngestServer:
                     s1 = self.backend.staging_stats()
                     self.tick_staged_batches += s1[0] - s0[0]
                     self.tick_tail_batches += s1[1] - s0[1]
+                if pump is not None:
+                    pump.mark("drive")
                 self._sweep_completions()
+                if pump is not None:
+                    pump.mark("sweep")
             except Exception as ex:
                 # a tick-loop crash must not strand every client on a
                 # silent dead task: answer everything in flight with a
@@ -593,6 +679,8 @@ class IngestServer:
                 raise
             self._publish_status()
             await self._flush_writers()
+            if pump is not None:
+                pump.iter_end()          # residue -> the flush phase
             # yield so reader tasks can coalesce the next batch
             await asyncio.sleep(0)
 
@@ -606,7 +694,12 @@ class IngestServer:
                 continue
             sp = req.span
             if sp is not None:
-                sp.annotate("wire_ingest", self.backend.now())
+                # ingest-batch attribution: WHICH pump iteration and
+                # how many frames coalesced with this op — the joined
+                # timeline's "wire frame -> ingest batch" link
+                sp.annotate("wire_ingest", self.backend.now(),
+                            pump_iter=self.pump_iters,
+                            coalesce=len(batch))
                 if self.spans is not None:
                     self.spans.current = sp
             try:
@@ -629,8 +722,9 @@ class IngestServer:
                 self._not_leader(req, 0)
             except Exception as ex:     # never kill the pump
                 self._finish_span(req, "failed")
-                self._send(req.conn, P.encode_error(req.req_id,
-                                                    repr(ex)))
+                self._send(req.conn, P.encode_error(
+                    req.req_id, repr(ex), trace=self._rtrace(req),
+                ))
                 self.responses_total += 1
             finally:
                 if self.spans is not None:
@@ -649,9 +743,19 @@ class IngestServer:
         """One frame, many entries: admission runs per entry (refused
         entries are tallied, never queued — the provably-no-effect
         contract holds entry-wise), admitted entries await durability
-        as one unit."""
+        as one unit.
+
+        Span altitude: a batch is ONE wire op, so its span records
+        unit-level facts (wire phases, accepted/shed, floors) — the
+        ambient binding is cleared around the per-entry submit loop,
+        because the engine's per-seq causal hooks would otherwise pay
+        O(entries) span work per frame (measured ~6% of wire goodput
+        at the macro shape, against the plane's <= 5% budget; the
+        single-SUBMIT path keeps the full per-entry chain)."""
         batch = _Batch(req)
         client = f"conn{req.conn.cid}"
+        if self.spans is not None:
+            self.spans.current = None
         for key, value in req.value:
             try:
                 g, seq = self.backend.submit(key, value, client=client)
@@ -673,14 +777,15 @@ class IngestServer:
         floors = {g: self.backend.commit_floor(g) for g in batch.groups}
         for g, idx in floors.items():
             batch.conn.observe_floor(g, idx)
-        self._send(batch.conn, P.encode_ok_batch(
-            batch.req_id, batch.accepted, batch.shed, floors
-        ))
-        self.responses_total += 1
         if batch.span is not None and not batch.span.terminal:
             batch.span.annotate("wire_sent", self.backend.now())
             batch.span.finish("ok", self.backend.now(),
                               accepted=batch.accepted, shed=batch.shed)
+        self._send(batch.conn, P.encode_ok_batch(
+            batch.req_id, batch.accepted, batch.shed, floors,
+            trace=self._rtrace(batch),
+        ))
+        self.responses_total += 1
 
     def _ingest_read(self, req: _Req) -> None:
         out = self.backend.begin_read(
@@ -706,10 +811,11 @@ class IngestServer:
                 continue
             floor = self.backend.commit_floor(g)
             req.conn.observe_floor(g, floor)
-            self._send(req.conn, P.encode_ok(req.req_id, g, seq,
-                                             floor))
-            self.responses_total += 1
             self._finish_span(req, "ok")
+            self._send(req.conn, P.encode_ok(
+                req.req_id, g, seq, floor, trace=self._rtrace(req),
+            ))
+            self.responses_total += 1
         expired = [key for key, req in self._awaiting_writes.items()
                    if now - req.t_in > self.op_timeout_s
                    or not req.conn.open]
@@ -719,6 +825,10 @@ class IngestServer:
             if id(req) in responded:
                 continue
             responded.add(id(req))
+            if not isinstance(req, _Batch):
+                self._finish_span(req, "info")
+            elif req.span is not None and not req.span.terminal:
+                req.span.finish("info", now)
             if req.conn.open:
                 # outcome unknown: the entry may have been dropped
                 # across a leadership change (never durable) — not a
@@ -727,12 +837,9 @@ class IngestServer:
                     req.req_id,
                     "outcome unknown: write not durable within the "
                     "op timeout",
+                    trace=self._rtrace(req),
                 ))
                 self.responses_total += 1
-            if not isinstance(req, _Batch):
-                self._finish_span(req, "info")
-            elif req.span is not None and not req.span.terminal:
-                req.span.finish("info", now)
         still: List[Tuple[_Req, object]] = []
         for req, handle in self._pending_reads:
             if not req.conn.open:
@@ -759,29 +866,50 @@ class IngestServer:
 
     def _serve_read(self, req: _Req, out: _Done) -> None:
         req.conn.observe_floor(out.group, out.index)
+        self._finish_span(req, "ok", read_class=out.cls)
         self._send(req.conn, P.encode_value(
-            req.req_id, out.group, out.index, out.cls, out.value
+            req.req_id, out.group, out.index, out.cls, out.value,
+            trace=self._rtrace(req),
         ))
         self.responses_total += 1
-        self._finish_span(req, "ok", read_class=out.cls)
 
     # ---------------------------------------------------------- responses
+    def _rtrace(self, req) -> Optional[Tuple[int, int, bool]]:
+        """The response's echoed trace context: the op's trace id, OUR
+        span id (the client records it — the join hint), the current
+        sampling bit. None for untraced requests — their responses stay
+        byte-identical to the pre-trace protocol."""
+        ctx = req.trace
+        if ctx is None:
+            return None
+        sp = req.span
+        if sp is None:
+            # no server span exists (a wire_backlog refusal fires
+            # before span creation): echo the trace id with span id 0
+            # — "no span to join", never the client's own id back
+            return (ctx[0], 0, ctx[2])
+        return (ctx[0],
+                sp.span_id if sp.span_id is not None else sp.trace_id,
+                sp.sampled)
+
     def _refuse(self, req: _Req, reason: str,
                 retry_after_s: float) -> None:
         self._refusal(reason)
+        self._finish_span(req, "shed", reason=reason)
         self._send(req.conn, P.encode_refused(
-            req.req_id, reason, float(retry_after_s)
+            req.req_id, reason, float(retry_after_s),
+            trace=self._rtrace(req),
         ))
         self.responses_total += 1
-        self._finish_span(req, "shed", reason=reason)
 
     def _not_leader(self, req: _Req, group: int) -> None:
         self._refusal("not_leader")
+        self._finish_span(req, "shed", reason="not_leader")
         self._send(req.conn, P.encode_not_leader(
-            req.req_id, group, self.backend.leader_hint(group)
+            req.req_id, group, self.backend.leader_hint(group),
+            trace=self._rtrace(req),
         ))
         self.responses_total += 1
-        self._finish_span(req, "shed", reason="not_leader")
 
     def _finish_span(self, req: _Req, state: str, **fields) -> None:
         sp = req.span
@@ -854,7 +982,7 @@ class IngestServer:
         bytes_in = self._bytes_in_closed + sum(
             c.bytes_in for c in self._conns
         )
-        return {
+        out = {
             "connections": len(self._conns),
             "draining": self.draining,
             "in_flight": (len(self._pending)
@@ -871,7 +999,11 @@ class IngestServer:
             "wire_staged_batches": self.wire_staged_batches,
             "tick_staged_batches": self.tick_staged_batches,
             "tick_tail_batches": self.tick_tail_batches,
+            "pump_iters": self.pump_iters,
         }
+        if self.pump is not None:
+            out["pump"] = self.pump.stats()
+        return out
 
     def _publish_status(self) -> None:
         if self.status_board is None:

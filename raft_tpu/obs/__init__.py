@@ -22,7 +22,11 @@ differential-test join key between the golden model, the engine, and
 - ``hostprof``  — per-tick host-time attribution: phase timers tiling
   the engine step (heap_pop / host_pre / pack / dispatch / device_wait
   / host_post), feeding the ``raft_host_phase_seconds`` histogram and
-  the bench ``attribution`` leg.
+  the bench ``attribution`` leg — plus the wire-side twin
+  ``PumpProfiler`` tiling each ingest-pump iteration (read_decode /
+  coalesce / ingest / drive / sweep / flush) for the
+  ``raft_net_pump_phase_seconds`` histogram and the ``macro`` leg's
+  pump table (docs/OBSERVABILITY.md "Wire plane").
 - ``blackbox``  — the hang-proof half: per-process append-only progress
   journals (phase marks written BEFORE every blocking operation) and
   the stall watchdog that dumps all-thread stacks + the journal tail
@@ -100,7 +104,7 @@ from raft_tpu.obs.forensics import (
     load_bundle,
     write_bundle,
 )
-from raft_tpu.obs.hostprof import HostProfiler
+from raft_tpu.obs.hostprof import HostProfiler, PumpProfiler
 from raft_tpu.obs.metrics import LatencySummary, summarize_engine
 from raft_tpu.obs.registry import MetricsRegistry, parse_prometheus
 from raft_tpu.obs.serve import OpsServer, StatusBoard, serve_demo
@@ -125,6 +129,7 @@ __all__ = [
     "EventRing",
     "FlightRecorder",
     "HostProfiler",
+    "PumpProfiler",
     "LatencyDigest",
     "LatencySummary",
     "MemoryCensus",

@@ -3,7 +3,7 @@
 Post-mortem tooling over repro bundles (``obs.forensics``) and black-box
 artifacts (``obs.blackbox``) — nothing here re-runs a seed:
 
-- ``--explain PATH``            — reconstruct the failure story from
+- ``--explain PATH [PATH ...]``  — reconstruct the failure story from
   whatever PATH is: a repro bundle (minimal failure timeline: last
   leader per term, faults in flight, the violating op — and, when the
   run carried the device plane, the decoded device ring: kind summary,
@@ -14,7 +14,13 @@ artifacts (``obs.blackbox``) — nothing here re-runs a seed:
   all-thread stacks), a **blackbox journal** ``.jsonl`` (per-process
   phase timeline with durations; the final in-flight phase flagged),
   or a directory of journals (one timeline per process — the multihost
-  post-mortem view).
+  post-mortem view). MULTIPLE paths must all be repro bundles: their
+  span tables are JOINED on the cross-process wire trace id into one
+  causal timeline per op (client attempt → wire frame → ingest batch →
+  tick → completion sweep → response — ``obs.forensics.explain_joined``;
+  a single bundle carrying both a ``spans`` and a ``client_spans``
+  table, as the chaos wire drill writes, gets the joined view
+  appended automatically).
 - ``--render-perfetto BUNDLE``  — convert the bundle's span table to
   Chrome/Perfetto trace JSON (load at ui.perfetto.dev); ``-o`` writes
   to a file, default stdout.
@@ -31,7 +37,12 @@ import sys
 from typing import Optional
 
 from raft_tpu.obs.blackbox import STALL_FORMAT, explain_journal, explain_stall
-from raft_tpu.obs.forensics import BUNDLE_FORMAT, explain, load_bundle
+from raft_tpu.obs.forensics import (
+    BUNDLE_FORMAT,
+    explain,
+    explain_joined,
+    load_bundle,
+)
 
 
 def _render_perfetto(bundle: dict) -> dict:
@@ -44,6 +55,26 @@ def _render_perfetto(bundle: dict) -> dict:
     tracker = SpanTracker()
     tracker.spans = spans_from_jsonable(bundle["spans"])
     return tracker.to_perfetto()
+
+
+def _explain_many(paths: list) -> str:
+    """--explain with 2+ paths: every artifact must be a repro bundle;
+    their span tables join on the wire trace id into one causal
+    timeline per op (the cross-process wire forensics view)."""
+    bundles = []
+    for path in paths:
+        if not os.path.exists(path):
+            raise SystemExit(f"{path}: no such file")
+        try:
+            bundles.append(load_bundle(path))
+        except (ValueError, json.JSONDecodeError, OSError) as ex:
+            # OSError covers e.g. a journal DIRECTORY among the paths
+            # — joined mode is bundles-only, and the user deserves the
+            # typed message, not a traceback
+            raise SystemExit(
+                f"{path}: joined --explain needs repro bundles ({ex})"
+            )
+    return explain_joined(bundles)
 
 
 def _explain_any(path: str) -> str:
@@ -92,7 +123,12 @@ def _explain_any(path: str) -> str:
             f"{path}: not a raft_tpu artifact "
             f"(format={doc.get('format')!r})"
         )
-    return explain(doc)
+    text = explain(doc)
+    if doc.get("client_spans"):
+        # one bundle carrying both sides (the wire drill): the joined
+        # per-op view rides along without a second artifact
+        text += "\n\n" + explain_joined([doc])
+    return text
 
 
 def _metrics_prometheus(snapshot: dict) -> str:
@@ -136,10 +172,13 @@ def main(argv: Optional[list] = None) -> int:
         description="raft_tpu observability tooling (repro bundles)",
     )
     g = ap.add_mutually_exclusive_group(required=True)
-    g.add_argument("--explain", metavar="PATH",
+    g.add_argument("--explain", metavar="PATH", nargs="+",
                    help="reconstruct the failure timeline from a repro "
                         "bundle, a stall bundle, a blackbox journal "
-                        "(.jsonl), or a directory of journals")
+                        "(.jsonl), or a directory of journals; with "
+                        "2+ bundle paths, join their span tables on "
+                        "the wire trace id into one causal timeline "
+                        "per op (client+server forensics)")
     g.add_argument("--render-perfetto", metavar="BUNDLE",
                    help="bundle span table -> Chrome/Perfetto trace JSON")
     g.add_argument("--metrics-dump", metavar="BUNDLE",
@@ -178,7 +217,8 @@ def main(argv: Optional[list] = None) -> int:
         print(json.dumps(result))
         return 0
     if args.explain:
-        text = _explain_any(args.explain)
+        text = (_explain_any(args.explain[0]) if len(args.explain) == 1
+                else _explain_many(args.explain))
     elif args.render_perfetto:
         text = json.dumps(_render_perfetto(load_bundle(args.render_perfetto)))
     else:

@@ -15,6 +15,16 @@ anything but its expected verdict and a destination is configured
 (``bundle_dir=`` argument, or the ``RAFT_TPU_BUNDLE_DIR`` environment
 variable); with neither set, nothing is written (CI trees stay clean —
 the pinned broken-variant tests opt in with a tmp dir).
+
+Joined wire forensics (ISSUE 15): a bundle may carry TWO span tables —
+``spans`` (the process's own) and ``client_spans`` (the wire-client
+side, when one process ran both ends, as the chaos wire drill does) —
+and :func:`explain_joined` reconstructs ONE causal timeline per wire
+op by joining span tables on ``wire_trace``: client attempt N → wire
+frame → ingest batch (pump iteration) → tick/launch → completion sweep
+→ response, across however many artifacts the two processes left
+behind. ``python -m raft_tpu.obs --explain CLIENT.json SERVER.json``
+(any number of paths) is the CLI entry; nothing re-runs.
 """
 
 from __future__ import annotations
@@ -24,7 +34,7 @@ import json
 import os
 import re
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 BUNDLE_FORMAT = "raft_tpu.obs/bundle.v1"
 
@@ -159,10 +169,23 @@ def write_bundle(
     nemesis_log: Optional[List[str]] = None,
     history=None,
     obs: Optional[ObsStack] = None,
+    spans=None,
+    client_spans=None,
     extra: Optional[Dict[str, Any]] = None,
 ) -> str:
-    """Write one repro bundle; returns the bundle file path."""
+    """Write one repro bundle; returns the bundle file path.
+
+    ``spans`` overrides the span table when no full ObsStack exists
+    (a wire-client-side artifact is just a SpanTracker); ``client_spans``
+    adds the client-side table ALONGSIDE a server-side stack when one
+    process ran both ends of the wire (the chaos wire drill) — the
+    input :func:`explain_joined` joins on."""
     Path(bundle_dir).mkdir(parents=True, exist_ok=True)
+    span_table = None
+    if spans is not None:
+        span_table = spans.to_jsonable()
+    elif obs is not None:
+        span_table = obs.spans.to_jsonable()
     bundle = {
         "format": BUNDLE_FORMAT,
         "kind": kind,
@@ -179,7 +202,9 @@ def write_bundle(
         "faults": list(nemesis_log or []),
         "history": history_jsonable(history) if history is not None else [],
         "events": obs.recorder.to_jsonable() if obs is not None else None,
-        "spans": obs.spans.to_jsonable() if obs is not None else None,
+        "spans": span_table,
+        "client_spans": (client_spans.to_jsonable()
+                         if client_spans is not None else None),
         "metrics": obs.registry.to_json() if obs is not None else None,
         "device_ring": (
             obs.device.to_jsonable()
@@ -223,6 +248,151 @@ def load_bundle(path: str) -> dict:
             f"(format={bundle.get('format')!r})"
         )
     return bundle
+
+
+# -------------------------------------------------- joined wire explain
+def _wire_sides(bundles: List[dict]):
+    """Partition every wire-traced span across the artifacts into
+    (client, server) lists. The discriminator is structural, not
+    positional: a span that MINTED its trace has no ``parent_span``
+    (the client op root); a span that ADOPTED a remote parent is the
+    server side — so it does not matter which artifact carried which
+    table, or whether one bundle carried both."""
+    from raft_tpu.obs.spans import spans_from_jsonable
+
+    client, server = [], []
+    for b in bundles:
+        for key in ("spans", "client_spans"):
+            tbl = b.get(key)
+            if not tbl:
+                continue
+            for sp in spans_from_jsonable(tbl):
+                if sp.wire_trace is None:
+                    continue
+                (client if sp.parent_span is None else server).append(sp)
+    return client, server
+
+
+def _span_entries(sp, side: str):
+    """(t, side, text) timeline entries for one span, in the span's
+    own causal (annotation) order."""
+    out = []
+    label = f"begin {sp.op}"
+    if sp.key:
+        label += f" key={sp.key.decode('latin1')!r}"
+    if side == "server" and sp.client is not None:
+        label += f" ({sp.client})"
+    out.append((sp.t_start, side, label))
+    for t, name, fields in sp.annotations:
+        if name.startswith("end:"):
+            continue
+        desc = name + "".join(
+            f" {k}={v}" for k, v in fields.items() if v is not None
+        )
+        out.append((t, side, desc))
+    t_end = sp.t_end if sp.t_end is not None else sp.t_start
+    end = f"end:{sp.state}"
+    if sp.refusal_reasons:
+        end += f" refusals={','.join(sp.refusal_reasons)}"
+    out.append((t_end, side, end))
+    return out
+
+
+def explain_joined(bundles: List[dict], max_traces: int = 64) -> str:
+    """ONE causal timeline per wire op, joined across both processes'
+    span tables on ``wire_trace`` — client attempt N → wire frame →
+    ingest batch → tick/launch → completion sweep → response — from
+    the artifacts alone (nothing re-runs). A client op with retries
+    joins to SEVERAL server spans (one per wire frame); all of them
+    render into the op's single timeline."""
+    client, server = _wire_sides(bundles)
+    by_trace: Dict[int, Tuple[list, list]] = {}
+    for sp in client:
+        by_trace.setdefault(sp.wire_trace, ([], []))[0].append(sp)
+    for sp in server:
+        by_trace.setdefault(sp.wire_trace, ([], []))[1].append(sp)
+    out = [
+        f"joined wire forensics: {len(by_trace)} trace(s) — "
+        f"{len(client)} client op(s), {len(server)} server span(s)"
+    ]
+
+    def _severity(tid: int) -> tuple:
+        # non-ok ops are the forensic signal: render them FIRST so the
+        # max_traces elision can only ever drop clean ops
+        cs, ss = by_trace[tid]
+        ok = all(sp.state == "ok" for sp in cs + ss)
+        return (1 if ok else 0, tid)
+
+    shown = 0
+    for tid in sorted(by_trace, key=_severity):
+        cs, ss = by_trace[tid]
+        if shown >= max_traces:
+            out.append(
+                f"... {len(by_trace) - shown} more trace(s) elided "
+                f"(max_traces={max_traces})"
+            )
+            break
+        shown += 1
+        root = cs[0] if cs else ss[0]
+        head = f"trace 0x{tid:x}: {root.op}"
+        if root.key:
+            head += f" key={root.key.decode('latin1')!r}"
+        if cs:
+            head += f" -> {cs[0].state}"
+            if cs[0].refusal_reasons:
+                head += f" ({cs[0].refusal_reasons[-1]})"
+            if cs[0].retries:
+                head += f" after {cs[0].retries} retr" + (
+                    "y" if cs[0].retries == 1 else "ies")
+            if cs[0].redials:
+                head += f", {cs[0].redials} redial(s)"
+        if not ss:
+            head += " [no server span joined]"
+        elif not cs:
+            head += " [no client span joined]"
+        out.append(head)
+        # CAUSAL merge, not a timestamp sort: the virtual clock often
+        # stamps a whole request/response exchange with ONE time, and
+        # the two processes' clocks need not even agree — but the
+        # client saga's annotation order is authoritative, and every
+        # response annotation carries the answering server span's id
+        # (``server_span=``), so each server span ANCHORS exactly
+        # before the client entry that observed its response.
+        entries = []            # (rank tuple, t, side, text)
+        pos = 0
+        anchor: Dict[int, int] = {}
+        for sp in cs:
+            base = pos
+            for t, side, text in _span_entries(sp, "client"):
+                entries.append(((pos, 1, 0, 0), t, side, text))
+                pos += 1
+            j = base + 1        # entry index of the first annotation
+            for _t, name, fields in sp.annotations:
+                if name.startswith("end:"):
+                    continue
+                ssid = fields.get("server_span")
+                if ssid is not None and ssid not in anchor:
+                    anchor[ssid] = j
+                j += 1
+        for o, sp in enumerate(ss):
+            sid = sp.span_id if sp.span_id is not None else sp.trace_id
+            base = anchor.get(sid, pos)
+            for k, (t, side, text) in enumerate(
+                _span_entries(sp, "server")
+            ):
+                # all of a server span's entries land just BEFORE the
+                # client entry that saw its response (rank slot 0 < the
+                # client's slot 1 at the same base); `o` keeps two
+                # spans sharing one base — e.g. two never-answered
+                # attempts — as intact blocks instead of interleaving
+                # line-by-line, and `k` keeps each span's own order
+                entries.append(((base, 0, o, k), t, side, text))
+        entries.sort(key=lambda e: e[0])
+        out.extend(
+            f"  [{side}] t={t:<10.4f} {text}"
+            for _rank, t, side, text in entries
+        )
+    return "\n".join(out)
 
 
 # --------------------------------------------------------------- explain

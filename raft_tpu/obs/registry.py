@@ -25,6 +25,9 @@ raft_segments_sealed_total                counter  group
 raft_net_requests_total                   counter  kind
 raft_net_bytes_total                      counter  dir
 raft_net_refusals_total                   counter  reason
+raft_net_pump_phase_seconds               histogram phase
+raft_net_coalesce_batch                   histogram (none)
+raft_net_frame_queue_age_seconds          histogram (none)
 raft_commit_latency_seconds               histogram group
 raft_queue_depth_high_water               gauge    group
 raft_term                                 gauge    group

@@ -30,8 +30,12 @@ scrapeable while the engine runs, without locks on the hot path:
               when the tiered log store is configured, and a ``net``
               section (connections, draining, in-flight frames,
               bytes in/out, per-reason wire refusals, staged-ingest
-              split) when a ``raft_tpu.net.IngestServer`` publishes
-              to the same board — JSON
+              split — plus a ``pump`` block with per-phase
+              µs/iteration, attribution coverage and the
+              coalesce-batch / frame-queue-age percentiles when a
+              ``PumpProfiler`` is attached) when a
+              ``raft_tpu.net.IngestServer`` publishes to the same
+              board — JSON
   /compile    the CompileWatch snapshot (per-program trace/compile
               tallies, event log, sentinel freeze state + violations)
   /memory     the MemoryWatch snapshot with a FRESH live-buffer census

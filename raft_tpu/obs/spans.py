@@ -15,6 +15,24 @@ header) and the engine binds the span to its sequence number / read
 ticket from there. After that the causal chain is keyed by seq → log
 index → apply, no ambient state needed.
 
+Cross-process propagation (the wire, docs/OBSERVABILITY.md "Wire
+plane"): a span that crosses a process boundary carries ``wire_trace``
+— the cross-process trace id minted by the CLIENT side
+(``net.client.WireClient``) and propagated in every negotiated frame's
+trace context — and, on the adopting (server) side, ``parent_span``,
+the remote parent's span id. Joining the two sides' span tables on
+``wire_trace`` reconstructs one causal timeline per op
+(``obs.forensics.explain_joined``).
+
+Sampling: ``sampled`` is the Dapper head-sampling bit — decided at the
+root (``SpanTracker(sample_every=N)`` keeps every Nth trace;
+default 1 = everything) and propagated in the wire context so both
+sides agree. The TAIL policy overrides the head decision in
+:meth:`Span.finish`: an op that ends in anything but ``ok``, or whose
+duration exceeds the tracker's ``slow_s`` threshold, is ALWAYS sampled
+— slow/refused/unknown-outcome ops never vanish into the sampling
+noise, which is what makes a sampled span table forensically sound.
+
 Terminal states:
 
 - ``ok``      — outcome observed (write durable, read served).
@@ -64,6 +82,22 @@ class Span:
     #   served read class (docs/READS.md matrix): "lease" |
     #   "read_index" | "follower" | "session"; None for writes and
     #   never-served reads
+    wire_trace: Optional[int] = None
+    #   cross-process trace id (client-minted, rides every negotiated
+    #   wire frame) — the join key between the two sides' span tables
+    parent_span: Optional[int] = None
+    #   remote parent's span id (set on the ADOPTING side: the server
+    #   span whose parent is the client op span)
+    span_id: Optional[int] = None
+    #   this span's WIRE-VISIBLE id, when it differs from the local
+    #   trace_id: client roots use wire_trace; a server composes its
+    #   listening port into the id so two servers' spans stay
+    #   distinguishable in a joined timeline (port << 32 | local id)
+    sampled: bool = True
+    #   head-sampling decision (tail policy may flip it True in finish)
+    slow_s: Optional[float] = None
+    #   tail-sampling slowness threshold (copied from the tracker at
+    #   begin; None = duration never forces sampling)
     refusal_reasons: List[str] = dataclasses.field(default_factory=list)
     annotations: List[Tuple[float, str, Dict[str, Any]]] = \
         dataclasses.field(default_factory=list)
@@ -77,7 +111,12 @@ class Span:
 
     def finish(self, state: str, t: Optional[float], **fields: Any) -> None:
         """Record the span's single terminal state. A second terminal
-        transition is a harness bug (an op resolved twice) and raises."""
+        transition is a harness bug (an op resolved twice) and raises —
+        the contract holds for EVERY span population, engine-side and
+        wire-client-side alike (tests/test_wire_trace.py pins the
+        client paths). Tail sampling happens here: a non-``ok`` outcome
+        or a duration past ``slow_s`` forces ``sampled`` True, whatever
+        the head decision said."""
         if state not in TERMINAL_STATES:
             raise ValueError(f"not a terminal span state: {state!r}")
         if self.terminal:
@@ -87,6 +126,11 @@ class Span:
             )
         self.state = state
         self.t_end = t                # None = unbounded (info at give-up)
+        if state != "ok":
+            self.sampled = True       # tail policy: bad outcomes always
+        elif (self.slow_s is not None and t is not None
+                and t - self.t_start >= self.slow_s):
+            self.sampled = True       # tail policy: slow ops always
         if fields:
             self.annotate(f"end:{state}", t if t is not None else
                           self.t_start, **fields)
@@ -107,12 +151,20 @@ class SpanTracker:
     ``current`` is the ambient trace context (see module docstring); the
     ``note_*`` hooks are what the engine calls at each causal step — all
     tolerant of unbound ids, so instrumented engines keep working for
-    callers that never open spans."""
+    callers that never open spans.
 
-    def __init__(self) -> None:
+    ``sample_every=N`` head-samples every Nth span (deterministic
+    counter, no rng — the determinism contract); ``slow_s`` arms the
+    tail policy's slowness override (module docstring)."""
+
+    def __init__(self, sample_every: int = 1,
+                 slow_s: Optional[float] = None) -> None:
         self.spans: List[Span] = []
         self.current: Optional[Span] = None
+        self.sample_every = max(1, int(sample_every))
+        self.slow_s = slow_s
         self._next_id = 1
+        self._begun = 0
         self._by_seq: Dict[int, Span] = {}
         self._by_idx: Dict[int, Span] = {}
         self._by_ticket: Dict[int, Span] = {}
@@ -128,9 +180,23 @@ class SpanTracker:
         sp = Span(
             trace_id=self._next_id, op=op, t_start=t,
             client=client, key=key, group=group,
+            sampled=(self._begun % self.sample_every == 0),
+            slow_s=self.slow_s,
         )
         self._next_id += 1
+        self._begun += 1
         self.spans.append(sp)
+        return sp
+
+    def adopt(self, sp: Span,
+              ctx: Optional[Tuple[int, int, bool]]) -> Span:
+        """Adopt a remote trace context onto ``sp`` (the server side of
+        the wire join): the context's trace id becomes the join key,
+        its span id the parent, and its sampling bit OVERRIDES the
+        local head decision — the root decided (tail policy still
+        applies at finish)."""
+        if ctx is not None:
+            sp.wire_trace, sp.parent_span, sp.sampled = ctx
         return sp
 
     # ------------------------------------------------ engine-side hooks
@@ -233,9 +299,16 @@ class SpanTracker:
             out[sp.state] = out.get(sp.state, 0) + 1
         return out
 
+    def sampled_spans(self) -> List[Span]:
+        """The spans the sampling policy kept: head-sampled plus every
+        tail-promoted one (non-``ok`` terminal or slow — the capture a
+        forensics bundle embeds when sampling is on)."""
+        return [sp for sp in self.spans if sp.sampled]
+
     # ------------------------------------------------------------ export
-    def to_jsonable(self) -> dict:
-        return {"spans": [sp.to_jsonable() for sp in self.spans]}
+    def to_jsonable(self, sampled_only: bool = False) -> dict:
+        spans = self.sampled_spans() if sampled_only else self.spans
+        return {"spans": [sp.to_jsonable() for sp in spans]}
 
     def to_perfetto(self) -> dict:
         """Chrome/Perfetto trace JSON on the virtual clock: pid = raft
@@ -266,6 +339,8 @@ class SpanTracker:
                     "replication_rounds": sp.replication_rounds,
                     "read_class": sp.read_class,
                     "refusals": sp.refusal_reasons,
+                    "wire_trace": sp.wire_trace,
+                    "parent_span": sp.parent_span,
                 },
             })
             for t, aname, fields in sp.annotations:

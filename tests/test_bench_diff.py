@@ -156,6 +156,27 @@ class TestCompare:
         _, reg2 = compare_runs(old, better, 0.10)
         assert reg2 == []
 
+    def test_wire_trace_leg_gates(self):
+        """The round-15 trace-plane columns: the tracing-overhead ratio
+        and the pump attribution coverage both gate UP (the <= 5%
+        budget and the phases-tile-the-pump contract); the per-phase
+        walls and percentiles ride ungated."""
+        old = {"macro_wire_traced": {
+            "tracing_overhead_ratio": 0.97, "pump_coverage": 0.99,
+            "coalesce_batch_p99": 15.0, "queue_age_p99_us": 1500.0,
+        }}
+        worse = {"macro_wire_traced": {
+            "tracing_overhead_ratio": 0.80, "pump_coverage": 0.60,
+            "coalesce_batch_p99": 64.0, "queue_age_p99_us": 9000.0,
+        }}
+        _, reg = compare_runs(old, worse, 0.10)
+        assert {(d.metric, d.status) for d in reg} == {
+            ("tracing_overhead_ratio", "regressed"),
+            ("pump_coverage", "regressed"),
+        }
+        _, reg2 = compare_runs(worse, old, 0.10)
+        assert reg2 == []                   # improvements never gate
+
     def test_format_table_mentions_threshold(self):
         deltas, _ = compare_runs(self._legs(2.0, 1e6),
                                  self._legs(2.5, 1e6), 0.10)

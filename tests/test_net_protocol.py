@@ -183,3 +183,98 @@ class TestFraming:
         assert len(dec.feed(ok)) == 1
         with pytest.raises(P.ProtocolError):
             dec.feed(b"\xde\xad\xbe\xef\x00\x00\x00\x00")
+
+
+class TestCapabilityCompat:
+    """ISSUE 15 satellite: an unknown/absent capability bit must
+    round-trip as TODAY'S frames byte-for-byte — the old-client ↔
+    new-server and new-client ↔ old-server interop contract, pinned at
+    the codec level (the wire-level halves live in
+    tests/test_wire_trace.py::TestCapabilityNegotiation)."""
+
+    def test_capless_hello_byte_identical_to_pre_capability(self):
+        # the PRE-capability encoding, built by hand
+        import struct
+
+        old = (P._HEADER.pack(P.MAGIC, P.VERSION, P.HELLO, 14)
+               + struct.pack("!H", 1) + struct.pack("!IQ", 2, 99))
+        assert P.encode_hello({2: 99}) == old
+
+    def test_capless_welcome_byte_identical_to_pre_capability(self):
+        import struct
+
+        old = (P._HEADER.pack(P.MAGIC, P.VERSION, P.WELCOME, 8)
+               + struct.pack("!II", 64, 4))
+        assert P.encode_welcome(64, 4) == old
+
+    def test_hello_caps_roundtrip_and_old_decoder_ignores(self):
+        frame = P.encode_hello({0: 7}, caps=P.CAP_TRACE)
+        (_, payload), = P.FrameDecoder().feed(frame)
+        assert P.decode_hello_caps(payload) == ({0: 7}, P.CAP_TRACE)
+        # the OLD decoder reads exactly its floor table; the trailing
+        # capability byte is provably invisible to it
+        assert P.decode_hello(payload) == {0: 7}
+
+    def test_welcome_caps_roundtrip_and_old_decoder_ignores(self):
+        frame = P.encode_welcome(64, 4, caps=P.CAP_TRACE)
+        (_, payload), = P.FrameDecoder().feed(frame)
+        assert P.decode_welcome_caps(payload) == (64, 4, P.CAP_TRACE)
+        assert P.decode_welcome(payload) == (64, 4)
+
+    def test_absent_caps_decode_as_zero_never_error(self):
+        (_, payload), = P.FrameDecoder().feed(P.encode_hello({1: 5}))
+        assert P.decode_hello_caps(payload) == ({1: 5}, 0)
+        (_, payload), = P.FrameDecoder().feed(P.encode_welcome(32, 1))
+        assert P.decode_welcome_caps(payload) == (32, 1, 0)
+
+    def test_untraced_op_frames_byte_identical(self):
+        # trace=None (the un-negotiated default) is the pre-trace
+        # encoding byte-for-byte, for every op frame kind
+        import struct
+
+        body = struct.pack("!Q", 9) + b"\x00\x01k" + b"\x00\x00\x00\x01v"
+        old = P._HEADER.pack(P.MAGIC, P.VERSION, P.SUBMIT, len(body)) + body
+        assert P.encode_submit(9, b"k", b"v") == old
+        assert P.encode_submit(9, b"k", b"v", trace=None) == old
+
+
+class TestTraceContext:
+    def test_traced_frame_roundtrip(self):
+        ctx = (0xABCDEF0123, 0x42, True)
+        frame = P.encode_ok(5, 1, 10, 9, trace=ctx)
+        (kind, payload), = P.FrameDecoder().feed(frame)
+        assert kind == (P.OK | P.TRACE_FLAG)
+        base, got, rest = P.split_trace(kind, payload)
+        assert (base, got) == (P.OK, ctx)
+        assert P.decode_ok(rest) == (5, 1, 10, 9)
+
+    def test_unsampled_bit_roundtrips(self):
+        frame = P.encode_read(3, "session", b"k",
+                              trace=(7, 7, False))
+        (kind, payload), = P.FrameDecoder().feed(frame)
+        _, ctx, rest = P.split_trace(kind, payload)
+        assert ctx == (7, 7, False)
+        assert P.decode_read(rest) == (3, "session", b"k")
+
+    def test_untraced_frame_splits_to_none(self):
+        (kind, payload), = P.FrameDecoder().feed(P.encode_ok(1, 0, 1, 1))
+        assert P.split_trace(kind, payload) == (P.OK, None, payload)
+
+    def test_truncated_trace_context_rejected(self):
+        # a flagged frame too short for the 17-byte context is corrupt
+        frame = P._HEADER.pack(
+            P.MAGIC, P.VERSION, P.OK | P.TRACE_FLAG, 8
+        ) + bytes(8)
+        (kind, payload), = P.FrameDecoder().feed(frame)
+        with pytest.raises(P.ProtocolError, match="trace context"):
+            P.split_trace(kind, payload)
+
+    def test_trace_context_counts_toward_frame_bound(self):
+        # 995 B of value fits untraced (1010 B payload) but NOT with
+        # the 17 B context prepended — the bound covers the whole
+        # payload, context included
+        P.encode_submit(1, b"k", bytes(995), max_frame_bytes=1024)
+        with pytest.raises(P.FrameTooLarge):
+            P.encode_submit(1, b"k", bytes(995),
+                            max_frame_bytes=1024,
+                            trace=(1, 1, True))

@@ -412,6 +412,15 @@ class TestWireChaos:
         assert rep.leader_kills == 1
         assert rep.wire_refusals.get("depth", 0) >= 1
         assert rep.op_counts.get("ok", 0) > 50
+        # ISSUE 15: the drill runs TRACED by default — every client op
+        # spanned, the pump attributed (coverage >= 0.9), commit CRC
+        # reported (the trace-on/off comparison lives in
+        # tests/test_wire_trace.py::TestDeterminism)
+        assert rep.traced
+        assert rep.client_spans == rep.ops
+        assert rep.server_spans >= rep.ops
+        assert rep.pump is not None and rep.pump["coverage"] >= 0.9
+        assert rep.commit_digest
 
     def test_chaos_seeds_replay_byte_identically_wire_plane_off(self):
         """The other half of the acceptance pin: the wire plane is

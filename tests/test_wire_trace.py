@@ -1,0 +1,658 @@
+"""The cross-process wire trace plane (ISSUE 15): capability
+negotiation compat, span joins, tail sampling, the pump profiler, the
+determinism + overhead pins, and joined --explain forensics.
+
+Wall-budget note (README "Testing strategy"): everything here is
+event-driven loopback like tests/test_net_wire.py — the only
+real-clock waits are millisecond-scale client backoffs; the pinned
+wire drill runs once traced and once untraced (~0.5 s together after
+warmup).
+"""
+
+import asyncio
+import struct
+import zlib
+
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.examples.kv import ReplicatedKV
+from raft_tpu.net import (
+    EngineBackend,
+    IngestServer,
+    RouterBackend,
+    WireClient,
+    WireRefused,
+)
+from raft_tpu.net import protocol as P
+from raft_tpu.obs.hostprof import PumpProfiler
+from raft_tpu.obs.registry import MetricsRegistry
+from raft_tpu.obs.spans import SpanTracker
+from raft_tpu.raft import RaftEngine
+
+
+def _engine_cfg(**kw):
+    base = dict(
+        n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=256,
+        transport="single", seed=0,
+    )
+    base.update(kw)
+    return RaftConfig(**base)
+
+
+def _serve(backend, scenario, **server_kw):
+    async def main():
+        srv = IngestServer(backend, **server_kw)
+        port = await srv.start()
+        try:
+            return await scenario(srv, port)
+        finally:
+            await srv.stop()
+    return asyncio.run(main())
+
+
+def _traced_stack(engine):
+    """(server tracker, client tracker, registry, pump) with the
+    engine's causal hooks chained onto the server wire spans."""
+    sspans, cspans = SpanTracker(), SpanTracker()
+    reg = MetricsRegistry()
+    pump = PumpProfiler(registry=reg)
+    engine.spans = sspans
+    return sspans, cspans, reg, pump
+
+
+# ------------------------------------------------ capability negotiation
+class TestCapabilityNegotiation:
+    def test_old_client_against_new_traced_server_byte_identical(self):
+        """A PRE-trace client (raw socket speaking the old encoding)
+        against a fully instrumented server: the WELCOME and every
+        response frame must be byte-for-byte today's frames — no caps
+        byte, no TRACE_FLAG — even though the server traces its side
+        locally."""
+        e = RaftEngine(_engine_cfg())
+        e.run_until_leader()
+        sspans, _, reg, pump = _traced_stack(e)
+
+        async def scenario(srv, port):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port
+            )
+            # the old HELLO: floors only, no capability byte
+            old_hello = (P._HEADER.pack(P.MAGIC, P.VERSION, P.HELLO, 14)
+                         + struct.pack("!H", 1)
+                         + struct.pack("!IQ", 0, 3))
+            assert old_hello == P.encode_hello({0: 3})   # still today's
+            writer.write(old_hello)
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(1 << 16), 5)
+            # byte-for-byte the pre-capability WELCOME
+            assert data == P.encode_welcome(e.cfg.entry_bytes, 1)
+            writer.write(P.encode_submit(1, b"k", b"v"))
+            await writer.drain()
+            buf = b""
+            while not buf:
+                buf = await asyncio.wait_for(reader.read(1 << 16), 5)
+            (kind, payload), = P.FrameDecoder().feed(buf)
+            assert kind == P.OK                  # no TRACE_FLAG bit
+            assert P.decode_ok(payload)[0] == 1
+            writer.close()
+
+        _serve(EngineBackend(e, ReplicatedKV(e)), scenario,
+               spans=sspans, registry=reg, pump=pump)
+        # the server still spanned its side (local observability is
+        # not gated on the peer), but adopted no remote parent
+        wire = [sp for sp in sspans.spans if sp.op == "wire_submit"]
+        assert wire and wire[0].wire_trace is None
+
+    def test_new_traced_client_against_old_server_interop(self):
+        """A traced client against a PRE-trace server (stubbed with the
+        old decoders): HELLO's trailing caps byte is ignored, the old
+        WELCOME yields caps=0, and every subsequent op frame the client
+        sends is byte-compatible — no TRACE_FLAG ever reaches the old
+        peer."""
+        seen_kinds = []
+
+        async def old_server(reader, writer):
+            dec = P.FrameDecoder()
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                for kind, payload in dec.feed(data):
+                    seen_kinds.append(kind)
+                    if kind == P.HELLO:
+                        # the OLD decoder: floors parse, the trailing
+                        # capability byte is provably ignored
+                        assert P.decode_hello(payload) == {}
+                        writer.write(P.encode_welcome(32, 1))
+                    elif kind == P.SUBMIT:
+                        req_id, _k, _v = P.decode_submit(payload)
+                        writer.write(P.encode_ok(req_id, 0, 7, 7))
+                await writer.drain()
+            writer.close()
+
+        async def main():
+            srv = await asyncio.start_server(
+                old_server, "127.0.0.1", 0
+            )
+            port = srv.sockets[0].getsockname()[1]
+            cspans = SpanTracker()
+            c = await WireClient("127.0.0.1", port,
+                                 spans=cspans).connect()
+            r = await c.submit(b"k", b"v")
+            await c.close()
+            srv.close()
+            await srv.wait_closed()
+            return r, cspans
+
+        r, cspans = asyncio.run(main())
+        assert r.seq == 7
+        # every frame the traced client sent was flag-free
+        assert seen_kinds == [P.HELLO, P.SUBMIT]
+        # the client still spans its side; the trace just cannot
+        # propagate (no negotiated capability)
+        sp, = cspans.spans
+        assert sp.state == "ok" and sp.wire_trace is not None
+
+    def test_traced_pair_negotiates_and_propagates(self):
+        e = RaftEngine(_engine_cfg())
+        e.run_until_leader()
+        sspans, cspans, reg, pump = _traced_stack(e)
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port,
+                                 spans=cspans,
+                                 clock=lambda: e.clock.now,
+                                 trace_node=5).connect()
+            assert c._conns[0].caps == P.CAP_TRACE
+            await c.submit(b"k", b"v")
+            await c.close()
+
+        _serve(EngineBackend(e, ReplicatedKV(e)), scenario,
+               spans=sspans, registry=reg, pump=pump)
+        csp, = cspans.spans
+        ssp, = [sp for sp in sspans.spans if sp.op == "wire_submit"]
+        assert csp.wire_trace == (5 << 32) | 1
+        assert ssp.wire_trace == csp.wire_trace
+        assert ssp.parent_span == csp.wire_trace
+
+
+# ------------------------------------------------------------ span join
+class TestSpanJoin:
+    def test_uninstrumented_server_does_not_advertise_trace(self):
+        """A server WITHOUT a SpanTracker must not negotiate CAP_TRACE
+        (it could only echo contexts it never recorded — bogus join
+        hints); the traced client falls back to flag-free frames."""
+        e = RaftEngine(_engine_cfg())
+        e.run_until_leader()
+        cspans = SpanTracker()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port,
+                                 spans=cspans).connect()
+            caps = c._conns[0].caps
+            await c.submit(b"", bytes(e.cfg.entry_bytes))
+            await c.close()
+            return caps
+
+        caps = _serve(EngineBackend(e), scenario)   # no spans= on srv
+        assert caps == 0
+        sp, = cspans.spans
+        assert sp.state == "ok"
+        # no server_span join hints were fabricated
+        assert all(f.get("server_span") is None
+                   for _, _, f in sp.annotations)
+
+    def test_connect_failure_span_is_failed_not_info(self):
+        """A pure connect failure provably sent nothing: the span
+        closes 'failed' (no effect), never 'info' (outcome unknown) —
+        and WireDisconnected says so (``sent=False``)."""
+        from raft_tpu.net.client import WireDisconnected
+
+        cspans = SpanTracker()
+
+        async def main():
+            c = WireClient("127.0.0.1", 1, retries=0, spans=cspans)
+            with pytest.raises(WireDisconnected) as ei:
+                await c.submit(b"k", b"v")
+            assert ei.value.sent is False
+            with pytest.raises(WireDisconnected) as ei2:
+                await c.submit_many([(b"k", b"v")])
+            assert ei2.value.sent is False
+            await c.close()
+
+        asyncio.run(main())
+        assert [sp.state for sp in cspans.spans] == ["failed", "failed"]
+
+    def test_server_span_carries_engine_causal_chain(self):
+        """The remote parent adoption makes the EXISTING engine hooks
+        children of the wire op: queued/ingested/committed/applied all
+        land on the server span whose parent is the client op."""
+        e = RaftEngine(_engine_cfg())
+        e.run_until_leader()
+        sspans, cspans, reg, pump = _traced_stack(e)
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, spans=cspans,
+                                 clock=lambda: e.clock.now).connect()
+            await c.submit(b"k", b"v")
+            out = await c.read(b"k")
+            assert out.value == b"v"
+            await c.close()
+
+        _serve(EngineBackend(e, ReplicatedKV(e)), scenario,
+               spans=sspans, registry=reg, pump=pump)
+        sub, = [sp for sp in sspans.spans if sp.op == "wire_submit"]
+        names = {n for _, n, _ in sub.annotations}
+        assert {"wire_recv", "wire_ingest", "queued", "ingested",
+                "committed", "wire_sent"} <= names
+        ing, = [f for _, n, f in sub.annotations if n == "wire_ingest"]
+        assert ing["pump_iter"] >= 1 and ing["coalesce"] >= 1
+        # and the client side recorded the attempt + the server span id
+        csub = [sp for sp in cspans.spans
+                if sp.op == "client_submit"][0]
+        resp, = [f for _, n, f in csub.annotations if n == "response"]
+        assert resp["server_span"] == sub.span_id
+        assert sub.span_id is not None and sub.span_id != sub.trace_id
+
+    def test_batch_span_stays_unit_level(self):
+        """A SUBMIT_BATCH is ONE wire op: its server span must not pay
+        (or record) per-entry engine annotations — the altitude that
+        keeps the trace plane inside its <= 5% overhead budget."""
+        e = RaftEngine(_engine_cfg(admission_max_writes=64))
+        e.run_until_leader()
+        sspans, cspans, reg, pump = _traced_stack(e)
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, spans=cspans,
+                                 clock=lambda: e.clock.now).connect()
+            pay = bytes(e.cfg.entry_bytes)
+            r = await c.submit_many([(b"", pay) for _ in range(8)])
+            assert r.accepted == 8
+            await c.close()
+
+        _serve(EngineBackend(e), scenario,
+               spans=sspans, registry=reg, pump=pump)
+        bsp, = [sp for sp in sspans.spans
+                if sp.op == "wire_submit_batch"]
+        names = [n for _, n, _ in bsp.annotations]
+        assert "queued" not in names        # unit level, not per entry
+        assert bsp.state == "ok"
+        end, = [f for _, n, f in bsp.annotations if n == "end:ok"]
+        assert end["accepted"] == 8
+
+    def test_head_sampling_with_tail_override(self):
+        """sample_every=4 head-keeps every 4th op — but a refused op is
+        ALWAYS sampled, whatever its head draw said (the tail policy
+        that makes sampled capture forensically sound)."""
+        e = RaftEngine(_engine_cfg(admission_max_writes=2))
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        cspans = SpanTracker(sample_every=4)
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, spans=cspans,
+                                 retries=0,
+                                 clock=lambda: e.clock.now).connect()
+            for i in range(4):                    # serial: all land
+                await c.submit(b"k", b"v%d" % i)
+            # now saturate: concurrent ops past the depth bound shed
+            outs = await asyncio.gather(
+                *[c.submit(b"k", b"w%d" % i) for i in range(8)],
+                return_exceptions=True,
+            )
+            await c.close()
+            return outs
+
+        outs = _serve(EngineBackend(e, kv), scenario)
+        sheds = [sp for sp in cspans.spans if sp.state == "shed"]
+        assert sheds                                # some were refused
+        assert all(sp.sampled for sp in sheds)      # tail: always kept
+        ok_unsampled = [sp for sp in cspans.spans
+                        if sp.state == "ok" and not sp.sampled]
+        assert ok_unsampled                 # head sampling really drops
+        kept = cspans.sampled_spans()
+        assert sheds[0] in kept and ok_unsampled[0] not in kept
+        assert any(isinstance(o, WireRefused) for o in outs)
+
+    def test_client_span_exactly_one_terminal_state(self):
+        """The Span.finish contract extended to client spans: every
+        client path closes its span exactly once, and a second terminal
+        transition raises (the harness-bug tripwire)."""
+        e = RaftEngine(_engine_cfg(admission_max_writes=2))
+        e.run_until_leader()
+        cspans = SpanTracker()
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, spans=cspans,
+                                 retries=1, base_backoff_s=0.001,
+                                 max_backoff_s=0.002).connect()
+            pay = bytes(e.cfg.entry_bytes)
+            outs = await asyncio.gather(
+                *[c.submit(b"k", pay) for _ in range(10)],
+                return_exceptions=True,
+            )
+            await c.close()
+            return outs
+
+        outs = _serve(EngineBackend(e), scenario)
+        assert any(isinstance(o, WireRefused) for o in outs)
+        assert cspans.spans and all(sp.terminal for sp in cspans.spans)
+        by_state = cspans.by_state()
+        assert by_state.get("ok") and by_state.get("shed")
+        shed = [sp for sp in cspans.spans if sp.state == "shed"][0]
+        assert shed.refusal_reasons          # the saga was annotated
+        names = {n for _, n, _ in shed.annotations}
+        assert {"attempt", "refused", "backoff"} <= names
+        with pytest.raises(RuntimeError, match="already terminal"):
+            shed.finish("ok", 0.0)
+
+
+# -------------------------------------------------------- pump profiler
+class TestPumpProfiler:
+    def test_phases_tile_the_iteration(self):
+        prof = PumpProfiler()
+        prof.iter_begin()
+        prof.mark("coalesce")
+        sum(range(2000))
+        prof.mark("ingest")
+        prof.mark("drive")
+        sum(range(2000))
+        prof.mark("sweep")
+        prof.iter_end()
+        assert prof.iters == 1
+        tiled = sum(s for p, s in prof.phase_s.items()
+                    if p != "read_decode")
+        assert tiled == pytest.approx(prof.iter_wall_s, rel=1e-6)
+        assert prof.coverage() == pytest.approx(1.0, rel=1e-6)
+        # marks outside a bracket are no-ops (the HostProfiler rule)
+        prof.mark("drive")
+        assert prof.coverage() == pytest.approx(1.0, rel=1e-6)
+
+    def test_server_pump_section_and_registry(self):
+        e = RaftEngine(_engine_cfg(admission_max_writes=64))
+        e.run_until_leader()
+        reg = MetricsRegistry()
+        pump = PumpProfiler(registry=reg)
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port).connect()
+            pay = bytes(e.cfg.entry_bytes)
+            await asyncio.gather(
+                *[c.submit(b"", pay) for _ in range(16)]
+            )
+            await c.close()
+            return srv.stats()
+
+        stats = _serve(EngineBackend(e), scenario,
+                       registry=reg, pump=pump)
+        ps = stats["pump"]
+        assert ps["iters"] >= 1
+        assert ps["coverage"] >= 0.90          # the acceptance floor
+        assert set(ps["us_per_iter"]) >= {"coalesce", "ingest",
+                                          "drive", "sweep", "flush"}
+        assert ps["coalesce_batch"]["n"] >= 1
+        assert ps["coalesce_batch"]["p99"] >= ps["coalesce_batch"]["p50"]
+        assert ps["queue_age_us"]["n"] >= 16   # one age per frame
+        hist = reg.get("raft_net_pump_phase_seconds")
+        assert hist is not None
+        assert hist.summary(phase="drive")["count"] >= ps["iters"]
+        assert reg.get("raft_net_coalesce_batch") is not None
+        assert reg.get("raft_net_frame_queue_age_seconds") is not None
+
+    def test_pump_profiler_costs_zero_extra_device_fetches(self):
+        """The PR-6 overhead contract: the profiler is pure
+        perf_counter bookkeeping — an identical serial workload
+        performs the IDENTICAL device-fetch count with the profiler
+        attached or absent."""
+        def run(profiled: bool):
+            e = RaftEngine(_engine_cfg(admission_max_writes=64,
+                                       seed=3))
+            e.run_until_leader()
+            fetches = [0]
+            orig = e._fetch
+            e._fetch = lambda x: (
+                fetches.__setitem__(0, fetches[0] + 1), orig(x)
+            )[1]
+            pump = PumpProfiler() if profiled else None
+
+            async def scenario(srv, port):
+                c = await WireClient("127.0.0.1", port).connect()
+                pay = bytes(e.cfg.entry_bytes)
+                for _ in range(6):
+                    await c.submit(b"", pay)
+                await c.close()
+
+            _serve(EngineBackend(e), scenario, pump=pump)
+            return fetches[0], int(e.commit_watermark)
+
+        f_on, wm_on = run(True)
+        f_off, wm_off = run(False)
+        assert wm_on == wm_off >= 6
+        assert f_on == f_off
+
+
+# ----------------------------------------------------------- determinism
+class TestDeterminism:
+    @staticmethod
+    def _serial_run(traced: bool):
+        """A fully deterministic wire scenario: ONE connection, serial
+        request/response (no concurrent coroutines, so the asyncio
+        interleaving that makes the open drill nondeterministic cannot
+        occur) — the domain where byte-identity is provable."""
+        e = RaftEngine(_engine_cfg(admission_max_writes=64, seed=9))
+        kv = ReplicatedKV(e)
+        e.run_until_leader()
+        trackers = _traced_stack(e) if traced else None
+        srv_kw = {}
+        cli_kw = {}
+        if traced:
+            sspans, cspans, reg, pump = trackers
+            srv_kw = dict(spans=sspans, registry=reg, pump=pump)
+            cli_kw = dict(spans=cspans, clock=lambda: e.clock.now)
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, **cli_kw).connect()
+            trace = []
+            for i in range(12):
+                r = await c.submit(b"dk%d" % (i % 3), b"dv%d" % i)
+                trace.append(("ok", r.group, r.seq, r.floor))
+                if i % 3 == 0:
+                    o = await c.read(b"dk0")
+                    trace.append(("rd", o.index, o.value))
+            await c.close()
+            return trace
+
+        trace = _serve(EngineBackend(e, kv), scenario, **srv_kw)
+        crc = 0
+        for item in trace:
+            crc = zlib.crc32(repr(item).encode(), crc)
+        return (int(e.commit_watermark), crc,
+                kv.get(b"dk0"), kv.get(b"dk1"), kv.get(b"dk2"))
+
+    def test_serial_wire_byte_identical_trace_on_vs_off(self):
+        """THE determinism pin: commit watermark, per-op results CRC
+        and applied values are byte-identical with the whole trace
+        plane (client spans + contexts + server adoption + pump
+        profiler + registry) armed vs absent."""
+        assert self._serial_run(True) == self._serial_run(False)
+
+    def test_wire_drill_seed7_traced_vs_untraced_invariants(self):
+        """The drill-level half (ISSUE 15 acceptance): seed 7 stays
+        LINEARIZABLE with the trace plane on AND off, with the same
+        deterministic op total. (The drill's asyncio/TCP interleaving
+        is outside the seeded-replay domain — run-to-run op ORDER over
+        real sockets is kernel-scheduled — so exact commit-CRC
+        identity lives on the serial pin above; the drill's soundness
+        currency is the history checker, which is precisely why it
+        grades recorded histories instead of assuming replay.)"""
+        from raft_tpu.chaos.runner import wire_run
+
+        on = wire_run(7)
+        off = wire_run(7, trace=False)
+        assert on.traced and not off.traced
+        assert on.verdict == off.verdict == "LINEARIZABLE"
+        assert on.ops == off.ops            # total invocations pinned
+        assert on.shed_writes >= 1 and off.shed_writes >= 1
+        assert on.commit_digest and off.commit_digest
+        # the traced run carried the whole plane
+        assert on.client_spans == on.ops
+        assert on.server_spans >= on.ops    # retries add server spans
+        assert on.pump is not None and on.pump["coverage"] >= 0.90
+        assert off.client_spans == 0 and off.pump is None
+
+
+# ----------------------------------------------------- joined forensics
+class TestJoinedForensics:
+    @staticmethod
+    def _explain(paths):
+        import contextlib
+        import io
+
+        from raft_tpu.obs.__main__ import main as obs_main
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = obs_main(["--explain", *paths])
+        assert rc == 0
+        return buf.getvalue()
+
+    def _write_side(self, tmp_path, name, tracker):
+        from raft_tpu.obs.forensics import write_bundle
+
+        return write_bundle(
+            str(tmp_path), kind=name, seed=0, expected="-",
+            verdict="-", spans=tracker,
+            extra={"side": name},
+        )
+
+    def test_refused_op_one_causal_chain_from_artifacts(self, tmp_path):
+        """A shed (Overloaded) op: client bundle + server bundle alone
+        reconstruct ONE chain — client attempt → server ingest batch →
+        typed refusal → client backoff/shed — without re-running."""
+        e = RaftEngine(_engine_cfg(admission_max_writes=2))
+        e.run_until_leader()
+        sspans, cspans, reg, pump = _traced_stack(e)
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, spans=cspans,
+                                 retries=0,
+                                 clock=lambda: e.clock.now).connect()
+            pay = bytes(e.cfg.entry_bytes)
+            outs = await asyncio.gather(
+                *[c.submit(b"jk", pay) for _ in range(8)],
+                return_exceptions=True,
+            )
+            await c.close()
+            return outs
+
+        outs = _serve(EngineBackend(e), scenario,
+                      spans=sspans, registry=reg, pump=pump)
+        assert any(isinstance(o, WireRefused) for o in outs)
+        p_client = self._write_side(tmp_path, "client", cspans)
+        p_server = self._write_side(tmp_path, "server", sspans)
+        text = self._explain([p_client, p_server])
+        shed = [sp for sp in cspans.spans if sp.state == "shed"][0]
+        block = text[text.index(f"trace 0x{shed.wire_trace:x}"):]
+        block = block.split("\ntrace 0x")[0]
+        # the one causal chain spans both processes, in causal order
+        assert "-> shed (depth)" in block
+        i_att = block.index("[client]"), block.index("attempt")
+        i_ing = block.index("wire_ingest")
+        i_end = block.index("end:shed")
+        assert block.index("[server]") > i_att[0]
+        assert i_att[1] < i_ing < i_end
+        assert "refused reason=depth" in block
+
+    def test_redialed_op_one_causal_chain_across_two_servers(
+        self, tmp_path,
+    ):
+        """A NOT_LEADER redial: server A refuses with a hint, the
+        client redials to server B and lands the write — THREE
+        artifacts (client + both servers) join into one chain."""
+        from raft_tpu.multi.engine import MultiEngine
+        from raft_tpu.multi.router import Router
+
+        cfg = _engine_cfg(admission_max_writes=16)
+
+        class HintedBackend(RouterBackend):
+            # the single-process tier cannot know a *real* peer
+            # address, so the redial hint is pinned (exactly what a
+            # multi-server deployment's hint will carry)
+            def leader_hint(self, group):
+                return "replica:1"
+
+        eng_a = MultiEngine(cfg, 1)              # never elects: refuses
+        eng_b = MultiEngine(cfg, 1)
+        eng_b.seed_leaders()
+        spans_a, spans_b, cspans = (SpanTracker(), SpanTracker(),
+                                    SpanTracker())
+        eng_a.spans = spans_a
+        eng_b.spans = spans_b
+
+        async def main():
+            srv_a = IngestServer(
+                HintedBackend(Router(eng_a, drive=False)),
+                spans=spans_a,
+            )
+            srv_b = IngestServer(
+                RouterBackend(Router(eng_b, drive=False)),
+                spans=spans_b,
+            )
+            port_a = await srv_a.start()
+            port_b = await srv_b.start()
+            c = await WireClient(
+                "127.0.0.1", port_a, spans=cspans, retries=3,
+                base_backoff_s=0.001, max_backoff_s=0.002,
+                addr_map={"replica:1": ("localhost", port_b)},
+                clock=lambda: eng_b.clock.now,
+            ).connect()
+            r = await c.submit(b"rk", bytes(cfg.entry_bytes))
+            stats = c.stats.copy()
+            await c.close()
+            await srv_a.stop()
+            await srv_b.stop()
+            return r, stats
+
+        r, stats = asyncio.run(main())
+        assert stats["redials"] == 1
+        assert eng_b.is_durable(r.group, r.seq)
+        paths = [
+            self._write_side(tmp_path, "client", cspans),
+            self._write_side(tmp_path, "server_a", spans_a),
+            self._write_side(tmp_path, "server_b", spans_b),
+        ]
+        text = self._explain(paths)
+        sp, = cspans.spans
+        block = text[text.index(f"trace 0x{sp.wire_trace:x}"):]
+        # one chain: attempt 1 -> A's not_leader -> redial -> attempt 2
+        # -> B's commit -> ok, with BOTH server spans joined
+        assert "1 client op(s), 2 server span(s)" in text
+        body = block.split("\n", 1)[1]       # past the headline
+        assert "redial target=replica:1" in body
+        assert body.index("attempt n=1") < body.index("not_leader")
+        assert (body.index("redial")
+                < body.index("attempt n=2")
+                < body.index("end:ok"))
+        # both servers' spans joined with their own outcomes, in saga
+        # order: A's shed answers attempt 1, B's ok answers attempt 2
+        assert body.index("end:shed") < body.index("attempt n=2")
+        assert body.index("attempt n=2") < body.index("end:ok")
+
+    def test_wire_drill_bundle_self_joins(self, tmp_path):
+        """The drill's single bundle carries BOTH span tables; a plain
+        --explain on it appends the joined view automatically."""
+        from raft_tpu.chaos.runner import wire_run
+
+        rep = wire_run(3, clients=2, ops_per_phase=4,
+                       bundle_dir=str(tmp_path))
+        assert rep.bundle_path is not None
+        text = self._explain([rep.bundle_path])
+        assert "joined wire forensics" in text
+        assert "client op(s)" in text
+
+    def test_joined_explain_rejects_non_bundles(self, tmp_path):
+        p = tmp_path / "junk.json"
+        p.write_text("{}")
+        with pytest.raises(SystemExit):
+            self._explain([str(p), str(p)])

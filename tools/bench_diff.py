@@ -99,6 +99,16 @@ GATED_METRICS: Dict[str, str] = {
     "e2e_p50_ms": "down",
     "e2e_p99_ms": "down",
     "wire_goodput_ratio": "up",
+    # wire trace plane (round 15): the tracing-overhead ratio (traced /
+    # untraced wire goodput, bracketed windows) gates UP so the trace
+    # plane can never quietly grow past its <= 5% budget, and the
+    # pump-phase attribution coverage gates UP so the phase table can
+    # never silently stop tiling the pump iteration. The per-phase
+    # µs/iter and coalesce/queue-age percentiles are REPORTED UNGATED
+    # (shape-dependent wall numbers; the ratio and coverage carry the
+    # contract).
+    "tracing_overhead_ratio": "up",
+    "pump_coverage": "up",
 }
 
 
