@@ -1814,6 +1814,166 @@ def bench_macro() -> dict:
     return rows
 
 
+# -------------------------------------------------- txn wire macro leg
+def bench_txn() -> dict:
+    """Cross-group transactions on the wire (docs/TXN.md): the macro
+    wire shape re-run with the 2PC coordinator plane attached and a
+    90/10 single-key / transaction request mix, all through the same
+    batched ingest pump. Each connection issues REQS requests; each
+    request is (p=0.10) a validated two-account transfer — read both
+    balances, expect both, write both, the OCC shape, so racing
+    workers produce real ``expect_failed`` aborts — or (p=0.90) a
+    B-entry single-key ``SUBMIT_BATCH`` frame.
+
+    Reports txn commit latency p50/p99 (the wire BEGIN+COMMIT round:
+    prewrite fan-out, replicated decision, release), committed-txn
+    goodput, the abort rate (reported, deliberately NOT gated by
+    tools/bench_diff.py — it measures workload contention, not a
+    regression), and the single-key goodput riding alongside. The
+    transfer keyspace (``ta*``) is disjoint from the single-key
+    keyspace (``mk*``) per the lock-discipline contract in
+    docs/TXN.md."""
+    import asyncio
+    import random as _random
+
+    from raft_tpu.multi.engine import MultiEngine
+    from raft_tpu.multi.router import Router
+    from raft_tpu.net import (
+        IngestServer,
+        RouterBackend,
+        WireClient,
+        WireRefused,
+    )
+    from raft_tpu.net.client import WireDisconnected, WireError
+    from raft_tpu.txn import TxnCoordinator, TxnShardedKV
+
+    G, B, CONNS, REQS, ACCOUNTS = 4, 64, 8, 30, 16
+    cfg = RaftConfig(
+        n_replicas=3, entry_bytes=64, batch_size=B,
+        log_capacity=1 << 11, transport="single", seed=17,
+        admission_max_writes=512,
+    )
+    # with a ShardedKV attached the wire re-encodes each write as a
+    # typed KV op INSIDE the entry, so the value budget is entry_bytes
+    # minus the op header + key — half-size values keep comfortable room
+    payload = bytes(cfg.entry_bytes // 2)
+    keys = [b"mk%d" % i for i in range(64)]
+    accounts = [b"ta%d" % i for i in range(ACCOUNTS)]
+
+    eng = MultiEngine(cfg, G)
+    router = Router(eng, drive=False)
+    skv = TxnShardedKV(eng, router)
+    eng.seed_leaders()
+    coord = TxnCoordinator(skv, decision_group=0)
+
+    txn_lats: list = []
+    committed = [0]
+    aborted = [0]
+    txn_refused = [0]
+    txn_unknown = [0]
+    single_acked = [0]
+    single_shed = [0]
+
+    async def run_leg():
+        srv = IngestServer(RouterBackend(router, skv), txn=coord,
+                           drive_quantum_s=cfg.heartbeat_period)
+        port = await srv.start()
+        cs = [
+            await WireClient(
+                "127.0.0.1", port, txn=True,
+                rng=_random.Random(f"bench-txn:{i}"),
+            ).connect()
+            for i in range(CONNS)
+        ]
+        # seed every account once (plain durable writes: the txn
+        # traffic has not started, so nothing is locked yet)
+        for i, a in enumerate(accounts):
+            await cs[i % CONNS].submit(a, b"100")
+
+        async def one_txn(c, rng) -> None:
+            src, dst = rng.sample(range(ACCOUNTS), 2)
+            ka, kb = accounts[src], accounts[dst]
+            try:
+                va = (await c.read(ka)).value or b"0"
+                vb = (await c.read(kb)).value or b"0"
+            except (WireRefused, WireDisconnected, WireError):
+                txn_refused[0] += 1
+                return
+            amt = 1 + rng.randrange(5)
+            t0 = time.perf_counter()
+            try:
+                r = await c.txn_commit(
+                    [(ka, b"%d" % (int(va) - amt)),
+                     (kb, b"%d" % (int(vb) + amt))],
+                    expects=[(ka, va), (kb, vb)],
+                )
+            except WireRefused:
+                txn_refused[0] += 1
+                return
+            except (WireDisconnected, WireError):
+                txn_unknown[0] += 1
+                return
+            txn_lats.append((time.perf_counter() - t0) * 1e3)
+            if r.status == "committed":
+                committed[0] += 1
+            else:
+                aborted[0] += 1
+
+        async def one_frame(c, j: int) -> None:
+            items = [(keys[(j * B + i) % len(keys)], payload)
+                     for i in range(B)]
+            try:
+                r = await c.submit_many(items)
+            except (WireRefused, WireDisconnected, WireError):
+                single_shed[0] += B
+            else:
+                single_acked[0] += r.accepted
+                single_shed[0] += r.shed
+
+        async def worker(i: int) -> None:
+            c = cs[i]
+            rng = _random.Random(f"bench-txn-mix:{i}")
+            for j in range(REQS):
+                if rng.random() < 0.10:
+                    await one_txn(c, rng)
+                else:
+                    await one_frame(c, j)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker(i) for i in range(CONNS)])
+        wall = time.perf_counter() - t0
+        for c in cs:
+            await c.close()
+        await srv.stop()
+        return wall
+
+    wall = asyncio.run(run_leg())
+    p50, p99 = _percentiles(txn_lats)
+    txns = committed[0] + aborted[0]
+    return {
+        "connections": CONNS,
+        "requests": CONNS * REQS,
+        "wire_batch": B,
+        "groups": G,
+        "txns": txns,
+        "txn_committed": committed[0],
+        "txn_aborted": aborted[0],
+        "txn_refused": txn_refused[0],
+        "txn_unknown": txn_unknown[0],
+        "abort_rate": round(aborted[0] / max(txns, 1), 4),
+        "txn_p50_ms": round(p50, 2),
+        "txn_p99_ms": round(p99, 2),
+        "txn_goodput_eps": round(committed[0] / max(wall, 1e-9), 2),
+        "single_entries": single_acked[0],
+        "single_shed": single_shed[0],
+        "single_goodput_eps": round(
+            single_acked[0] / max(wall, 1e-9), 1
+        ),
+        "lock_conflicts": coord.lock_conflicts,
+        "wall_s": round(wall, 3),
+    }
+
+
 # ------------------------------------------------- mesh per-device kernel
 def bench_mesh1(rng) -> dict:
     """Per-device fused-kernel overhead (VERDICT r4 #1 'Done' row): the
@@ -2766,6 +2926,7 @@ def main(argv=None) -> None:
         ("overload", bench_overload),
         ("reconfig", bench_reconfig),
         ("macro", bench_macro),
+        ("txn", bench_txn),
     ):
         configs[name] = dl.run(name, leg)
     if dl.expired:

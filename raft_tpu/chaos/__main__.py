@@ -20,6 +20,7 @@ from raft_tpu.chaos.runner import (
     segment_storage_run,
     torture_run,
     torture_run_multi,
+    txn_run,
     wire_run,
 )
 
@@ -101,6 +102,19 @@ def main(argv=None) -> int:
                          "admission gate's typed refusals surfaced as "
                          "wire backpressure (shed >= 1), and clients "
                          "rode NOT_LEADER frames through the election")
+    ap.add_argument("--txn", action="store_true",
+                    help="run the cross-group transaction drill "
+                         "(docs/TXN.md): a replicated 2PC coordinator "
+                         "drives validated transfers across a mesh-"
+                         "sharded MultiEngine while the nemesis kills "
+                         "leaders, partitions groups, and migrates a "
+                         "participant mid-transaction; succeeds only "
+                         "if the commit-order witness checks "
+                         "SERIALIZABLE, money is conserved, AND the "
+                         "single-key side-traffic checks linearizable; "
+                         "with --broken txn_partial_commit or "
+                         "txn_dirty_read, succeeds only if the "
+                         "serializability checker CAUGHT the bug")
     ap.add_argument("--read-plane", action="store_true",
                     help="arm the read scale-out plane on a torture "
                          "run: leader leases (prevote implied) plus "
@@ -117,7 +131,8 @@ def main(argv=None) -> int:
                          "window")
     ap.add_argument("--broken",
                     choices=["dirty_reads", "commit_rewind",
-                             "lease_skew"],
+                             "lease_skew", "txn_partial_commit",
+                             "txn_dirty_read"],
                     default=None,
                     help="deliberately broken variant; the run SUCCEEDS "
                          "(exit 0) only if the harness catches it — "
@@ -129,8 +144,14 @@ def main(argv=None) -> int:
                          "lease_skew (leader leases that ignore the "
                          "clock-drift bound; needs --reads) must serve "
                          "a stale read the per-class checker and/or "
-                         "auditor catch. A passing broken run means "
-                         "the harness lost its teeth")
+                         "auditor catch, txn_partial_commit (a 2PC "
+                         "coordinator that commits a transaction whose "
+                         "prewrite lost its locks; needs --txn) and "
+                         "txn_dirty_read (a store that serves staged "
+                         "intents before the decision; needs --txn) "
+                         "must both be CAUGHT by the serializability "
+                         "checker. A passing broken run means the "
+                         "harness lost its teeth")
     ap.add_argument("--audit", action="store_true",
                     help="attach the ONLINE safety plane: the "
                          "obs.audit.SafetyAuditor invariant checks "
@@ -199,6 +220,18 @@ def main(argv=None) -> int:
         ap.error("--segments is a standalone single-engine drill")
     if args.broken == "lease_skew" and not args.reads:
         ap.error("--broken lease_skew applies to the --reads drill")
+    if (args.broken in ("txn_partial_commit", "txn_dirty_read")
+            and not args.txn):
+        ap.error("--broken %s applies to the --txn drill" % args.broken)
+    if args.txn and (args.multi or args.overload or args.reconfig
+                     or args.migration or args.segments
+                     or args.membership or args.reads or args.wire
+                     or args.broken not in (None, "txn_partial_commit",
+                                            "txn_dirty_read")
+                     or args.overload_recovery is not None):
+        ap.error("--txn is a standalone sharded-multi drill (--broken "
+                 "txn_partial_commit / txn_dirty_read are its only "
+                 "compositions)")
     if args.reads and (args.multi or args.overload or args.reconfig
                        or args.migration or args.segments
                        or args.membership
@@ -214,6 +247,43 @@ def main(argv=None) -> int:
                  "overload nemeses are built in)")
 
     ok = True
+    if args.txn:
+        for seed in range(args.seed, args.seed + args.sweep):
+            rep = txn_run(
+                seed, n_groups=args.groups, broken=args.broken,
+                step_budget=args.step_budget,
+                bundle_dir=args.bundle_dir,
+                blackbox_dir=args.blackbox_dir,
+            )
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "singles": rep.singles.verdict,
+                "txns": rep.txns,
+                "committed": rep.committed,
+                "aborted": rep.aborted,
+                "unresolved": rep.unresolved,
+                "conflicts": rep.conflicts,
+                "single_ops": rep.single_ops,
+                "conserved_ok": rep.conserved_ok,
+                "moves": rep.moves,
+                "nemeses": rep.nemeses,
+                "broken": rep.broken,
+                "commit_digest": rep.commit_digest,
+                "bundle": rep.bundle_path,
+            }), flush=True)
+            if args.broken:
+                # the flag's contract: a CAUGHT violation IS success
+                ok = ok and rep.caught
+            else:
+                ok = ok and (
+                    rep.verdict == "SERIALIZABLE"
+                    and rep.conserved_ok
+                    and rep.singles.verdict == "LINEARIZABLE"
+                    and rep.committed >= 1
+                )
+        return 0 if ok else 1
     if args.wire:
         for seed in range(args.seed, args.seed + args.sweep):
             rep = wire_run(

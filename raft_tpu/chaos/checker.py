@@ -52,6 +52,7 @@ from raft_tpu.chaos.history import (
 LINEARIZABLE = "LINEARIZABLE"
 VIOLATION = "VIOLATION"
 UNDETERMINED = "UNDETERMINED"
+SERIALIZABLE = "SERIALIZABLE"        # check_serializable's passing verdict
 
 _INF = float("inf")
 
@@ -366,3 +367,133 @@ def _check_session(ops: List[OpRecord]) -> CheckResult:
     return CheckResult(LINEARIZABLE, steps,
                        detail="session model (monotone + RYW + "
                               "read-committed)")
+
+
+# ------------------------------------------------- transactional checking
+@dataclasses.dataclass
+class TxnRecord:
+    """One transaction as the serializability checker sees it.
+
+    ``expects`` are the validation reads the coordinator certified
+    UNDER THE LOCKS (key -> committed value observed, None = absent);
+    ``writes`` are the staged intents (key -> new value, None =
+    delete). ``status`` follows chaos.history: ``ok`` = committed with
+    a known decision position, ``fail`` = aborted (provably no
+    effect), ``info`` = outcome unknown (the drill resolves these from
+    the replicated decision map at quiesce, so a clean run has none).
+    ``pos`` is the decision record's apply position in the decision
+    group — the commit-order witness."""
+
+    txn_id: int
+    writes: Dict[bytes, Optional[bytes]]
+    expects: Dict[bytes, Optional[bytes]]
+    status: str = OK
+    pos: Optional[int] = None
+    invoke_t: float = 0.0
+    complete_t: Optional[float] = None
+
+
+def check_serializable(
+    txns: List[TxnRecord],
+    final_state: Optional[Dict[bytes, bytes]] = None,
+    initial: Optional[Dict[bytes, bytes]] = None,
+) -> CheckResult:
+    """Grade a cross-group transactional history against STRICT
+    serializability by VERIFYING the system's own commit-order witness
+    (the decision group's apply order) rather than searching for one.
+
+    The witness obligates three things, and failing any is a
+    ``VIOLATION`` — this checker can call the system wrong, which is
+    the falsifiability contract (``--broken txn_*`` pins it):
+
+    1. **Reads explained at the serial point** — replaying committed
+       transactions in decision order, every transaction's certified
+       ``expects`` must equal the model state at its position (a
+       coordinator that commits after a failed prewrite, or validates
+       against staged/dirty values, breaks here);
+    2. **Real time respected** — a transaction that completed before
+       another was invoked must hold the earlier decision position
+       (strictness: the witness cannot reorder non-overlapping txns);
+    3. **Atomicity at the end state** — when ``final_state`` (a
+       quiesced read of every key) is supplied, the replay's end state
+       must match it exactly: a half-applied commit or an aborted
+       transaction's leaked write both surface as a mismatch.
+
+    ``info`` transactions (outcome unknown) make a failed end-state
+    comparison ``UNDETERMINED`` instead of ``VIOLATION`` — the missing
+    effects might be theirs. A committed txn with no recorded position
+    is an incomplete witness: ``UNDETERMINED``."""
+    committed = [t for t in txns if t.status == OK]
+    unknown = [t for t in txns if t.status == INFO]
+    steps = 0
+    for t in committed:
+        if t.pos is None:
+            return CheckResult(
+                UNDETERMINED, steps,
+                detail=f"txn {t.txn_id} committed without a decision "
+                       "position: witness incomplete",
+            )
+    order = sorted(committed, key=lambda t: t.pos)
+    for a, b in zip(order, order[1:]):
+        if a.pos == b.pos:
+            return CheckResult(
+                VIOLATION, steps,
+                detail=f"txns {a.txn_id} and {b.txn_id} share decision "
+                       f"position {a.pos}: the witness is not an order",
+            )
+    # 2) strictness: completed-before implies decided-before
+    for i, a in enumerate(order):
+        for b in order[i + 1:]:
+            steps += 1
+            if (b.complete_t is not None
+                    and b.complete_t < a.invoke_t):
+                return CheckResult(
+                    VIOLATION, steps,
+                    detail=f"txn {b.txn_id} completed at "
+                           f"{b.complete_t:.3f} before txn {a.txn_id} "
+                           f"was invoked at {a.invoke_t:.3f}, but "
+                           f"decided later (pos {b.pos} > {a.pos})",
+                )
+    # 1) replay the witness
+    state: Dict[bytes, Optional[bytes]] = dict(initial or {})
+    for t in order:
+        for k in sorted(t.expects):
+            steps += 1
+            if state.get(k) != t.expects[k]:
+                return CheckResult(
+                    VIOLATION, steps, key=k,
+                    detail=f"txn {t.txn_id} (pos {t.pos}) certified "
+                           f"{t.expects[k]!r} for key {k!r} but the "
+                           f"serial state holds {state.get(k)!r}",
+                )
+        for k, v in t.writes.items():
+            if v is None:
+                state.pop(k, None)
+            else:
+                state[k] = v
+    # 3) atomicity at the end state
+    if final_state is not None:
+        model = {k: v for k, v in state.items() if v is not None}
+        for k in sorted(set(model) | set(final_state)):
+            steps += 1
+            if model.get(k) != final_state.get(k):
+                if unknown:
+                    return CheckResult(
+                        UNDETERMINED, steps, key=k,
+                        detail=f"end state of key {k!r} is "
+                               f"{final_state.get(k)!r}, replay says "
+                               f"{model.get(k)!r}; {len(unknown)} "
+                               "unresolved txn(s) could explain it",
+                    )
+                return CheckResult(
+                    VIOLATION, steps, key=k,
+                    detail=f"end state of key {k!r} is "
+                           f"{final_state.get(k)!r} but replaying the "
+                           f"commit order yields {model.get(k)!r} "
+                           "(atomicity broken)",
+                )
+    return CheckResult(
+        SERIALIZABLE, steps,
+        detail=f"{len(order)} committed txn(s) replayed in decision "
+               f"order; {len(txns) - len(committed)} aborted/unknown",
+    )

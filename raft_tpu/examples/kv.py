@@ -26,6 +26,12 @@ Entry encoding (fits one fixed-size log entry, entry_bytes >= 6):
 ``[op u8][klen u16][vlen u16][key][value]`` zero-padded; op 1 = SET,
 op 2 = DELETE. Zero padding is self-delimiting because op 0 is invalid
 (an all-zero heartbeat entry is ignored).
+
+Ops 3-6 are CLAIMED by the transaction plane (``raft_tpu.txn.ops``:
+LOCK=3, COMMIT=4, ABORT=5, DECIDE=6 — docs/TXN.md); a new plain-KV op
+must start at 7. This store ignores them (unknown op = no-op on apply),
+which is what lets ``txn.store.TxnShardedKV`` layer the typed entries
+over the same log without forking the wire format.
 """
 
 from __future__ import annotations

@@ -306,7 +306,15 @@ class Router:
         rather than resubmitting them. A bucket refused mid-way (a
         bounded queue filling between items) resumes from its first
         UNPLACED item on retry, so a retried bucket can never queue an
-        entry twice."""
+        entry twice.
+
+        The txn plane's prewrite fan-out (``txn.coordinator``) depends
+        on exactly this contract: a partially placed prewrite must
+        keep its placed lock entries (they will apply, first-lock-wins
+        arbitrates) while the coordinator pivots the transaction to a
+        replicated ABORT decision — double-queuing a lock entry would
+        make the release roll-forward double-apply its staged intent.
+        ``tests/test_txn.py`` pins never-double-queued directly."""
         buckets: Dict[int, List[int]] = {}
         for i, (key, _) in enumerate(items):
             buckets.setdefault(self.group_of(key), []).append(i)

@@ -132,6 +132,27 @@ class BatchResult:
         self.floors = floors
 
 
+class TxnResult:
+    """One transaction's resolution. ``status`` is ``"committed"`` /
+    ``"aborted"`` / ``"unknown"`` — an abort is an OUTCOME the caller
+    inspects, not an error (``reason`` says why: ``lock_lost`` /
+    ``expect_failed`` / ``prewrite_refused`` / ``ttl_expired``).
+    ``unknown`` only comes from :meth:`WireClient.txn_status`: no
+    decision is recorded yet."""
+
+    __slots__ = ("txn_id", "status", "reason", "attempts")
+
+    def __init__(self, txn_id, status, reason, attempts):
+        self.txn_id = txn_id
+        self.status = status
+        self.reason = reason
+        self.attempts = attempts
+
+    @property
+    def committed(self) -> bool:
+        return self.status == "committed"
+
+
 class _PoolConn:
     """One pooled connection: writer + a reader task dispatching
     response frames to per-request futures by ``req_id``."""
@@ -158,6 +179,8 @@ class _PoolConn:
         # byte-for-byte
         fut = self._expect_welcome()
         caps = P.CAP_TRACE if self.client.spans is not None else 0
+        if self.client.txn_enabled:
+            caps |= P.CAP_TXN
         self.writer.write(P.encode_hello(self.client.session.floor,
                                          caps=caps))
         await self.writer.drain()
@@ -227,6 +250,9 @@ class _PoolConn:
         elif kind == P.NOT_LEADER:
             req_id, group, hint = P.decode_not_leader(payload)
             result = ("not_leader", (group, hint), ctx)
+        elif kind == P.TXN_STATE:
+            req_id, txn_id, status, reason = P.decode_txn_state(payload)
+            result = ("txn_state", (txn_id, status, reason), ctx)
         elif kind == P.ERROR:
             req_id, message = P.decode_error(payload)
             if req_id == 0:
@@ -293,6 +319,7 @@ class WireClient:
         spans=None,
         clock=None,
         trace_node: Optional[int] = None,
+        txn: bool = False,
     ) -> None:
         self.host = host
         self.port = port
@@ -309,6 +336,10 @@ class WireClient:
         self._sleep = sleep if sleep is not None else asyncio.sleep
         self.spans = spans
         self._clock = clock
+        #   txn=True advertises CAP_TXN in HELLO (the additive-
+        #   capability contract: an un-opted client's HELLO stays
+        #   byte-identical to the pre-txn protocol)
+        self.txn_enabled = txn
         if trace_node is None:
             WireClient._next_node += 1
             trace_node = WireClient._next_node
@@ -629,21 +660,7 @@ class WireClient:
                     sp.annotate("not_leader", self._now(), group=group,
                                 hint=hint,
                                 server_span=self._sid(rctx))
-                target = self.addr_map.get(hint)
-                if target is not None and target != (self.host,
-                                                     self.port):
-                    # leader-hint redial: repoint the pool (closing
-                    # the old conns — an orphaned socket per redial
-                    # would leak across a flappy election)
-                    self.host, self.port = target
-                    for old in self._conns:
-                        if old is not None:
-                            old.close()
-                    self._conns = [None] * self.pool_size
-                    self.stats["redials"] += 1
-                    if sp is not None:
-                        sp.redials += 1
-                        sp.annotate("redial", self._now(), target=hint)
+                self._maybe_redial(hint, sp)
             if attempt > self.retries:
                 raise WireRefused(last_reason, last_hint, attempt)
             if not self.budget.try_spend():
@@ -660,3 +677,218 @@ class WireClient:
                 sp.retries += 1
                 sp.annotate("backoff", self._now(), delay_s=delay)
             await self._sleep(delay)
+
+    def _maybe_redial(self, hint: str, sp) -> None:
+        """Leader-hint redial: repoint the pool (closing the old conns
+        — an orphaned socket per redial would leak across a flappy
+        election)."""
+        target = self.addr_map.get(hint)
+        if target is None or target == (self.host, self.port):
+            return
+        self.host, self.port = target
+        for old in self._conns:
+            if old is not None:
+                old.close()
+        self._conns = [None] * self.pool_size
+        self.stats["redials"] += 1
+        if sp is not None:
+            sp.redials += 1
+            sp.annotate("redial", self._now(), target=hint)
+
+    # --------------------------------------------------------- transactions
+    async def txn_commit(self, writes, expects=()) -> TxnResult:
+        """One cross-group transaction: ``writes`` = [(key, new value |
+        None to delete)] staged under replicated locks, ``expects`` =
+        [(key, committed value | None for expect-absent)] certified
+        under them (docs/TXN.md). BEGIN allocates the server-side txn
+        id; TXN_COMMIT is the single effectful frame.
+
+        Retry discipline: a typed refusal (``txn_lock``, admission
+        sheds, ``not_leader``) provably queued NOTHING, so the loop
+        backs off under the usual budget and re-opens with a FRESH txn
+        id. A connection loss before COMMIT is sent retries freely
+        (BEGIN has no effect); from the COMMIT send onward the outcome
+        is UNKNOWN — :class:`WireDisconnected` surfaces it and
+        :meth:`txn_status` resolves it. ``aborted`` comes back as a
+        RESULT, not an exception: certification failures are an outcome
+        the application inspects. Requires ``txn=True`` and a server
+        that spoke ``CAP_TXN`` back."""
+        key0 = writes[0][0] if writes \
+            else (expects[0][0] if expects else b"")
+        sp = self._begin_span("client_txn", key0)
+        try:
+            out = await self._txn_commit_loop(writes, expects, sp)
+        except WireRefused as ex:
+            self._finish_at(sp, "shed", reason=ex.reason,
+                            attempts=ex.attempts)
+            raise
+        except WireDisconnected as ex:
+            self._finish_at(sp, "info" if ex.sent else "failed")
+            raise
+        except asyncio.CancelledError:
+            self._finish_at(sp, "info")
+            raise
+        except BaseException:
+            self._finish_at(sp, "failed")
+            raise
+        self._finish_at(sp, "ok", status=out.status,
+                        attempts=out.attempts)
+        return out
+
+    async def _txn_commit_loop(self, writes, expects, sp) -> TxnResult:
+        last_reason, last_hint = "unknown", 0.0
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                conn = await self._pick()
+            except OSError as ex:
+                if attempt <= self.retries:
+                    self.stats["retries"] += 1
+                    delay = self.backoff.delay(attempt - 1)
+                    if sp is not None:
+                        sp.retries += 1
+                        sp.annotate("backoff", self._now(),
+                                    delay_s=delay,
+                                    cause="connect_failed")
+                    await self._sleep(delay)
+                    continue
+                raise WireDisconnected(
+                    f"cannot connect to {self.host}:{self.port}: {ex}",
+                    sent=False,
+                )
+            if not (conn.caps & P.CAP_TXN):
+                raise WireError(
+                    "server did not negotiate CAP_TXN (no transaction "
+                    "coordinator attached, or txn=False on this client)"
+                )
+            if sp is not None:
+                sp.annotate("attempt", self._now(), n=attempt)
+            # BEGIN allocates an id and nothing else: a disconnect
+            # here provably left no effect, so it retries freely
+            req_id = self._next_req_id
+            self._next_req_id += 1
+            try:
+                tag, body, rctx = await conn.request(
+                    req_id, P.encode_txn_begin(
+                        req_id, trace=self._ctx(sp, conn),
+                    ))
+            except WireDisconnected:
+                if attempt <= self.retries:
+                    if sp is not None:
+                        sp.annotate("reconnect", self._now(), n=attempt)
+                    continue
+                raise
+            if tag == "txn_state":
+                txn_id = body[0]
+                if sp is not None:
+                    sp.annotate("txn_open", self._now(), txn=txn_id)
+                # the effectful frame: from here a disconnect is
+                # outcome UNKNOWN (WireDisconnected bubbles)
+                req_id = self._next_req_id
+                self._next_req_id += 1
+                tag, body, rctx = await conn.request(
+                    req_id, P.encode_txn_commit(
+                        req_id, txn_id, writes, expects,
+                        max_frame_bytes=self.max_frame_bytes,
+                        trace=self._ctx(sp, conn),
+                    ))
+                if tag == "txn_state":
+                    txn_id, status, reason = body
+                    self.budget.on_success()
+                    if sp is not None and rctx is not None:
+                        sp.annotate("response", self._now(), tag=tag,
+                                    server_span=self._sid(rctx))
+                    return TxnResult(txn_id, status, reason, attempt)
+            if tag == "error":
+                if sp is not None and rctx is not None:
+                    sp.annotate("server_error", self._now(),
+                                server_span=self._sid(rctx))
+                raise WireError(body)
+            if tag == "refused":
+                # typed: nothing queued — the next attempt re-BEGINs
+                # under a fresh txn id
+                last_reason, last_hint = body
+                self.stats["sheds"] += 1
+                if sp is not None:
+                    sp.refusal_reasons.append(last_reason)
+                    sp.annotate("refused", self._now(),
+                                reason=last_reason,
+                                retry_after_s=last_hint,
+                                server_span=self._sid(rctx))
+            elif tag == "not_leader":
+                group, hint = body
+                last_reason, last_hint = "not_leader", 0.0
+                self.stats["not_leader"] += 1
+                if sp is not None:
+                    sp.refusal_reasons.append("not_leader")
+                    sp.annotate("not_leader", self._now(), group=group,
+                                hint=hint,
+                                server_span=self._sid(rctx))
+                self._maybe_redial(hint, sp)
+            if attempt > self.retries:
+                raise WireRefused(last_reason, last_hint, attempt)
+            if not self.budget.try_spend():
+                self.stats["budget_denied"] += 1
+                raise WireRefused(last_reason, last_hint, attempt)
+            self.stats["retries"] += 1
+            delay = self.backoff.delay(
+                attempt - 1, last_hint if last_hint > 0 else None
+            )
+            if len(self.last_delays) >= 256:
+                del self.last_delays[:128]
+            self.last_delays.append(delay)
+            if sp is not None:
+                sp.retries += 1
+                sp.annotate("backoff", self._now(), delay_s=delay)
+            await self._sleep(delay)
+
+    async def txn_status(self, txn_id: int) -> TxnResult:
+        """Decision lookup (effect-free, reconnect-retries): how a
+        :meth:`txn_commit` that died mid-flight resolves its outcome.
+        ``unknown`` means no decision is recorded YET — an undecided
+        transaction's locks fall to the server's TTL resolver, so
+        re-ask after its ``ttl_s``."""
+        return await self._txn_query(
+            "client_txn_status", P.encode_txn_status, txn_id
+        )
+
+    async def txn_abort(self, txn_id: int) -> TxnResult:
+        """Abandon an open (never-committed) transaction. BEGIN placed
+        nothing server-side, so this is trivially effect-free; a txn
+        with a recorded decision answers with THAT verdict instead."""
+        return await self._txn_query(
+            "client_txn_abort", P.encode_txn_abort, txn_id
+        )
+
+    async def _txn_query(self, op: str, enc, txn_id: int) -> TxnResult:
+        sp = self._begin_span(op, b"")
+
+        def parse(tag, body, attempts):
+            if tag != "txn_state":
+                return None
+            tid, status, reason = body
+            return TxnResult(tid, status, reason, attempts)
+
+        try:
+            conn = await self._pick()
+            if not (conn.caps & P.CAP_TXN):
+                raise WireError(
+                    "server did not negotiate CAP_TXN (no transaction "
+                    "coordinator attached, or txn=False on this "
+                    "client)"
+                )
+            out = await self._with_retries(
+                lambda req_id, trace: enc(req_id, txn_id, trace=trace),
+                parse, reconnect_retry=True, sp=sp,
+            )
+        except WireRefused as ex:
+            self._finish_at(sp, "shed", reason=ex.reason,
+                            attempts=ex.attempts)
+            raise
+        except BaseException:
+            self._finish_at(sp, "failed")
+            raise
+        self._finish_at(sp, "ok", status=out.status,
+                        attempts=out.attempts)
+        return out

@@ -64,12 +64,20 @@ NOT_LEADER = 8   # server -> client: no routed leader; hint attached
 ERROR = 9        # server -> client: protocol violation (conn closes)
 SUBMIT_BATCH = 10  # client -> server: many writes, ONE frame
 OK_BATCH = 11      # server -> client: batch acked (admitted part durable)
+TXN_BEGIN = 12     # client -> server: open a transaction (id allocated)
+TXN_COMMIT = 13    # client -> server: commit a txn's write/expect set
+TXN_ABORT = 14     # client -> server: abandon an open transaction
+TXN_STATUS = 15    # client -> server: decision lookup by txn id
+TXN_STATE = 16     # server -> client: txn outcome / status
 
 KIND_NAMES = {
     HELLO: "hello", WELCOME: "welcome", SUBMIT: "submit", READ: "read",
     OK: "ok", VALUE: "value", REFUSED: "refused",
     NOT_LEADER: "not_leader", ERROR: "error",
     SUBMIT_BATCH: "submit_batch", OK_BATCH: "ok_batch",
+    TXN_BEGIN: "txn_begin", TXN_COMMIT: "txn_commit",
+    TXN_ABORT: "txn_abort", TXN_STATUS: "txn_status",
+    TXN_STATE: "txn_state",
 }
 
 #: high bit on the kind byte: the payload starts with a 17-byte trace
@@ -82,6 +90,10 @@ TRACE_FLAG = 0x80
 #: intersection appended to WELCOME). Absent byte = no capabilities —
 #: byte-identical to the pre-capability frames.
 CAP_TRACE = 0x01
+#: the server fronts a transaction coordinator and speaks the TXN_*
+#: frames (ISSUE 16). Same additive contract as CAP_TRACE: a pre-txn
+#: peer never sees the bit, never the frames.
+CAP_TXN = 0x02
 
 _TRACE_CTX = struct.Struct("!QQB")
 TRACE_CTX_BYTES = _TRACE_CTX.size        # 17
@@ -484,3 +496,123 @@ def decode_error(payload: bytes) -> Tuple[int, str]:
     (req_id,) = struct.unpack_from("!Q", payload)
     message, _ = _ub16(payload, 8)
     return req_id, message.decode()
+
+
+# ------------------------------------------------------------- TXN_*
+#: TXN_STATE status codes (the coordinator's verdict as the wire
+#: speaks it). ``unknown`` answers a TXN_STATUS for a txn the decision
+#: group never decided.
+TXN_STATUSES = {"open": 0, "committed": 1, "aborted": 2, "unknown": 3}
+TXN_STATUS_NAMES = {v: k for k, v in TXN_STATUSES.items()}
+
+
+def encode_txn_begin(req_id: int, **kw) -> bytes:
+    """Open a transaction: the server allocates the txn id (TXN_STATE
+    ``open`` carries it back). Gated on ``CAP_TXN`` — a server that
+    never advertised it treats every TXN frame as an unknown kind."""
+    return encode_frame(TXN_BEGIN, struct.pack("!Q", req_id), **kw)
+
+
+def decode_txn_begin(payload: bytes) -> int:
+    _need(payload, 0, 8)
+    return struct.unpack_from("!Q", payload)[0]
+
+
+def _pack_kv_list(items) -> bytes:
+    """``[(key, value|None)]`` — the shared shape of a txn's write set
+    (None = delete) and expect set (None = expect-absent)."""
+    body = struct.pack("!H", len(items))
+    for key, value in items:
+        body += _pb16(key) + struct.pack(
+            "!B", 0 if value is None else 1
+        )
+        if value is not None:
+            body += _pb32(value)
+    return body
+
+
+def _unpack_kv_list(payload: bytes, off: int):
+    _need(payload, off, 2)
+    (n,) = struct.unpack_from("!H", payload, off)
+    off += 2
+    items = []
+    for _ in range(n):
+        key, off = _ub16(payload, off)
+        _need(payload, off, 1)
+        has = payload[off]
+        off += 1
+        value = None
+        if has:
+            value, off = _ub32(payload, off)
+        items.append((key, value))
+    return items, off
+
+
+def encode_txn_commit(req_id: int, txn_id: int, writes,
+                      expects=(), **kw) -> bytes:
+    """Commit one transaction: ``writes`` = [(key, new_value | None
+    for delete)], ``expects`` = [(key, committed value the coordinator
+    must still certify under the locks | None for expect-absent)]. One
+    TXN_STATE resolves it: ``committed``, or ``aborted`` with the
+    reason (lock lost / expect failed / prewrite refused)."""
+    body = (struct.pack("!QI", req_id, txn_id)
+            + _pack_kv_list(list(writes))
+            + _pack_kv_list(list(expects)))
+    return encode_frame(TXN_COMMIT, body, **kw)
+
+
+def decode_txn_commit(payload: bytes):
+    _need(payload, 0, 12)
+    req_id, txn_id = struct.unpack_from("!QI", payload)
+    writes, off = _unpack_kv_list(payload, 12)
+    expects, _ = _unpack_kv_list(payload, off)
+    return req_id, txn_id, writes, expects
+
+
+def encode_txn_abort(req_id: int, txn_id: int, **kw) -> bytes:
+    """Abandon an open (never-committed) transaction — nothing was
+    prewritten at BEGIN, so the abort is trivially effect-free."""
+    return encode_frame(
+        TXN_ABORT, struct.pack("!QI", req_id, txn_id), **kw
+    )
+
+
+def decode_txn_abort(payload: bytes) -> Tuple[int, int]:
+    _need(payload, 0, 12)
+    return struct.unpack_from("!QI", payload)
+
+
+def encode_txn_status(req_id: int, txn_id: int, **kw) -> bytes:
+    """Decision lookup: how a client whose TXN_COMMIT died mid-flight
+    (WireDisconnected — outcome unknown) resolves the outcome."""
+    return encode_frame(
+        TXN_STATUS, struct.pack("!QI", req_id, txn_id), **kw
+    )
+
+
+def decode_txn_status(payload: bytes) -> Tuple[int, int]:
+    _need(payload, 0, 12)
+    return struct.unpack_from("!QI", payload)
+
+
+def encode_txn_state(req_id: int, txn_id: int, status: str,
+                     reason: str = "", **kw) -> bytes:
+    code = TXN_STATUSES.get(status)
+    if code is None:
+        raise ProtocolError(f"unknown txn status {status!r}")
+    return encode_frame(
+        TXN_STATE,
+        struct.pack("!QIB", req_id, txn_id, code)
+        + _pb16(reason.encode()),
+        **kw,
+    )
+
+
+def decode_txn_state(payload: bytes) -> Tuple[int, int, str, str]:
+    _need(payload, 0, 13)
+    req_id, txn_id, code = struct.unpack_from("!QIB", payload)
+    status = TXN_STATUS_NAMES.get(code)
+    if status is None:
+        raise ProtocolError(f"unknown txn-status code {code}")
+    reason, _ = _ub16(payload, 13)
+    return req_id, txn_id, status, reason.decode()

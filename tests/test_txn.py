@@ -1,0 +1,528 @@
+"""Cross-group transactions (docs/TXN.md): participant-plane ops,
+the 2PC coordinator, the wire frames + capability compat, the
+serializability checker's accept/reject units, the submit_many
+never-double-queued pin, the chaos drill on a pinned seed with both
+broken variants CAUGHT, and the txn-off byte-identity pin.
+
+Wall-budget note (README "Testing strategy"): the in-process stacks
+here are tiny (G=4, 32-byte entries) and event-driven; the three
+drill runs (~6-12 s each) and the two byte-identity torture replays
+dominate the file — everything else is sub-second.
+"""
+
+import asyncio
+
+import pytest
+
+from raft_tpu.chaos.checker import (
+    SERIALIZABLE,
+    UNDETERMINED,
+    VIOLATION,
+    TxnRecord,
+    check_serializable,
+)
+from raft_tpu.config import RaftConfig
+from raft_tpu.examples.kv import apply_op, decode_op
+from raft_tpu.multi.engine import MultiEngine
+from raft_tpu.multi.router import Router
+from raft_tpu.net import IngestServer, RouterBackend, WireClient
+from raft_tpu.net import protocol as P
+from raft_tpu.net.client import WireError
+from raft_tpu.txn import (
+    LockConflict,
+    TxnCoordinator,
+    TxnItem,
+    TxnShardedKV,
+)
+from raft_tpu.txn import ops as T
+from tests._torture_fingerprints import fingerprint, plain_membership_run
+
+
+def _cfg(**kw):
+    base = dict(
+        n_replicas=3, entry_bytes=32, batch_size=4, log_capacity=256,
+        transport="single", seed=0,
+    )
+    base.update(kw)
+    return RaftConfig(**base)
+
+
+def _stack(G=4, ttl_s=None, coord_broken=None, **cfg_kw):
+    eng = MultiEngine(_cfg(**cfg_kw), G)
+    router = Router(eng, drive=False)
+    skv = TxnShardedKV(eng, router)
+    eng.seed_leaders()
+    coord = TxnCoordinator(skv, decision_group=0, ttl_s=ttl_s,
+                           broken=coord_broken)
+    return eng, router, skv, coord
+
+
+def _distinct_group_keys(router, n=2):
+    seen, out, i = set(), [], 0
+    while len(out) < n:
+        k = b"t%d" % i
+        g = router.group_of(k)
+        if g not in seen:
+            seen.add(g)
+            out.append(k)
+        i += 1
+    return out
+
+
+def _settle(eng, coord, *handles, limit=400):
+    hb = eng.cfg.heartbeat_period
+    for _ in range(limit):
+        eng.run_for(2 * hb)
+        coord.poll_all()
+        if all(coord.poll(h) for h in handles):
+            return
+    raise AssertionError(
+        "handles did not settle: "
+        + str([(h.txn_id, h.state, h.status) for h in handles])
+    )
+
+
+def _drain_resolves(eng, coord, limit=400):
+    hb = eng.cfg.heartbeat_period
+    for _ in range(limit):
+        if not coord._resolves:
+            return
+        eng.run_for(2 * hb)
+        coord.poll_all()
+    raise AssertionError("resolver handles did not drain")
+
+
+# --------------------------------------------------------- entry encodings
+class TestOps:
+    def test_lock_roundtrip_write_delete_readonly(self):
+        rec = T.decode_lock(T.encode_lock(32, 7, b"k1", b"v9", 12.5))
+        assert rec == (7, 12.5, T.FLAG_WRITE, b"k1", b"v9")
+        rec = T.decode_lock(
+            T.encode_lock(32, 8, b"k2", None, 3.0, delete=True)
+        )
+        assert rec.flags == T.FLAG_WRITE | T.FLAG_DELETE
+        assert (rec.txn_id, rec.key, rec.value) == (8, b"k2", b"")
+        rec = T.decode_lock(T.encode_lock(32, 9, b"k3", None, 1.0))
+        assert rec.flags == 0 and rec.value == b""
+
+    def test_release_and_decision_roundtrip(self):
+        assert T.decode_release(T.encode_release(32, True, 5)) == (True, 5)
+        assert T.decode_release(T.encode_release(32, False, 6)) == (False, 6)
+        d = T.decode_decision(T.encode_decision(32, 11, True, 0b1010))
+        assert d == (11, True, 0b1010)
+        d = T.decode_decision(T.encode_decision(32, 12, False, 0b1))
+        assert (d.commit, d.group_mask) == (False, 1)
+
+    def test_txn_ops_invisible_to_plain_kv(self):
+        # the op-space contract (examples/kv.py): unknown ops decode as
+        # padding and apply as no-ops, so txn-carrying logs replay
+        # byte-identically through a plain store
+        data = {b"x": b"1"}
+        for payload in (
+            T.encode_lock(32, 3, b"x", b"9", 4.0),
+            T.encode_release(32, True, 3),
+            T.encode_decision(32, 3, True, 1),
+        ):
+            assert decode_op(payload) == (0, b"", None)
+            apply_op(data, payload)
+        assert data == {b"x": b"1"}
+
+    def test_oversized_lock_refused(self):
+        with pytest.raises(ValueError):
+            T.encode_lock(32, 1, b"k" * 20, b"v" * 20, 1.0)
+
+
+# ------------------------------------------------------------- wire frames
+class TestProtocol:
+    def test_txn_frame_roundtrips(self):
+        (_, p), = P.FrameDecoder().feed(P.encode_txn_begin(3))
+        assert P.decode_txn_begin(p) == 3
+        (_, p), = P.FrameDecoder().feed(P.encode_txn_commit(
+            4, 77, [(b"a", b"1"), (b"d", None)], [(b"a", None)]
+        ))
+        req, txn, writes, expects = P.decode_txn_commit(p)
+        assert (req, txn) == (4, 77)
+        assert writes == [(b"a", b"1"), (b"d", None)]
+        assert expects == [(b"a", None)]
+        (_, p), = P.FrameDecoder().feed(P.encode_txn_abort(5, 78))
+        assert P.decode_txn_abort(p) == (5, 78)
+        (_, p), = P.FrameDecoder().feed(P.encode_txn_status(6, 79))
+        assert P.decode_txn_status(p) == (6, 79)
+        (_, p), = P.FrameDecoder().feed(P.encode_txn_state(
+            7, 80, "aborted", "expect_failed"
+        ))
+        assert P.decode_txn_state(p) == (7, 80, "aborted",
+                                         "expect_failed")
+
+    def test_txn_state_rejects_unknown_status(self):
+        with pytest.raises(P.ProtocolError):
+            P.encode_txn_state(1, 2, "maybe")
+
+
+# --------------------------------------------------- store and coordinator
+class TestCoordinator:
+    def test_commit_atomicity_across_groups(self):
+        eng, router, skv, coord = _stack()
+        ka, kb = _distinct_group_keys(router)
+        h = coord.run([TxnItem(ka, b"1"), TxnItem(kb, b"2")])
+        assert h.status == "committed" and h.final is True
+        assert skv.get(ka) == b"1" and skv.get(kb) == b"2"
+        assert skv.lock_stats()["held"] == 0
+        d = skv.decision(h.txn_id)
+        assert d is not None and d[0] is True and len(h.groups) == 2
+
+    def test_abort_applies_nothing(self):
+        eng, router, skv, coord = _stack()
+        ka, kb = _distinct_group_keys(router)
+        # expect-absent holds for ka; the kb expect fails -> the WHOLE
+        # transaction aborts: neither staged intent may leak
+        h = coord.run([TxnItem(ka, b"1", expect=None),
+                       TxnItem(kb, b"2", expect=b"nope")])
+        assert h.status == "aborted" and h.reason == "expect_failed"
+        assert skv.get(ka) is None and skv.get(kb) is None
+        assert skv.lock_stats()["held"] == 0
+        d = skv.decision(h.txn_id)
+        assert d is not None and d[0] is False
+
+    def test_racing_prewrites_first_lock_wins(self):
+        eng, router, skv, coord = _stack()
+        (k,) = _distinct_group_keys(router, 1)
+        # back-to-back begins: neither lock has APPLIED yet, so the
+        # conflict check passes both — log order arbitrates
+        h1 = coord.begin([TxnItem(k, b"first")])
+        h2 = coord.begin([TxnItem(k, b"second")])
+        _settle(eng, coord, h1, h2)
+        assert h1.status == "committed"
+        assert h2.status == "aborted" and h2.reason == "lock_lost"
+        assert skv.get(k) == b"first"
+        assert skv.locks_lost >= 1
+
+    def test_live_lock_refuses_writers_and_txns(self):
+        eng, router, skv, coord = _stack()
+        (k,) = _distinct_group_keys(router, 1)
+        h = coord.begin([TxnItem(k, b"x")])
+        hb = eng.cfg.heartbeat_period
+        for _ in range(200):
+            if skv.lock_of(k)[1] is not None:
+                break
+            eng.run_for(2 * hb)
+        assert skv.lock_of(k)[1] is not None
+        with pytest.raises(LockConflict) as ei:
+            skv.set(k, b"plain")
+        assert ei.value.retry_after_s > 0
+        with pytest.raises(LockConflict):
+            coord.begin([TxnItem(k, b"other")])
+        _settle(eng, coord, h)
+        assert h.status == "committed"
+        # released: both paths admit again
+        skv.set(k, b"plain")
+
+    def test_crash_restore_replays_same_verdict(self):
+        eng, router, skv, coord = _stack()
+        ka, kb = _distinct_group_keys(router)
+        h = coord.run([TxnItem(ka, b"1"), TxnItem(kb, b"2")])
+        assert h.status == "committed"
+        # a NEW coordinator (the restarted process) status-checks the
+        # same txn id: the replicated decision record replays to the
+        # SAME verdict, and the idempotent release changes nothing
+        c2 = TxnCoordinator(skv, decision_group=0, coord_id=7)
+        r = c2.resolve_txn(h.txn_id)
+        _settle(eng, c2, r)
+        assert r.status == "committed" and r.final is True
+        assert skv.get(ka) == b"1" and skv.get(kb) == b"2"
+
+    def test_ttl_expiry_resolves_abandoned_txn(self):
+        eng, router, skv, coord = _stack(ttl_s=None)
+        coord.ttl_s = 10.0 * eng.cfg.heartbeat_period
+        (k,) = _distinct_group_keys(router, 1)
+        h = coord.begin([TxnItem(k, b"ghost")])      # then never polled
+        hb = eng.cfg.heartbeat_period
+        for _ in range(200):
+            if skv.lock_of(k)[1] is not None:
+                break
+            eng.run_for(2 * hb)
+        eng.run_for(12.0 * hb)                        # past the TTL
+        # the expired lock does not wedge: the next begin kicks the
+        # status-check resolver and refuses THIS attempt with a hint
+        with pytest.raises(LockConflict):
+            coord.begin([TxnItem(k, b"new")])
+        assert coord.ttl_resolved == 1
+        _drain_resolves(eng, coord)
+        d = skv.decision(h.txn_id)
+        assert d is not None and d[0] is False        # aborted, recorded
+        h2 = coord.run([TxnItem(k, b"new")])
+        assert h2.status == "committed" and skv.get(k) == b"new"
+
+    def test_observability_counters_slo_and_status(self):
+        from raft_tpu.obs.registry import MetricsRegistry
+        from raft_tpu.obs.serve import StatusBoard
+        from raft_tpu.obs.slo import SLObjective, SloTracker
+
+        eng, router, skv, coord = _stack()
+        eng.metrics = MetricsRegistry()
+        eng.slo = SloTracker(objectives=(
+            SLObjective("txn_commit_fast", "txn_commit",
+                        threshold_s=100.0 * eng.cfg.heartbeat_period),
+        ))
+        eng.status_board = StatusBoard()
+        ka, kb = _distinct_group_keys(router)
+        coord.run([TxnItem(ka, b"1"), TxnItem(kb, b"2")])
+        coord.run([TxnItem(ka, b"9", expect=b"wrong")])
+        m = eng.metrics.get("raft_txn_total")
+        assert m is not None
+        assert m.value(outcome="committed", group="0") == 1
+        assert m.value(outcome="aborted", group="0") == 1
+        locks = eng.metrics.get("raft_txn_locks_total")
+        assert locks is not None
+        assert sum(v for _, v in locks.series()) >= 3
+        # commit latency landed in the SLO digest for the objective
+        assert eng.slo.digests[("txn_commit", 0)].n >= 1
+        board = eng.status_board.compose()
+        assert board["txn"]["committed"] == 1
+        assert board["txn"]["aborted"] == 1
+        assert board["txn"]["held"] == 0
+
+
+# ------------------------------------------- submit_many placement contract
+class TestSubmitManyPin:
+    def test_partial_carries_alignment_no_double_queue(self):
+        # drive=False: a mid-bucket refusal surfaces with .partial
+        # aligned to the input (None = unplaced), nothing re-queued
+        from raft_tpu.admission import Overloaded
+
+        eng = MultiEngine(_cfg(), 2)
+        router = Router(eng, drive=False)
+        eng.seed_leaders()
+        k = b"pin"
+        orig = eng.submit_to_leader
+        n = {"calls": 0}
+
+        def flaky(g, payload):
+            n["calls"] += 1
+            if n["calls"] == 3:
+                raise Overloaded("depth", eng.cfg.heartbeat_period,
+                                 group=g)
+            return orig(g, payload)
+
+        eng.submit_to_leader = flaky
+        items = [(k, (b"p%d" % i).ljust(32, b".")) for i in range(5)]
+        with pytest.raises(Overloaded) as ei:
+            router.submit_many(items)
+        partial = ei.value.partial
+        assert len(partial) == 5
+        assert [p is not None for p in partial] == [
+            True, True, False, False, False
+        ]
+
+    def test_driving_retry_resumes_from_first_unplaced(self):
+        # drive=True: the bucket retries after the refusal and resumes
+        # from its first UNPLACED item — each payload queues EXACTLY
+        # once (the prewrite fan-out's never-double-queued dependency)
+        from raft_tpu.admission import Overloaded
+
+        eng = MultiEngine(_cfg(), 2)
+        router = Router(eng)
+        eng.seed_leaders()
+        k = b"pin"
+        orig = eng.submit_to_leader
+        placed = []
+        n = {"calls": 0}
+
+        def flaky(g, payload):
+            n["calls"] += 1
+            if n["calls"] == 3:
+                raise Overloaded("depth", eng.cfg.heartbeat_period,
+                                 group=g)
+            seq = orig(g, payload)
+            placed.append(bytes(payload))
+            return seq
+
+        eng.submit_to_leader = flaky
+        items = [(k, (b"q%d" % i).ljust(32, b".")) for i in range(5)]
+        out = router.submit_many(items)
+        assert all(p is not None for p in out)
+        seqs = [seq for _, seq in out]
+        assert len(set(seqs)) == 5
+        assert sorted(placed) == sorted(v for _, v in items)
+
+
+# ------------------------------------------------------------ wire + caps
+def _serve(backend, scenario, **server_kw):
+    async def main():
+        srv = IngestServer(backend, **server_kw)
+        port = await srv.start()
+        try:
+            return await scenario(srv, port)
+        finally:
+            await srv.stop()
+    return asyncio.run(main())
+
+
+class TestTxnWire:
+    def test_commit_abort_status_over_wire(self):
+        eng, router, skv, coord = _stack()
+        cfg = eng.cfg
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, txn=True).connect()
+            assert c._conns[0].caps & P.CAP_TXN
+            r = await c.txn_commit([(b"a", b"1"), (b"b", b"2")])
+            assert r.status == "committed" and r.committed
+            r2 = await c.txn_commit([(b"a", b"9")],
+                                    expects=[(b"a", b"0")])
+            assert r2.status == "aborted"
+            assert r2.reason == "expect_failed" and not r2.committed
+            v = await c.read(b"a")
+            assert v.value == b"1"
+            st = await c.txn_status(r.txn_id)
+            assert st.status == "committed"
+            st = await c.txn_status(0xDEAD)
+            assert st.status == "unknown"
+            ab = await c.txn_abort(0xBEEF)
+            assert ab.status == "aborted" and ab.reason == "client_abort"
+            await c.close()
+            return srv.stats()
+
+        stats = _serve(RouterBackend(router, skv), scenario, txn=coord,
+                       drive_quantum_s=cfg.heartbeat_period)
+        assert stats["pending_txns"] == 0
+        assert stats["requests_total"]["txn_commit"] == 2
+
+    def test_server_without_coordinator_never_speaks_cap_txn(self):
+        # additive-capability contract, pairing 1: a txn-opted client
+        # against a plain server — CAP_TXN is not negotiated, txn calls
+        # fail typed CLIENT-side, plain traffic is unaffected
+        eng, router, skv, _coord = _stack()
+        cfg = eng.cfg
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port, txn=True).connect()
+            assert not (c._conns[0].caps & P.CAP_TXN)
+            with pytest.raises(WireError):
+                await c.txn_commit([(b"k", b"v")])
+            with pytest.raises(WireError):
+                await c.txn_status(1)
+            r = await c.submit(b"k", b"v")
+            assert eng.is_durable(r.group, r.seq)
+            await c.close()
+            return srv.stats()
+
+        stats = _serve(RouterBackend(router, skv), scenario,
+                       drive_quantum_s=cfg.heartbeat_period)
+        assert "txn_commit" not in stats["requests_total"]
+
+    def test_unopted_client_against_txn_server(self):
+        # pairing 2: a plain client against a coordinator-bearing
+        # server — the client never requested CAP_TXN, so txn entry
+        # points refuse before any frame is sent
+        eng, router, skv, coord = _stack()
+        cfg = eng.cfg
+
+        async def scenario(srv, port):
+            c = await WireClient("127.0.0.1", port).connect()
+            assert not (c._conns[0].caps & P.CAP_TXN)
+            with pytest.raises(WireError):
+                await c.txn_commit([(b"k", b"v")])
+            r = await c.submit(b"k", b"v")
+            assert eng.is_durable(r.group, r.seq)
+            await c.close()
+            return True
+
+        assert _serve(RouterBackend(router, skv), scenario, txn=coord,
+                      drive_quantum_s=cfg.heartbeat_period)
+
+
+# ------------------------------------------------------- checker obligations
+class TestSerializabilityChecker:
+    def _t(self, i, writes, expects=None, status="ok", pos=None,
+           invoke=0.0, complete=None):
+        return TxnRecord(i, writes, expects or {}, status=status,
+                         pos=pos, invoke_t=invoke, complete_t=complete)
+
+    def test_accepts_consistent_witness(self):
+        r = check_serializable(
+            [self._t(1, {b"a": b"1"}, pos=0),
+             self._t(2, {b"a": b"2"}, {b"a": b"1"}, pos=1),
+             self._t(3, {b"a": b"9"}, status="fail")],
+            final_state={b"a": b"2"},
+        )
+        assert r.verdict == SERIALIZABLE
+
+    def test_rejects_uncertifiable_expect(self):
+        r = check_serializable(
+            [self._t(1, {b"a": b"1"}, pos=0),
+             self._t(2, {b"a": b"2"}, {b"a": b"0"}, pos=1)],
+        )
+        assert r.verdict == VIOLATION and "certified" in r.detail
+
+    def test_rejects_duplicate_position(self):
+        r = check_serializable(
+            [self._t(1, {b"a": b"1"}, pos=3),
+             self._t(2, {b"b": b"2"}, pos=3)],
+        )
+        assert r.verdict == VIOLATION and "not an order" in r.detail
+
+    def test_rejects_real_time_inversion(self):
+        # txn 2 completed before txn 1 was even invoked, yet the
+        # witness orders it later: strictness broken
+        r = check_serializable(
+            [self._t(1, {b"a": b"1"}, pos=0, invoke=10.0, complete=11.0),
+             self._t(2, {b"b": b"2"}, pos=1, invoke=1.0, complete=2.0)],
+        )
+        assert r.verdict == VIOLATION and "before" in r.detail
+
+    def test_rejects_atomicity_break_at_end_state(self):
+        r = check_serializable(
+            [self._t(1, {b"a": b"1", b"b": b"1"}, pos=0)],
+            final_state={b"a": b"1"},           # b never applied
+        )
+        assert r.verdict == VIOLATION and "atomicity" in r.detail
+
+    def test_unknown_outcome_softens_to_undetermined(self):
+        r = check_serializable(
+            [self._t(1, {b"a": b"1"}, pos=0),
+             self._t(2, {b"b": b"9"}, status="info")],
+            final_state={b"a": b"1", b"b": b"9"},
+        )
+        assert r.verdict == UNDETERMINED
+        r = check_serializable(
+            [self._t(1, {b"a": b"1"})],         # committed, no position
+        )
+        assert r.verdict == UNDETERMINED and "witness" in r.detail
+
+
+# ------------------------------------------------------------- chaos drill
+class TestDrill:
+    def test_txn_drill_serializable_seed7(self):
+        from raft_tpu.chaos.runner import txn_run
+
+        rep = txn_run(7)
+        assert rep.verdict == "SERIALIZABLE"
+        assert rep.conserved_ok and not rep.caught
+        assert rep.singles.verdict == "LINEARIZABLE"
+        assert rep.committed >= 1 and rep.aborted >= 0
+        assert rep.moves and len(rep.nemeses) == 3
+        assert rep.unresolved == 0
+
+    @pytest.mark.parametrize("broken", ["txn_partial_commit",
+                                        "txn_dirty_read"])
+    def test_txn_drill_broken_is_caught(self, broken):
+        from raft_tpu.chaos.runner import txn_run
+
+        rep = txn_run(0, broken=broken)
+        assert rep.caught
+        assert rep.verdict == "VIOLATION"
+        assert not rep.conserved_ok
+
+
+# -------------------------------------------------------- byte-identity pin
+@pytest.mark.parametrize("seed", [11, 22])
+def test_txn_plane_keeps_torture_byte_identical(seed):
+    """The txn plane loaded (this module imports all of it) must leave
+    the single-engine membership torture run byte-identical to the
+    session-shared plain baseline — the txn ops extend the op space
+    additively and touch nothing on the plain path."""
+    from raft_tpu.chaos.runner import torture_run
+
+    rep = torture_run(seed, phases=4, membership=True)
+    assert fingerprint(rep) == plain_membership_run(seed)
