@@ -1974,6 +1974,292 @@ def bench_txn() -> dict:
     }
 
 
+# ------------------------------------------------- multi-process cluster
+def bench_cluster() -> dict:
+    """The serving tier measured AS DEPLOYED (docs/CLUSTER.md): real OS
+    processes, one replica each, peer frames over loopback TCP. Three
+    rows, emitted incrementally:
+
+    - ``cluster_goodput`` — N unbatched single-op writes over CONNS
+      pipelined connections against the 3-process cluster, next to the
+      SAME shape against a single-process wire server (the
+      ``macro_wire`` stack, unbatched so the comparison isolates the
+      multi-process hop, not the batching). ``cluster_goodput_eps``
+      gates UP in tools/bench_diff.py; the ratio is REPORTED UNGATED —
+      it prices real peer replication across process boundaries, a
+      deployment property, not a regression axis.
+    - ``cluster_kill9`` — open-loop arrivals paced at 2x the measured
+      cluster capacity with the LEADER killed -9 mid-window: e2e p99
+      through failover (``e2e_p99_ms`` gates DOWN), plus the
+      refused/unknown split the typed client errors give.
+    - ``cluster_handoff`` — the restart economics: respawn the victim
+      on its own dirs (manifest adoption + resumable tail stream,
+      ``segments_resealed == 0``) vs respawn on a WIPED dir (every
+      segment re-sealed from the stream). ``handoff_ratio``
+      (= handoff_s / reseal_s) gates DOWN — adoption must stay cheaper
+      than redoing the durable work.
+
+    Degrades to a ``{"skipped": "cluster_broken"}`` row where child
+    processes cannot run (the fast-fail supervision contract)."""
+    import asyncio
+    import random as _random
+    import shutil
+    import tempfile as _tempfile
+
+    from raft_tpu.cluster import ClusterBroken, ClusterSupervisor
+    from raft_tpu.multi.engine import MultiEngine
+    from raft_tpu.multi.router import Router
+    from raft_tpu.net import (
+        IngestServer,
+        RouterBackend,
+        WireClient,
+        WireRefused,
+    )
+    from raft_tpu.net.client import WireDisconnected, WireError
+
+    NODES, CONNS, N = 3, 6, 900
+    keys = [b"bk%d" % i for i in range(32)]
+    rows: dict = {}
+    _errs = (WireRefused, WireDisconnected, WireError,
+             ConnectionError, OSError)
+
+    # ---- single-process reference: the macro_wire stack, unbatched ----
+    cfgw = RaftConfig(
+        n_replicas=3, entry_bytes=64, batch_size=8,
+        log_capacity=1 << 11, transport="single", seed=23,
+        admission_max_writes=512,
+    )
+    # the raw router backend takes exact entry-size payloads; the
+    # cluster children pack (key, value) into their own 64-byte records
+    payload = bytes(cfgw.entry_bytes)
+
+    async def wire_ref() -> float:
+        eng = MultiEngine(cfgw, 4)
+        eng.seed_leaders()
+        srv = IngestServer(RouterBackend(Router(eng, drive=False)),
+                           drive_quantum_s=cfgw.heartbeat_period)
+        port = await srv.start()
+        cs = [await WireClient("127.0.0.1", port).connect()
+              for _ in range(CONNS)]
+        t0 = time.perf_counter()
+
+        async def w(c, n):
+            ok = 0
+            for j in range(n):
+                try:
+                    await c.submit(keys[j % len(keys)], payload)
+                    ok += 1
+                except _errs:
+                    pass
+            return ok
+
+        acked = sum(await asyncio.gather(
+            *[w(c, N // CONNS) for c in cs]
+        ))
+        wall = time.perf_counter() - t0
+        for c in cs:
+            await c.close()
+        await srv.stop()
+        return acked / max(wall, 1e-9)
+
+    singleproc_eps = asyncio.run(wire_ref())
+
+    # ---- the 3-process cluster --------------------------------------
+    base = _tempfile.mkdtemp(prefix="bench-cluster-")
+    sup = ClusterSupervisor(
+        NODES, base, heartbeat_s=0.05, election_timeout_s=0.4,
+        snap_threshold=24, segment_entries=16, hot_entries=32,
+    )
+    try:
+        try:
+            sup.start_all()
+        except ClusterBroken as ex:
+            return {"skipped": "cluster_broken", "error": str(ex)}
+        deadline = time.monotonic() + 15.0
+        while sup.leader() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        addr_map = sup.addr_map()
+
+        async def connect(i: int) -> WireClient:
+            host, _, port = sup.addr(i).rpartition(":")
+            return await WireClient(
+                host, int(port), retries=40, max_backoff_s=0.25,
+                addr_map=addr_map,
+            ).connect()
+
+        def commit_of(i: int) -> int:
+            st = sup.status(i)
+            return int(st["commit"]) if st else 0
+
+        def wait_commit(i: int, target: int, budget_s: float) -> bool:
+            end = time.monotonic() + budget_s
+            while time.monotonic() < end:
+                if sup.alive(i) and commit_of(i) >= target:
+                    return True
+                time.sleep(0.05)
+            return False
+
+        # ---- row 1: goodput ------------------------------------------
+        async def goodput_row() -> dict:
+            cs = [await connect(i % NODES) for i in range(CONNS)]
+            t0 = time.perf_counter()
+
+            async def w(ci, c, n):
+                ok = 0
+                for j in range(n):
+                    try:
+                        await c.submit(keys[j % len(keys)],
+                                       b"c%d-%d" % (ci, j))
+                        ok += 1
+                    except _errs:
+                        pass
+                return ok
+
+            acked = sum(await asyncio.gather(
+                *[w(ci, c, N // CONNS) for ci, c in enumerate(cs)]
+            ))
+            wall = time.perf_counter() - t0
+            for c in cs:
+                await c.close()
+            eps = acked / max(wall, 1e-9)
+            return {
+                "processes": NODES,
+                "connections": CONNS,
+                "entries": acked,
+                "wall_s": round(wall, 3),
+                "cluster_goodput_eps": round(eps, 1),
+                "singleproc_goodput_eps": round(singleproc_eps, 1),
+                "cluster_vs_singleproc": round(
+                    eps / max(singleproc_eps, 1e-9), 3
+                ),
+            }
+
+        rows["goodput"] = _emit_leg("cluster_goodput",
+                                    asyncio.run(goodput_row()))
+        eps = max(rows["goodput"]["cluster_goodput_eps"], 1.0)
+
+        # ---- row 2: kill -9 at 2x ------------------------------------
+        rate = 2.0 * eps
+        OPS_KILL = max((int(rate * 3.0) // CONNS) * CONNS, 300)
+        #   ~3 s of arrivals at exactly 2x measured capacity: the window
+        #   must SPAN the kill + re-election, at the claimed rate
+        victim = sup.leader()
+        if victim is None:
+            victim = 0
+
+        async def kill_row() -> dict:
+            cs = [await connect(i % NODES) for i in range(CONNS)]
+            lats: list = []
+            refused = [0]
+            unknown = [0]
+            per_conn = OPS_KILL // CONNS
+            gap = CONNS / rate
+            killed_at = per_conn // 3
+
+            async def w(ci, c):
+                for j in range(per_conn):
+                    if ci == 0 and j == killed_at:
+                        sup.kill9(victim)
+                    b0 = time.perf_counter()
+                    try:
+                        await c.submit(keys[j % len(keys)],
+                                       b"k%d-%d" % (ci, j))
+                    except WireRefused:
+                        refused[0] += 1
+                    except _errs:
+                        unknown[0] += 1
+                    else:
+                        lats.append(
+                            (time.perf_counter() - b0) * 1e3
+                        )
+                    left = gap - (time.perf_counter() - b0)
+                    if left > 0:
+                        await asyncio.sleep(left)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *[w(ci, c) for ci, c in enumerate(cs)]
+            )
+            wall = time.perf_counter() - t0
+            for c in cs:
+                await c.close()
+            p50, p99 = _percentiles(lats)
+            return {
+                "offered": OPS_KILL,
+                "rate_x_capacity": round(rate / eps, 2),
+                "killed_node": victim,
+                "acked": len(lats),
+                "refused": refused[0],
+                "outcome_unknown": unknown[0],
+                "e2e_p50_ms": round(p50, 2),
+                "e2e_p99_ms": round(p99, 2),
+                "wall_s": round(wall, 3),
+            }
+
+        rows["kill9"] = _emit_leg("cluster_kill9",
+                                  asyncio.run(kill_row()))
+
+        # ---- row 3: restart handoff vs re-seal -----------------------
+        def survivors_commit() -> int:
+            return max(
+                (commit_of(i) for i in range(NODES)
+                 if i != victim and sup.alive(i)),
+                default=0,
+            )
+
+        def timed_restart(budget_s: float = 30.0) -> dict:
+            """Respawn the victim and split the clock: ``boot_s``
+            (process start to ready — interpreter + import + bind,
+            identical either way) and ``catchup_s`` (ready to commit
+            caught up with the survivors — where adoption vs re-seal
+            actually differ)."""
+            target = survivors_commit()
+            t0 = time.monotonic()
+            sup.restart(victim, wait_ready=True)
+            t_ready = time.monotonic()
+            caught = wait_commit(victim, target, budget_s)
+            t_caught = time.monotonic()
+            st = sup.status(victim) or {}
+            tier = st.get("tier", {})
+            return {
+                "boot_s": round(t_ready - t0, 3),
+                "catchup_s": round(t_caught - t_ready, 3),
+                "total_s": round(t_caught - t0, 3),
+                "caught_up": caught,
+                "generation": int(st.get("generation", 0)),
+                "segments_adopted": int(
+                    tier.get("segments_adopted", 0)
+                ),
+                "segments_resealed": int(
+                    tier.get("segments_resealed", 0)
+                ),
+            }
+
+        handoff = timed_restart()
+        sup.kill9(victim)
+        shutil.rmtree(sup.node_dir(victim), ignore_errors=True)
+        reseal = timed_restart()
+        rows["handoff"] = _emit_leg("cluster_handoff", {
+            "handoff_s": handoff["catchup_s"],
+            "reseal_s": reseal["catchup_s"],
+            "handoff_ratio": round(
+                handoff["catchup_s"] / max(reseal["catchup_s"], 1e-9),
+                3,
+            ),
+            "handoff_boot_s": handoff["boot_s"],
+            "reseal_boot_s": reseal["boot_s"],
+            "handoff_caught_up": handoff["caught_up"],
+            "reseal_caught_up": reseal["caught_up"],
+            "segments_adopted": handoff["segments_adopted"],
+            "segments_resealed": handoff["segments_resealed"],
+            "wiped_segments_adopted": reseal["segments_adopted"],
+        })
+    finally:
+        sup.stop_all()
+        shutil.rmtree(base, ignore_errors=True)
+    return rows
+
+
 # ------------------------------------------------- mesh per-device kernel
 def bench_mesh1(rng) -> dict:
     """Per-device fused-kernel overhead (VERDICT r4 #1 'Done' row): the
@@ -2927,6 +3213,7 @@ def main(argv=None) -> None:
         ("reconfig", bench_reconfig),
         ("macro", bench_macro),
         ("txn", bench_txn),
+        ("cluster", bench_cluster),
     ):
         configs[name] = dl.run(name, leg)
     if dl.expired:

@@ -13,6 +13,7 @@ import json
 import sys
 
 from raft_tpu.chaos.runner import (
+    cluster_run,
     migration_run,
     overload_run,
     reads_run,
@@ -102,6 +103,21 @@ def main(argv=None) -> int:
                          "admission gate's typed refusals surfaced as "
                          "wire backpressure (shed >= 1), and clients "
                          "rode NOT_LEADER frames through the election")
+    ap.add_argument("--cluster", action="store_true",
+                    help="run the multi-process cluster drill "
+                         "(docs/CLUSTER.md): 3 REAL OS processes, one "
+                         "replica each, speaking peer frames over "
+                         "loopback TCP, with kill -9 composed with a "
+                         "userspace partition, an open-loop burst, "
+                         "SIGSTOP/SIGCONT, and restart-with-handoff; "
+                         "succeeds only if every read class holds its "
+                         "contract AND the killed-and-restarted "
+                         "process adopted its prior generation's "
+                         "sealed segments (segments_resealed == 0) "
+                         "and rejoined via the resumable snapshot "
+                         "stream")
+    ap.add_argument("--cluster-nodes", type=int, default=3,
+                    help="--cluster process count (>= 3)")
     ap.add_argument("--txn", action="store_true",
                     help="run the cross-group transaction drill "
                          "(docs/TXN.md): a replicated 2PC coordinator "
@@ -115,6 +131,20 @@ def main(argv=None) -> int:
                          "with --broken txn_partial_commit or "
                          "txn_dirty_read, succeeds only if the "
                          "serializability checker CAUGHT the bug")
+    ap.add_argument("--txn-extra", action="store_true",
+                    help="compose the round-16 remainder nemeses into "
+                         "the --txn drill (phase 4b): a mem_replace "
+                         "window (participant follower out, "
+                         "replacement catches up on the same row), an "
+                         "induced-slow-follower wire fault, and an "
+                         "open-loop overload burst through the "
+                         "admission gate")
+    ap.add_argument("--txn-lease-reads", action="store_true",
+                    help="arm the read-plane lease path for the --txn "
+                         "drill's basis reads: every transfer's "
+                         "expects anchor to a leader-certified read "
+                         "index (zero quorum rounds while the "
+                         "participant leader holds a valid lease)")
     ap.add_argument("--read-plane", action="store_true",
                     help="arm the read scale-out plane on a torture "
                          "run: leader leases (prevote implied) plus "
@@ -232,6 +262,9 @@ def main(argv=None) -> int:
         ap.error("--txn is a standalone sharded-multi drill (--broken "
                  "txn_partial_commit / txn_dirty_read are its only "
                  "compositions)")
+    if (args.txn_extra or args.txn_lease_reads) and not args.txn:
+        ap.error("--txn-extra / --txn-lease-reads apply to the --txn "
+                 "drill")
     if args.reads and (args.multi or args.overload or args.reconfig
                        or args.migration or args.segments
                        or args.membership
@@ -245,8 +278,65 @@ def main(argv=None) -> int:
                       or args.overload_recovery is not None):
         ap.error("--wire is a standalone drill (its leader-kill and "
                  "overload nemeses are built in)")
+    if args.cluster and (args.multi or args.broken or args.overload
+                         or args.reconfig or args.migration
+                         or args.segments or args.membership
+                         or args.reads or args.wire or args.txn
+                         or args.overload_recovery is not None):
+        ap.error("--cluster is a standalone multi-process drill (its "
+                 "kill -9 / partition / pause / overload / restart "
+                 "nemeses are built in)")
 
     ok = True
+    if args.cluster:
+        from raft_tpu.cluster import ClusterBroken
+
+        for seed in range(args.seed, args.seed + args.sweep):
+            try:
+                rep = cluster_run(
+                    seed, nodes=args.cluster_nodes,
+                    clients=args.clients, keys=args.keys,
+                    step_budget=args.step_budget,
+                    blackbox_dir=args.blackbox_dir,
+                )
+            except ClusterBroken as ex:
+                # fast-fail: the environment cannot spawn children at
+                # all — say so in the result line and stop burning time
+                print(json.dumps({
+                    "seed": seed, "verdict": "BROKEN_ENV",
+                    "error": str(ex).splitlines()[0],
+                }), flush=True)
+                return 1
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "per_class": {c: r.verdict
+                              for c, r in rep.per_class.items()},
+                "ops": rep.ops,
+                "op_counts": rep.op_counts,
+                "nodes": rep.nodes,
+                "kills": rep.kills,
+                "restarts": rep.restarts,
+                "partitions": rep.partitions,
+                "pauses": rep.pauses,
+                "flood_ops": rep.flood_ops,
+                "generation": rep.generation,
+                "segments_adopted": rep.segments_adopted,
+                "segments_resealed": rep.segments_resealed,
+                "snap_chunks_in": rep.snap_chunks_in,
+                "rejoined": rep.rejoined,
+                "incarnations": rep.incarnations,
+                "failovers": rep.failovers,
+                "base_dir": rep.base_dir,
+            }), flush=True)
+            ok = ok and (
+                rep.verdict == "LINEARIZABLE"
+                and rep.handoff_ok
+                and rep.kills >= 1
+                and rep.snap_chunks_in >= 1
+            )
+        return 0 if ok else 1
     if args.txn:
         for seed in range(args.seed, args.seed + args.sweep):
             rep = txn_run(
@@ -254,6 +344,8 @@ def main(argv=None) -> int:
                 step_budget=args.step_budget,
                 bundle_dir=args.bundle_dir,
                 blackbox_dir=args.blackbox_dir,
+                extra_nemeses=args.txn_extra,
+                lease_reads=args.txn_lease_reads,
             )
             print(rep.summary())
             print(json.dumps({
@@ -272,6 +364,7 @@ def main(argv=None) -> int:
                 "broken": rep.broken,
                 "commit_digest": rep.commit_digest,
                 "bundle": rep.bundle_path,
+                "read_certs": rep.read_certs,
             }), flush=True)
             if args.broken:
                 # the flag's contract: a CAUGHT violation IS success

@@ -2948,6 +2948,7 @@ class TxnReport:
     repro: str
     commit_digest: str = ""
     bundle_path: Optional[str] = None
+    read_certs: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def verdict(self) -> str:
@@ -2983,6 +2984,8 @@ def txn_run(
     step_budget: int = 500_000,
     bundle_dir: Optional[str] = None,
     blackbox_dir: Optional[str] = None,
+    extra_nemeses: bool = False,
+    lease_reads: bool = False,
 ) -> TxnReport:
     """The deterministic transaction drill (``--txn``). Scripted
     phases, every choice seeded:
@@ -3014,14 +3017,33 @@ def txn_run(
     (``k*`` vs ``a*``): lock-oblivious plain writes landing inside a
     lock window would genuinely break strict serializability, which is
     a documented property of the mixed deployment (docs/TXN.md), not a
-    bug this drill should trip over."""
+    bug this drill should trip over.
+
+    ``extra_nemeses=True`` composes the round-16 remainder nemeses
+    into the same run (phase 4b): ``mem_replace`` (MultiEngine runs
+    fixed membership, so the replace window is the honest
+    approximation — a participant follower fails for a window and a
+    "replacement" rejoins on the same row via catch-up), a
+    ``wire_slow`` induced-slow-follower window (the wire fault the
+    mesh transport can express: traffic received, nothing appended),
+    and an open-loop ``overload`` burst through the admission gate on
+    the single-key plane — each landing mid-transaction or against
+    live lock traffic, named in ``nemeses``.
+
+    ``lease_reads=True`` arms the read-plane lease path
+    (``cfg.read_lease`` + prevote) and routes every transfer's basis
+    read through :meth:`TxnCoordinator.validated_read`: the expects a
+    transaction validates under its locks anchor to a leader-certified
+    read index — zero quorum rounds when the participant leader holds
+    a valid lease — instead of the bare applied map. ``read_certs``
+    on the report counts the certification classes ridden."""
     from raft_tpu.chaos.checker import (
         SERIALIZABLE,
         TxnRecord,
         check_serializable,
     )
     from raft_tpu.chaos.history import FAIL, INFO, OK
-    from raft_tpu.multi.engine import MultiEngine, NotLeader
+    from raft_tpu.multi.engine import MultiEngine, NotLeader, ReadLagging
     from raft_tpu.multi.router import Router
     from raft_tpu.txn import TxnCoordinator, TxnItem, TxnShardedKV
     from raft_tpu.txn import ops as _T
@@ -3032,6 +3054,13 @@ def txn_run(
         base = cfg or RaftConfig(
             n_replicas=3, entry_bytes=32, batch_size=4,
             log_capacity=256, transport="mesh_groups", seed=seed,
+            # the lease path rests on §9.6 leader stickiness — read_lease
+            # refuses to arm without prevote (config.py validation)
+            prevote=lease_reads, read_lease=lease_reads,
+            # the overload window needs a gate that can actually shed:
+            # a write-depth bound far above steady drill traffic but
+            # inside the burst's open-loop spill
+            admission_max_writes=(12 if extra_nemeses else None),
         )
         eng = MultiEngine(base, n_groups)
         if eng.n_shards < 2:
@@ -3049,6 +3078,7 @@ def txn_run(
         coord = TxnCoordinator(
             skv, decision_group=0, ttl_s=40.0 * hb,
             broken=(broken if broken == "txn_partial_commit" else None),
+            lease_reads=lease_reads,
         )
         rng = random.Random(f"txn-drill:{seed}")
         acct = [b"a%d" % i for i in range(accounts)]
@@ -3175,6 +3205,16 @@ def txn_run(
                 drive(4 * hb)
 
         def bal(key: bytes) -> Optional[bytes]:
+            """A transfer's basis read. With ``lease_reads`` armed it
+            goes through the coordinator's validated path (certified
+            read index, zero rounds under a valid lease), riding out
+            elections and apply lag like any router read; otherwise
+            the plain applied read the drill always used."""
+            for _ in range(80):
+                try:
+                    return coord.validated_read(key)
+                except (NotLeader, ReadLagging):
+                    drive(4 * hb)
             return skv.get(key)
 
         def transfer(src: bytes, dst: bytes, mid=None):
@@ -3327,6 +3367,102 @@ def txn_run(
                 j = rng.randrange(accounts)
             transfer(acct[i], acct[j])
 
+        # ---- phase 4b: round-16 remainder nemeses (opt-in) -----------
+        if extra_nemeses:
+            blackbox.mark("txn_phase", name="nemesis_extra")
+
+            def _pick_pair():
+                a = rng.randrange(accounts)
+                b = rng.randrange(accounts)
+                while b == a:
+                    b = rng.randrange(accounts)
+                return a, b
+
+            # mem_replace: MultiEngine runs FIXED membership, so the
+            # replace window is approximated the only honest way the
+            # layer allows — a participant FOLLOWER fails mid-txn (the
+            # removed voter) and the "replacement" rejoins on the same
+            # row via log catch-up. Quorum survives (2/3 up), so the
+            # transaction must ride it out, not abort.
+            replaced: List[tuple] = []
+
+            def replace_mid(h) -> None:
+                g = h.groups[0]
+                lead = eng.leader_id[g]
+                r = next(
+                    (x for x in range(base.n_replicas)
+                     if x != lead and eng.alive[g, x]),
+                    None,
+                )
+                if r is None:
+                    return
+                eng.fail(g, r)
+                replaced.append((g, r))
+                nemeses.append(
+                    f"mem_replace g{g} r{r} (fixed-membership window)"
+                )
+                blackbox.mark("txn_nemesis", kind="mem_replace",
+                              group=g, replica=r)
+
+            i, j = _pick_pair()
+            transfer(acct[i], acct[j], mid=replace_mid)
+            drive(6 * hb)
+            for g, r in replaced:
+                eng.recover(g, r)
+            transfer(acct[j], acct[i])
+
+            # wire fault: the induced-slow follower — the wire-level
+            # fault the mesh transport expresses (traffic received,
+            # nothing appended, matchIndex goes stale) — for a window
+            # spanning a transaction's prewrite/validate.
+            slowed: List[tuple] = []
+
+            def wire_mid(h) -> None:
+                g = h.groups[-1]
+                lead = eng.leader_id[g]
+                r = next(
+                    (x for x in range(base.n_replicas) if x != lead),
+                    0,
+                )
+                eng.set_slow(g, r, True)
+                slowed.append((g, r))
+                nemeses.append(f"wire_slow g{g} r{r}")
+                blackbox.mark("txn_nemesis", kind="wire_slow",
+                              group=g, replica=r)
+
+            i, j = _pick_pair()
+            transfer(acct[i], acct[j], mid=wire_mid)
+            drive(8 * hb)
+            for g, r in slowed:
+                eng.set_slow(g, r, False)
+            transfer(acct[j], acct[i])
+
+            # overload window: an open-loop burst on the single-key
+            # plane — submits queue faster than the drill drives, the
+            # admission gate refuses the spill (typed), lock traffic
+            # keeps flowing underneath.
+            burst, refused = 64, 0
+            for _ in range(burst):
+                key = rng.choice(skeys)
+                single_count[0] += 1
+                value = b"o%d" % single_count[0]
+                rec = history.invoke(7000 + single_count[0], WRITE,
+                                     key, value, now())
+                try:
+                    handle = skv.set(key, value)
+                except (NotLeader, Overloaded, _T.LockConflict):
+                    refused += 1
+                    rec.fail(history.stamp(now()))
+                else:
+                    _single_pending.append((rec, handle))
+            nemeses.append(f"overload burst {burst} "
+                           f"({refused} refused)")
+            blackbox.mark("txn_nemesis", kind="overload",
+                          submitted=burst, refused=refused)
+            drive(12 * hb)
+            i, j = _pick_pair()
+            transfer(acct[i], acct[j])
+
         # ---- phase 5: quiesce + grade --------------------------------
         blackbox.mark("txn_phase", name="quiesce")
         for g in range(eng.G):
@@ -3368,6 +3504,8 @@ def txn_run(
     repro = (
         f"python -m raft_tpu.chaos --txn --seed {seed}"
         + (f" --broken {broken}" if broken else "")
+        + (" --txn-extra" if extra_nemeses else "")
+        + (" --txn-lease-reads" if lease_reads else "")
     )
     shim = type("_Shim", (), {
         "seed": seed, "cfg": base, "history": history, "obs": None,
@@ -3389,4 +3527,363 @@ def txn_run(
         broken=broken, repro=repro,
         commit_digest=multi_commit_digest(eng),
         bundle_path=bundle_path,
+        read_certs=dict(coord.read_certs) if lease_reads else {},
+    )
+
+
+# ------------------------------------------------- the cluster drill
+@dataclasses.dataclass
+class ClusterReport:
+    """Result of :func:`cluster_run` — the multi-process acceptance
+    drill (docs/CLUSTER.md): N REAL OS processes, each one replica
+    (``cluster.child``) on its own port, tortured with the faults the
+    in-process harness could only simulate — ``kill -9`` (the RAM tail
+    is GONE), SIGSTOP/SIGCONT, userspace partition, an open-loop write
+    burst, and restart-with-handoff on the same dirs. Every client op
+    is recorded in one ``History`` stamped by the DRIVER's monotonic
+    clock (one process, one clock — the real-time-order soundness
+    argument), so the per-class verdicts are the same currency every
+    other tier earns: LINEARIZABLE or it does not ship.
+
+    The restart evidence is the tentpole claim: the resurrected child
+    must ADOPT its prior generation's sealed segments by manifest
+    (``segments_adopted >= 1`` with ``segments_resealed == 0`` — the
+    durable work is never redone) and catch the cluster's commit via
+    the resumable snapshot stream (``snap_chunks_in >= 1``, resumed
+    from its sealed high-water mark)."""
+
+    seed: int
+    per_class: Dict[str, "CheckResult"]
+    ops: int
+    op_counts: Dict[str, int]
+    read_classes: Dict[str, int]
+    nodes: int
+    kills: int
+    restarts: int
+    partitions: int
+    pauses: int
+    flood_ops: int
+    generation: int          # restarted node's post-restart generation
+    segments_adopted: int    # sealed segments adopted via manifest
+    segments_resealed: int   # MUST stay 0: durable work never redone
+    snap_chunks_in: int      # resumable-stream chunks the rejoin rode
+    rejoined: bool           # restarted commit caught the cluster's
+    incarnations: int        # child_start marks in the victim journal
+    failovers: int           # client dead-dial failovers ridden
+    statuses: Dict[int, Optional[dict]]
+    base_dir: str            # where the forensics artifacts live
+    repro: str
+
+    @property
+    def verdict(self) -> str:
+        verdicts = [c.verdict for c in self.per_class.values()]
+        if VIOLATION in verdicts:
+            return VIOLATION
+        if any(v != LINEARIZABLE for v in verdicts):
+            return "UNDETERMINED"
+        return LINEARIZABLE
+
+    @property
+    def handoff_ok(self) -> bool:
+        """The durable-restart contract, in one bool."""
+        return (self.generation >= 2 and self.segments_adopted >= 1
+                and self.segments_resealed == 0 and self.rejoined)
+
+    def summary(self) -> str:
+        cls = {c: r.verdict for c, r in self.per_class.items()}
+        return (
+            f"seed={self.seed} classes={cls} ops={self.ops} "
+            f"procs={self.nodes} kills={self.kills} "
+            f"restarts={self.restarts} partitions={self.partitions} "
+            f"pauses={self.pauses} gen={self.generation} "
+            f"adopted={self.segments_adopted} "
+            f"resealed={self.segments_resealed} "
+            f"snap_in={self.snap_chunks_in} rejoined={self.rejoined} "
+            f"failovers={self.failovers}"
+        )
+
+
+def cluster_run(
+    seed: int,
+    nodes: int = 3,
+    clients: int = 3,
+    keys: int = 4,
+    ops_per_phase: int = 10,
+    preload: int = 96,
+    step_budget: int = 500_000,
+    base_dir: Optional[str] = None,
+    blackbox_dir: Optional[str] = None,
+) -> ClusterReport:
+    """The multi-process cluster drill (``--cluster``): spawn ``nodes``
+    real replica processes under a :class:`ClusterSupervisor`, drive
+    recorded client traffic through the wire tier, and compose the
+    process nemeses in sequence:
+
+    1. PRELOAD — enough committed writes that the hot tier spills and
+       seals segments (the durable handoff needs something to hand off);
+    2. steady traffic;
+    3. PARTITION a follower off (userspace deny-lists), keep writing on
+       the majority side, then ``kill -9`` the isolated follower — the
+       composed fault: a process that was partitioned AND died;
+    4. OVERLOAD — an open-loop burst of one-shot writers while the
+       victim is down (also widens the log gap past ``snap_threshold``,
+       so the rejoin MUST ride the resumable snapshot stream);
+    5. RESTART the victim on the same dirs + port: it adopts the prior
+       generation's sealed segments by manifest and streams the tail;
+       the drill polls its self-published status until its commit
+       catches the survivors' (the rejoin witness);
+    6. SIGSTOP a follower through live traffic, SIGCONT it (the
+       paused-not-dead partial failure), then a final read round.
+
+    Ops record ``ok``/``fail``/``info`` under the wire client's typed
+    exceptions (a mid-flight disconnect is ``info`` — the op may have
+    committed; a typed refusal is ``fail`` — provably no effect) and
+    the history is graded per read class. Raises
+    :class:`raft_tpu.cluster.ClusterBroken` (fast-fail) when the
+    environment cannot spawn children at all — callers translate that
+    to a skip, not minutes of timeout burn."""
+    import asyncio
+    import time as _time
+
+    from raft_tpu.cluster import ClusterBroken, ClusterSupervisor
+    from raft_tpu.net import WireClient, WireDisconnected, WireRefused
+    from raft_tpu.net.client import WireError
+
+    base = base_dir or tempfile.mkdtemp(prefix=f"cluster-seed{seed}-")
+    bdir = blackbox_dir or os.path.join(base, "blackbox")
+    sup = ClusterSupervisor(
+        nodes, base,
+        heartbeat_s=0.05, election_timeout_s=0.4,
+        snap_threshold=24, segment_entries=16, hot_entries=32,
+        env={"RAFT_TPU_BLACKBOX_DIR": bdir},
+    )
+    history = History()
+    key_pool = [f"ck{i}".encode() for i in range(keys)]
+    now = _time.monotonic
+    counters = [0] * (clients + 1)
+    kills = restarts = partitions = pauses = 0
+    flood_ops = 0
+    evidence: Dict[int, Optional[dict]] = {}
+    rejoined = False
+    victim = -1
+    failovers = 0
+
+    _WRITE_AMBIGUOUS = (WireDisconnected, WireError, ConnectionError,
+                        OSError)
+    _READ_DEAD = (WireRefused, WireError, WireDisconnected,
+                  ConnectionError, OSError)
+
+    async def write_one(wc, cid: int, key: bytes, value: bytes) -> None:
+        rec = history.invoke(cid, WRITE, key, value, now())
+        try:
+            await wc.submit(key, value)
+        except WireRefused:
+            rec.fail(history.stamp(now()))   # typed: provably no effect
+        except _WRITE_AMBIGUOUS:
+            rec.info()                        # outcome unknown
+        else:
+            rec.ok(history.stamp(now()))
+
+    async def client_ops(wc, cid: int, n: int, rng) -> None:
+        """One serial client: the §6.3 discipline over real processes."""
+        for _ in range(n):
+            key = key_pool[rng.randrange(len(key_pool))]
+            p = rng.random()
+            if p < 0.55:
+                counters[cid] += 1
+                await write_one(wc, cid, key,
+                                f"c{cid}v{counters[cid]}".encode())
+            else:
+                cls = "session" if p > 0.85 else "linearizable"
+                rec = history.invoke(cid, READ, key, None, now())
+                if cls == "session":
+                    rec.ryw_floor = wc.session.floor.get(0, 0)
+                try:
+                    out = await wc.read(key, cls=cls)
+                except _READ_DEAD:
+                    # an unserved read has no effect, whatever killed it
+                    rec.fail(history.stamp(now()))
+                else:
+                    rec.read_class = out.cls
+                    rec.serve_index = out.index
+                    rec.ok(history.stamp(now()), out.value)
+
+    async def preload_writes(wc, cid: int, n: int) -> None:
+        for _ in range(n):
+            counters[cid] += 1
+            i = counters[cid]
+            await write_one(wc, cid, key_pool[i % len(key_pool)],
+                            f"c{cid}v{i}".encode())
+
+    async def flood(n: int) -> int:
+        """Open-loop one-shot writers against whichever node answers:
+        no retries, unique client ids — the overload nemesis at the
+        process tier (and the gap-widener for the snap rejoin)."""
+        lead = sup.leader()
+        host, _, port = sup.addr(lead if lead is not None
+                                 else 0).rpartition(":")
+        wc = await WireClient(
+            host or "127.0.0.1", int(port), pool=1, retries=1,
+            rng=random.Random(f"cluster-flood:{seed}"),
+            addr_map=sup.addr_map(),
+        ).connect()
+        async def one(j: int) -> None:
+            key = key_pool[j % len(key_pool)]
+            await write_one(wc, 1000 + j, key, f"flood{j}".encode())
+        await asyncio.gather(*[one(j) for j in range(n)])
+        await wc.close()
+        return n
+
+    def _commit_of(i: int) -> int:
+        st = sup.status(i)
+        return int(st["commit"]) if st else 0
+
+    async def main() -> None:
+        nonlocal kills, restarts, partitions, pauses, flood_ops
+        nonlocal evidence, rejoined, victim, failovers
+        wcs = []
+        for cid in range(1, clients + 1):
+            host, _, port = sup.addr((cid - 1) % nodes).rpartition(":")
+            wcs.append(await WireClient(
+                host or "127.0.0.1", int(port), pool=1, retries=40,
+                max_backoff_s=0.25,
+                rng=random.Random(f"cluster:{seed}:conn{cid}"),
+                addr_map=sup.addr_map(),
+            ).connect())
+        rngs = [random.Random(f"cluster:{seed}:{cid}")
+                for cid in range(1, clients + 1)]
+
+        # ---- phase 0: preload — seal segments to hand off later -----
+        per = max(1, preload // clients)
+        blackbox.mark("cluster_preload", writes=per * clients)
+        await asyncio.gather(*[
+            preload_writes(wc, cid + 1, per)
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- phase 1: steady traffic --------------------------------
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        blackbox.mark("cluster_steady_done", ops=len(history))
+        # ---- phase 2: partition a follower, then kill -9 it ---------
+        lead = sup.leader()
+        victim = next(i for i in range(nodes)
+                      if i != (lead if lead is not None else 0))
+        majority = [i for i in range(nodes) if i != victim]
+        sup.partition([majority, [victim]])
+        partitions += 1
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        sup.kill9(victim)
+        kills += 1
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        sup.heal()
+        # ---- phase 3: open-loop burst while the victim is down ------
+        flood_ops = await flood(32)
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- phase 4: restart-with-handoff --------------------------
+        target = max(_commit_of(i) for i in majority)
+        sup.restart(victim)
+        restarts += 1
+        deadline = now() + 15.0
+        while now() < deadline:
+            st = sup.status(victim)
+            if (st and st.get("generation", 1) >= 2
+                    and int(st.get("commit", 0)) >= target):
+                rejoined = True
+                break
+            await asyncio.sleep(0.1)
+        blackbox.mark("cluster_rejoin", node=victim, rejoined=rejoined,
+                      target=target)
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- phase 5: SIGSTOP a follower through live traffic -------
+        lead = sup.leader()
+        candidates = [i for i in range(nodes)
+                      if i != (lead if lead is not None else 0)
+                      and sup.alive(i)]
+        # prefer a follower that is NOT the freshly restarted victim:
+        # pausing mid-catch-up is a different drill than paused-not-dead
+        paused = next((i for i in candidates if i != victim),
+                      candidates[0])
+
+        async def pause_cycle() -> None:
+            sup.pause(paused)
+            await asyncio.sleep(0.8)
+            sup.resume(paused)
+
+        pauses += 1
+        await asyncio.gather(pause_cycle(), *[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- quiesce + evidence -------------------------------------
+        for wc in wcs:
+            failovers += wc.stats.get("failovers", 0)
+            await wc.close()
+        await asyncio.sleep(0.7)   # one status-publish period
+        evidence = {i: sup.status(i) for i in range(nodes)}
+
+    with blackbox.journal_for(f"cluster_seed{seed}", bdir):
+        blackbox.mark("cluster_run", seed=seed, nodes=nodes)
+        try:
+            sup.start_all()
+            asyncio.run(main())
+        finally:
+            sup.stop_all()
+        history.close()
+        blackbox.mark("check_history", ops=len(history))
+        per_class = check_read_classes(history, step_budget=step_budget)
+        blackbox.mark("check_done", verdicts={
+            c: r.verdict for c, r in per_class.items()
+        })
+
+    vstat = evidence.get(victim) or {}
+    tier = vstat.get("tier", {})
+    incarnations = 0
+    try:
+        marks = blackbox.read_journal(os.path.join(
+            bdir, f"journal_cluster-n{victim}.jsonl"))
+        incarnations = sum(1 for m in marks
+                           if m.get("phase") == "child_start")
+    except Exception:
+        pass
+    counts: Dict[str, int] = {}
+    for rec in history.ops:
+        c = getattr(rec, "read_class", None)
+        if c:
+            counts[c] = counts.get(c, 0) + 1
+    return ClusterReport(
+        seed=seed,
+        per_class=per_class,
+        ops=len(history),
+        op_counts=history.counts(),
+        read_classes=counts,
+        nodes=nodes,
+        kills=kills,
+        restarts=restarts,
+        partitions=partitions,
+        pauses=pauses,
+        flood_ops=flood_ops,
+        generation=int(vstat.get("generation", 0)),
+        segments_adopted=int(tier.get("segments_adopted", 0)),
+        segments_resealed=int(tier.get("segments_resealed", -1)),
+        snap_chunks_in=int(vstat.get("snap_chunks_in", 0)),
+        rejoined=rejoined,
+        incarnations=incarnations,
+        failovers=failovers,
+        statuses=evidence,
+        base_dir=base,
+        repro=f"python -m raft_tpu.chaos --cluster --seed {seed}",
     )

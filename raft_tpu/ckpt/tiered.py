@@ -47,6 +47,7 @@ baselines).
 
 from __future__ import annotations
 
+import json
 import os
 import struct
 import tempfile
@@ -230,6 +231,17 @@ class TieredStore(CheckpointStore):
     through the segment tier. ``apply_cursor`` (set by the engine) caps
     sealing: only entries the apply stream has consumed are sealed, so
     the hot path never pays a segment read for the next apply index.
+
+    **Restart handoff** (``adopt=True``, docs/CLUSTER.md). A
+    generation-stamped ``manifest.json`` in ``root`` records the sealed
+    index after every seal; a restarted process constructs with
+    ``adopt=True`` and inherits the prior generation's sealed segments
+    verbatim — the seal cursor resumes past ``sealed_hi``, so re-filling
+    the log from a peer's snapshot stream re-seals NOTHING it already
+    paid for (``segments_resealed`` counts any violation; the cluster
+    drill asserts it stays 0). Shard health is not re-audited at adopt
+    time: a shard rotted across the restart surfaces through the normal
+    read-path CRC/RS machinery, same as any other loss.
     """
 
     def __init__(
@@ -243,6 +255,7 @@ class TieredStore(CheckpointStore):
         cache_segments: int = 2,
         on_seal=None,
         checkpoint_span: Optional[int] = None,
+        adopt: bool = False,
     ):
         if hot_entries < segment_entries:
             raise ValueError("hot_entries must be >= segment_entries")
@@ -276,9 +289,47 @@ class TieredStore(CheckpointStore):
         self.stats: Dict[str, int] = {
             "segments_sealed": 0, "entries_sealed": 0, "seal_bytes": 0,
             "segment_loads": 0, "segment_reconstructs": 0,
-            "segments_lost": 0,
+            "segments_lost": 0, "segments_adopted": 0,
+            "segments_resealed": 0,
         }
         self.seal_wall_s = 0.0       # cumulative wall time inside seal()
+        # --------------------------------------------- restart handoff
+        self.generation = 1
+        self._adopted_hi = 0     # prior generation's sealed_hi: sealing
+        #   at or below it means the handoff failed and we re-paid
+        if adopt:
+            self._adopt_manifest()
+
+    # --------------------------------------------------------- manifest
+    def _manifest_path(self) -> str:
+        return os.path.join(self.root, "manifest.json")
+
+    def _write_manifest(self) -> None:
+        _atomic_write(self._manifest_path(), json.dumps({
+            "generation": self.generation,
+            "entry_bytes": self.entry_bytes,
+            "sealed": [[lo, hi] for lo, hi in self._sealed],
+            "sealed_hi": self._sealed_hi,
+        }).encode())
+
+    def _adopt_manifest(self) -> None:
+        try:
+            with open(self._manifest_path()) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            return                  # first generation: nothing to adopt
+        if m.get("entry_bytes") != self.entry_bytes:
+            return                  # layout changed under us: reseal all
+        self.generation = int(m.get("generation", 0)) + 1
+        self._sealed = [(int(lo), int(hi)) for lo, hi in m["sealed"]]
+        self._sealed_hi = int(m["sealed_hi"])
+        self._adopted_hi = self._sealed_hi
+        self._hot_first = self._sealed_hi + 1
+        # the archive extends at least to the adopted index; backfill
+        # puts past it raise ``last`` normally
+        self.last = max(self.last, self._sealed_hi)
+        self.stats["segments_adopted"] = len(self._sealed)
+        self._write_manifest()      # stamp the new generation
 
     # ----------------------------------------------------------- sealing
     def _seal_ceiling(self) -> int:
@@ -330,6 +381,11 @@ class TieredStore(CheckpointStore):
         self.stats["segments_sealed"] += 1
         self.stats["entries_sealed"] += hi - lo + 1
         self.stats["seal_bytes"] += ents.nbytes
+        if hi <= self._adopted_hi:
+            # the prior generation already sealed this range — the
+            # restart handoff failed to spare us the work
+            self.stats["segments_resealed"] += 1
+        self._write_manifest()
         # drop the hot copies: slots individually, spans wholly below
         for i in range(lo, hi + 1):
             self._slots.pop(i, None)
@@ -405,8 +461,11 @@ class TieredStore(CheckpointStore):
         self._sealed_hi = max(self._sealed_hi, first - 1)
         if self._seal_block is not None and self._seal_block < first:
             self._seal_block = None
-        self._sealed = [(lo, hi) for (lo, hi) in self._sealed
-                        if hi >= self._first]
+        kept = [(lo, hi) for (lo, hi) in self._sealed
+                if hi >= self._first]
+        if kept != self._sealed:
+            self._sealed = kept
+            self._write_manifest()
         for lo in [lo for lo in self._cache if lo < self._first]:
             self._cache.pop(lo, None)
             if lo in self._cache_order:
@@ -436,6 +495,7 @@ class TieredStore(CheckpointStore):
         return {
             "hot_first": self._hot_first,
             "sealed_hi": self._sealed_hi,
+            "generation": self.generation,
             "segments": len(self._sealed),
             "host_bytes": self.host_bytes(),
             "seal_wall_s": round(self.seal_wall_s, 6),

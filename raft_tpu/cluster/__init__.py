@@ -1,0 +1,28 @@
+"""Multi-process cluster mode: replicas as wire peers (docs/CLUSTER.md).
+
+One OS process per replica, inter-replica traffic as ``PEER_*`` frames
+on the same length-prefixed protocol the client tier speaks — no XLA
+cross-process collectives, no Gloo rendezvous. The pieces:
+
+- :mod:`raft_tpu.cluster.node` — the host-level replica: Raft roles and
+  timers, a fixed-record log mirrored into a :class:`TieredStore` for
+  the durable-across-restart segment handoff, and the ingest-server
+  backend surface so the SAME wire tier serves clients.
+- :mod:`raft_tpu.cluster.dialer` — outbound peer connections with
+  reconnect + backoff + ``PEER_HELLO`` auth.
+- :mod:`raft_tpu.cluster.auth` — shared-token verification and the TLS
+  context seam.
+- :mod:`raft_tpu.cluster.supervisor` — spawn / ``kill -9`` / SIGSTOP /
+  restart real OS processes, with the crash-loop fast-fail guard.
+- :mod:`raft_tpu.cluster.child` — the per-process entrypoint
+  (``python -m raft_tpu.cluster.child``).
+"""
+
+from raft_tpu.cluster.auth import ClusterAuth, PeerAuthError
+from raft_tpu.cluster.node import RaftNode, pack_record, unpack_record
+from raft_tpu.cluster.supervisor import ClusterBroken, ClusterSupervisor
+
+__all__ = [
+    "ClusterAuth", "PeerAuthError", "RaftNode", "pack_record",
+    "unpack_record", "ClusterBroken", "ClusterSupervisor",
+]

@@ -1,0 +1,152 @@
+"""Outbound peer connections: dial, authenticate, reconnect, pump.
+
+Each node keeps at most ONE outbound connection per peer, dialed to
+the peer's single wire port (the same ``IngestServer`` that fronts
+clients — ``PEER_HELLO`` instead of ``HELLO`` as the first frame is
+what marks the stream as replica traffic). The leader-hint redial the
+client tier grew on loopback (PR 13/15) generalizes here into its
+real shape: addresses are ``host:port`` strings from the cluster
+spec, reconnects back off exponentially, and a peer that died is
+simply re-dialed when the next frame wants out — process death is an
+expected state, not an error path.
+
+Flow control is deliberately simple: frames for a DOWN peer are
+dropped past a small bounded buffer (Raft retransmits by design — the
+next heartbeat re-sends whatever mattered), so a dead peer can never
+balloon the sender's memory. Replies to inbound frames ride the same
+connection they arrived on (the server side handles that); this
+module only carries the node's proactive traffic — vote requests,
+appends, snapshot chunks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.net import protocol as P
+from raft_tpu.obs import blackbox
+
+MAX_BUFFERED = 64          # frames queued per down peer before dropping
+
+
+class PeerDialer:
+    def __init__(self, node, auth, *, backoff_s: float = 0.05,
+                 max_backoff_s: float = 1.0):
+        self.node = node
+        self.auth = auth
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._writers: Dict[int, asyncio.StreamWriter] = {}
+        self._tasks: Dict[int, asyncio.Task] = {}
+        self._buf: Dict[int, List[bytes]] = {}
+        self.stats = {"dials": 0, "drops": 0, "frames_out": 0,
+                      "frames_in": 0}
+        self._closed = False
+
+    # ------------------------------------------------------------ sending
+    def pump_outbox(self) -> None:
+        """Drain the node's outbox — called from tick/drive, sync (the
+        asyncio transport buffers the write)."""
+        if not self.node.outbox:
+            return
+        out, self.node.outbox = self.node.outbox, []
+        for peer, frame in out:
+            self.send(peer, frame)
+
+    def send(self, peer: int, frame: bytes) -> None:
+        if self._closed or peer in self.node.deny:
+            return
+        w = self._writers.get(peer)
+        if w is not None:
+            try:
+                w.write(frame)
+                self.stats["frames_out"] += 1
+                return
+            except (ConnectionError, RuntimeError):
+                self._drop_conn(peer)
+        buf = self._buf.setdefault(peer, [])
+        if len(buf) >= MAX_BUFFERED:
+            buf.pop(0)
+            self.stats["drops"] += 1
+        buf.append(frame)
+        self._ensure_dialing(peer)
+
+    # ----------------------------------------------------------- dialing
+    def _ensure_dialing(self, peer: int) -> None:
+        t = self._tasks.get(peer)
+        if t is None or t.done():
+            self._tasks[peer] = asyncio.get_running_loop().create_task(
+                self._dial_loop(peer)
+            )
+
+    async def _dial_loop(self, peer: int) -> None:
+        delay = self.backoff_s
+        while not self._closed and self._buf.get(peer):
+            addr = self.node.peers.get(peer, "")
+            host, _, port = addr.rpartition(":")
+            try:
+                reader, writer = await asyncio.open_connection(
+                    host or "127.0.0.1", int(port),
+                    ssl=self.auth.client_ssl(),
+                )
+            except (OSError, ValueError):
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, self.max_backoff_s)
+                continue
+            self.stats["dials"] += 1
+            writer.write(P.encode_peer_hello(
+                self.node.node_id, self.auth.token,
+                self.node.store._sealed_hi,
+            ))
+            self._writers[peer] = writer
+            for frame in self._buf.pop(peer, []):
+                writer.write(frame)
+                self.stats["frames_out"] += 1
+            asyncio.get_running_loop().create_task(
+                self._read_loop(peer, reader, writer)
+            )
+            return
+
+    async def _read_loop(self, peer: int, reader, writer) -> None:
+        """Replies from the peer's server (vote replies, append acks,
+        snap acks) come back on our outbound connection."""
+        decoder = P.FrameDecoder()
+        try:
+            while not self._closed:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                for kind, payload in decoder.feed(data):
+                    self.stats["frames_in"] += 1
+                    kind, _tr, payload = P.split_trace(kind, payload)
+                    if kind == P.ERROR:
+                        # auth rejection or protocol desync: log and
+                        # drop the conn (the dial loop will retry)
+                        _rid, msg = P.decode_error(payload)
+                        blackbox.mark("peer_conn_error",
+                                      node=self.node.node_id,
+                                      peer=peer, error=msg)
+                        return
+                    for reply in self.node.on_peer_frame(kind, payload):
+                        writer.write(reply)
+        except (ConnectionError, P.ProtocolError,
+                asyncio.IncompleteReadError):
+            pass
+        finally:
+            self._drop_conn(peer)
+
+    def _drop_conn(self, peer: int) -> None:
+        w = self._writers.pop(peer, None)
+        if w is not None:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    async def close(self) -> None:
+        self._closed = True
+        for peer in list(self._writers):
+            self._drop_conn(peer)
+        for t in self._tasks.values():
+            t.cancel()

@@ -1,0 +1,659 @@
+"""The host-level replica: one Raft role machine per OS process.
+
+This is the cluster counterpart of ``raft.engine.RaftEngine``. The
+in-process engines replicate by device collectives inside ONE process;
+a :class:`RaftNode` replicates by ``PEER_*`` frames over TCP — real
+AppendEntries, real RequestVote, a real commit quorum counted from
+real sockets — so the fault model finally includes the one thing the
+torture harness could never drive before: the OS killing a replica.
+
+Design decisions, and why:
+
+- **Pure host state machine.** Roles, timers, the log, and the KV live
+  in plain Python on the event-loop thread; no device state, no
+  threads, no locks. Frames arrive on reader tasks and are handled
+  synchronously (:meth:`on_peer_frame` returns the reply frames for
+  the same connection); timers advance in :meth:`tick`, driven by the
+  child's ticker task and by the ingest pump's ``drive``. One replica
+  per process means the per-replica work is a handful of dict ops per
+  frame — the wire, not the CPU, is the bound.
+- **The log is a list; durability is the tiered store.** The
+  authoritative log (including the uncommitted tail) is a RAM list of
+  ``(term, record)``; every COMMITTED entry is mirrored into a
+  :class:`TieredStore` rooted in the node's data dir, whose sweep
+  seals cold segments to disk as RS-coded shards. ``kill -9`` loses
+  the RAM tail by construction — recovery is Raft's job, not fsync's:
+  a restarted node adopts the prior generation's sealed segments by
+  manifest (``adopt=True`` — zero re-seals, the PR-12 remainder),
+  replays them into the KV, and asks the leader for the rest via the
+  resumable catch-up stream, which resumes from the adopted floor
+  because ``PEER_HELLO`` carries it.
+- **ReadIndex over heartbeat rounds.** Every append carries the
+  leader's ``round_no``; followers echo it. A linearizable read mints
+  a ticket pinned at (commit, round+1); a majority of echoes at or
+  past that round certifies leadership after the ticket was minted —
+  the same confirmation rule as docs/READS.md, carried peer-to-peer.
+  A leader holding a fresh majority (``lease_s`` of ack recency, the
+  PR-13 lease shape) serves reads with zero waiting.
+- **Partitions are deny-lists.** The process nemesis writes
+  ``ctrl-<id>.json`` (``{"deny": [peer ids]}``) into the node dir; the
+  node polls it each tick and drops matching traffic both ways. No
+  root, no iptables — and heals by deleting the file.
+
+Record format (``REC_BYTES`` fixed): ``u8 klen | key | u16 vlen |
+value``, zero-padded; ``klen == 0`` is the leadership noop. Fixed-size
+records keep the TieredStore's entry math trivial and match the
+engine's fixed ``entry_bytes`` convention.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.ckpt.tiered import TieredStore
+from raft_tpu.multi.engine import NotLeader
+from raft_tpu.net import protocol as P
+from raft_tpu.net.server import _Done, _Pending
+from raft_tpu.obs import blackbox
+
+REC_BYTES = 64
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+def pack_record(key: bytes, value: bytes,
+                rec_bytes: int = REC_BYTES) -> bytes:
+    if 3 + len(key) + len(value) > rec_bytes:
+        raise ValueError("record overflow")
+    rec = struct.pack("!B", len(key)) + key + struct.pack(
+        "!H", len(value)) + value
+    return rec + b"\x00" * (rec_bytes - len(rec))
+
+
+def unpack_record(rec: bytes) -> Optional[Tuple[bytes, bytes]]:
+    klen = rec[0]
+    if klen == 0:
+        return None                                  # leadership noop
+    key = rec[1:1 + klen]
+    (vlen,) = struct.unpack_from("!H", rec, 1 + klen)
+    return key, rec[3 + klen:3 + klen + vlen]
+
+
+class RaftNode:
+    """One replica process's consensus state + the ingest-server
+    backend surface (module docstring).
+
+    ``peers`` maps EVERY node id (including ``node_id``) to its
+    ``"host:port"`` wire address — the single port each process serves
+    clients AND peers on; ``leader_hint`` returns the believed
+    leader's address verbatim, which is what lets a client redial past
+    loopback."""
+
+    def __init__(
+        self,
+        node_id: int,
+        peers: Dict[int, str],
+        data_dir: str,
+        *,
+        heartbeat_s: float = 0.05,
+        election_timeout_s: float = 0.3,
+        lease_s: Optional[float] = None,
+        max_append: int = 64,
+        snap_chunk: int = 128,
+        snap_threshold: Optional[int] = None,
+        hot_entries: int = 256,
+        segment_entries: int = 64,
+        seed: Optional[int] = None,
+    ):
+        self.node_id = node_id
+        self.peers = dict(peers)
+        self.others = sorted(p for p in self.peers if p != node_id)
+        self.majority = len(self.peers) // 2 + 1
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.hb_s = heartbeat_s
+        self.timeout_base = election_timeout_s
+        self.lease_s = lease_s if lease_s is not None else 4 * heartbeat_s
+        self.max_append = max_append
+        self.snap_chunk = snap_chunk
+        self.snap_threshold = (snap_threshold if snap_threshold is not None
+                               else 2 * snap_chunk)
+        self._rng = random.Random(seed if seed is not None
+                                  else (os.getpid() << 8) | node_id)
+
+        # ------------------------------------------------- durable state
+        self.term = 0
+        self.voted_for: Optional[int] = None
+        self.generation = 1
+        self.store = TieredStore(
+            REC_BYTES, os.path.join(data_dir, "segments"),
+            hot_entries=hot_entries, segment_entries=segment_entries,
+            adopt=True,
+        )
+        self._load_vote()
+
+        # -------------------------------------------------- volatile state
+        self.role = FOLLOWER
+        self.leader_id: Optional[int] = None
+        self.log: List[Tuple[int, bytes]] = []       # log[0] = index 1
+        self.kv: Dict[bytes, bytes] = {}
+        self.commit = 0
+        self.applied = 0
+        self._replay_adopted()
+
+        now = time.monotonic()
+        self.last_heard = now
+        self.timeout = self._new_timeout()
+        self.outbox: List[Tuple[int, bytes]] = []    # (peer id, frame)
+        self.deny: set = set()
+        self._ctrl_mtime = 0.0
+
+        # leader bookkeeping (reset on every election win)
+        self.next_idx: Dict[int, int] = {}
+        self.match_idx: Dict[int, int] = {}
+        self.hb_round = 0
+        self.peer_round: Dict[int, int] = {}     # highest echoed round
+        self.ack_at: Dict[int, float] = {}       # last successful ack
+        self.last_hb = 0.0
+        self.snap_mode: set = set()              # peers in catch-up stream
+        self._snap_sent: Dict[int, float] = {}   # last chunk send time
+        self.votes: set = set()
+        self._dirty = False      # un-broadcast appended entries exist
+        self._reads: Dict[int, Tuple[int, int, bytes]] = {}
+        self._next_ticket = 1
+        self.stats: Dict[str, int] = {
+            "elections": 0, "terms_won": 0, "appends_in": 0,
+            "appends_out": 0, "snap_chunks_in": 0, "snap_chunks_out": 0,
+            "reads_lease": 0, "reads_read_index": 0, "denied_frames": 0,
+        }
+
+    # ----------------------------------------------------- durable state
+    def _vote_path(self) -> str:
+        return os.path.join(self.data_dir, "vote.json")
+
+    def _persist_vote(self) -> None:
+        from raft_tpu.ckpt.tiered import _atomic_write
+
+        _atomic_write(self._vote_path(), json.dumps({
+            "term": self.term, "voted_for": self.voted_for,
+            "generation": self.generation,
+        }).encode())
+
+    def _load_vote(self) -> None:
+        try:
+            with open(self._vote_path()) as f:
+                v = json.load(f)
+            self.term = int(v["term"])
+            self.voted_for = v["voted_for"]
+            self.generation = int(v.get("generation", 0)) + 1
+        except (OSError, ValueError, KeyError):
+            pass
+        self._persist_vote()
+
+    def _replay_adopted(self) -> None:
+        """Rebuild log + KV from the adopted sealed prefix. Entries past
+        ``sealed_hi`` died with the previous process — the catch-up
+        stream re-replicates them, which is safe precisely because only
+        COMMITTED entries were ever mirrored to the store."""
+        hi = self.store._sealed_hi
+        for i in range(1, hi + 1):
+            got = self.store.get(i)
+            if got is None:        # segment lost below k shards: the
+                break              # stream re-replicates from here
+            rec, term = got
+            self.log.append((term, rec))
+            kvv = unpack_record(rec)
+            if kvv is not None:
+                self.kv[kvv[0]] = kvv[1]
+            self.commit = self.applied = i
+        self.log = self.log[: self.commit]
+        self.store.apply_cursor = self.applied
+
+    # -------------------------------------------------------- log helpers
+    @property
+    def last_idx(self) -> int:
+        return len(self.log)
+
+    def term_at(self, idx: int) -> int:
+        if idx == 0:
+            return 0
+        return self.log[idx - 1][0]
+
+    def _new_timeout(self) -> float:
+        return self.timeout_base * (1.0 + self._rng.random())
+
+    # ------------------------------------------------------------- timers
+    def tick(self, now: float) -> None:
+        self._poll_ctrl()
+        if self.role == LEADER:
+            if self._dirty or now - self.last_hb >= self.hb_s:
+                self._broadcast_appends(now, heartbeat=True)
+                self._dirty = False
+            self._advance_commit(now)
+        elif now - self.last_heard >= self.timeout:
+            self._start_election(now)
+
+    def _poll_ctrl(self) -> None:
+        path = os.path.join(self.data_dir, f"ctrl-{self.node_id}.json")
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            if self.deny:
+                self.deny = set()
+                blackbox.mark("ctrl_heal", node=self.node_id)
+            return
+        if mtime == self._ctrl_mtime:
+            return
+        self._ctrl_mtime = mtime
+        try:
+            with open(path) as f:
+                self.deny = set(json.load(f).get("deny", []))
+            blackbox.mark("ctrl_deny", node=self.node_id,
+                          deny=sorted(self.deny))
+        except (OSError, ValueError):
+            pass
+
+    # ---------------------------------------------------------- elections
+    def _start_election(self, now: float) -> None:
+        self.term += 1
+        self.role = CANDIDATE
+        self.voted_for = self.node_id
+        self.leader_id = None
+        self._persist_vote()
+        self.votes = {self.node_id}
+        self.last_heard = now
+        self.timeout = self._new_timeout()
+        self.stats["elections"] += 1
+        blackbox.mark("election_start", node=self.node_id,
+                      term=self.term)
+        for p in self.others:
+            self._to(p, P.encode_peer_vote(
+                self.node_id, self.term, self.last_idx,
+                self.term_at(self.last_idx),
+            ))
+
+    def _become_leader(self, now: float) -> None:
+        self.role = LEADER
+        self.leader_id = self.node_id
+        self.stats["terms_won"] += 1
+        self.next_idx = {p: self.last_idx + 1 for p in self.others}
+        self.match_idx = {p: 0 for p in self.others}
+        self.hb_round = 0
+        self.peer_round = {p: 0 for p in self.others}
+        self.ack_at = {}
+        self.snap_mode = set()
+        self._snap_sent = {}
+        blackbox.mark("leader_won", node=self.node_id, term=self.term)
+        # the noop: commits an entry of the CURRENT term, which is what
+        # lets _advance_commit move the watermark over prior-term tails
+        self.log.append((self.term, pack_record(b"", b"")))
+        self._broadcast_appends(now, heartbeat=True)
+
+    def _step_down(self, term: int, now: float) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_vote()
+        if self.role != FOLLOWER:
+            blackbox.mark("step_down", node=self.node_id, term=term)
+        self.role = FOLLOWER
+        self.last_heard = now
+        self.timeout = self._new_timeout()
+
+    # ------------------------------------------------------- leader sends
+    def _broadcast_appends(self, now: float, heartbeat: bool = False
+                           ) -> None:
+        self.last_hb = now
+        self.hb_round += 1
+        for p in self.others:
+            if p in self.snap_mode:
+                # the stream paces itself on acks — but a chunk (or its
+                # ack) lost to a partition, drop, or process death would
+                # stall it forever, so re-send from the recorded match
+                # after a few silent heartbeats: resumable-by-match-index
+                if now - self._snap_sent.get(p, 0.0) > 4 * self.hb_s:
+                    self._send_snap_chunk(p)
+                continue
+            nxt = self.next_idx.get(p, self.last_idx + 1)
+            if (self.commit - self.match_idx.get(p, 0)
+                    > self.snap_threshold):
+                self._start_snap(p)
+                continue
+            ents = [self.log[i - 1]
+                    for i in range(nxt, min(self.last_idx,
+                                            nxt + self.max_append - 1) + 1)]
+            self._to(p, P.encode_peer_append(
+                self.node_id, self.term, nxt - 1, self.term_at(nxt - 1),
+                self.commit, self.hb_round, ents,
+            ))
+            self.stats["appends_out"] += 1
+
+    def _start_snap(self, p: int) -> None:
+        self.snap_mode.add(p)
+        blackbox.mark("snap_stream_start", node=self.node_id, peer=p,
+                      match=self.match_idx.get(p, 0), commit=self.commit)
+        self._send_snap_chunk(p)
+
+    def _send_snap_chunk(self, p: int) -> None:
+        base = self.match_idx.get(p, 0) + 1
+        hi = min(self.commit, base + self.snap_chunk - 1)
+        if base > hi:
+            self.snap_mode.discard(p)
+            self.next_idx[p] = self.match_idx.get(p, 0) + 1
+            return
+        ents = [self.log[i - 1] for i in range(base, hi + 1)]
+        self._to(p, P.encode_peer_snap_chunk(
+            self.node_id, self.term, base, self.commit, self.commit,
+            ents,
+        ))
+        self._snap_sent[p] = time.monotonic()
+        self.stats["snap_chunks_out"] += 1
+
+    def _advance_commit(self, now: float) -> None:
+        if self.role != LEADER:
+            return
+        matches = sorted(
+            [self.match_idx.get(p, 0) for p in self.others]
+            + [self.last_idx],
+            reverse=True,
+        )
+        n = matches[self.majority - 1]
+        if n > self.commit and self.term_at(n) == self.term:
+            self.commit = n
+            self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        while self.applied < self.commit:
+            self.applied += 1
+            term, rec = self.log[self.applied - 1]
+            kvv = unpack_record(rec)
+            if kvv is not None:
+                self.kv[kvv[0]] = kvv[1]
+            # mirror into the durable tier: only committed entries ever
+            # reach the store, so adoption after a crash never resurrects
+            # an uncommitted suffix
+            self.store.apply_cursor = self.applied
+            self.store.put(self.applied, rec, term=term)
+
+    # --------------------------------------------------------- lease math
+    def _quorum_recency(self, now: float) -> float:
+        """Age of the freshest MAJORITY of append acks (self counts as
+        age 0) — the lease clock: below ``lease_s`` the leader provably
+        led within the window."""
+        ages = sorted(now - self.ack_at.get(p, -1e9) for p in self.others)
+        return ages[self.majority - 2] if self.majority >= 2 else 0.0
+
+    def has_lease(self, now: float) -> bool:
+        return (self.role == LEADER
+                and self._quorum_recency(now) < self.lease_s)
+
+    # ------------------------------------------------------ inbound frames
+    def on_peer_frame(self, kind: int, payload: bytes) -> List[bytes]:
+        """Handle one peer frame; returns reply frames for the SAME
+        connection. Called from reader tasks — same thread as tick."""
+        now = time.monotonic()
+        sender = struct.unpack_from("!I", payload)[0]
+        if sender in self.deny:
+            self.stats["denied_frames"] += 1
+            return []
+        if kind == P.PEER_VOTE:
+            return self._on_vote(payload, now)
+        if kind == P.PEER_VOTE_REPLY:
+            return self._on_vote_reply(payload, now)
+        if kind == P.PEER_APPEND:
+            return self._on_append(payload, now)
+        if kind == P.PEER_APPEND_REPLY:
+            return self._on_append_reply(payload, now)
+        if kind == P.PEER_SNAP_CHUNK:
+            return self._on_snap_chunk(payload, now)
+        if kind == P.PEER_SNAP_ACK:
+            return self._on_snap_ack(payload, now)
+        raise P.ProtocolError(f"unexpected peer frame kind {kind}")
+
+    def on_peer_hello(self, peer_id: int, last_idx: int) -> List[bytes]:
+        """An inbound peer identified itself; its durable floor seeds
+        ``match`` so a restarted follower's catch-up stream starts at
+        the adopted segments' edge, not at zero. The floor is
+        AUTHORITATIVE downward too: a fresh hello advertising less than
+        the recorded match means the peer restarted and lost its RAM
+        tail — keeping the stale-high match would base every snapshot
+        chunk past the follower's log forever (the ping-pong this
+        branch exists to kill). Lowering match is always safe: it only
+        delays commit advancement, never regresses it."""
+        if self.role == LEADER and peer_id in self.match_idx:
+            cur = self.match_idx[peer_id]
+            if cur == 0 and last_idx > 0:
+                self.match_idx[peer_id] = min(last_idx, self.commit)
+                self.next_idx[peer_id] = self.match_idx[peer_id] + 1
+            elif last_idx < cur:
+                self.match_idx[peer_id] = last_idx
+                self.next_idx[peer_id] = last_idx + 1
+                # restart the stream from the REAL floor
+                self.snap_mode.discard(peer_id)
+        return []
+
+    def _on_vote(self, payload: bytes, now: float) -> List[bytes]:
+        cand, term, last_idx, last_term, _pv = P.decode_peer_vote(payload)
+        if term > self.term:
+            self._step_down(term, now)
+        up_to_date = (last_term, last_idx) >= (
+            self.term_at(self.last_idx), self.last_idx)
+        granted = (term == self.term
+                   and self.voted_for in (None, cand)
+                   and up_to_date)
+        if granted:
+            self.voted_for = cand
+            self._persist_vote()
+            self.last_heard = now
+        return [P.encode_peer_vote_reply(self.node_id, self.term,
+                                         granted)]
+
+    def _on_vote_reply(self, payload: bytes, now: float) -> List[bytes]:
+        voter, term, granted, _pv = P.decode_peer_vote_reply(payload)
+        if term > self.term:
+            self._step_down(term, now)
+            return []
+        if (self.role == CANDIDATE and term == self.term and granted):
+            self.votes.add(voter)
+            if len(self.votes) >= self.majority:
+                self._become_leader(now)
+        return []
+
+    def _on_append(self, payload: bytes, now: float) -> List[bytes]:
+        (leader, term, prev_idx, prev_term, commit, round_no,
+         entries) = P.decode_peer_append(payload)
+        self.stats["appends_in"] += 1
+        if term < self.term:
+            return [P.encode_peer_append_reply(
+                self.node_id, self.term, False, self.last_idx, round_no)]
+        self._step_down(term, now)
+        self.leader_id = leader
+        if prev_idx > self.last_idx or (
+                prev_idx > 0 and self.term_at(prev_idx) != prev_term):
+            # divergent / missing prefix: reply our last index as the
+            # rewind hint (one round per divergent tail)
+            return [P.encode_peer_append_reply(
+                self.node_id, self.term, False,
+                min(self.last_idx, prev_idx - 1), round_no)]
+        idx = prev_idx
+        for ent_term, rec in entries:
+            idx += 1
+            if idx <= self.last_idx:
+                if self.log[idx - 1][0] == ent_term:
+                    continue
+                del self.log[idx - 1:]       # conflict: truncate suffix
+            self.log.append((ent_term, rec))
+        match = prev_idx + len(entries)
+        if commit > self.commit:
+            self.commit = min(commit, self.last_idx)
+            self._apply_committed()
+        return [P.encode_peer_append_reply(
+            self.node_id, self.term, True, match, round_no)]
+
+    def _on_append_reply(self, payload: bytes, now: float) -> List[bytes]:
+        (follower, term, ok, match_idx, round_no
+         ) = P.decode_peer_append_reply(payload)
+        if term > self.term:
+            self._step_down(term, now)
+            return []
+        if self.role != LEADER or term != self.term:
+            return []
+        self.ack_at[follower] = now
+        if round_no > self.peer_round.get(follower, 0):
+            self.peer_round[follower] = round_no
+        if ok:
+            if match_idx > self.match_idx.get(follower, 0):
+                self.match_idx[follower] = match_idx
+            self.next_idx[follower] = max(
+                self.next_idx.get(follower, 1), match_idx + 1)
+            self._advance_commit(now)
+        else:
+            self.next_idx[follower] = max(1, min(
+                self.next_idx.get(follower, 1) - 1, match_idx + 1))
+        return []
+
+    def _on_snap_chunk(self, payload: bytes, now: float) -> List[bytes]:
+        (leader, term, base, _total, commit, entries
+         ) = P.decode_peer_snap_chunk(payload)
+        self.stats["snap_chunks_in"] += 1
+        if term < self.term:
+            return []
+        self._step_down(term, now)
+        self.leader_id = leader
+        if base != self.last_idx + 1:
+            # not the chunk we need (stale retry): re-ack our floor so
+            # the stream resumes from the right base
+            return [P.encode_peer_snap_ack(self.node_id, self.term,
+                                           self.last_idx)]
+        for ent_term, rec in entries:
+            self.log.append((ent_term, rec))
+        if commit > self.commit:
+            self.commit = min(commit, self.last_idx)
+            self._apply_committed()
+        return [P.encode_peer_snap_ack(self.node_id, self.term,
+                                       self.last_idx)]
+
+    def _on_snap_ack(self, payload: bytes, now: float) -> List[bytes]:
+        follower, term, match_idx = P.decode_peer_snap_ack(payload)
+        if term > self.term:
+            self._step_down(term, now)
+            return []
+        if self.role != LEADER or term != self.term:
+            return []
+        self.ack_at[follower] = now
+        if follower in self.snap_mode:
+            # a snap ack carries the follower's literal last_idx — it
+            # is AUTHORITATIVE, downward included: a follower that
+            # restarted mid-stream reports the floor it really has,
+            # and the next chunk must base there or loop forever
+            self.match_idx[follower] = match_idx
+        elif match_idx > self.match_idx.get(follower, 0):
+            self.match_idx[follower] = match_idx
+        self._advance_commit(now)
+        if follower in self.snap_mode:
+            if self.match_idx[follower] >= self.commit:
+                self.snap_mode.discard(follower)
+                self.next_idx[follower] = self.match_idx[follower] + 1
+                blackbox.mark("snap_stream_done", node=self.node_id,
+                              peer=follower, match=match_idx)
+            else:
+                self._send_snap_chunk(follower)
+        return []
+
+    def _to(self, peer: int, frame: bytes) -> None:
+        if peer in self.deny:
+            self.stats["denied_frames"] += 1
+            return
+        self.outbox.append((peer, frame))
+
+    # ===================================================== backend surface
+    # the ingest-server duck type (net/server.py): the SAME wire tier
+    # that fronts the in-process engines serves this node to clients.
+    @property
+    def heartbeat_s(self) -> float:
+        return self.hb_s
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def drive(self, seconds: float) -> None:
+        # real clock: one timer pass per pump iteration (the ticker
+        # task paces the idle path; reader tasks already handled frames)
+        self.tick(time.monotonic())
+
+    def meta(self) -> Tuple[int, int]:
+        return REC_BYTES, 1
+
+    def leader_hint(self, group: int) -> str:
+        lid = self.leader_id
+        return "" if lid is None else self.peers.get(lid, "")
+
+    def submit(self, key: bytes, value: bytes, client=None
+               ) -> Tuple[int, int]:
+        if self.role != LEADER:
+            raise NotLeader(0, "not the leader")
+        self.log.append((self.term, pack_record(key, value)))
+        self._dirty = True       # next tick broadcasts without waiting
+        return 0, self.last_idx
+
+    def is_durable(self, group: int, seq: int) -> bool:
+        return self.commit >= seq
+
+    def commit_floor(self, group: int) -> int:
+        return self.commit
+
+    def begin_read(self, cls: str, key: bytes, session: Dict[int, int],
+                   client=None):
+        now = time.monotonic()
+        if cls == "session":
+            floor = session.get(0, 0)
+            if self.applied < floor:
+                from raft_tpu.multi.engine import ReadLagging
+
+                raise ReadLagging(0, None, floor - self.applied,
+                                  retry_after_s=self.hb_s)
+            return _Done(0, self.applied, "session", self.kv.get(key))
+        if self.role != LEADER:
+            raise NotLeader(0, "reads need the leader")
+        if self.has_lease(now):
+            self.stats["reads_lease"] += 1
+            return _Done(0, self.applied, "lease", self.kv.get(key))
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        # certify: a majority must echo a round minted AFTER this point
+        self._reads[ticket] = (self.commit, self.hb_round + 1, key)
+        return _Pending(ticket)
+
+    def poll_read(self, handle):
+        got = self._reads.get(handle)
+        if got is None:
+            raise NotLeader(0, "read ticket lost to a leadership change")
+        read_idx, need_round, key = got
+        if self.role != LEADER:
+            self._reads.pop(handle, None)
+            raise NotLeader(0, "stepped down mid-read")
+        echoes = sum(1 for p in self.others
+                     if self.peer_round.get(p, 0) >= need_round)
+        if echoes + 1 < self.majority or self.applied < read_idx:
+            return None
+        self._reads.pop(handle, None)
+        self.stats["reads_read_index"] += 1
+        return _Done(0, read_idx, "read_index", self.kv.get(key))
+
+    def staging_stats(self):
+        return None
+
+    def status(self) -> dict:
+        return {
+            "node": self.node_id, "role": self.role, "term": self.term,
+            "leader": self.leader_id, "commit": self.commit,
+            "applied": self.applied, "last_idx": self.last_idx,
+            "generation": self.generation,
+            "tier": self.store.tier_summary(),
+            **{k: v for k, v in self.stats.items()},
+        }

@@ -346,6 +346,8 @@ class WireClient:
         self.trace_node = int(trace_node) & 0xFFFFFFFF
         self._conns: List[Optional[_PoolConn]] = [None] * self.pool_size
         self._rr = 0
+        self._connect_fails = 0     # consecutive dead dials (failover)
+        self._failover_idx = 0
         self._next_req_id = 1
         self.entry_bytes: Optional[int] = None
         self.groups: Optional[int] = None
@@ -404,6 +406,7 @@ class WireClient:
             return conn
         conn = _PoolConn(self)
         await conn.connect(self.host, self.port)
+        self._connect_fails = 0
         self._conns[i] = conn
         if conn.welcome is not None:
             self.entry_bytes, self.groups = conn.welcome
@@ -604,6 +607,13 @@ class WireClient:
                 # same backoff instead of leaking a raw OSError
                 if attempt <= self.retries:
                     self.stats["retries"] += 1
+                    # a server that answers NOTHING can never hint the
+                    # leader — after two dead dials, fail over to the
+                    # next address in the map (cluster mode: a killed
+                    # node's clients must find the survivors)
+                    self._connect_fails += 1
+                    if self._connect_fails >= 2:
+                        self._failover(sp)
                     delay = self.backoff.delay(attempt - 1)
                     if sp is not None:
                         sp.retries += 1
@@ -681,19 +691,52 @@ class WireClient:
     def _maybe_redial(self, hint: str, sp) -> None:
         """Leader-hint redial: repoint the pool (closing the old conns
         — an orphaned socket per redial would leak across a flappy
-        election)."""
+        election). Hints resolve through ``addr_map`` first (symbolic
+        names like ``replica:2``), then as literal ``host:port``
+        addresses — the cluster tier's nodes hint each other's wire
+        addresses directly, so redial works past loopback with no
+        pre-shared map."""
         target = self.addr_map.get(hint)
+        if target is None and ":" in hint:
+            host, _, port = hint.rpartition(":")
+            try:
+                target = (host, int(port))
+            except ValueError:
+                target = None
         if target is None or target == (self.host, self.port):
             return
+        self._repoint(target)
+        self.stats["redials"] += 1
+        if sp is not None:
+            sp.redials += 1
+            sp.annotate("redial", self._now(), target=hint)
+
+    def _failover(self, sp) -> None:
+        """Dead-server failover: round-robin to the next DISTINCT
+        address in ``addr_map``. Redial-by-hint cannot work when the
+        server is gone (no frame, no hint) — this is the blind half of
+        the multi-server story; the survivors' ``NOT_LEADER`` hints
+        take over once anything answers."""
+        ring = sorted(set(tuple(v) for v in self.addr_map.values()))
+        cur = (self.host, self.port)
+        others = [a for a in ring if a != cur]
+        if not others:
+            return
+        nxt = others[(self._failover_idx) % len(others)]
+        self._failover_idx += 1
+        self._connect_fails = 0
+        self._repoint(nxt)
+        self.stats["failovers"] = self.stats.get("failovers", 0) + 1
+        if sp is not None:
+            sp.annotate("failover", self._now(),
+                        target=f"{nxt[0]}:{nxt[1]}")
+
+    def _repoint(self, target) -> None:
         self.host, self.port = target
         for old in self._conns:
             if old is not None:
                 old.close()
         self._conns = [None] * self.pool_size
-        self.stats["redials"] += 1
-        if sp is not None:
-            sp.redials += 1
-            sp.annotate("redial", self._now(), target=hint)
 
     # --------------------------------------------------------- transactions
     async def txn_commit(self, writes, expects=()) -> TxnResult:

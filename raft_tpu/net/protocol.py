@@ -69,6 +69,16 @@ TXN_COMMIT = 13    # client -> server: commit a txn's write/expect set
 TXN_ABORT = 14     # client -> server: abandon an open transaction
 TXN_STATUS = 15    # client -> server: decision lookup by txn id
 TXN_STATE = 16     # server -> client: txn outcome / status
+# peer plane (ISSUE 17, docs/CLUSTER.md): replica-to-replica RPCs on
+# the SAME framed protocol, gated on CAP_PEER — a cluster of N server
+# processes replicates through these instead of in-process collectives
+PEER_HELLO = 17       # peer -> peer: identify + authenticate
+PEER_VOTE = 18        # candidate -> peer: RequestVote
+PEER_VOTE_REPLY = 19  # peer -> candidate: vote verdict
+PEER_APPEND = 20      # leader -> peer: AppendEntries batch
+PEER_APPEND_REPLY = 21  # peer -> leader: success + match index
+PEER_SNAP_CHUNK = 22  # leader -> lagging peer: bulk catch-up chunk
+PEER_SNAP_ACK = 23    # peer -> leader: resumable-stream floor
 
 KIND_NAMES = {
     HELLO: "hello", WELCOME: "welcome", SUBMIT: "submit", READ: "read",
@@ -78,6 +88,10 @@ KIND_NAMES = {
     TXN_BEGIN: "txn_begin", TXN_COMMIT: "txn_commit",
     TXN_ABORT: "txn_abort", TXN_STATUS: "txn_status",
     TXN_STATE: "txn_state",
+    PEER_HELLO: "peer_hello", PEER_VOTE: "peer_vote",
+    PEER_VOTE_REPLY: "peer_vote_reply", PEER_APPEND: "peer_append",
+    PEER_APPEND_REPLY: "peer_append_reply",
+    PEER_SNAP_CHUNK: "peer_snap_chunk", PEER_SNAP_ACK: "peer_snap_ack",
 }
 
 #: high bit on the kind byte: the payload starts with a 17-byte trace
@@ -94,6 +108,11 @@ CAP_TRACE = 0x01
 #: frames (ISSUE 16). Same additive contract as CAP_TRACE: a pre-txn
 #: peer never sees the bit, never the frames.
 CAP_TXN = 0x02
+#: the server owns one replica of a multi-process cluster and speaks
+#: the PEER_* frames (ISSUE 17). Same additive contract: a server
+#: without a peer backend never advertises the bit, and every PEER
+#: frame it receives falls to the unknown-kind close.
+CAP_PEER = 0x04
 
 _TRACE_CTX = struct.Struct("!QQB")
 TRACE_CTX_BYTES = _TRACE_CTX.size        # 17
@@ -616,3 +635,174 @@ def decode_txn_state(payload: bytes) -> Tuple[int, int, str, str]:
         raise ProtocolError(f"unknown txn-status code {code}")
     reason, _ = _ub16(payload, 13)
     return req_id, txn_id, status, reason.decode()
+
+
+# ------------------------------------------------------------- PEER_*
+# The replica plane (docs/CLUSTER.md). Gated on CAP_PEER; every frame
+# leads with the sender's node id so a multi-homed process can tell
+# which peer a shared-acceptor connection belongs to. Entries travel
+# as (term u64, record pb16) pairs — records are the node's fixed-size
+# log entries, so an append batch is self-describing.
+
+def is_peer_kind(kind: int) -> bool:
+    return PEER_HELLO <= kind <= PEER_SNAP_ACK
+
+
+def encode_peer_hello(node_id: int, token: bytes = b"",
+                      last_idx: int = 0, **kw) -> bytes:
+    """Peer identification + auth: ``token`` is verified by the
+    receiving server's auth hook (cluster.auth) before any other PEER
+    frame is honored on the connection; a mismatch answers ERROR and
+    closes. ``last_idx`` is the sender's durable log floor — the
+    resumable-handoff hint a restarted process opens with, so the
+    leader resumes the catch-up stream past the adopted segments
+    instead of replaying history the disk already holds."""
+    return encode_frame(
+        PEER_HELLO,
+        struct.pack("!IQ", node_id, last_idx) + _pb16(token), **kw
+    )
+
+
+def decode_peer_hello(payload: bytes) -> Tuple[int, int, bytes]:
+    _need(payload, 0, 12)
+    node_id, last_idx = struct.unpack_from("!IQ", payload)
+    token, _ = _ub16(payload, 12)
+    return node_id, last_idx, token
+
+
+def encode_peer_vote(node_id: int, term: int, last_idx: int,
+                     last_term: int, prevote: bool = False,
+                     **kw) -> bytes:
+    """RequestVote: grant iff the candidate's log is at least as
+    up-to-date (§5.4.1) and no vote was cast this term. ``prevote``
+    probes without bumping terms (the disruption guard)."""
+    return encode_frame(
+        PEER_VOTE,
+        struct.pack("!IQQQB", node_id, term, last_idx, last_term,
+                    1 if prevote else 0),
+        **kw,
+    )
+
+
+def decode_peer_vote(payload: bytes) -> Tuple[int, int, int, int, bool]:
+    _need(payload, 0, 29)
+    node_id, term, last_idx, last_term, pv = struct.unpack_from(
+        "!IQQQB", payload
+    )
+    return node_id, term, last_idx, last_term, bool(pv)
+
+
+def encode_peer_vote_reply(node_id: int, term: int, granted: bool,
+                           prevote: bool = False, **kw) -> bytes:
+    return encode_frame(
+        PEER_VOTE_REPLY,
+        struct.pack("!IQBB", node_id, term, 1 if granted else 0,
+                    1 if prevote else 0),
+        **kw,
+    )
+
+
+def decode_peer_vote_reply(payload: bytes) -> Tuple[int, int, bool, bool]:
+    _need(payload, 0, 14)
+    node_id, term, granted, pv = struct.unpack_from("!IQBB", payload)
+    return node_id, term, bool(granted), bool(pv)
+
+
+def _pack_entries(entries) -> bytes:
+    body = struct.pack("!H", len(entries))
+    for term, data in entries:
+        body += struct.pack("!Q", term) + _pb16(data)
+    return body
+
+
+def _unpack_entries(payload: bytes, off: int):
+    _need(payload, off, 2)
+    (n,) = struct.unpack_from("!H", payload, off)
+    off += 2
+    entries = []
+    for _ in range(n):
+        _need(payload, off, 8)
+        (term,) = struct.unpack_from("!Q", payload, off)
+        data, off = _ub16(payload, off + 8)
+        entries.append((term, data))
+    return entries, off
+
+
+def encode_peer_append(node_id: int, term: int, prev_idx: int,
+                       prev_term: int, commit: int, round_no: int = 0,
+                       entries=(), **kw) -> bytes:
+    """AppendEntries: consistency-checked at (prev_idx, prev_term),
+    ``commit`` is the leader's watermark. An empty batch is the
+    heartbeat. ``round_no`` is the leader's heartbeat-round counter,
+    echoed in the reply — a majority of echoes >= R certifies the
+    leader was still leader when round R was minted (the ReadIndex
+    confirmation, docs/READS.md, carried peer-to-peer)."""
+    body = struct.pack("!IQQQQQ", node_id, term, prev_idx, prev_term,
+                       commit, round_no) + _pack_entries(list(entries))
+    return encode_frame(PEER_APPEND, body, **kw)
+
+
+def decode_peer_append(payload: bytes):
+    _need(payload, 0, 44)
+    node_id, term, prev_idx, prev_term, commit, round_no = \
+        struct.unpack_from("!IQQQQQ", payload)
+    entries, _ = _unpack_entries(payload, 44)
+    return node_id, term, prev_idx, prev_term, commit, round_no, entries
+
+
+def encode_peer_append_reply(node_id: int, term: int, success: bool,
+                             match_idx: int, round_no: int = 0,
+                             **kw) -> bytes:
+    """``match_idx``: on success, the highest index now replicated on
+    the sender; on failure, the follower's last log index — the
+    conflict hint the leader rewinds ``next`` to (one round-trip per
+    divergent tail, not per entry). ``round_no`` echoes the append's
+    heartbeat round for ReadIndex certification."""
+    return encode_frame(
+        PEER_APPEND_REPLY,
+        struct.pack("!IQBQQ", node_id, term, 1 if success else 0,
+                    match_idx, round_no),
+        **kw,
+    )
+
+
+def decode_peer_append_reply(payload: bytes):
+    _need(payload, 0, 29)
+    node_id, term, ok, match_idx, round_no = struct.unpack_from(
+        "!IQBQQ", payload
+    )
+    return node_id, term, bool(ok), match_idx, round_no
+
+
+def encode_peer_snap_chunk(node_id: int, term: int, base: int,
+                           last_total: int, commit: int, entries=(),
+                           **kw) -> bytes:
+    """One bulk catch-up chunk: entries ``[base, base+len)`` of a
+    stream whose end is ``last_total`` (the PR-12 resumable contract
+    carried peer-to-peer: each PEER_SNAP_ACK names the floor, so a
+    stream cut by a kill resumes at the ack, not at zero)."""
+    body = struct.pack("!IQQQQ", node_id, term, base, last_total,
+                       commit) + _pack_entries(list(entries))
+    return encode_frame(PEER_SNAP_CHUNK, body, **kw)
+
+
+def decode_peer_snap_chunk(payload: bytes):
+    _need(payload, 0, 36)
+    node_id, term, base, last_total, commit = struct.unpack_from(
+        "!IQQQQ", payload
+    )
+    entries, _ = _unpack_entries(payload, 36)
+    return node_id, term, base, last_total, commit, entries
+
+
+def encode_peer_snap_ack(node_id: int, term: int, match_idx: int,
+                         **kw) -> bytes:
+    return encode_frame(
+        PEER_SNAP_ACK,
+        struct.pack("!IQQ", node_id, term, match_idx), **kw
+    )
+
+
+def decode_peer_snap_ack(payload: bytes) -> Tuple[int, int, int]:
+    _need(payload, 0, 20)
+    return struct.unpack_from("!IQQ", payload)

@@ -298,6 +298,39 @@ class RouterBackend:
         }
 
 
+class PeerBackend:
+    """The replica plane's server-side half (docs/CLUSTER.md): adapts a
+    ``cluster.node.RaftNode`` to the frame loop. Arms ``CAP_PEER`` and
+    the ``PEER_*`` kinds on the server it is attached to; a server
+    without one treats every peer frame as an unknown kind and closes —
+    the additive-capability contract, third time around.
+
+    Auth-before-anything: the first peer frame on a connection MUST be
+    ``PEER_HELLO`` with the cluster token; until it verifies, every
+    other peer kind is refused as a protocol error (the frame loop's
+    ERROR-and-close teardown). Peer frames are handled synchronously in
+    the reader task — pure host dict-ops, no device work — and replies
+    go back on the arrival connection."""
+
+    def __init__(self, node, auth=None):
+        self.node = node
+        self.auth = auth
+
+    def on_frame(self, conn, kind: int, payload: bytes):
+        if kind == P.PEER_HELLO:
+            peer_id, last_idx, token = P.decode_peer_hello(payload)
+            if self.auth is not None:
+                self.auth.verify(token)       # raises PeerAuthError
+            conn.peer_id = peer_id
+            return self.node.on_peer_hello(peer_id, last_idx)
+        if getattr(conn, "peer_id", None) is None and self.auth is not None:
+            raise P.ProtocolError("peer frame before PEER_HELLO auth")
+        return self.node.on_peer_frame(kind, payload)
+
+    def status_snapshot(self) -> dict:
+        return self.node.status()
+
+
 class _Conn:
     """One accepted connection's server-side state."""
 
@@ -309,6 +342,7 @@ class _Conn:
         self.decoder = P.FrameDecoder(max_frame_bytes)
         self.session: Dict[int, int] = {}
         self.caps = 0            # negotiated capability intersection
+        self.peer_id = None      # set by an authenticated PEER_HELLO
         self.bytes_in = 0
         self.bytes_out = 0
         self.open = True
@@ -390,6 +424,7 @@ class IngestServer:
         spans=None,
         pump=None,
         txn=None,
+        peer=None,
     ) -> None:
         self.backend = backend
         self.host = host
@@ -422,6 +457,11 @@ class IngestServer:
         #   the CAP_TXN capability bit; the pump's sweep phase polls
         #   in-flight transactions exactly like awaited writes (None =
         #   the server predates transactions byte-for-byte)
+        self.peer = peer
+        #   PeerBackend — arms the PEER_* frames and CAP_PEER: this
+        #   server is one replica of a multi-process cluster and its
+        #   port carries replica-to-replica traffic alongside clients
+        #   (None = clients only, peer frames are unknown kinds)
 
         self._server: Optional[asyncio.base_events.Server] = None
         self._pump_task: Optional[asyncio.Task] = None
@@ -568,6 +608,8 @@ class IngestServer:
                 speak = P.CAP_TRACE if self.spans is not None else 0
                 if self.txn is not None:
                     speak |= P.CAP_TXN
+                if self.peer is not None:
+                    speak |= P.CAP_PEER
                 conn.caps = caps & speak
                 entry_bytes, groups = self.backend.meta()
                 self._send(conn, P.encode_welcome(
@@ -613,6 +655,18 @@ class IngestServer:
                     req = _Req(conn, kind, req_id, b"", value=txn_id,
                                trace=trace)
                 self._count_request(P.KIND_NAMES[kind])
+            elif P.is_peer_kind(kind) and self.peer is not None:
+                # the replica plane: handled synchronously in the
+                # reader (pure host state transitions — the node's
+                # timers live on the ticker task and the pump's drive),
+                # replies written straight back on this connection. An
+                # auth failure raises PeerAuthError (a ProtocolError)
+                # into the handler below: ERROR + close, same teardown
+                # an unauthenticated prober gets for any bad frame.
+                self._count_request(P.KIND_NAMES[kind])
+                for reply in self.peer.on_frame(conn, kind, payload):
+                    self._send(conn, reply)
+                return
             else:
                 # a kind we do not speak means the peer is desynced or
                 # newer than us: per the protocol contract a
@@ -1131,3 +1185,6 @@ class IngestServer:
         if self.txn is not None:
             self.status_board.publish(self.txn.status_snapshot(),
                                       section="txn")
+        if self.peer is not None:
+            self.status_board.publish(self.peer.status_snapshot(),
+                                      section="cluster")

@@ -36,7 +36,12 @@ import os
 import sys
 from typing import Optional
 
-from raft_tpu.obs.blackbox import STALL_FORMAT, explain_journal, explain_stall
+from raft_tpu.obs.blackbox import (
+    STALL_FORMAT,
+    explain_journal,
+    explain_merged,
+    explain_stall,
+)
 from raft_tpu.obs.forensics import (
     BUNDLE_FORMAT,
     explain,
@@ -103,6 +108,12 @@ def _explain_any(path: str) -> str:
                 f"{path}: no .jsonl journals or stall bundles in directory"
             )
         parts = [explain_journal(journals)] if journals else []
+        if len(journals) > 1:
+            # 2+ journals in one directory = a multi-process run: the
+            # per-journal views above tell each process's story, the
+            # merged wall-clock view tells THE story (a kill -9 in the
+            # supervisor's journal next to the victim's last gasp)
+            parts.append(explain_merged(journals))
         return "\n\n".join(parts + stalls)
     if not os.path.exists(path):
         # read_journal forgives unreadable files (it must not choke on

@@ -435,6 +435,44 @@ def explain_journal(paths: Sequence[str]) -> str:
     return "\n".join(out)
 
 
+def explain_merged(paths: Sequence[str], limit: int = 400) -> str:
+    """The MERGED cross-process timeline: every mark from every journal
+    interleaved on the shared wall clock (``t`` — the one field
+    comparable across processes; ``mono`` restarts with each
+    incarnation and never crosses a pid). This is the forensics view a
+    multi-process drill needs — ``kill -9`` lands in the supervisor's
+    journal, the last gasp in the victim's, the re-election in a
+    peer's, and only side by side do they read as one story. Each line
+    carries its offset from the EARLIEST mark across all journals plus
+    the owning process (``proc[pid]``), so an incarnation change shows
+    up as the same proc under a new pid. ``limit`` caps the render from
+    the tail (the interesting end of a crashed run), with an elision
+    line saying how many earlier marks were folded."""
+    recs: List[dict] = []
+    for path in paths:
+        recs.extend(read_journal(path))
+    recs = [r for r in recs if "t" in r]
+    if not recs:
+        return "no marks in any journal"
+    recs.sort(key=lambda r: (r.get("t", 0.0), r.get("pid", 0),
+                             r.get("seq", 0)))
+    t0 = recs[0]["t"]
+    out = [f"merged timeline ({len(recs)} marks, "
+           f"{len(set((r.get('proc'), r.get('pid')) for r in recs))} "
+           f"process incarnations):"]
+    if len(recs) > limit:
+        out.append(f"  ... {len(recs) - limit} earlier marks elided")
+        recs = recs[-limit:]
+    width = max(len(str(r.get("proc"))) for r in recs)
+    for r in recs:
+        out.append(
+            f"  +{r['t'] - t0:9.3f}s  "
+            f"{str(r.get('proc')):<{width}} [{r.get('pid')}]  "
+            f"{r.get('phase')}{_fmt_fields(r)}"
+        )
+    return "\n".join(out)
+
+
 def explain_stall(bundle: dict) -> str:
     """The stall bundle's failure story: who stalled, in which phase,
     the journal tail leading up to it, and every thread's stack."""

@@ -48,6 +48,21 @@ storage — the stand-in for the deployment's supervisor or config service
   ``reform`` falls through to ``request_join`` instead of proposing
   epochs the members will never join.
 
+**Death certificates (round 17)**: staleness is a GUESS — the detector
+cannot distinguish a dead process from a slow one, which is why the
+reform loop pays a settle window before proposing. A supervisor that
+reaped the process (``waitpid`` after ``kill -9``) has POSITIVE
+evidence, and the cluster tier's :class:`ClusterSupervisor` owns
+exactly that evidence. ``declare_dead`` publishes it as a write-once
+``dead-{pid}.json`` certificate stamped with the victim's last
+published ``beat``; ``fresh_peers`` excludes certified pids
+immediately (no staleness wait), and ``reform`` skips the settle
+window entirely when every missing member is certified — reformation
+driven by real process death converges in one poll instead of
+``stall_s + settle``. The certificate self-heals: a heartbeat whose
+``beat`` PROGRESSES past the certified beat proves the declaration
+stale (false positive, pid reuse) and retires the file.
+
 **Failure detector (single-clock-domain)**: heartbeat freshness is
 derived from per-writer stamp *progression*, observed entirely on the
 OBSERVER's monotonic clock (ADVICE r5 #1). Each heartbeat carries a
@@ -195,7 +210,59 @@ class Rendezvous:
                 out[pid] = hb
             elif now - seen[1] <= stale_s:
                 out[pid] = hb                     # unchanged but recent
+        # positive evidence overrides recency: a certified-dead peer is
+        # out NOW (no staleness wait) — unless its beat progressed past
+        # the certificate, which proves the declaration stale
+        for pid, cert in self.declared_dead().items():
+            hb = out.get(pid)
+            if (hb is not None and cert.get("beat") is not None
+                    and (hb.get("beat") or 0) > cert["beat"]):
+                self.clear_dead(pid)              # false positive: retire
+            else:
+                out.pop(pid, None)
         return out
+
+    # ---- death certificates (positive evidence) ------------------------
+    def declare_dead(self, pid: int, evidence: str = "waitpid") -> None:
+        """Publish positive death evidence for member ``pid`` (module
+        doc, death certificates): the caller REAPED the process or
+        otherwise knows it is gone — not a staleness guess. Stamped
+        with the victim's last published ``beat`` so a later heartbeat
+        that progresses past it can prove the certificate stale."""
+        hb = None
+        try:
+            hb = json.load(open(os.path.join(self.root,
+                                             f"hb-{pid}.json")))
+        except (OSError, json.JSONDecodeError):
+            pass
+        path = os.path.join(self.root, f"dead-{pid}.json")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"time": time.time(), "evidence": evidence,
+                       "beat": None if hb is None else hb.get("beat"),
+                       "by": self.pid}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        blackbox.mark("declare_dead", rv_pid=self.pid, dead=pid,
+                      evidence=evidence)
+
+    def declared_dead(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for f in os.listdir(self.root):
+            if f.startswith("dead-") and f.endswith(".json"):
+                try:
+                    out[int(f[5:-5])] = json.load(
+                        open(os.path.join(self.root, f)))
+                except (OSError, ValueError):
+                    continue
+        return out
+
+    def clear_dead(self, pid: int) -> None:
+        try:
+            os.unlink(os.path.join(self.root, f"dead-{pid}.json"))
+        except FileNotFoundError:
+            pass
 
     # ---- epochs --------------------------------------------------------
     def latest_epoch(self) -> Optional[Epoch]:
@@ -383,13 +450,21 @@ class Rendezvous:
             key = tuple(sorted(fresh))
             if key != seen:
                 seen, seen_at = key, time.monotonic()
+            # death-driven short-circuit: when every missing member is
+            # covered by a death certificate, the survivor set is not a
+            # guess that needs to hold still — it is reaped fact, and
+            # the settle window would only delay recovery
+            missing = set(cur.members) - set(fresh)
+            certified = missing and missing <= set(self.declared_dead())
+            settle = 0.0 if certified else settle_s
             if (
                 self.is_coordinator(fresh, cur.members)
-                and time.monotonic() - seen_at >= settle_s
+                and time.monotonic() - seen_at >= settle
             ):
                 blackbox.mark("reform_propose", rv_pid=self.pid,
                               next_epoch=cur.n + 1,
-                              survivors=sorted(fresh))
+                              survivors=sorted(fresh),
+                              death_driven=bool(certified))
                 self.propose_next_epoch(cur, fresh, list(joiners))
             time.sleep(0.5)
         raise TimeoutError(f"pid {self.pid}: re-formation stalled")

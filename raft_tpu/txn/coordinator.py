@@ -49,7 +49,7 @@ from typing import Dict, List, Optional
 
 from raft_tpu.admission.gate import Overloaded
 from raft_tpu.admission.retry import Backoff, RetryBudget
-from raft_tpu.multi.engine import NotLeader
+from raft_tpu.multi.engine import NotLeader, ReadLagging
 from raft_tpu.txn import ops as T
 
 _UNSET = object()
@@ -119,7 +119,8 @@ class TxnCoordinator:
 
     def __init__(self, store, decision_group: int = 0,
                  ttl_s: Optional[float] = None, coord_id: int = 0,
-                 broken: Optional[str] = None):
+                 broken: Optional[str] = None,
+                 lease_reads: bool = False):
         self.store = store
         self.router = store.router
         self.engine = store.engine
@@ -140,6 +141,38 @@ class TxnCoordinator:
         self.aborted = 0
         self.lock_conflicts = 0
         self.ttl_resolved = 0
+        self.lease_reads = lease_reads
+        self.read_certs: Dict[str, int] = {"lease": 0, "read_index": 0}
+
+    # --------------------------------------------------- validated reads
+    def validated_read(self, key: bytes) -> Optional[bytes]:
+        """Basis read for a transaction's ``expects``: certify the read
+        index on the key's group through the participant leader's
+        certified path — ZERO quorum rounds when that leader holds a
+        valid lease (the read-plane fast path, ``cfg.read_lease``), one
+        classic ReadIndex quorum round otherwise — then serve from
+        applied state at or past the certified index. The expect a
+        transaction later validates under its lock is thereby anchored
+        to a LINEARIZABLE observation, not a maybe-stale applied map.
+
+        With ``lease_reads=False`` (the default) this degrades to the
+        plain applied read so callers need no branching; armed, it
+        raises ``NotLeader`` / ``ReadLagging`` exactly like the router
+        reads (typed, retryable) and counts each certification class in
+        ``read_certs``."""
+        if not self.lease_reads:
+            return self.store.get(key)
+        g = self.router.group_of(key)
+        idx, cert = self.engine.certified_read_index(g)
+        self.read_certs[cert] = self.read_certs.get(cert, 0) + 1
+        self.engine.note_read_class(g, cert)
+        applied = self.store.last_applied[g]
+        if applied < idx:
+            raise ReadLagging(
+                g, None, idx - applied,
+                retry_after_s=self.engine.cfg.heartbeat_period,
+            )
+        return self.store.get(key)
 
     # ----------------------------------------------------------- allocate
     def allocate(self) -> int:
@@ -430,6 +463,8 @@ class TxnCoordinator:
             "decision_group": self.decision_group,
             "ttl_s": self.ttl_s,
         }
+        if self.lease_reads:
+            out["read_certs"] = dict(self.read_certs)
         out.update(self.store.lock_stats())
         return out
 

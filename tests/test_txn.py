@@ -503,6 +503,10 @@ class TestDrill:
         assert rep.committed >= 1 and rep.aborted >= 0
         assert rep.moves and len(rep.nemeses) == 3
         assert rep.unresolved == 0
+        # the seed-7 commit digest, cross-pinned by the lease-reads
+        # equivalence test (tests/test_cluster.py): the lease run must
+        # reproduce THIS digest without re-running the plain drill
+        assert rep.commit_digest == "6961c982"
 
     @pytest.mark.parametrize("broken", ["txn_partial_commit",
                                         "txn_dirty_read"])

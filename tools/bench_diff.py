@@ -118,6 +118,15 @@ GATED_METRICS: Dict[str, str] = {
     "txn_p50_ms": "down",
     "txn_p99_ms": "down",
     "txn_goodput_eps": "up",
+    # cluster leg (round 17): the 3-process deployed goodput gates UP
+    # and the restart economics gate DOWN — handoff_ratio is
+    # restart-with-manifest-adoption time over wiped-dir re-seal time,
+    # so a regression means the durable handoff started redoing work.
+    # cluster_vs_singleproc and the kill row's shed split are REPORTED
+    # UNGATED (deployment-shaped, not regression axes); e2e_p99_ms on
+    # the kill row rides the existing macro gate.
+    "cluster_goodput_eps": "up",
+    "handoff_ratio": "down",
 }
 
 
