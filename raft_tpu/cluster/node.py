@@ -17,24 +17,42 @@ Design decisions, and why:
   child's ticker task and by the ingest pump's ``drive``. One replica
   per process means the per-replica work is a handful of dict ops per
   frame — the wire, not the CPU, is the bound.
-- **The log is a list; durability is the tiered store.** The
-  authoritative log (including the uncommitted tail) is a RAM list of
-  ``(term, record)``; every COMMITTED entry is mirrored into a
-  :class:`TieredStore` rooted in the node's data dir, whose sweep
-  seals cold segments to disk as RS-coded shards. ``kill -9`` loses
-  the RAM tail by construction — recovery is Raft's job, not fsync's:
-  a restarted node adopts the prior generation's sealed segments by
-  manifest (``adopt=True`` — zero re-seals, the PR-12 remainder),
-  replays them into the KV, and asks the leader for the rest via the
-  resumable catch-up stream, which resumes from the adopted floor
-  because ``PEER_HELLO`` carries it.
+- **The log is a list; acked entries are WAL'd; cold history is the
+  tiered store.** The authoritative log (including the uncommitted
+  tail) is a RAM list of ``(term, record)``, but every entry this
+  node ever lets a QUORUM count — entries a follower acknowledges in
+  an append reply, entries the leader counts as its own quorum
+  member — is first appended to a flat write-ahead log
+  (``wal.log``, flushed and fsynced per frame) in the node's data
+  dir. Raft's commit safety assumes voters keep their acked log
+  across restarts; without the WAL a single ``kill -9`` of one
+  replica could roll an acked quorum back below a committed entry
+  and elect a leader missing a client-acked write. Every COMMITTED
+  entry is additionally mirrored into a :class:`TieredStore`, whose
+  sweep seals cold segments to disk as RS-coded shards; the WAL is
+  rotated down to the unsealed suffix as sealing advances, so it
+  stays one hot-tier long. A restarted node adopts the prior
+  generation's sealed segments by manifest (``adopt=True`` — zero
+  re-seals, the PR-12 remainder), replays them into the KV, replays
+  the WAL suffix into the LOG (not the KV: the commit watermark is
+  re-derived from leader contact, never guessed), and streams any
+  remainder via the resumable catch-up stream, which resumes from
+  the sealed floor because ``PEER_HELLO`` carries it.
 - **ReadIndex over heartbeat rounds.** Every append carries the
   leader's ``round_no``; followers echo it. A linearizable read mints
-  a ticket pinned at (commit, round+1); a majority of echoes at or
-  past that round certifies leadership after the ticket was minted —
-  the same confirmation rule as docs/READS.md, carried peer-to-peer.
-  A leader holding a fresh majority (``lease_s`` of ack recency, the
-  PR-13 lease shape) serves reads with zero waiting.
+  a ticket pinned at (commit, round+1); a majority of SUCCESSFUL
+  echoes at or past that round certifies leadership after the ticket
+  was minted — the same confirmation rule as docs/READS.md, carried
+  peer-to-peer. A leader holding a fresh majority serves reads with
+  zero waiting; the lease clock runs from the SEND time of the acked
+  round (never reply arrival, so RTT cannot stretch the window), and
+  the lease bound itself rests on vote stickiness: a follower in
+  live leader contact ignores RequestVote for the minimum election
+  timeout (§4.2.3), so no rival can be elected inside a lease whose
+  duration is clamped strictly below that timeout. Neither leases
+  nor ReadIndex tickets are honored until an entry of the leader's
+  CURRENT term has committed (the §6.4 / §8 fresh-leader rule): a
+  new leader's commit may lag writes its predecessor already acked.
 - **Partitions are deny-lists.** The process nemesis writes
   ``ctrl-<id>.json`` (``{"deny": [peer ids]}``) into the node dir; the
   node polls it each tick and drops matching traffic both ways. No
@@ -55,13 +73,17 @@ import struct
 import time
 from typing import Dict, List, Optional, Tuple
 
-from raft_tpu.ckpt.tiered import TieredStore
-from raft_tpu.multi.engine import NotLeader
+from raft_tpu.ckpt.tiered import TieredStore, _atomic_write
+from raft_tpu.multi.engine import NotLeader, ReadLagging
 from raft_tpu.net import protocol as P
 from raft_tpu.net.server import _Done, _Pending
 from raft_tpu.obs import blackbox
 
 REC_BYTES = 64
+
+# wal.log record: kind (1 = append) | index | term | REC_BYTES payload
+_WAL_REC = struct.Struct("!BQI")
+_WAL_APPEND = 1
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
@@ -109,6 +131,7 @@ class RaftNode:
         hot_entries: int = 256,
         segment_entries: int = 64,
         seed: Optional[int] = None,
+        wal_fsync: bool = True,
     ):
         self.node_id = node_id
         self.peers = dict(peers)
@@ -118,7 +141,12 @@ class RaftNode:
         os.makedirs(data_dir, exist_ok=True)
         self.hb_s = heartbeat_s
         self.timeout_base = election_timeout_s
-        self.lease_s = lease_s if lease_s is not None else 4 * heartbeat_s
+        # the lease is only sound strictly inside the vote-stickiness
+        # window (the MINIMUM election timeout, measured from append
+        # SEND time) — clamp rather than trust configuration
+        want_lease = lease_s if lease_s is not None else 4 * heartbeat_s
+        self.lease_s = min(want_lease, 0.8 * election_timeout_s)
+        self.wal_fsync = wal_fsync
         self.max_append = max_append
         self.snap_chunk = snap_chunk
         self.snap_threshold = (snap_threshold if snap_threshold is not None
@@ -144,6 +172,10 @@ class RaftNode:
         self.kv: Dict[bytes, bytes] = {}
         self.commit = 0
         self.applied = 0
+        self._wal_path = os.path.join(data_dir, "wal.log")
+        self._wal_f = None       # opened by _wal_rewrite in replay
+        self._wal_hi = 0         # highest index durable in the WAL
+        self._wal_records = 0    # records in the file (rotation clock)
         self._replay_adopted()
 
         now = time.monotonic()
@@ -158,7 +190,11 @@ class RaftNode:
         self.match_idx: Dict[int, int] = {}
         self.hb_round = 0
         self.peer_round: Dict[int, int] = {}     # highest echoed round
-        self.ack_at: Dict[int, float] = {}       # last successful ack
+        self.ack_at: Dict[int, float] = {}       # SEND time of the
+        #   freshest successfully acked round per peer — the lease
+        #   clock runs from when the append left, not when the reply
+        #   arrived, so RTT can only SHRINK the lease, never stretch it
+        self._round_sent: Dict[int, float] = {}  # round -> send stamp
         self.last_hb = 0.0
         self.snap_mode: set = set()              # peers in catch-up stream
         self._snap_sent: Dict[int, float] = {}   # last chunk send time
@@ -166,6 +202,7 @@ class RaftNode:
         self._dirty = False      # un-broadcast appended entries exist
         self._reads: Dict[int, Tuple[int, int, bytes]] = {}
         self._next_ticket = 1
+        self._submit_terms: Dict[int, int] = {}  # seq -> term at submit
         self.stats: Dict[str, int] = {
             "elections": 0, "terms_won": 0, "appends_in": 0,
             "appends_out": 0, "snap_chunks_in": 0, "snap_chunks_out": 0,
@@ -177,8 +214,6 @@ class RaftNode:
         return os.path.join(self.data_dir, "vote.json")
 
     def _persist_vote(self) -> None:
-        from raft_tpu.ckpt.tiered import _atomic_write
-
         _atomic_write(self._vote_path(), json.dumps({
             "term": self.term, "voted_for": self.voted_for,
             "generation": self.generation,
@@ -196,10 +231,21 @@ class RaftNode:
         self._persist_vote()
 
     def _replay_adopted(self) -> None:
-        """Rebuild log + KV from the adopted sealed prefix. Entries past
-        ``sealed_hi`` died with the previous process — the catch-up
-        stream re-replicates them, which is safe precisely because only
-        COMMITTED entries were ever mirrored to the store."""
+        """Rebuild log + KV from the adopted sealed prefix, then the
+        log (NOT the KV) from the WAL suffix.
+
+        The sealed prefix is committed by construction (only committed
+        entries are ever mirrored to the store), so it replays into
+        both log and KV and sets the commit/applied floor. The WAL
+        holds every entry this node ever let a quorum count — acked
+        appends, the leader's own quorum share — including entries
+        that were still uncommitted at the kill: those replay into the
+        LOG ONLY, with replace semantics for logged conflict
+        truncations, and the commit watermark is re-derived from
+        leader contact. This is the invariant Raft's commit safety
+        stands on: a voter's acked log survives restart, so a restart
+        can never roll a commit quorum back below a client-acked
+        entry."""
         hi = self.store._sealed_hi
         for i in range(1, hi + 1):
             got = self.store.get(i)
@@ -212,7 +258,77 @@ class RaftNode:
                 self.kv[kvv[0]] = kvv[1]
             self.commit = self.applied = i
         self.log = self.log[: self.commit]
+        for idx, term, rec in self._wal_scan():
+            if idx <= self.commit:
+                continue               # sealed prefix is authoritative
+            if idx > self.last_idx + 1:
+                break                  # torn tail: stream re-replicates
+            if idx <= self.last_idx:
+                del self.log[idx - 1:]     # a logged truncation
+            self.log.append((term, rec))
         self.store.apply_cursor = self.applied
+        # normalize: drop stale replace records and any torn tail, and
+        # leave an open append handle at the live suffix
+        self._wal_rewrite(self.commit)
+
+    # ------------------------------------------------- write-ahead log
+    def _wal_scan(self):
+        """Yield ``(idx, term, rec)`` append records; stops at the
+        first torn or unknown record (a crash mid-write loses at most
+        the record being written — which was never acked)."""
+        try:
+            with open(self._wal_path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return
+        off, step = 0, _WAL_REC.size + REC_BYTES
+        while off + step <= len(blob):
+            kind, idx, term = _WAL_REC.unpack_from(blob, off)
+            if kind != _WAL_APPEND:
+                return
+            yield idx, term, blob[off + _WAL_REC.size: off + step]
+            off += step
+
+    def _wal_rewrite(self, keep_above: int) -> None:
+        """Rewrite the WAL to exactly ``log[keep_above:]`` (atomic),
+        then reopen for appending — the rotation and the restart
+        normalization share this path."""
+        if self._wal_f is not None:
+            self._wal_f.close()
+        blob = b"".join(
+            _WAL_REC.pack(_WAL_APPEND, i, self.log[i - 1][0])
+            + self.log[i - 1][1]
+            for i in range(keep_above + 1, self.last_idx + 1)
+        )
+        _atomic_write(self._wal_path, blob)
+        self._wal_f = open(self._wal_path, "ab")
+        if self.wal_fsync:
+            os.fsync(self._wal_f.fileno())
+        self._wal_records = self.last_idx - keep_above
+        self._wal_hi = self.last_idx
+
+    def _wal_extend(self, upto: int) -> None:
+        """Make ``log[.. upto]`` WAL-durable — called BEFORE any reply
+        or quorum count rides on those entries. One flush+fsync per
+        call (per frame / per broadcast), not per entry."""
+        if upto <= self._wal_hi:
+            return
+        self._wal_f.write(b"".join(
+            _WAL_REC.pack(_WAL_APPEND, i, self.log[i - 1][0])
+            + self.log[i - 1][1]
+            for i in range(self._wal_hi + 1, upto + 1)
+        ))
+        self._wal_records += upto - self._wal_hi
+        self._wal_hi = upto
+        self._wal_f.flush()
+        if self.wal_fsync:
+            os.fsync(self._wal_f.fileno())
+        # rotation: sealing moved the durable floor up — shed the
+        # sealed prefix (and accumulated replace records) once the
+        # file is mostly history
+        sealed = self.store._sealed_hi
+        if self._wal_records > 2 * max(1, self.last_idx - sealed) + 256:
+            self._wal_rewrite(sealed)
 
     # -------------------------------------------------------- log helpers
     @property
@@ -286,6 +402,7 @@ class RaftNode:
         self.hb_round = 0
         self.peer_round = {p: 0 for p in self.others}
         self.ack_at = {}
+        self._round_sent = {}
         self.snap_mode = set()
         self._snap_sent = {}
         blackbox.mark("leader_won", node=self.node_id, term=self.term)
@@ -310,6 +427,15 @@ class RaftNode:
                            ) -> None:
         self.last_hb = now
         self.hb_round += 1
+        # the round's send stamp: a successful echo of round R proves
+        # the follower's election timer was reset no earlier than this
+        # moment, so lease recency is measured from here (reply RTT
+        # can only make the lease MORE conservative)
+        self._round_sent[self.hb_round] = now
+        self._round_sent.pop(self.hb_round - 4096, None)
+        # the leader is a quorum member too: its own log share must be
+        # WAL-durable before any follower ack can complete a commit
+        self._wal_extend(self.last_idx)
         for p in self.others:
             if p in self.snap_mode:
                 # the stream paces itself on acks — but a chunk (or its
@@ -359,7 +485,10 @@ class RaftNode:
             return
         matches = sorted(
             [self.match_idx.get(p, 0) for p in self.others]
-            + [self.last_idx],
+            # the leader's own quorum share is its WAL-DURABLE floor,
+            # not its RAM tail: an entry submitted but not yet
+            # broadcast (hence not yet fsynced) must not count
+            + [min(self.last_idx, self._wal_hi)],
             reverse=True,
         )
         n = matches[self.majority - 1]
@@ -382,9 +511,14 @@ class RaftNode:
 
     # --------------------------------------------------------- lease math
     def _quorum_recency(self, now: float) -> float:
-        """Age of the freshest MAJORITY of append acks (self counts as
-        age 0) — the lease clock: below ``lease_s`` the leader provably
-        led within the window."""
+        """Age of the freshest MAJORITY of successful append acks,
+        measured from the SEND time of each acked round (self counts
+        as age 0). Below ``lease_s`` — clamped under the minimum
+        election timeout — every member of that majority had its
+        election timer reset inside the stickiness window, so no rival
+        leader can have been elected: any vote quorum intersects this
+        ack quorum, and the intersection refuses votes (``_on_vote``)
+        until at least ``timeout_base`` past its timer reset."""
         ages = sorted(now - self.ack_at.get(p, -1e9) for p in self.others)
         return ages[self.majority - 2] if self.majority >= 2 else 0.0
 
@@ -439,6 +573,17 @@ class RaftNode:
 
     def _on_vote(self, payload: bytes, now: float) -> List[bytes]:
         cand, term, last_idx, last_term, _pv = P.decode_peer_vote(payload)
+        if (self.role == FOLLOWER and self.leader_id is not None
+                and now - self.last_heard < self.timeout_base):
+            # §4.2.3 stickiness: a follower in live leader contact
+            # ignores RequestVote outright — no term bump, no grant.
+            # This is the other half of the lease bound (see
+            # _quorum_recency): without it, a long-partitioned peer
+            # could be elected by followers the leaseholder acked
+            # moments ago, and a lease read would race the new
+            # leader's first write
+            return [P.encode_peer_vote_reply(self.node_id, self.term,
+                                             False)]
         if term > self.term:
             self._step_down(term, now)
         up_to_date = (last_term, last_idx) >= (
@@ -487,10 +632,18 @@ class RaftNode:
                 if self.log[idx - 1][0] == ent_term:
                     continue
                 del self.log[idx - 1:]       # conflict: truncate suffix
+                self._wal_hi = min(self._wal_hi, idx - 1)
             self.log.append((ent_term, rec))
         match = prev_idx + len(entries)
+        # durable BEFORE the ack: the reply lets the leader count this
+        # log into a commit quorum, so it must survive our kill -9
+        self._wal_extend(self.last_idx)
         if commit > self.commit:
-            self.commit = min(commit, self.last_idx)
+            # clamp to the last entry THIS append validated, not
+            # last_idx: a retained tail past `match` has not been
+            # term-checked against the leader yet (§5.3's "index of
+            # last new entry" rule)
+            self.commit = min(commit, match)
             self._apply_committed()
         return [P.encode_peer_append_reply(
             self.node_id, self.term, True, match, round_no)]
@@ -503,10 +656,20 @@ class RaftNode:
             return []
         if self.role != LEADER or term != self.term:
             return []
-        self.ack_at[follower] = now
-        if round_no > self.peer_round.get(follower, 0):
-            self.peer_round[follower] = round_no
         if ok:
+            # leadership evidence (lease clock, ReadIndex round
+            # certification) rides SUCCESSFUL replies only — a
+            # log-mismatch reply proves nothing about what the
+            # follower accepted — and the lease clock records the
+            # SEND stamp of the acked round, so reply latency can
+            # never stretch the window past a partitioned peer's
+            # earliest legal election
+            sent = self._round_sent.get(round_no)
+            if sent is not None and sent > self.ack_at.get(
+                    follower, -1e9):
+                self.ack_at[follower] = sent
+            if round_no > self.peer_round.get(follower, 0):
+                self.peer_round[follower] = round_no
             if match_idx > self.match_idx.get(follower, 0):
                 self.match_idx[follower] = match_idx
             self.next_idx[follower] = max(
@@ -525,18 +688,40 @@ class RaftNode:
             return []
         self._step_down(term, now)
         self.leader_id = leader
-        if base != self.last_idx + 1:
-            # not the chunk we need (stale retry): re-ack our floor so
-            # the stream resumes from the right base
+        if base > self.last_idx + 1:
+            # a gap (we restarted mid-stream and lost the RAM tail):
+            # re-ack the COMMITTED floor — committed entries are the
+            # only prefix guaranteed to match the leader's log, so
+            # that is the largest match we may claim unvalidated
             return [P.encode_peer_snap_ack(self.node_id, self.term,
-                                           self.last_idx)]
+                                           self.commit)]
+        # the chunk overlaps (or extends) our log: term-check the
+        # overlap exactly like AppendEntries. A follower whose log
+        # extends past the base with a deposed leader's uncommitted
+        # tail must truncate at the first conflicting term — never
+        # re-ack that tail as matched
+        idx = base - 1
         for ent_term, rec in entries:
+            idx += 1
+            if idx <= self.last_idx:
+                if self.log[idx - 1][0] == ent_term:
+                    continue
+                del self.log[idx - 1:]       # conflict: truncate suffix
+                self._wal_hi = min(self._wal_hi, idx - 1)
             self.log.append((ent_term, rec))
+        validated = base - 1 + len(entries)
+        # durable BEFORE the ack (the leader treats snap acks as
+        # authoritative match — a quorum count may ride on this)
+        self._wal_extend(self.last_idx)
         if commit > self.commit:
-            self.commit = min(commit, self.last_idx)
+            # clamp to the chunk's end: a retained tail past it has
+            # not been term-checked against the leader yet
+            self.commit = min(commit, validated)
             self._apply_committed()
+        # the ack claims exactly the VALIDATED prefix, never a raw
+        # last_idx that may include an unchecked suffix
         return [P.encode_peer_snap_ack(self.node_id, self.term,
-                                       self.last_idx)]
+                                       max(validated, self.commit))]
 
     def _on_snap_ack(self, payload: bytes, now: float) -> List[bytes]:
         follower, term, match_idx = P.decode_peer_snap_ack(payload)
@@ -545,7 +730,9 @@ class RaftNode:
             return []
         if self.role != LEADER or term != self.term:
             return []
-        self.ack_at[follower] = now
+        # no ack_at refresh here: snap acks carry no round number, so
+        # there is no send stamp to clock a lease from — a streaming
+        # peer contributes catch-up progress, not lease evidence
         if follower in self.snap_mode:
             # a snap ack carries the follower's literal last_idx — it
             # is AUTHORITATIVE, downward included: a follower that
@@ -598,11 +785,27 @@ class RaftNode:
         if self.role != LEADER:
             raise NotLeader(0, "not the leader")
         self.log.append((self.term, pack_record(key, value)))
+        # remember WHICH entry was promised at this index: durability
+        # must later be certified for this term's entry, not whatever
+        # a successor leader committed at the same index
+        self._submit_terms[self.last_idx] = self.term
         self._dirty = True       # next tick broadcasts without waiting
         return 0, self.last_idx
 
     def is_durable(self, group: int, seq: int) -> bool:
-        return self.commit >= seq
+        want = self._submit_terms.get(seq)
+        if want is not None and (seq > self.last_idx
+                                 or self.term_at(seq) != want):
+            # the submitted entry was truncated or replaced across a
+            # leadership change: it can never commit, and `commit >=
+            # seq` now certifies a DIFFERENT entry — acking it would
+            # be a durability lie to the client
+            self._submit_terms.pop(seq, None)
+            raise NotLeader(0, "entry lost to a leadership change")
+        if self.commit >= seq:
+            self._submit_terms.pop(seq, None)
+            return True
+        return False
 
     def commit_floor(self, group: int) -> int:
         return self.commit
@@ -613,13 +816,18 @@ class RaftNode:
         if cls == "session":
             floor = session.get(0, 0)
             if self.applied < floor:
-                from raft_tpu.multi.engine import ReadLagging
-
                 raise ReadLagging(0, None, floor - self.applied,
                                   retry_after_s=self.hb_s)
             return _Done(0, self.applied, "session", self.kv.get(key))
         if self.role != LEADER:
             raise NotLeader(0, "reads need the leader")
+        if self.term_at(self.commit) != self.term:
+            # fresh leader: until an entry of THIS term commits, the
+            # commit watermark may lag writes the previous leader
+            # already acked — a read pinned here could miss them (the
+            # ReadIndex precondition, §6.4 / §8). The leadership noop
+            # commits within a round; the client retries after it
+            raise ReadLagging(0, None, 1, retry_after_s=self.hb_s)
         if self.has_lease(now):
             self.stats["reads_lease"] += 1
             return _Done(0, self.applied, "lease", self.kv.get(key))
@@ -653,6 +861,7 @@ class RaftNode:
             "node": self.node_id, "role": self.role, "term": self.term,
             "leader": self.leader_id, "commit": self.commit,
             "applied": self.applied, "last_idx": self.last_idx,
+            "wal_hi": self._wal_hi,
             "generation": self.generation,
             "tier": self.store.tier_summary(),
             **{k: v for k, v in self.stats.items()},

@@ -441,9 +441,12 @@ class IngestServer:
         )
         #   VIRTUAL-clock bound on an in-flight op. A queued entry
         #   dropped across a leadership change never acks durable and
-        #   its loss is not cheaply provable, so an expired WRITE is
-        #   answered with ERROR ("outcome unknown") — the one wire
-        #   response that is not a typed no-effect refusal. Expired
+        #   its loss is not always cheaply provable, so an expired
+        #   WRITE is answered with ERROR ("outcome unknown") — the one
+        #   wire response that is not a typed no-effect refusal. A
+        #   backend that CAN prove the loss (RaftNode's term-checked
+        #   is_durable raises NotLeader) gets the typed refusal from
+        #   the sweep instead of waiting out the timeout. Expired
         #   READS provably served nothing and map to NOT_LEADER.
         self.registry = registry
         self.status_board = status_board
@@ -939,8 +942,17 @@ class IngestServer:
     # ------------------------------------------------------- completions
     def _sweep_completions(self) -> None:
         now = self.backend.now()
-        done = [key for key, req in self._awaiting_writes.items()
-                if self.backend.is_durable(*key)]
+        done: List[Tuple[int, int]] = []
+        lost: List[Tuple[int, int]] = []
+        for key in self._awaiting_writes:
+            try:
+                if self.backend.is_durable(*key):
+                    done.append(key)
+            except NotLeader:
+                # the backend certifies the entry at seq is no longer
+                # THIS request's entry (superseded across a leadership
+                # change): provably never durable
+                lost.append(key)
         for g, seq in done:
             req = self._awaiting_writes.pop((g, seq))
             if isinstance(req, _Batch):
@@ -955,6 +967,34 @@ class IngestServer:
                 req.req_id, g, seq, floor, trace=self._rtrace(req),
             ))
             self.responses_total += 1
+        for key in lost:
+            req = self._awaiting_writes.pop(key, None)
+            if req is None:
+                continue
+            if isinstance(req, _Batch):
+                # one lost member poisons the whole batch: sibling
+                # entries may already be durable, so neither OK_BATCH
+                # nor a no-effect NOT_LEADER would be honest — ERROR,
+                # like the expired path
+                for k2 in [k for k, r in self._awaiting_writes.items()
+                           if r is req]:
+                    del self._awaiting_writes[k2]
+                if req.span is not None and not req.span.terminal:
+                    req.span.finish("info", now)
+                if req.conn.open:
+                    self._send(req.conn, P.encode_error(
+                        req.req_id,
+                        "write lost: entry superseded across a "
+                        "leadership change",
+                        trace=self._rtrace(req),
+                    ))
+                    self.responses_total += 1
+            elif req.conn.open:
+                # single write: provably no effect — the typed refusal
+                # with a redial hint, exactly as if submit had refused
+                self._not_leader(req, key[0])
+            else:
+                self._finish_span(req, "info")
         expired = [key for key, req in self._awaiting_writes.items()
                    if now - req.t_in > self.op_timeout_s
                    or not req.conn.open]

@@ -201,6 +201,317 @@ class TestDeathCertificates:
         assert rv.declared_dead() == {}
 
 
+# -------------------------------------------- consensus-safety pins
+# Review-hardening round: each test here pins one safety argument of
+# the host-level RaftNode — the snap stream's conflict handling, the
+# term-checked durability ack, the fresh-leader read gate, the lease
+# clock, vote stickiness, and the append-ack WAL.
+
+PEERS3 = {i: f"127.0.0.1:{7400 + i}" for i in range(3)}
+
+
+def _node(tmp_path, node_id=1, **kw):
+    from raft_tpu.cluster.node import RaftNode
+
+    kw.setdefault("heartbeat_s", 0.01)
+    kw.setdefault("election_timeout_s", 0.05)
+    kw.setdefault("segment_entries", 8)
+    kw.setdefault("hot_entries", 16)
+    return RaftNode(node_id, PEERS3, str(tmp_path / f"n{node_id}"), **kw)
+
+
+def _rec(key: bytes, value: bytes) -> bytes:
+    from raft_tpu.cluster.node import pack_record
+
+    return pack_record(key, value)
+
+
+class TestSnapStreamConflicts:
+    def test_chunk_truncates_conflicting_uncommitted_tail(self, tmp_path):
+        """A follower whose log extends past the chunk base with a
+        deposed leader's tail must term-check the overlap and truncate
+        the conflicting suffix — never re-ack its stale last_idx as
+        matched (that ack is authoritative match at the leader)."""
+        n = _node(tmp_path)
+        n.log = [(1, _rec(b"a", b"1")),
+                 (2, _rec(b"b", b"stale")),      # deposed leader's tail
+                 (2, _rec(b"c", b"stale"))]
+        n.commit = n.applied = 1
+        n.kv = {b"a": b"1"}
+        ents = [(3, _rec(b"b", b"new2")), (3, _rec(b"c", b"new3"))]
+        chunk = _one_frame(P.encode_peer_snap_chunk(
+            0, term=3, base=2, last_total=3, commit=3, entries=ents))
+        (ack,) = n.on_peer_frame(*chunk)
+        assert n.log[1][0] == 3 and n.log[2][0] == 3   # tail replaced
+        assert n.commit == 3 and n.kv[b"b"] == b"new2"
+        nid, term, match = P.decode_peer_snap_ack(_one_frame(ack)[1])
+        assert (nid, term, match) == (1, 3, 3)
+
+    def test_matching_overlap_is_idempotent(self, tmp_path):
+        """A stale chunk retry over entries we already hold (same
+        terms) appends nothing and acks the validated prefix."""
+        n = _node(tmp_path)
+        n.log = [(1, _rec(b"a", b"1")), (1, _rec(b"b", b"2"))]
+        n.commit = n.applied = 2
+        ents = [(1, _rec(b"a", b"1")), (1, _rec(b"b", b"2"))]
+        chunk = _one_frame(P.encode_peer_snap_chunk(
+            0, term=1, base=1, last_total=2, commit=2, entries=ents))
+        (ack,) = n.on_peer_frame(*chunk)
+        assert n.last_idx == 2
+        assert P.decode_peer_snap_ack(_one_frame(ack)[1])[2] == 2
+
+    def test_gap_reacks_committed_floor_not_raw_last_idx(self, tmp_path):
+        """On a gap (restart lost the RAM tail mid-stream) the re-ack
+        claims only the COMMITTED floor: an uncommitted suffix has
+        never been validated against this leader's log."""
+        n = _node(tmp_path)
+        n.log = [(1, _rec(b"a", b"1")), (1, _rec(b"b", b"2")),
+                 (2, _rec(b"c", b"??")), (2, _rec(b"d", b"??"))]
+        n.commit = n.applied = 2
+        chunk = _one_frame(P.encode_peer_snap_chunk(
+            0, term=3, base=10, last_total=12, commit=12, entries=[]))
+        (ack,) = n.on_peer_frame(*chunk)
+        assert P.decode_peer_snap_ack(_one_frame(ack)[1])[2] == 2
+
+
+class TestDurabilityTermCheck:
+    def test_is_durable_raises_when_entry_superseded(self, tmp_path):
+        """`commit >= seq` alone is a durability lie once a successor
+        leader committed a DIFFERENT entry at the same index: the ack
+        must be refused as NotLeader, not served as OK."""
+        from raft_tpu.cluster.node import LEADER
+        from raft_tpu.multi.engine import NotLeader
+
+        n = _node(tmp_path)
+        n.role, n.term = LEADER, 1
+        _, seq = n.submit(b"k", b"mine")
+        assert seq == 1 and n.is_durable(0, seq) is False
+        # a rival leader (term 2) replaces index 1
+        app = _one_frame(P.encode_peer_append(
+            2, term=2, prev_idx=0, prev_term=0, commit=1, round_no=1,
+            entries=[(2, _rec(b"k", b"theirs"))]))
+        n.on_peer_frame(*app)
+        assert n.commit >= seq          # a different entry committed
+        with pytest.raises(NotLeader):
+            n.is_durable(0, seq)
+
+    def test_is_durable_true_when_own_entry_commits(self, tmp_path):
+        from raft_tpu.cluster.node import LEADER
+
+        n = _node(tmp_path)
+        n.role, n.term = LEADER, 1
+        _, seq = n.submit(b"k", b"v")
+        n._wal_extend(n.last_idx)
+        n.match_idx = {0: 1, 2: 1}
+        n._advance_commit(n.now())
+        assert n.is_durable(0, seq) is True
+
+    def test_sweep_answers_lost_single_write_with_not_leader(self):
+        """The server sweep translates the backend's proof of loss
+        into the typed no-effect refusal (single write) or an ERROR
+        (batch: sibling entries may already be durable)."""
+        from raft_tpu.multi.engine import NotLeader
+        from raft_tpu.net.server import IngestServer, _Batch, _Req
+
+        class _Conn:
+            def __init__(self):
+                self.frames, self.open, self.cid = [], True, 1
+                self.session = {}
+
+            def send(self, frame):
+                self.frames.append(frame)
+                return len(frame)
+
+            def observe_floor(self, g, idx):
+                pass
+
+        class _Backend:
+            heartbeat_s = 0.01
+            LOST = {1, 5}
+
+            def now(self):
+                return 0.0
+
+            def is_durable(self, g, seq):
+                if seq in self.LOST:
+                    raise NotLeader(0, "entry lost")
+                return seq == 2
+
+            def commit_floor(self, g):
+                return 2
+
+            def leader_hint(self, g):
+                return "127.0.0.1:9"
+
+            def staging_stats(self):
+                return None
+
+        srv = IngestServer(_Backend())
+        single = _Req(_Conn(), P.SUBMIT, 7, b"k", b"v")
+        srv._awaiting_writes[(0, 1)] = single
+        ok = _Req(_Conn(), P.SUBMIT, 8, b"k", b"v")
+        srv._awaiting_writes[(0, 2)] = ok
+        batch = _Batch(_Req(_Conn(), P.SUBMIT_BATCH, 9, b""))
+        batch.remaining, batch.accepted = 2, 2
+        batch.groups = {0}
+        srv._awaiting_writes[(0, 5)] = batch
+        srv._awaiting_writes[(0, 6)] = batch
+
+        srv._sweep_completions()
+        assert not srv._awaiting_writes
+        kinds = [_one_frame(c.frames[0])[0]
+                 for c in (single.conn, ok.conn, batch.conn)]
+        assert kinds == [P.NOT_LEADER, P.OK, P.ERROR]
+        assert srv.refusals.get("not_leader") == 1
+
+
+class TestFreshLeaderReadGate:
+    def test_reads_refused_until_current_term_commit(self, tmp_path):
+        """A freshly elected leader's commit may lag entries its
+        predecessor already acked: lease/ReadIndex reads are refused
+        until an entry of the CURRENT term commits (§6.4 / §8)."""
+        from raft_tpu.cluster.node import LEADER
+        from raft_tpu.multi.engine import ReadLagging
+        from raft_tpu.net.server import _Pending
+
+        n = _node(tmp_path)
+        n.log = [(1, _rec(b"a", b"1"))]
+        n.commit = n.applied = 1
+        n.kv = {b"a": b"1"}
+        n.role, n.term = LEADER, 2                 # noop not committed
+        with pytest.raises(ReadLagging):
+            n.begin_read("linearizable", b"a", {})
+        # session reads never needed the leader gate
+        out = n.begin_read("session", b"a", {})
+        assert out.value == b"1"
+        # the current-term noop commits: reads flow again
+        n.log.append((2, _rec(b"", b"")))
+        n.commit = n.applied = 2
+        assert isinstance(n.begin_read("linearizable", b"a", {}),
+                          _Pending)
+
+
+class TestLeaseClock:
+    def test_failed_replies_carry_no_evidence(self, tmp_path):
+        """A log-mismatch reply must not refresh the lease clock nor
+        certify a ReadIndex round — it proves nothing about what the
+        follower accepted."""
+        from raft_tpu.cluster.node import LEADER
+
+        n = _node(tmp_path)
+        n.role, n.term = LEADER, 1
+        n._round_sent = {7: 100.0}
+        rep = _one_frame(P.encode_peer_append_reply(
+            0, term=1, success=False, match_idx=0, round_no=7))
+        n.on_peer_frame(*rep)
+        assert n.ack_at == {} and n.peer_round.get(0, 0) == 0
+
+    def test_lease_clock_runs_from_send_time(self, tmp_path):
+        """A successful echo credits the SEND stamp of the acked
+        round, so reply RTT can only shrink the lease window."""
+        from raft_tpu.cluster.node import LEADER
+
+        n = _node(tmp_path)
+        n.log = [(1, _rec(b"a", b"1"))] * 3
+        n.role, n.term = LEADER, 1
+        n._wal_hi = 3
+        n._round_sent = {7: 100.0}
+        rep = _one_frame(P.encode_peer_append_reply(
+            0, term=1, success=True, match_idx=3, round_no=7))
+        n.on_peer_frame(*rep)
+        assert n.ack_at[0] == 100.0          # send stamp, not arrival
+        assert n.peer_round[0] == 7 and n.match_idx[0] == 3
+        # an echo of an unknown (pruned) round credits nothing
+        n2 = _node(tmp_path, node_id=2)
+        n2.role, n2.term = LEADER, 1
+        rep = _one_frame(P.encode_peer_append_reply(
+            0, term=1, success=True, match_idx=0, round_no=99))
+        n2.on_peer_frame(*rep)
+        assert n2.ack_at == {}
+
+    def test_lease_clamped_under_minimum_election_timeout(self, tmp_path):
+        n = _node(tmp_path, lease_s=5.0, election_timeout_s=0.3)
+        assert n.lease_s <= 0.8 * 0.3 + 1e-9
+
+    def test_vote_stickiness_guards_the_lease(self, tmp_path):
+        """A follower in live leader contact ignores RequestVote for
+        the minimum election timeout (§4.2.3): no term bump, no grant
+        — the intersection argument the lease bound stands on. Once
+        contact lapses, votes flow normally."""
+        import time as _t
+
+        n = _node(tmp_path)
+        n.leader_id = 0
+        n.last_heard = _t.monotonic()
+        vote = _one_frame(P.encode_peer_vote(2, term=9, last_idx=100,
+                                             last_term=9))
+        (rep,) = n.on_peer_frame(*vote)
+        _, term, granted, _pv = P.decode_peer_vote_reply(
+            _one_frame(rep)[1])
+        assert granted is False and n.term == 0 and n.voted_for is None
+        n.last_heard = _t.monotonic() - 10.0       # contact lapsed
+        (rep,) = n.on_peer_frame(*vote)
+        _, term, granted, _pv = P.decode_peer_vote_reply(
+            _one_frame(rep)[1])
+        assert granted is True and n.term == 9 and n.voted_for == 2
+
+
+class TestAppendAckWal:
+    def test_acked_log_survives_kill_minus_nine(self, tmp_path):
+        """Raft's commit safety assumes a voter keeps its acked log
+        across restarts. Follower acks ride the WAL: a rebuilt node
+        (same dir, RAM gone) holds the FULL acked log — committed
+        AND uncommitted suffix — with the commit watermark re-derived
+        from leader contact, never guessed."""
+        n = _node(tmp_path)
+        recs = [(1, _rec(b"k%d" % i, b"v%d" % i)) for i in range(1, 31)]
+        app = _one_frame(P.encode_peer_append(
+            0, term=1, prev_idx=0, prev_term=0, commit=20, round_no=1,
+            entries=recs))
+        (rep,) = n.on_peer_frame(*app)
+        assert P.decode_peer_append_reply(_one_frame(rep)[1])[2] is True
+        assert n.last_idx == 30 and n.commit == 20
+        sealed = n.store._sealed_hi
+
+        r = _node(tmp_path)                      # kill -9: new process
+        assert r.last_idx == 30                  # the acked log survived
+        assert [t for t, _ in r.log] == [1] * 30
+        assert r.commit == sealed                # committed = sealed floor
+        assert r.store.stats["segments_resealed"] == 0
+        # leader contact re-commits and re-applies the tail
+        hb = _one_frame(P.encode_peer_append(
+            0, term=1, prev_idx=30, prev_term=1, commit=30, round_no=2,
+            entries=[]))
+        r.on_peer_frame(*hb)
+        assert r.commit == 30 and r.kv[b"k30"] == b"v30"
+
+    def test_torn_wal_tail_is_dropped_not_fatal(self, tmp_path):
+        n = _node(tmp_path)
+        app = _one_frame(P.encode_peer_append(
+            0, term=1, prev_idx=0, prev_term=0, commit=0, round_no=1,
+            entries=[(1, _rec(b"a", b"1")), (1, _rec(b"b", b"2"))]))
+        n.on_peer_frame(*app)
+        with open(n._wal_path, "ab") as f:
+            f.write(b"\x01torn-half-record")     # crash mid-write
+        r = _node(tmp_path)
+        assert r.last_idx == 2                   # intact prefix kept
+
+    def test_heartbeat_commit_clamps_to_validated_prefix(self, tmp_path):
+        """An empty append (heartbeat) validates nothing past its
+        prev_idx: the commit watermark must clamp to the last entry
+        THIS append checked, not to a retained unvalidated tail."""
+        n = _node(tmp_path)
+        n.log = [(1, _rec(b"a", b"1")),
+                 (2, _rec(b"b", b"??")), (2, _rec(b"c", b"??"))]
+        n.commit = n.applied = 1
+        n.kv = {b"a": b"1"}
+        hb = _one_frame(P.encode_peer_append(
+            0, term=3, prev_idx=1, prev_term=1, commit=3, round_no=1,
+            entries=[]))
+        n.on_peer_frame(*hb)
+        assert n.commit == 1                     # tail never validated
+
+
 # ------------------------------------------------------ cluster drill
 @pytest.fixture(scope="class")
 def cluster_drill():
