@@ -2100,8 +2100,13 @@ def bench_cluster() -> dict:
             return False
 
         # ---- row 1: goodput ------------------------------------------
+        def total_wal_fsyncs() -> int:
+            return sum(int((sup.status(i) or {}).get("wal_fsyncs", 0))
+                       for i in range(NODES))
+
         async def goodput_row() -> dict:
             cs = [await connect(i % NODES) for i in range(CONNS)]
+            fsyncs0 = total_wal_fsyncs()
             t0 = time.perf_counter()
 
             async def w(ci, c, n):
@@ -2121,12 +2126,20 @@ def bench_cluster() -> dict:
             wall = time.perf_counter() - t0
             for c in cs:
                 await c.close()
+            await asyncio.sleep(0.7)    # one status-publish period
+            # group-commit batching factor: every ack rode a WAL fsync
+            # on a quorum, so cluster-wide replicated entries per fsync
+            # (NODES * acked / fsyncs) measures how many acks each
+            # shared fsync carried — 1.0 is fsync-per-append, higher is
+            # the one-fsync-per-ingest-sweep coalescing doing its job
+            dsync = max(total_wal_fsyncs() - fsyncs0, 1)
             eps = acked / max(wall, 1e-9)
             return {
                 "processes": NODES,
                 "connections": CONNS,
                 "entries": acked,
                 "wall_s": round(wall, 3),
+                "wal_fsync_batched": round(NODES * acked / dsync, 2),
                 "cluster_goodput_eps": round(eps, 1),
                 "singleproc_goodput_eps": round(singleproc_eps, 1),
                 "cluster_vs_singleproc": round(

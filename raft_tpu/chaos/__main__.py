@@ -14,6 +14,7 @@ import sys
 
 from raft_tpu.chaos.runner import (
     cluster_run,
+    cluster_storage_run,
     migration_run,
     overload_run,
     reads_run,
@@ -118,6 +119,26 @@ def main(argv=None) -> int:
                          "stream")
     ap.add_argument("--cluster-nodes", type=int, default=3,
                     help="--cluster process count (>= 3)")
+    ap.add_argument("--cluster-storage", action="store_true",
+                    help="run the storage-fault nemesis over the "
+                         "multi-process cluster (docs/CLUSTER.md "
+                         "storage-fault model): every durable write "
+                         "rides the FaultyIO VFS seam, and torn "
+                         "writes, fsync stalls, a disk-full window, "
+                         "post-kill media rot (mid-file WAL bit flip, "
+                         "torn manifest, flipped sealed shard), and a "
+                         "mid-run fsync-EIO fail-stop compose with "
+                         "partition / kill -9 / restart-with-handoff; "
+                         "succeeds only if every read class holds its "
+                         "contract AND every recovery receipt is "
+                         "present (WAL truncated at the first bad "
+                         "CRC, manifest.json.prev fallback, RS shard "
+                         "reconstruct, typed disk-full sheds, death "
+                         "certificate + exit 97 with ZERO post-EIO "
+                         "fsyncs, commit digests agreeing at shared "
+                         "checkpoints); with --broken fsync_lies or "
+                         "wal_skip_corrupt, succeeds only if the lie "
+                         "was CAUGHT")
     ap.add_argument("--txn", action="store_true",
                     help="run the cross-group transaction drill "
                          "(docs/TXN.md): a replicated 2PC coordinator "
@@ -162,7 +183,8 @@ def main(argv=None) -> int:
     ap.add_argument("--broken",
                     choices=["dirty_reads", "commit_rewind",
                              "lease_skew", "txn_partial_commit",
-                             "txn_dirty_read"],
+                             "txn_dirty_read", "fsync_lies",
+                             "wal_skip_corrupt"],
                     default=None,
                     help="deliberately broken variant; the run SUCCEEDS "
                          "(exit 0) only if the harness catches it — "
@@ -180,8 +202,16 @@ def main(argv=None) -> int:
                          "txn_dirty_read (a store that serves staged "
                          "intents before the decision; needs --txn) "
                          "must both be CAUGHT by the serializability "
-                         "checker. A passing broken run means the "
-                         "harness lost its teeth")
+                         "checker, fsync_lies (a disk whose fsync "
+                         "returns before durability; needs "
+                         "--cluster-storage) must lose acked writes "
+                         "the checker sees after a cluster-wide "
+                         "kill -9, and wal_skip_corrupt (a WAL replay "
+                         "that SKIPS a corrupt record instead of "
+                         "truncating; needs --cluster-storage) must "
+                         "trip the cross-node commit-digest plane. "
+                         "A passing broken run means the harness "
+                         "lost its teeth")
     ap.add_argument("--audit", action="store_true",
                     help="attach the ONLINE safety plane: the "
                          "obs.audit.SafetyAuditor invariant checks "
@@ -282,12 +312,85 @@ def main(argv=None) -> int:
                          or args.reconfig or args.migration
                          or args.segments or args.membership
                          or args.reads or args.wire or args.txn
+                         or args.cluster_storage
                          or args.overload_recovery is not None):
         ap.error("--cluster is a standalone multi-process drill (its "
                  "kill -9 / partition / pause / overload / restart "
                  "nemeses are built in)")
+    if (args.broken in ("fsync_lies", "wal_skip_corrupt")
+            and not args.cluster_storage):
+        ap.error("--broken %s applies to the --cluster-storage drill"
+                 % args.broken)
+    if args.cluster_storage and (
+            args.multi or args.overload or args.reconfig
+            or args.migration or args.segments or args.membership
+            or args.reads or args.wire or args.txn
+            or args.broken not in (None, "fsync_lies",
+                                   "wal_skip_corrupt")
+            or args.overload_recovery is not None):
+        ap.error("--cluster-storage is a standalone multi-process "
+                 "drill (--broken fsync_lies / wal_skip_corrupt are "
+                 "its only compositions)")
 
     ok = True
+    if args.cluster_storage:
+        from raft_tpu.cluster import ClusterBroken
+
+        for seed in range(args.seed, args.seed + args.sweep):
+            try:
+                rep = cluster_storage_run(
+                    seed, nodes=args.cluster_nodes,
+                    clients=args.clients, keys=args.keys,
+                    step_budget=args.step_budget,
+                    blackbox_dir=args.blackbox_dir,
+                    broken=args.broken,
+                )
+            except ClusterBroken as ex:
+                print(json.dumps({
+                    "seed": seed, "verdict": "BROKEN_ENV",
+                    "error": str(ex).splitlines()[0],
+                }), flush=True)
+                return 1
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "per_class": {c: r.verdict
+                              for c, r in rep.per_class.items()},
+                "ops": rep.ops,
+                "op_counts": rep.op_counts,
+                "kills": rep.kills,
+                "restarts": rep.restarts,
+                "partitions": rep.partitions,
+                "generation": rep.generation,
+                "segments_adopted": rep.segments_adopted,
+                "segments_resealed": rep.segments_resealed,
+                "rejoined": rep.rejoined,
+                "wal_truncated": rep.wal_truncated,
+                "manifest_fallbacks": rep.manifest_fallbacks,
+                "segment_reconstructs": rep.segment_reconstructs,
+                "disk_full_sheds": rep.disk_full_sheds,
+                "stalls": rep.stalls,
+                "eio_exit": rep.eio_exit,
+                "eio_cert": rep.eio_cert,
+                "fsync_after_eio": rep.fsync_after_eio,
+                "digest_ok": rep.digest_ok,
+                "digest_detail": rep.digest_detail,
+                "broken": rep.broken,
+                "caught": rep.caught,
+                "caught_by": rep.caught_by,
+                "base_dir": rep.base_dir,
+            }), flush=True)
+            if args.broken:
+                # the flag's contract: a CAUGHT lie IS success
+                ok = ok and bool(rep.caught)
+            else:
+                ok = ok and (
+                    rep.verdict == "LINEARIZABLE"
+                    and rep.handoff_ok
+                    and rep.storage_ok
+                )
+        return 0 if ok else 1
     if args.cluster:
         from raft_tpu.cluster import ClusterBroken
 

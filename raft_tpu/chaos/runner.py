@@ -3887,3 +3887,603 @@ def cluster_run(
         base_dir=base,
         repro=f"python -m raft_tpu.chaos --cluster --seed {seed}",
     )
+
+
+# ---------------------------------------- the cluster storage drill
+@dataclasses.dataclass
+class ClusterStorageReport:
+    """Result of :func:`cluster_storage_run` — the lying-disk nemesis
+    over the multi-process cluster tier (docs/CLUSTER.md storage-fault
+    model): every durable write the replicas make goes through the
+    ``FaultyIO`` VFS seam, and the drill composes seed-driven torn
+    writes, fsync stalls, a disk-full window, post-kill media rot
+    (mid-file WAL bit flip, torn manifest, flipped sealed shard), and
+    an fsync-EIO fail-stop with the process faults the cluster drill
+    already owns (partition, ``kill -9``, restart-with-handoff).
+
+    The healthy run must come back LINEARIZABLE per read class WITH
+    the recovery receipts: the victim truncated its WAL at the first
+    bad CRC (never skipped past it), rode the ``manifest.json.prev``
+    fallback, reconstructed the flipped shard through the RS decode,
+    the leader shed the full-disk window as typed refusals, and the
+    EIO'd node FAIL-STOPPED — death certificate published, exit 97,
+    and ZERO fsync calls after the EIO (the fsyncgate contract).
+
+    The broken variants are the teeth check: ``fsync_lies`` (acks ride
+    fsyncs that never persisted — a cluster-wide kill -9 must surface
+    the lost acked writes as a checker VIOLATION) and
+    ``wal_skip_corrupt`` (replay skips a corrupt record, silently
+    shifting every later index past Raft's (index, term) checks — the
+    commit-digest plane must catch the divergence). A broken run
+    SUCCEEDS only when ``caught``."""
+
+    seed: int
+    broken: Optional[str]
+    per_class: Dict[str, "CheckResult"]
+    ops: int
+    op_counts: Dict[str, int]
+    nodes: int
+    kills: int
+    restarts: int
+    partitions: int
+    generation: int           # torn victim's post-restart generation
+    segments_adopted: int
+    segments_resealed: int    # MUST stay 0 even off manifest.json.prev
+    rejoined: bool
+    wal_truncated: int        # records dropped at the first bad CRC
+    manifest_fallbacks: int   # recovery rode manifest.json.prev
+    segment_reconstructs: int  # flipped shard repaired via RS decode
+    disk_full_sheds: int      # typed refusals during the full window
+    stalls: int               # fsync-stall windows the victim absorbed
+    eio_cert: Optional[dict]  # the fail-stopped node's death.json
+    eio_exit: Optional[int]   # its exit code (97 = fail-stop contract)
+    fsync_after_eio: int      # MUST stay 0: no fsync retry after EIO
+    digest_ok: bool           # commit digests agree at shared ckpts
+    digest_detail: str
+    caught: Optional[bool]    # broken runs: the harness saw the lie
+    caught_by: str
+    statuses: Dict[int, Optional[dict]]
+    base_dir: str
+    repro: str
+
+    @property
+    def verdict(self) -> str:
+        verdicts = [c.verdict for c in self.per_class.values()]
+        if VIOLATION in verdicts:
+            return VIOLATION
+        if any(v != LINEARIZABLE for v in verdicts):
+            return "UNDETERMINED"
+        return LINEARIZABLE
+
+    @property
+    def handoff_ok(self) -> bool:
+        return (self.generation >= 2 and self.segments_adopted >= 1
+                and self.segments_resealed == 0 and self.rejoined)
+
+    @property
+    def fail_stop_ok(self) -> bool:
+        """The fsyncgate contract in one bool: the EIO'd node died
+        distinctly (exit 97), published its own death certificate, and
+        never called fsync again after the error."""
+        return (self.eio_cert is not None and self.eio_exit == 97
+                and self.fsync_after_eio == 0)
+
+    @property
+    def storage_ok(self) -> bool:
+        """Every recovery receipt the healthy run must produce."""
+        return (self.wal_truncated >= 1 and self.manifest_fallbacks >= 1
+                and self.segment_reconstructs >= 1
+                and self.disk_full_sheds >= 1 and self.stalls >= 1
+                and self.fail_stop_ok and self.digest_ok)
+
+    def summary(self) -> str:
+        cls = {c: r.verdict for c, r in self.per_class.items()}
+        core = (
+            f"seed={self.seed} classes={cls} ops={self.ops} "
+            f"gen={self.generation} adopted={self.segments_adopted} "
+            f"resealed={self.segments_resealed} rejoined={self.rejoined} "
+            f"wal_trunc={self.wal_truncated} "
+            f"manifest_fb={self.manifest_fallbacks} "
+            f"reconstructs={self.segment_reconstructs} "
+            f"full_sheds={self.disk_full_sheds} stalls={self.stalls} "
+            f"fail_stop={self.fail_stop_ok} digest_ok={self.digest_ok}"
+        )
+        if self.broken:
+            return (f"{core} broken={self.broken} caught={self.caught} "
+                    f"by={self.caught_by}")
+        return core
+
+
+def _digest_agreement(
+    statuses: Dict[int, Optional[dict]],
+) -> Tuple[bool, str]:
+    """Compare commit-digest checkpoints across nodes: every shared
+    checkpoint index must carry the same digest (replicas that applied
+    the same prefix MUST agree byte-for-byte). Returns (ok, detail);
+    zero overlap is ok=True with a detail saying so."""
+    ckpts: Dict[int, Dict[int, int]] = {}
+    for i, st in statuses.items():
+        if st:
+            ckpts[i] = {int(idx): int(d)
+                        for idx, d in st.get("digest_ckpts", [])}
+    overlap = 0
+    for i in ckpts:
+        for j in ckpts:
+            if j <= i:
+                continue
+            for idx in ckpts[i].keys() & ckpts[j].keys():
+                overlap += 1
+                if ckpts[i][idx] != ckpts[j][idx]:
+                    return False, (
+                        f"digest DIVERGED at idx {idx}: node {i} "
+                        f"{ckpts[i][idx]:#x} != node {j} "
+                        f"{ckpts[j][idx]:#x}")
+    if overlap == 0:
+        return True, "no shared checkpoint index"
+    return True, f"{overlap} shared checkpoints agree"
+
+
+def cluster_storage_run(
+    seed: int,
+    nodes: int = 3,
+    clients: int = 3,
+    keys: int = 4,
+    ops_per_phase: int = 10,
+    preload: int = 96,
+    step_budget: int = 500_000,
+    base_dir: Optional[str] = None,
+    blackbox_dir: Optional[str] = None,
+    broken: Optional[str] = None,
+) -> ClusterStorageReport:
+    """The storage-fault nemesis drill (``--cluster-storage``): the
+    multi-process cluster under a lying disk. Healthy composition:
+
+    1. PRELOAD — seal segments on every node (the faults need durable
+       state to chew on); all nodes boot with the ``FaultyIO`` seam
+       armed benign (``disk.json`` present, no faults yet);
+    2. arm TORN writes + fsync STALLS on one follower, keep traffic
+       flowing (acked writes still ride real fsyncs — torn prefixes
+       only ever leak UN-fsynced bytes, the crash-model guarantee);
+    3. PARTITION that follower, write through the majority, then
+       ``kill -9`` it — the RAM tail and the un-fsynced torn tail die;
+    4. rot the corpse: flip a mid-file WAL bit, tear the WAL tail
+       mid-record, truncate ``manifest.json`` half-written, flip one
+       payload bit in a sealed data shard (CRC sidecar left stale);
+    5. a wall-clock DISK-FULL window on the leader under traffic —
+       submits shed as typed refusals (provably no effect), never
+       corruption;
+    6. RESTART the victim on the rotten dirs: recovery must truncate
+       the WAL at the first bad CRC, fall back to
+       ``manifest.json.prev``, reconstruct the flipped shard through
+       the RS decode, and rejoin without resealing adopted work;
+    7. arm fsync-EIO on the OTHER follower mid-run: its next WAL fsync
+       fail-stops the process (death certificate, exit 97, no fsync
+       retry — fsyncgate), then restart it clean;
+    8. final traffic + quiesce; per-class check + cross-node commit-
+       digest comparison.
+
+    ``broken="fsync_lies"`` / ``broken="wal_skip_corrupt"`` run the
+    deliberately broken storage layers instead; see the report class.
+    Raises :class:`raft_tpu.cluster.ClusterBroken` when the
+    environment cannot spawn children at all."""
+    import asyncio
+    import time as _time
+
+    from raft_tpu.cluster import ClusterBroken, ClusterSupervisor
+    from raft_tpu.cluster.storage import (
+        flip_file_bit, flip_sealed_shard, read_disk_stats,
+        tear_file_tail, torn_truncate, write_plan,
+    )
+    from raft_tpu.net import WireClient, WireDisconnected, WireRefused
+    from raft_tpu.net.client import WireError
+
+    assert broken in (None, "fsync_lies", "wal_skip_corrupt"), broken
+    base = base_dir or tempfile.mkdtemp(
+        prefix=f"cluster-storage-seed{seed}-")
+    bdir = blackbox_dir or os.path.join(base, "blackbox")
+    rng = random.Random(f"cluster-storage:{seed}")
+    env = {"RAFT_TPU_BLACKBOX_DIR": bdir}
+    if broken == "wal_skip_corrupt":
+        env["RAFT_TPU_WAL_SKIP_CORRUPT"] = "1"
+    # broken variants keep every write in the WAL + RAM (no sealing):
+    # fsync_lies must be able to LOSE the acked writes wholesale, and
+    # wal_skip_corrupt needs the whole log replayed from the WAL
+    hot = 32 if broken is None else 128
+    snap = 24 if broken is None else 10_000
+    sup = ClusterSupervisor(
+        nodes, base,
+        heartbeat_s=0.05, election_timeout_s=0.4,
+        snap_threshold=snap, segment_entries=16, hot_entries=hot,
+        # recovering under injection is EXPECTED to include rough
+        # starts; the death-certificate exemption plus extra headroom
+        # keeps the crash-loop verdict for genuinely broken envs
+        fast_fail=6,
+        env=env,
+    )
+    if broken != "wal_skip_corrupt":
+        # arm the VFS seam on every node from first boot (benign until
+        # a phase rewrites the plan; fsync_lies starts lying at once)
+        plan = {"seed": seed}
+        if broken == "fsync_lies":
+            plan["fsync_lies"] = True
+        for i in range(nodes):
+            write_plan(sup.node_dir(i), plan)
+
+    history = History()
+    key_pool = [f"sk{i}".encode() for i in range(keys)]
+    now = _time.monotonic
+    counters = [0] * (clients + 1)
+    kills = restarts = partitions = 0
+    evidence: Dict[int, Optional[dict]] = {}
+    rejoined = False
+    victim = eio_node = full_node = -1
+    eio_cert: Optional[dict] = None
+    eio_exit: Optional[int] = None
+    fsync_after_eio = -1
+    stalls = 0
+    caught: Optional[bool] = None
+    caught_by = ""
+    digest_ok, digest_detail = True, ""
+
+    _WRITE_AMBIGUOUS = (WireDisconnected, WireError, ConnectionError,
+                        OSError)
+    _READ_DEAD = (WireRefused, WireError, WireDisconnected,
+                  ConnectionError, OSError)
+
+    async def write_one(wc, cid: int, key: bytes, value: bytes) -> None:
+        rec = history.invoke(cid, WRITE, key, value, now())
+        try:
+            await wc.submit(key, value)
+        except WireRefused:
+            rec.fail(history.stamp(now()))   # typed: provably no effect
+        except _WRITE_AMBIGUOUS:
+            rec.info()                        # outcome unknown
+        else:
+            rec.ok(history.stamp(now()))
+
+    async def client_ops(wc, cid: int, n: int, crng) -> None:
+        for _ in range(n):
+            key = key_pool[crng.randrange(len(key_pool))]
+            p = crng.random()
+            if p < 0.55:
+                counters[cid] += 1
+                await write_one(wc, cid, key,
+                                f"c{cid}v{counters[cid]}".encode())
+            else:
+                cls = "session" if p > 0.85 else "linearizable"
+                rec = history.invoke(cid, READ, key, None, now())
+                if cls == "session":
+                    rec.ryw_floor = wc.session.floor.get(0, 0)
+                try:
+                    out = await wc.read(key, cls=cls)
+                except _READ_DEAD:
+                    rec.fail(history.stamp(now()))
+                else:
+                    rec.read_class = out.cls
+                    rec.serve_index = out.index
+                    rec.ok(history.stamp(now()), out.value)
+
+    async def preload_writes(wc, cid: int, n: int) -> None:
+        for _ in range(n):
+            counters[cid] += 1
+            i = counters[cid]
+            await write_one(wc, cid, key_pool[i % len(key_pool)],
+                            f"c{cid}v{i}".encode())
+
+    async def read_round(wc, cid: int) -> None:
+        for key in key_pool:
+            rec = history.invoke(cid, READ, key, None, now())
+            try:
+                out = await wc.read(key, cls="linearizable")
+            except _READ_DEAD:
+                rec.fail(history.stamp(now()))
+            else:
+                rec.read_class = out.cls
+                rec.serve_index = out.index
+                rec.ok(history.stamp(now()), out.value)
+
+    def _commit_of(i: int) -> int:
+        st = sup.status(i)
+        return int(st["commit"]) if st else 0
+
+    async def _connect(cid: int):
+        host, _, port = sup.addr((cid - 1) % nodes).rpartition(":")
+        return await WireClient(
+            host or "127.0.0.1", int(port), pool=1, retries=40,
+            max_backoff_s=0.25,
+            rng=random.Random(f"cluster-storage:{seed}:conn{cid}"),
+            addr_map=sup.addr_map(),
+        ).connect()
+
+    def _corrupt_dead_victim() -> None:
+        """Phase 4: media rot on the killed victim's durable files —
+        the recovery paths, not steady state, are on trial."""
+        ndir = sup.node_dir(victim)
+        wal = os.path.join(ndir, "wal.bin")
+        pos = flip_file_bit(wal, rng)                 # mid-file rot
+        torn = tear_file_tail(wal, 37)                # mid-record tear
+        manifest = os.path.join(ndir, "segments", "manifest.json")
+        m_torn = torn_truncate(manifest)              # half-written
+        shard = flip_sealed_shard(
+            os.path.join(ndir, "segments"), rng)      # stale CRC
+        blackbox.mark("storage_rot", node=victim, wal_flip_at=pos,
+                      wal_torn_to=torn, manifest_torn=m_torn,
+                      shard=shard)
+
+    async def main_healthy() -> None:
+        nonlocal kills, restarts, partitions, evidence, rejoined
+        nonlocal victim, eio_node, full_node, eio_cert, eio_exit
+        nonlocal fsync_after_eio, stalls
+        wcs = [await _connect(cid) for cid in range(1, clients + 1)]
+        rngs = [random.Random(f"cluster-storage:{seed}:{cid}")
+                for cid in range(1, clients + 1)]
+
+        # ---- phase 1: preload — seal segments on every node ---------
+        per = max(1, preload // clients)
+        blackbox.mark("storage_preload", writes=per * clients)
+        await asyncio.gather(*[
+            preload_writes(wc, cid + 1, per)
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- phase 2: torn writes + fsync stalls on a follower ------
+        lead = sup.leader()
+        lead = lead if lead is not None else 0
+        followers = [i for i in range(nodes) if i != lead]
+        victim, eio_node = followers[0], followers[-1]
+        write_plan(sup.node_dir(victim), {
+            "seed": seed, "torn": True,
+            "stall_every": 3, "stall_s": 0.05,
+        })
+        blackbox.mark("storage_arm_torn", node=victim)
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- phase 3: partition the torn victim, then kill -9 -------
+        sup.partition([[i for i in range(nodes) if i != victim],
+                       [victim]])
+        partitions += 1
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        sup.kill9(victim)
+        kills += 1
+        sup.heal()
+        stats = read_disk_stats(sup.node_dir(victim))
+        stalls = int(stats.get("stalls", 0))
+        # ---- phase 4: media rot on the corpse -----------------------
+        _corrupt_dead_victim()
+        # ---- phase 5: disk-full window on the leader ----------------
+        full_node = sup.leader()
+        full_node = full_node if full_node is not None else lead
+        write_plan(sup.node_dir(full_node), {
+            "seed": seed, "full_until_ts": _time.time() + 0.8,
+        })
+        blackbox.mark("storage_arm_full", node=full_node)
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        # window expires by wall clock; restore the benign plan and
+        # let the shed submits' retries drain before the next phase
+        write_plan(sup.node_dir(full_node), {"seed": seed})
+        await asyncio.sleep(0.3)
+        # ---- phase 6: restart the victim on the rotten dirs ---------
+        write_plan(sup.node_dir(victim), {"seed": seed})  # faults off
+        target = max(_commit_of(i) for i in range(nodes) if i != victim)
+        sup.restart(victim)
+        restarts += 1
+        deadline = now() + 15.0
+        while now() < deadline:
+            st = sup.status(victim)
+            if (st and st.get("generation", 1) >= 2
+                    and int(st.get("commit", 0)) >= target):
+                rejoined = True
+                break
+            await asyncio.sleep(0.1)
+        blackbox.mark("storage_rejoin", node=victim, rejoined=rejoined,
+                      target=target)
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- phase 7: fsync EIO on the other follower → fail-stop ---
+        write_plan(sup.node_dir(eio_node), {"seed": seed,
+                                            "eio_arm": True})
+        blackbox.mark("storage_arm_eio", node=eio_node)
+
+        async def _await_fail_stop() -> None:
+            nonlocal eio_exit
+            end = now() + 10.0
+            while now() < end:
+                if not sup.alive(eio_node):
+                    p = sup.procs.get(eio_node)
+                    eio_exit = p.poll() if p is not None else None
+                    return
+                await asyncio.sleep(0.1)
+
+        await asyncio.gather(_await_fail_stop(), *[
+            client_ops(wc, cid + 1, ops_per_phase, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        # the certificate and the no-retry proof, BEFORE the respawn
+        # unlinks death.json
+        eio_cert = sup.death_certificate(eio_node)
+        fsync_after_eio = int(read_disk_stats(
+            sup.node_dir(eio_node)).get("fsync_after_eio", -1))
+        blackbox.mark("storage_fail_stop", node=eio_node,
+                      exit=eio_exit, cert=bool(eio_cert))
+        write_plan(sup.node_dir(eio_node), {"seed": seed})  # disk fixed
+        sup.restart(eio_node)
+        restarts += 1
+        # ---- phase 8: final traffic + read round + quiesce ----------
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        await read_round(wcs[0], 1)
+        for wc in wcs:
+            await wc.close()
+        await asyncio.sleep(0.7)   # one status-publish period
+        evidence = {i: sup.status(i) for i in range(nodes)}
+
+    async def main_fsync_lies() -> None:
+        """Every disk lies about fsync; a cluster-wide kill -9 drops
+        every acked-but-never-persisted write. The checker must see
+        the loss (reads of acked keys come back empty)."""
+        nonlocal kills, restarts, evidence, victim
+        wcs = [await _connect(cid) for cid in range(1, clients + 1)]
+        # enough acked writes to touch every key, few enough that
+        # nothing seals (segment writes are real; the WAL is the lie)
+        await asyncio.gather(*[
+            preload_writes(wc, cid + 1, 8)
+            for cid, wc in enumerate(wcs)
+        ])
+        for wc in wcs:
+            await wc.close()
+        victim = 0
+        for i in range(nodes):
+            sup.kill9(i)
+            kills += 1
+        blackbox.mark("storage_lies_killall", nodes=nodes)
+        for i in range(nodes):
+            sup.restart(i, wait_ready=False)
+            restarts += 1
+        for i in range(nodes):
+            sup.wait_ready(i)
+        # the read round that surfaces the loss
+        wc = await _connect(1)
+        deadline = now() + 10.0
+        while now() < deadline and sup.leader() is None:
+            await asyncio.sleep(0.1)
+        await read_round(wc, 1)
+        await wc.close()
+        await asyncio.sleep(0.7)
+        evidence = {i: sup.status(i) for i in range(nodes)}
+
+    async def main_wal_skip() -> None:
+        """Replay skips a corrupt WAL record (env-armed): every later
+        record shifts down one index, invisible to Raft's (index,
+        term) checks. The commit-digest plane must diverge."""
+        nonlocal kills, restarts, evidence, victim, rejoined
+        nonlocal caught, caught_by, digest_ok, digest_detail
+        wcs = [await _connect(cid) for cid in range(1, clients + 1)]
+        await asyncio.gather(*[
+            preload_writes(wc, cid + 1, max(1, 40 // clients))
+            for cid, wc in enumerate(wcs)
+        ])
+        lead = sup.leader()
+        lead = lead if lead is not None else 0
+        victim = next(i for i in range(nodes) if i != lead)
+        sup.kill9(victim)
+        kills += 1
+        # flip one payload bit mid-WAL: the skip-not-truncate replay
+        # swallows the record and shifts the suffix
+        wal = os.path.join(sup.node_dir(victim), "wal.bin")
+        step = 17 + 64           # _WAL_REC header + record payload
+        nrec = os.path.getsize(wal) // step
+        bad = max(1, int(nrec * 0.55))
+        off = bad * step + 17 + 5   # inside record bad+1's payload
+        with open(wal, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0x01]))
+        blackbox.mark("storage_wal_flip", node=victim, record=bad + 1,
+                      offset=off, records=nrec)
+        sup.restart(victim)
+        restarts += 1
+        # a little fresh traffic so the leader's appends walk the
+        # victim's shifted log forward past a checkpoint index
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase,
+                       random.Random(f"cluster-storage:{seed}:{cid}"))
+            for cid, wc in enumerate(wcs)
+        ])
+        target = max(_commit_of(i) for i in range(nodes) if i != victim)
+        deadline = now() + 15.0
+        while now() < deadline:
+            st = sup.status(victim)
+            if st and int(st.get("commit", 0)) >= target:
+                rejoined = True
+            evidence = {i: sup.status(i) for i in range(nodes)}
+            digest_ok, digest_detail = _digest_agreement(evidence)
+            if not digest_ok:
+                break
+            await asyncio.sleep(0.2)
+        for wc in wcs:
+            await wc.close()
+        await asyncio.sleep(0.7)
+        evidence = {i: sup.status(i) for i in range(nodes)}
+        ok2, det2 = _digest_agreement(evidence)
+        if not ok2:
+            digest_ok, digest_detail = ok2, det2
+        skipped = int((evidence.get(victim) or {})
+                      .get("wal_skipped_corrupt", 0))
+        caught = (not digest_ok) and skipped >= 1
+        caught_by = "digest" if caught else ""
+        blackbox.mark("storage_skip_verdict", caught=caught,
+                      skipped=skipped, detail=digest_detail)
+
+    mains = {None: main_healthy, "fsync_lies": main_fsync_lies,
+             "wal_skip_corrupt": main_wal_skip}
+    with blackbox.journal_for(f"cluster_storage_seed{seed}", bdir):
+        blackbox.mark("cluster_storage_run", seed=seed, nodes=nodes,
+                      broken=broken)
+        try:
+            sup.start_all()
+            asyncio.run(mains[broken]())
+        finally:
+            sup.stop_all()
+        history.close()
+        blackbox.mark("check_history", ops=len(history))
+        per_class = check_read_classes(history, step_budget=step_budget)
+        blackbox.mark("check_done", verdicts={
+            c: r.verdict for c, r in per_class.items()
+        })
+
+    if broken is None:
+        digest_ok, digest_detail = _digest_agreement(evidence)
+    elif broken == "fsync_lies":
+        verdicts = [c.verdict for c in per_class.values()]
+        caught = VIOLATION in verdicts
+        caught_by = "checker" if caught else ""
+        digest_detail = "n/a (fsync_lies)"
+
+    vstat = evidence.get(victim) or {}
+    tier = vstat.get("tier", {})
+    flag = {"fsync_lies": " --broken fsync_lies",
+            "wal_skip_corrupt": " --broken wal_skip_corrupt"}
+    return ClusterStorageReport(
+        seed=seed,
+        broken=broken,
+        per_class=per_class,
+        ops=len(history),
+        op_counts=history.counts(),
+        nodes=nodes,
+        kills=kills,
+        restarts=restarts,
+        partitions=partitions,
+        generation=int(vstat.get("generation", 0)),
+        segments_adopted=int(tier.get("segments_adopted", 0)),
+        segments_resealed=int(tier.get("segments_resealed", -1)),
+        rejoined=rejoined,
+        wal_truncated=int(vstat.get("wal_truncated_records", 0)),
+        manifest_fallbacks=int(tier.get("manifest_fallbacks", 0)),
+        segment_reconstructs=int(tier.get("segment_reconstructs", 0)),
+        disk_full_sheds=int(
+            (evidence.get(full_node) or {}).get("disk_full_shed", 0)),
+        stalls=stalls,
+        eio_cert=eio_cert,
+        eio_exit=eio_exit,
+        fsync_after_eio=fsync_after_eio,
+        digest_ok=digest_ok,
+        digest_detail=digest_detail,
+        caught=caught,
+        caught_by=caught_by,
+        statuses=evidence,
+        base_dir=base,
+        repro=(f"python -m raft_tpu.chaos --cluster-storage "
+               f"--seed {seed}{flag.get(broken, '')}"),
+    )

@@ -50,7 +50,6 @@ from __future__ import annotations
 import json
 import os
 import struct
-import tempfile
 import zlib
 from typing import Dict, List, Optional, Tuple
 
@@ -68,22 +67,23 @@ class SegmentCorrupt(Exception):
     respect, the storage analogue of keep-a-majority-alive)."""
 
 
+def _default_vio():
+    """The storage VFS this tier writes through when the caller didn't
+    hand one in. Resolved lazily: ``cluster/storage.py`` is stdlib-only,
+    but importing it at module level would run ``cluster/__init__``,
+    which imports ``cluster/node.py``, which imports THIS module — the
+    classic partially-initialized-module deadlock."""
+    from raft_tpu.cluster.storage import RealIO
+    return RealIO()
+
+
 def _atomic_write(path: str, blob: bytes) -> None:
-    """temp file + ``os.replace``: a crash mid-spill must never leave a
-    half-written file under the final name (the sidecar CRC catches a
-    torn file that somehow does appear — the ``torn_spill`` nemesis)."""
-    parent = os.path.dirname(os.path.abspath(path))
-    fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    """temp file + ``os.replace`` via the storage seam: a crash
+    mid-spill must never leave a half-written file under the final name
+    (the sidecar CRC catches a torn file that somehow does appear — the
+    ``torn_spill`` nemesis)."""
+    from raft_tpu.cluster.storage import atomic_write
+    atomic_write(path, blob)
 
 
 class SegmentIO:
@@ -103,11 +103,12 @@ class SegmentIO:
     shards are all healthy stitches without a decode.
     """
 
-    def __init__(self, root: str, k: int = 4, m: int = 2):
+    def __init__(self, root: str, k: int = 4, m: int = 2, vio=None):
         from raft_tpu.ec.rs import RSCode
 
         self.root = root
         self.code = RSCode(k + m, k)
+        self.vio = vio if vio is not None else _default_vio()
         os.makedirs(root, exist_ok=True)
 
     # ------------------------------------------------------------- paths
@@ -136,8 +137,9 @@ class SegmentIO:
             hdr = _HDR.pack(_MAGIC, code.k, code.m, lo, hi, pad, r)
             blob = hdr + tbytes + shards[r].tobytes()
             p = self.shard_path(name, r)
-            _atomic_write(p, blob)
-            _atomic_write(self._crc_path(p), f"{zlib.crc32(blob):08x}".encode())
+            self.vio.atomic_write(p, blob)
+            self.vio.atomic_write(self._crc_path(p),
+                                  f"{zlib.crc32(blob):08x}".encode())
         return name
 
     # -------------------------------------------------------------- load
@@ -147,10 +149,9 @@ class SegmentIO:
         (present, CRC-valid, header-consistent), else None."""
         p = self.shard_path(name, r)
         try:
-            with open(p, "rb") as f:
-                blob = f.read()
-            with open(self._crc_path(p)) as f:
-                want = int(f.read().strip(), 16)
+            blob = self.vio.read_bytes(p)
+            want = int(self.vio.read_bytes(self._crc_path(p)).strip(),
+                       16)
         except (OSError, ValueError):
             return None
         if zlib.crc32(blob) != want or len(blob) < _HDR.size:
@@ -216,10 +217,7 @@ class SegmentIO:
         for r in range(self.code.n):
             for p in (self.shard_path(name, r),
                       self._crc_path(self.shard_path(name, r))):
-                try:
-                    os.unlink(p)
-                except OSError:
-                    pass
+                self.vio.unlink(p)
 
 
 class TieredStore(CheckpointStore):
@@ -256,11 +254,13 @@ class TieredStore(CheckpointStore):
         on_seal=None,
         checkpoint_span: Optional[int] = None,
         adopt: bool = False,
+        io_backend=None,
     ):
         if hot_entries < segment_entries:
             raise ValueError("hot_entries must be >= segment_entries")
         super().__init__(entry_bytes, max_entries=None)
-        self.io = SegmentIO(root, k=rs_k, m=rs_m)
+        self.vio = io_backend if io_backend is not None else _default_vio()
+        self.io = SegmentIO(root, k=rs_k, m=rs_m, vio=self.vio)
         self.root = root
         self.hot_entries = hot_entries
         self.segment_entries = segment_entries
@@ -290,7 +290,7 @@ class TieredStore(CheckpointStore):
             "segments_sealed": 0, "entries_sealed": 0, "seal_bytes": 0,
             "segment_loads": 0, "segment_reconstructs": 0,
             "segments_lost": 0, "segments_adopted": 0,
-            "segments_resealed": 0,
+            "segments_resealed": 0, "manifest_fallbacks": 0,
         }
         self.seal_wall_s = 0.0       # cumulative wall time inside seal()
         # --------------------------------------------- restart handoff
@@ -305,24 +305,59 @@ class TieredStore(CheckpointStore):
         return os.path.join(self.root, "manifest.json")
 
     def _write_manifest(self) -> None:
-        _atomic_write(self._manifest_path(), json.dumps({
+        """Atomic replace, with the outgoing manifest preserved as
+        ``manifest.json.prev`` first — the fallback generation adopt
+        reaches for when the current manifest is torn or rotted. Both
+        writes are individually atomic, so a crash between them leaves
+        (old, old) and a crash after leaves (new, old): every
+        reachable state has at least one loadable manifest."""
+        path = self._manifest_path()
+        try:
+            prev = self.vio.read_bytes(path)
+        except OSError:
+            prev = None
+        if prev:
+            self.vio.atomic_write(path + ".prev", prev)
+        self.vio.atomic_write(path, json.dumps({
             "generation": self.generation,
             "entry_bytes": self.entry_bytes,
             "sealed": [[lo, hi] for lo, hi in self._sealed],
             "sealed_hi": self._sealed_hi,
         }).encode())
 
-    def _adopt_manifest(self) -> None:
+    def _load_manifest(self, path: str) -> Optional[dict]:
+        """Parse + validate one manifest candidate; None when torn,
+        missing, or from a different layout."""
         try:
-            with open(self._manifest_path()) as f:
-                m = json.load(f)
-        except (OSError, ValueError):
+            m = json.loads(self.vio.read_bytes(path))
+            if m.get("entry_bytes") != self.entry_bytes:
+                return None         # layout changed under us: reseal all
+            m["sealed"] = [(int(lo), int(hi)) for lo, hi in m["sealed"]]
+            m["sealed_hi"] = int(m["sealed_hi"])
+            m["generation"] = int(m.get("generation", 0))
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        return m
+
+    def _adopt_manifest(self) -> None:
+        m = self._load_manifest(self._manifest_path())
+        if m is None:
+            # torn / half-written manifest (the writer crashed inside
+            # _write_manifest, or the disk rotted it): fall back to the
+            # previous generation's manifest. Losing the last seal is
+            # SAFE — the range above the older sealed_hi re-replicates
+            # from the leader and re-seals above _adopted_hi, so it
+            # never counts as a handoff violation — whereas trusting a
+            # torn sealed list could adopt ranges whose shards were
+            # never written
+            m = self._load_manifest(self._manifest_path() + ".prev")
+            if m is not None:
+                self.stats["manifest_fallbacks"] += 1
+        if m is None:
             return                  # first generation: nothing to adopt
-        if m.get("entry_bytes") != self.entry_bytes:
-            return                  # layout changed under us: reseal all
-        self.generation = int(m.get("generation", 0)) + 1
-        self._sealed = [(int(lo), int(hi)) for lo, hi in m["sealed"]]
-        self._sealed_hi = int(m["sealed_hi"])
+        self.generation = m["generation"] + 1
+        self._sealed = list(m["sealed"])
+        self._sealed_hi = m["sealed_hi"]
         self._adopted_hi = self._sealed_hi
         self._hot_first = self._sealed_hi + 1
         # the archive extends at least to the adopted index; backfill

@@ -27,6 +27,7 @@ import sys
 from raft_tpu.cluster.auth import ClusterAuth
 from raft_tpu.cluster.dialer import PeerDialer
 from raft_tpu.cluster.node import RaftNode
+from raft_tpu.cluster.storage import DiskFailStop, FaultyIO
 from raft_tpu.net.server import IngestServer, PeerBackend
 from raft_tpu.obs import blackbox
 
@@ -35,6 +36,15 @@ async def serve(spec: dict, node_id: int) -> None:
     blackbox.mark("child_build", node=node_id)
     peers = {int(i): addr for i, addr in spec["nodes"].items()}
     data_dir = os.path.join(spec["dir"], f"n{node_id}")
+    os.makedirs(data_dir, exist_ok=True)
+    # the storage-nemesis hook: a fault plan at <data_dir>/disk.json
+    # swaps the lying disk in under EVERY durable write this process
+    # makes — absent the file, the seam is the real OS, full stop
+    io = (FaultyIO(data_dir)
+          if os.path.exists(os.path.join(data_dir, "disk.json"))
+          else None)
+    if io is not None:
+        blackbox.mark("faulty_io_armed", node=node_id, plan=io.plan)
     node = RaftNode(
         node_id, peers, data_dir,
         heartbeat_s=spec.get("heartbeat_s", 0.05),
@@ -42,17 +52,24 @@ async def serve(spec: dict, node_id: int) -> None:
         snap_threshold=spec.get("snap_threshold"),
         segment_entries=spec.get("segment_entries", 64),
         hot_entries=spec.get("hot_entries", 256),
+        io=io,
+        wal_group_commit=spec.get("wal_group_commit", True),
     )
     blackbox.mark("child_adopted", node=node_id,
                   generation=node.generation,
                   adopted=node.store.stats["segments_adopted"],
                   commit=node.commit)
-    auth = ClusterAuth(spec.get("token", "").encode())
+    auth = ClusterAuth(
+        spec.get("token", "").encode(),
+        certfile=spec.get("tls_cert"), keyfile=spec.get("tls_key"),
+        cafile=spec.get("tls_ca"),
+    )
     dialer = PeerDialer(node, auth)
     host, _, port = peers[node_id].rpartition(":")
     server = IngestServer(
         node, host=host or "127.0.0.1", port=int(port),
         peer=PeerBackend(node, auth),
+        ssl=auth.server_ssl(),     # None when no certs configured
     )
     blackbox.mark("child_bind", node=node_id, port=int(port))
     await server.start()
@@ -85,6 +102,11 @@ async def serve(spec: dict, node_id: int) -> None:
     try:
         while True:
             node.tick(node.now())
+            # laggard fallback for group commit: acks whose shared
+            # fsync somehow wasn't scheduled by the peer backend ride
+            # the dialer's outbound links at the next half-heartbeat
+            for p, frame in node.flush_wal():
+                node.outbox.append((p, frame))
             dialer.pump_outbox()
             watchdog.pet()
             if node.role != last_role:
@@ -122,6 +144,13 @@ def main(argv=None) -> int:
             asyncio.run(serve(spec, args.node))
         except KeyboardInterrupt:
             pass
+        except DiskFailStop as ex:
+            # the disk's state is unknowable (fsync EIO): the death
+            # certificate is already on disk — exit distinctly so the
+            # supervisor can tell fail-stop from a crash loop
+            blackbox.mark("child_fail_stop", node=args.node,
+                          error=str(ex))
+            return 97
     return 0
 
 

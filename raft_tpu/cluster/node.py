@@ -22,12 +22,17 @@ Design decisions, and why:
   tail) is a RAM list of ``(term, record)``, but every entry this
   node ever lets a QUORUM count — entries a follower acknowledges in
   an append reply, entries the leader counts as its own quorum
-  member — is first appended to a flat write-ahead log
-  (``wal.log``, flushed and fsynced per frame) in the node's data
-  dir. Raft's commit safety assumes voters keep their acked log
-  across restarts; without the WAL a single ``kill -9`` of one
-  replica could roll an acked quorum back below a committed entry
-  and elect a leader missing a client-acked write. Every COMMITTED
+  member — is first appended to a flat, per-record-CRC'd write-ahead
+  log (``wal.bin``, fsynced before the ack; group commit coalesces
+  the fsyncs of one ingest sweep) in the node's data dir. Raft's
+  commit safety assumes voters keep their acked log across restarts;
+  without the WAL a single ``kill -9`` of one replica could roll an
+  acked quorum back below a committed entry and elect a leader
+  missing a client-acked write. Every durable byte goes through the
+  ``cluster/storage.py`` VFS seam, so the nemesis plane can swap in
+  a lying disk (torn writes, bit rot, EIO, ENOSPC, stalls) under
+  the real recovery paths; fsync EIO FAIL-STOPS the node with a
+  death certificate — never a retry (docs/CLUSTER.md). Every COMMITTED
   entry is additionally mirrored into a :class:`TieredStore`, whose
   sweep seals cold segments to disk as RS-coded shards; the WAL is
   rotated down to the unsealed suffix as sealing advances, so it
@@ -66,14 +71,20 @@ engine's fixed ``entry_bytes`` convention.
 
 from __future__ import annotations
 
+import collections
+import errno
 import json
 import os
 import random
 import struct
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
-from raft_tpu.ckpt.tiered import TieredStore, _atomic_write
+from raft_tpu.admission.gate import Overloaded
+from raft_tpu.ckpt.tiered import TieredStore
+from raft_tpu.cluster import storage as vfs
+from raft_tpu.cluster.storage import DiskFailStop, DiskFull, RealIO
 from raft_tpu.multi.engine import NotLeader, ReadLagging
 from raft_tpu.net import protocol as P
 from raft_tpu.net.server import _Done, _Pending
@@ -81,8 +92,12 @@ from raft_tpu.obs import blackbox
 
 REC_BYTES = 64
 
-# wal.log record: kind (1 = append) | index | term | REC_BYTES payload
-_WAL_REC = struct.Struct("!BQI")
+# wal.bin record: kind (1 = append) | index | term | crc32 | payload.
+# The CRC covers header-sans-crc + payload, so replay can tell a torn
+# or bit-rotted record from a valid one anywhere in the file — not
+# just at the tail — and truncate to the last valid prefix.
+_WAL_REC = struct.Struct("!BQII")
+_WAL_HDR = struct.Struct("!BQI")
 _WAL_APPEND = 1
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
@@ -132,6 +147,9 @@ class RaftNode:
         segment_entries: int = 64,
         seed: Optional[int] = None,
         wal_fsync: bool = True,
+        io=None,
+        wal_group_commit: bool = False,
+        digest_every: int = 16,
     ):
         self.node_id = node_id
         self.peers = dict(peers)
@@ -147,6 +165,7 @@ class RaftNode:
         want_lease = lease_s if lease_s is not None else 4 * heartbeat_s
         self.lease_s = min(want_lease, 0.8 * election_timeout_s)
         self.wal_fsync = wal_fsync
+        self.wal_group_commit = wal_group_commit
         self.max_append = max_append
         self.snap_chunk = snap_chunk
         self.snap_threshold = (snap_threshold if snap_threshold is not None
@@ -155,13 +174,17 @@ class RaftNode:
                                   else (os.getpid() << 8) | node_id)
 
         # ------------------------------------------------- durable state
+        # every durable byte this node writes goes through ONE storage
+        # backend — the seam the nemesis plane swaps a lying disk into
+        self._io = io if io is not None else RealIO()
+        self.failed = False      # fail-stopped on an unknowable disk
         self.term = 0
         self.voted_for: Optional[int] = None
         self.generation = 1
         self.store = TieredStore(
             REC_BYTES, os.path.join(data_dir, "segments"),
             hot_entries=hot_entries, segment_entries=segment_entries,
-            adopt=True,
+            adopt=True, io_backend=self._io,
         )
         self._load_vote()
 
@@ -172,10 +195,34 @@ class RaftNode:
         self.kv: Dict[bytes, bytes] = {}
         self.commit = 0
         self.applied = 0
-        self._wal_path = os.path.join(data_dir, "wal.log")
+        self._wal_path = os.path.join(data_dir, "wal.bin")
         self._wal_f = None       # opened by _wal_rewrite in replay
-        self._wal_hi = 0         # highest index durable in the WAL
+        self._wal_hi = 0         # highest index FSYNC-durable in the WAL
+        self._wal_written = 0    # highest index written (maybe unsynced)
         self._wal_records = 0    # records in the file (rotation clock)
+        self._wal_deferred: List[Tuple] = []   # acks gated on the fsync
+        # the wal_skip_corrupt broken variant: replay SKIPS a corrupt
+        # record instead of truncating — the classic recovery bug the
+        # commit-digest plane exists to catch (env-gated so only the
+        # nemesis drill can arm it)
+        self._wal_skip_corrupt = bool(
+            os.environ.get("RAFT_TPU_WAL_SKIP_CORRUPT"))
+        # commit-digest audit plane: a rolling crc32 over every applied
+        # (idx, term, record), checkpointed at fixed indices — replicas
+        # applying the same prefix MUST agree byte-for-byte, so any
+        # recovery path that silently diverges the log trips this even
+        # when Raft's (index, term) checks all pass
+        self.digest_every = max(1, digest_every)
+        self._digest = 0
+        self._digest_ckpts: collections.deque = collections.deque(
+            maxlen=8)
+        self.stats: Dict[str, int] = {
+            "elections": 0, "terms_won": 0, "appends_in": 0,
+            "appends_out": 0, "snap_chunks_in": 0, "snap_chunks_out": 0,
+            "reads_lease": 0, "reads_read_index": 0, "denied_frames": 0,
+            "wal_fsyncs": 0, "wal_truncated_records": 0,
+            "wal_skipped_corrupt": 0, "disk_full_shed": 0,
+        }
         self._replay_adopted()
 
         now = time.monotonic()
@@ -203,18 +250,13 @@ class RaftNode:
         self._reads: Dict[int, Tuple[int, int, bytes]] = {}
         self._next_ticket = 1
         self._submit_terms: Dict[int, int] = {}  # seq -> term at submit
-        self.stats: Dict[str, int] = {
-            "elections": 0, "terms_won": 0, "appends_in": 0,
-            "appends_out": 0, "snap_chunks_in": 0, "snap_chunks_out": 0,
-            "reads_lease": 0, "reads_read_index": 0, "denied_frames": 0,
-        }
 
     # ----------------------------------------------------- durable state
     def _vote_path(self) -> str:
         return os.path.join(self.data_dir, "vote.json")
 
     def _persist_vote(self) -> None:
-        _atomic_write(self._vote_path(), json.dumps({
+        self._io.atomic_write(self._vote_path(), json.dumps({
             "term": self.term, "voted_for": self.voted_for,
             "generation": self.generation,
         }).encode())
@@ -257,10 +299,18 @@ class RaftNode:
             if kvv is not None:
                 self.kv[kvv[0]] = kvv[1]
             self.commit = self.applied = i
+            self._digest_update(i, term, rec)
         self.log = self.log[: self.commit]
         for idx, term, rec in self._wal_scan():
             if idx <= self.commit:
                 continue               # sealed prefix is authoritative
+            if self._wal_skip_corrupt:
+                # BROKEN (drill-armed): blind append — a skipped corrupt
+                # record shifts every later record down one index, and
+                # Raft's (index, term) checks cannot see it. Only the
+                # commit-digest plane can.
+                self.log.append((term, rec))
+                continue
             if idx > self.last_idx + 1:
                 break                  # torn tail: stream re-replicates
             if idx <= self.last_idx:
@@ -272,21 +322,42 @@ class RaftNode:
         self._wal_rewrite(self.commit)
 
     # ------------------------------------------------- write-ahead log
+    def _wal_pack(self, i: int) -> bytes:
+        term, rec = self.log[i - 1]
+        hdr = _WAL_HDR.pack(_WAL_APPEND, i, term)
+        return _WAL_REC.pack(_WAL_APPEND, i, term,
+                             zlib.crc32(hdr + rec)) + rec
+
     def _wal_scan(self):
         """Yield ``(idx, term, rec)`` append records; stops at the
-        first torn or unknown record (a crash mid-write loses at most
-        the record being written — which was never acked)."""
+        first record whose CRC does not verify — torn tail, mid-file
+        bit rot, or unknown kind alike — truncating replay to the last
+        valid prefix. NEVER skips past corruption: a skipped record
+        shifts every later index and silently diverges the log (the
+        ``wal_skip_corrupt`` broken variant exists to prove the digest
+        plane catches exactly that)."""
         try:
-            with open(self._wal_path, "rb") as f:
-                blob = f.read()
+            blob = self._io.read_bytes(self._wal_path)
         except OSError:
             return
         off, step = 0, _WAL_REC.size + REC_BYTES
         while off + step <= len(blob):
-            kind, idx, term = _WAL_REC.unpack_from(blob, off)
-            if kind != _WAL_APPEND:
+            kind, idx, term, crc = _WAL_REC.unpack_from(blob, off)
+            rec = blob[off + _WAL_REC.size: off + step]
+            ok = (kind == _WAL_APPEND
+                  and crc == zlib.crc32(_WAL_HDR.pack(kind, idx, term)
+                                        + rec))
+            if not ok:
+                if self._wal_skip_corrupt:       # BROKEN (drill-armed)
+                    self.stats["wal_skipped_corrupt"] += 1
+                    off += step
+                    continue
+                self.stats["wal_truncated_records"] += (
+                    len(blob) - off) // step
+                blackbox.mark("wal_truncate", node=self.node_id,
+                              at_record=off // step)
                 return
-            yield idx, term, blob[off + _WAL_REC.size: off + step]
+            yield idx, term, rec
             off += step
 
     def _wal_rewrite(self, keep_above: int) -> None:
@@ -296,39 +367,119 @@ class RaftNode:
         if self._wal_f is not None:
             self._wal_f.close()
         blob = b"".join(
-            _WAL_REC.pack(_WAL_APPEND, i, self.log[i - 1][0])
-            + self.log[i - 1][1]
+            self._wal_pack(i)
             for i in range(keep_above + 1, self.last_idx + 1)
         )
-        _atomic_write(self._wal_path, blob)
-        self._wal_f = open(self._wal_path, "ab")
-        if self.wal_fsync:
-            os.fsync(self._wal_f.fileno())
+        self._io.atomic_write(self._wal_path, blob)
+        self._wal_f = self._io.open_append(self._wal_path)
         self._wal_records = self.last_idx - keep_above
-        self._wal_hi = self.last_idx
-
-    def _wal_extend(self, upto: int) -> None:
-        """Make ``log[.. upto]`` WAL-durable — called BEFORE any reply
-        or quorum count rides on those entries. One flush+fsync per
-        call (per frame / per broadcast), not per entry."""
-        if upto <= self._wal_hi:
-            return
-        self._wal_f.write(b"".join(
-            _WAL_REC.pack(_WAL_APPEND, i, self.log[i - 1][0])
-            + self.log[i - 1][1]
-            for i in range(self._wal_hi + 1, upto + 1)
-        ))
-        self._wal_records += upto - self._wal_hi
-        self._wal_hi = upto
-        self._wal_f.flush()
+        self._wal_hi = self._wal_written = self.last_idx
         if self.wal_fsync:
-            os.fsync(self._wal_f.fileno())
+            self._wal_fsync_once("wal_rewrite")
+
+    def _wal_fsync_once(self, where: str) -> None:
+        """The ONLY fsync call site for the WAL handle. EIO here means
+        the kernel may have dropped dirty pages we can never see again
+        (the PostgreSQL fsyncgate lesson): the one sound response is
+        FAIL-STOP — publish a death certificate and die — because a
+        retried fsync that returns clean would certify bytes that are
+        gone."""
+        try:
+            self._wal_f.fsync()
+        except OSError as ex:
+            if getattr(ex, "errno", None) == errno.EIO:
+                self._fail_stop(where, ex)
+            raise
+        self.stats["wal_fsyncs"] += 1
+
+    def _fail_stop(self, where: str, ex: BaseException) -> None:
+        """Publish a death certificate (via a REAL write — the faulty
+        seam must not get a second chance to lie about it) and refuse
+        all further work. The supervisor reads the certificate to tell
+        'disk genuinely broken' from 'crashed while recovering'."""
+        self.failed = True
+        try:
+            vfs.atomic_write(
+                os.path.join(self.data_dir, "death.json"),
+                json.dumps({
+                    "node": self.node_id, "pid": os.getpid(),
+                    "where": where, "errno": getattr(ex, "errno", None),
+                    "error": str(ex), "term": self.term,
+                    "commit": self.commit, "wal_hi": self._wal_hi,
+                    "ts": time.time(),
+                }).encode())
+        except OSError:
+            pass
+        blackbox.mark("disk_fail_stop", node=self.node_id, where=where,
+                      error=str(ex))
+        raise DiskFailStop(f"{where}: {ex}") from ex
+
+    def _wal_extend(self, upto: int, *, defer: bool = False) -> bool:
+        """Write ``log[.. upto]`` into the WAL. With ``defer`` False
+        the records are fsynced before returning (one fsync per call —
+        per frame / per broadcast, not per entry) and the result is
+        True. With ``defer`` True (group commit) the write lands but
+        the fsync is left for :meth:`flush_wal`, which the peer
+        backend schedules once per ingest sweep — every frame handled
+        in the sweep shares ONE fsync, and every ack gated on it is
+        withheld until that fsync returns. Raises :class:`DiskFull`
+        with nothing acked when the disk refuses the write."""
+        if upto > self._wal_written:
+            self._wal_f.write(b"".join(
+                self._wal_pack(i)
+                for i in range(self._wal_written + 1, upto + 1)
+            ))
+            self._wal_records += upto - self._wal_written
+            self._wal_written = upto
+        if self._wal_written <= self._wal_hi:
+            return True                      # already durable
+        if defer and self.wal_group_commit:
+            return False
+        self._wal_sync()
+        return True
+
+    def _wal_sync(self) -> None:
+        """Promote everything written to fsync-durable, then rotate if
+        sealing has moved the durable floor past most of the file."""
+        if self.wal_fsync:
+            self._wal_fsync_once("wal_fsync")
+        self._wal_hi = self._wal_written
         # rotation: sealing moved the durable floor up — shed the
         # sealed prefix (and accumulated replace records) once the
         # file is mostly history
         sealed = self.store._sealed_hi
         if self._wal_records > 2 * max(1, self.last_idx - sealed) + 256:
             self._wal_rewrite(sealed)
+
+    def flush_wal(self) -> List[Tuple[int, bytes]]:
+        """Group commit's release point: ONE fsync promotes every
+        record written since the last flush, then the acks that were
+        deferred on it are built and returned as ``(peer, frame)``
+        pairs. Acks stamped with a superseded term are dropped — the
+        reply would be rejected anyway, and the entries it vouched for
+        may have been truncated by the new term's appends."""
+        if self.failed:
+            raise DiskFailStop("node has fail-stopped")
+        if self._wal_written > self._wal_hi:
+            self._wal_sync()
+        if not self._wal_deferred:
+            return []
+        out: List[Tuple[int, bytes]] = []
+        for term, peer, tag, a, b in self._wal_deferred:
+            if term != self.term:
+                continue
+            if tag == "append":
+                out.append((peer, P.encode_peer_append_reply(
+                    self.node_id, self.term, True, a, b)))
+            else:
+                out.append((peer, P.encode_peer_snap_ack(
+                    self.node_id, self.term, a)))
+        self._wal_deferred = []
+        return out
+
+    def wal_flush_pending(self) -> bool:
+        return (self._wal_written > self._wal_hi
+                or bool(self._wal_deferred))
 
     # -------------------------------------------------------- log helpers
     @property
@@ -345,6 +496,11 @@ class RaftNode:
 
     # ------------------------------------------------------------- timers
     def tick(self, now: float) -> None:
+        if self.failed:
+            # fail-stopped: the ticker must see this and exit the
+            # process — a node whose disk state is unknowable serves
+            # nothing, votes for nothing, acks nothing
+            raise DiskFailStop("node has fail-stopped")
         self._poll_ctrl()
         if self.role == LEADER:
             if self._dirty or now - self.last_hb >= self.hb_s:
@@ -435,7 +591,14 @@ class RaftNode:
         self._round_sent.pop(self.hb_round - 4096, None)
         # the leader is a quorum member too: its own log share must be
         # WAL-durable before any follower ack can complete a commit
-        self._wal_extend(self.last_idx)
+        try:
+            self._wal_extend(self.last_idx)
+        except DiskFull:
+            # a full disk stalls the leader's OWN quorum share (it
+            # stays at _wal_hi, so commit cannot ride un-persisted
+            # entries) but heartbeats keep flowing — leadership is not
+            # forfeited over ENOSPC
+            self.stats["disk_full_shed"] += 1
         for p in self.others:
             if p in self.snap_mode:
                 # the stream paces itself on acks — but a chunk (or its
@@ -503,11 +666,23 @@ class RaftNode:
             kvv = unpack_record(rec)
             if kvv is not None:
                 self.kv[kvv[0]] = kvv[1]
+            self._digest_update(self.applied, term, rec)
             # mirror into the durable tier: only committed entries ever
             # reach the store, so adoption after a crash never resurrects
             # an uncommitted suffix
             self.store.apply_cursor = self.applied
             self.store.put(self.applied, rec, term=term)
+
+    def _digest_update(self, idx: int, term: int, rec: bytes) -> None:
+        """Fold one applied entry into the rolling commit digest and
+        checkpoint at fixed indices — every replica that applied the
+        same prefix holds the same digest at the same checkpoint, so
+        the drill's cross-node comparison needs no synchronized
+        snapshot, only one overlapping checkpoint index."""
+        self._digest = zlib.crc32(
+            struct.pack("!QI", idx, term) + rec, self._digest)
+        if idx % self.digest_every == 0:
+            self._digest_ckpts.append((idx, self._digest))
 
     # --------------------------------------------------------- lease math
     def _quorum_recency(self, now: float) -> float:
@@ -531,6 +706,8 @@ class RaftNode:
         """Handle one peer frame; returns reply frames for the SAME
         connection. Called from reader tasks — same thread as tick."""
         now = time.monotonic()
+        if self.failed:
+            return []      # fail-stopped: the ticker is about to exit
         sender = struct.unpack_from("!I", payload)[0]
         if sender in self.deny:
             self.stats["denied_frames"] += 1
@@ -633,11 +810,20 @@ class RaftNode:
                     continue
                 del self.log[idx - 1:]       # conflict: truncate suffix
                 self._wal_hi = min(self._wal_hi, idx - 1)
+                self._wal_written = min(self._wal_written, idx - 1)
             self.log.append((ent_term, rec))
         match = prev_idx + len(entries)
         # durable BEFORE the ack: the reply lets the leader count this
         # log into a commit quorum, so it must survive our kill -9
-        self._wal_extend(self.last_idx)
+        try:
+            synced = self._wal_extend(self.last_idx, defer=True)
+        except DiskFull:
+            # nothing was persisted and nothing may be acked: report
+            # our durable floor so the leader retries from there
+            self.stats["disk_full_shed"] += 1
+            return [P.encode_peer_append_reply(
+                self.node_id, self.term, False,
+                min(self._wal_hi, prev_idx), round_no)]
         if commit > self.commit:
             # clamp to the last entry THIS append validated, not
             # last_idx: a retained tail past `match` has not been
@@ -645,6 +831,11 @@ class RaftNode:
             # last new entry" rule)
             self.commit = min(commit, match)
             self._apply_committed()
+        if not synced:
+            # group commit: the ack waits for the sweep's shared fsync
+            self._wal_deferred.append(
+                (self.term, leader, "append", match, round_no))
+            return []
         return [P.encode_peer_append_reply(
             self.node_id, self.term, True, match, round_no)]
 
@@ -708,11 +899,18 @@ class RaftNode:
                     continue
                 del self.log[idx - 1:]       # conflict: truncate suffix
                 self._wal_hi = min(self._wal_hi, idx - 1)
+                self._wal_written = min(self._wal_written, idx - 1)
             self.log.append((ent_term, rec))
         validated = base - 1 + len(entries)
         # durable BEFORE the ack (the leader treats snap acks as
         # authoritative match — a quorum count may ride on this)
-        self._wal_extend(self.last_idx)
+        try:
+            synced = self._wal_extend(self.last_idx, defer=True)
+        except DiskFull:
+            # ack nothing: the stream re-sends the chunk after a few
+            # silent heartbeats, by which time the disk may have room
+            self.stats["disk_full_shed"] += 1
+            return []
         if commit > self.commit:
             # clamp to the chunk's end: a retained tail past it has
             # not been term-checked against the leader yet
@@ -720,6 +918,11 @@ class RaftNode:
             self._apply_committed()
         # the ack claims exactly the VALIDATED prefix, never a raw
         # last_idx that may include an unchecked suffix
+        if not synced:
+            self._wal_deferred.append(
+                (self.term, leader, "snap",
+                 max(validated, self.commit), 0))
+            return []
         return [P.encode_peer_snap_ack(self.node_id, self.term,
                                        max(validated, self.commit))]
 
@@ -784,6 +987,13 @@ class RaftNode:
                ) -> Tuple[int, int]:
         if self.role != LEADER:
             raise NotLeader(0, "not the leader")
+        if self._io.is_full():
+            # ENOSPC is a SHED, never a corruption: refuse typed (the
+            # ingest tier turns Overloaded into a REFUSED frame with a
+            # retry hint) rather than accept an entry whose WAL write
+            # is known to fail
+            self.stats["disk_full_shed"] += 1
+            raise Overloaded("disk_full", retry_after_s=4 * self.hb_s)
         self.log.append((self.term, pack_record(key, value)))
         # remember WHICH entry was promised at this index: durability
         # must later be certified for this term's entry, not whatever
@@ -862,7 +1072,10 @@ class RaftNode:
             "leader": self.leader_id, "commit": self.commit,
             "applied": self.applied, "last_idx": self.last_idx,
             "wal_hi": self._wal_hi,
+            "wal_written": self._wal_written,
             "generation": self.generation,
+            "digest": self._digest,
+            "digest_ckpts": list(self._digest_ckpts),
             "tier": self.store.tier_summary(),
             **{k: v for k, v in self.stats.items()},
         }

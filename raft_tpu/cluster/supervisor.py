@@ -22,7 +22,11 @@ process-level fault surface the chaos nemeses compose:
 ``min_life_s``, the environment can never work — :class:`ClusterBroken`
 is raised immediately so a broken container costs ~3 short failures,
 not minutes of the tier-1 budget. Deliberate kills do NOT count; only
-spawns that never became ready.
+spawns that never became ready — and a spawn that published a death
+certificate (``death.json``, the disk fail-stop contract) is an
+EXPLAINED death, exempt too: the storage drill raises ``fast_fail``
+while injecting faults precisely so "recovering under injection" is
+never mistaken for "this environment cannot run clusters".
 """
 
 from __future__ import annotations
@@ -76,6 +80,10 @@ class ClusterSupervisor:
         ready_timeout_s: float = 20.0,
         fast_fail: int = 3,
         min_life_s: float = 15.0,
+        wal_group_commit: bool = True,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
+        tls_ca: Optional[str] = None,
         env: Optional[Dict[str, str]] = None,
         rendezvous_root: Optional[str] = None,
     ):
@@ -110,6 +118,10 @@ class ClusterSupervisor:
             "snap_threshold": snap_threshold,
             "segment_entries": segment_entries,
             "hot_entries": hot_entries,
+            "wal_group_commit": wal_group_commit,
+            "tls_cert": tls_cert,
+            "tls_key": tls_key,
+            "tls_ca": tls_ca,
         }
         self.spec_path = os.path.join(base_dir, "cluster.json")
         with open(self.spec_path, "w") as f:
@@ -166,6 +178,20 @@ class ClusterSupervisor:
         except (OSError, ValueError):
             return None
 
+    def death_certificate(self, i: int) -> Optional[dict]:
+        """The node's published fail-stop evidence (``death.json``,
+        written by the node itself when fsync reported EIO), or None.
+        This is how the harness tells 'the disk is genuinely broken'
+        (explained, certificate present) from 'crashed while
+        recovering under injection' (unexplained — the crash-loop
+        counter's business). Cleared on the next spawn."""
+        try:
+            with open(os.path.join(self.node_dir(i),
+                                   "death.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def alive(self, i: int) -> bool:
         p = self.procs.get(i)
         return p is not None and p.poll() is None
@@ -178,7 +204,8 @@ class ClusterSupervisor:
                 "multi-process clusters cannot run here"
             )
         for stale in (self._ready_path(i),
-                      os.path.join(self.base_dir, f"status-{i}.json")):
+                      os.path.join(self.base_dir, f"status-{i}.json"),
+                      os.path.join(self.node_dir(i), "death.json")):
             # a prior incarnation's ready/status files must not speak
             # for the new child: readiness keys off the fresh pid, and
             # a status poller must see "no snapshot yet", not the dead
@@ -230,8 +257,21 @@ class ClusterSupervisor:
             except (OSError, ValueError):
                 pass
             time.sleep(0.05)
-        # never became ready (died, or hung past the deadline): a
-        # young death for the crash-loop counter
+        # never became ready. A published death certificate from THIS
+        # pid is an EXPLAINED fail-stop (the disk lied and the node
+        # did the sound thing) — it must not count toward the
+        # crash-loop verdict, which exists to catch the UNexplained
+        cert = self.death_certificate(i)
+        p = self.procs.get(i)
+        if cert is not None and p is not None and (
+                cert.get("pid") == p.pid):
+            blackbox.mark("cluster_fail_stop", node=i,
+                          where=cert.get("where"))
+            self.kill9(i, count_young=False)
+            raise RuntimeError(
+                f"node {i} fail-stopped on a disk fault: {cert}")
+        # died young or hung past the deadline, with no certificate:
+        # a young death for the crash-loop counter
         life = time.monotonic() - t0
         if life < self.min_life_s or not self.alive(i):
             self._young_deaths += 1

@@ -315,6 +315,8 @@ class PeerBackend:
     def __init__(self, node, auth=None):
         self.node = node
         self.auth = auth
+        self._peer_conns: Dict[int, object] = {}   # peer id -> last conn
+        self._flush_scheduled = False
 
     def on_frame(self, conn, kind: int, payload: bytes):
         if kind == P.PEER_HELLO:
@@ -322,10 +324,53 @@ class PeerBackend:
             if self.auth is not None:
                 self.auth.verify(token)       # raises PeerAuthError
             conn.peer_id = peer_id
+            self._peer_conns[peer_id] = conn
             return self.node.on_peer_hello(peer_id, last_idx)
         if getattr(conn, "peer_id", None) is None and self.auth is not None:
             raise P.ProtocolError("peer frame before PEER_HELLO auth")
-        return self.node.on_peer_frame(kind, payload)
+        if getattr(conn, "peer_id", None) is not None:
+            self._peer_conns[conn.peer_id] = conn
+        out = self.node.on_peer_frame(kind, payload)
+        self._maybe_schedule_flush()
+        return out
+
+    # ------------------------------------------------- WAL group commit
+    def _maybe_schedule_flush(self) -> None:
+        """Group commit's scheduling half: when the node deferred acks
+        on an un-fsynced WAL tail, arrange ONE flush at the end of the
+        current event-loop sweep (``call_soon`` runs after every reader
+        task that already has buffered frames has handled them) — all
+        frames of the sweep share a single fsync, at zero added
+        latency. The ticker's ``flush_wal`` drain is only the laggard
+        fallback when no loop is running here."""
+        flush_pending = getattr(self.node, "wal_flush_pending", None)
+        if (self._flush_scheduled or flush_pending is None
+                or not flush_pending()):
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return       # driven synchronously (unit tests): no sweep
+        self._flush_scheduled = True
+        loop.call_soon(self._do_flush)
+
+    def _do_flush(self) -> None:
+        self._flush_scheduled = False
+        try:
+            replies = self.node.flush_wal()
+        except Exception:
+            # a disk fail-stop lands here on a bare loop callback: the
+            # node has already flagged itself failed, and the ticker —
+            # which owns process teardown — re-raises on its next tick
+            return
+        for peer, frame in replies:
+            conn = self._peer_conns.get(peer)
+            if conn is not None and getattr(conn, "open", False):
+                conn.send(frame)
+            else:
+                # arrival connection died while the fsync ran: the
+                # dialer's outbound link carries the ack instead
+                self.node.outbox.append((peer, frame))
 
     def status_snapshot(self) -> dict:
         return self.node.status()
@@ -425,6 +470,7 @@ class IngestServer:
         pump=None,
         txn=None,
         peer=None,
+        ssl=None,
     ) -> None:
         self.backend = backend
         self.host = host
@@ -465,6 +511,9 @@ class IngestServer:
         #   server is one replica of a multi-process cluster and its
         #   port carries replica-to-replica traffic alongside clients
         #   (None = clients only, peer frames are unknown kinds)
+        self.ssl = ssl
+        #   ssl.SSLContext (cluster/auth.py server_ssl) — every byte of
+        #   this port, client and peer alike, rides TLS when set
 
         self._server: Optional[asyncio.base_events.Server] = None
         self._pump_task: Optional[asyncio.Task] = None
@@ -500,7 +549,7 @@ class IngestServer:
     async def start(self) -> int:
         self._running = True
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port
+            self._handle_conn, self.host, self.port, ssl=self.ssl
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self._pump_task = asyncio.create_task(self._pump())

@@ -604,3 +604,401 @@ class TestTxnLeaseReads:
         assert lease.read_certs["lease"] > lease.read_certs.get(
             "read_index", 0)
         assert "--txn-lease-reads" in lease.repro
+
+
+# ------------------------------------------- storage-fault nemesis
+# Round-21 units: the lying-disk seam (cluster/storage.py), the WAL
+# CRC recovery discipline, group commit, manifest fallback, and the
+# fsyncgate fail-stop contract — each pinned in-process before the
+# multi-process drill composes them.
+class TestWalCrcRecovery:
+    def _filled(self, tmp_path, n_entries=12):
+        n = _node(tmp_path)
+        recs = [(1, _rec(b"k%d" % i, b"v%d" % i))
+                for i in range(1, n_entries + 1)]
+        app = _one_frame(P.encode_peer_append(
+            0, term=1, prev_idx=0, prev_term=0, commit=0, round_no=1,
+            entries=recs))
+        n.on_peer_frame(*app)
+        assert n.last_idx == n_entries
+        return n
+
+    def test_midfile_bit_rot_truncates_never_skips(self, tmp_path):
+        """A flipped bit in the MIDDLE of wal.bin (not the tail) must
+        truncate replay to the last valid prefix: entries before the
+        rot survive, everything after is re-fetched from the leader —
+        never silently skipped (that shifts every later index)."""
+        from raft_tpu.cluster.storage import flip_file_bit
+        import random as _random
+
+        n = self._filled(tmp_path)
+        pos = flip_file_bit(n._wal_path, _random.Random(3))
+        assert pos > 0
+        step = 17 + 64                     # _WAL_REC header + payload
+        bad_rec = pos // step              # 0-indexed rotten record
+
+        r = _node(tmp_path)
+        assert r.last_idx == bad_rec       # prefix kept, rot dropped
+        assert r.stats["wal_truncated_records"] >= 1
+        assert r.stats["wal_skipped_corrupt"] == 0
+
+    def test_skip_corrupt_broken_mode_shifts_the_log(
+            self, tmp_path, monkeypatch):
+        """The wal_skip_corrupt broken variant (env-armed): replay
+        skips the rotten record and blind-appends the suffix one index
+        early — Raft's (index, term) checks can't see it, which is
+        exactly why the commit-digest plane exists."""
+        import random as _random
+
+        from raft_tpu.cluster.storage import flip_file_bit
+
+        n = self._filled(tmp_path, n_entries=12)
+        flip_file_bit(n._wal_path, _random.Random(3))
+        monkeypatch.setenv("RAFT_TPU_WAL_SKIP_CORRUPT", "1")
+        r = _node(tmp_path)
+        assert r.stats["wal_skipped_corrupt"] >= 1
+        assert r.last_idx == 12 - r.stats["wal_skipped_corrupt"]
+        assert r.stats["wal_truncated_records"] == 0
+
+
+class TestWalGroupCommit:
+    def test_ack_defers_until_the_shared_fsync(self, tmp_path):
+        """Under group commit a follower append returns NO reply
+        inline: the ack is stashed until flush_wal() runs ONE fsync
+        for the whole sweep and releases every deferred ack."""
+        n = _node(tmp_path, wal_group_commit=True)
+        f0 = n.stats["wal_fsyncs"]
+        replies = []
+        for i in (1, 2, 3):
+            app = _one_frame(P.encode_peer_append(
+                0, term=1, prev_idx=i - 1, prev_term=1 if i > 1 else 0,
+                commit=0, round_no=i,
+                entries=[(1, _rec(b"k%d" % i, b"v"))]))
+            replies.extend(n.on_peer_frame(*app))
+        assert replies == []               # nothing acked pre-fsync
+        assert n.wal_flush_pending()
+        assert n._wal_written == 3 and n._wal_hi == 0
+
+        out = n.flush_wal()
+        assert n.stats["wal_fsyncs"] == f0 + 1      # ONE shared fsync
+        assert n._wal_hi == 3
+        assert len(out) == 3
+        peer, frame = out[-1]
+        assert peer == 0
+        _, term, ok, match, rnd = P.decode_peer_append_reply(
+            _one_frame(frame)[1])
+        assert ok is True and match == 3 and rnd == 3
+
+    def test_stale_term_deferred_acks_are_dropped(self, tmp_path):
+        """A term bump between the append and its shared fsync makes
+        the stashed ack a lie from a past life: flush must drop it,
+        not sign it with the new term."""
+        n = _node(tmp_path, wal_group_commit=True)
+        app = _one_frame(P.encode_peer_append(
+            0, term=1, prev_idx=0, prev_term=0, commit=0, round_no=1,
+            entries=[(1, _rec(b"a", b"1"))]))
+        assert n.on_peer_frame(*app) == []
+        # a rival leader's higher-term heartbeat lands before the flush
+        hb = _one_frame(P.encode_peer_append(
+            2, term=5, prev_idx=0, prev_term=0, commit=0, round_no=9,
+            entries=[]))
+        n.on_peer_frame(*hb)
+        assert n.term == 5
+        out = n.flush_wal()
+        # the rival's own (term-5) heartbeat ack survives the flush;
+        # the term-1 append ack to the deposed leader does not
+        assert [p for p, _ in out] == [2]
+        assert all(P.decode_peer_append_reply(_one_frame(f)[1])[1] == 5
+                   for _, f in out)
+        assert n._wal_hi == 1              # the entry is still durable
+
+
+class TestFaultyIOFailStop:
+    def _io(self, tmp_path, plan):
+        from raft_tpu.cluster.storage import FaultyIO, write_plan
+
+        d = str(tmp_path / "n1")
+        os.makedirs(d, exist_ok=True)
+        write_plan(d, plan)
+        return FaultyIO(d)
+
+    def test_fsync_eio_fail_stops_with_death_certificate(self, tmp_path):
+        """fsyncgate: after fsync reports EIO the page-cache state is
+        unknowable — the node must FAIL-STOP (death certificate, no
+        retry), never fsync again and carry on."""
+        import json as _json
+
+        from raft_tpu.cluster.storage import DiskFailStop
+
+        io = self._io(tmp_path, {"seed": 0, "eio_arm": True})
+        with pytest.raises(DiskFailStop):
+            _node(tmp_path, io=io)         # first WAL fsync EIOs
+        cert_path = tmp_path / "n1" / "death.json"
+        assert cert_path.exists()
+        cert = _json.loads(cert_path.read_text())
+        assert cert["errno"] == 5 and cert["where"]
+        assert io.stats["eio_raised"] == 1
+        assert io.stats["fsync_after_eio"] == 0     # the node NEVER retried
+        # and the seam keeps its tooth: a hypothetical retry is counted
+        # and refused loudly
+        h = io.open_append(str(tmp_path / "n1" / "x.bin"))
+        with pytest.raises(OSError):
+            h.fsync()
+        assert io.stats["fsync_after_eio"] == 1
+
+    def test_disk_full_sheds_typed_never_corrupts(self, tmp_path):
+        """ENOSPC is an OPERATIONAL fault: submit must shed with the
+        admission plane's typed Overloaded (provably no effect), and
+        the WAL file must stay byte-identical through the window."""
+        import time as _time
+
+        from raft_tpu.admission.gate import Overloaded
+        from raft_tpu.cluster.node import LEADER
+        from raft_tpu.cluster.storage import write_plan
+
+        io = self._io(tmp_path, {"seed": 0})
+        n = _node(tmp_path, io=io)
+        n.role, n.term = LEADER, 1
+        n.submit(b"k", b"v1")
+        n._wal_extend(n.last_idx)
+        before = open(n._wal_path, "rb").read()
+
+        write_plan(str(tmp_path / "n1"),
+                   {"seed": 0, "full_until_ts": _time.time() + 30})
+        _time.sleep(0.06)                  # one plan-poll period
+        with pytest.raises(Overloaded) as ei:
+            n.submit(b"k", b"v2")
+        assert ei.value.reason == "disk_full"
+        assert n.stats["disk_full_shed"] == 1
+        assert open(n._wal_path, "rb").read() == before
+
+    def test_fsync_lies_loses_the_acked_suffix(self, tmp_path):
+        """The fsync_lies broken disk: acks flow normally but nothing
+        reaches the platter — a restart finds an EMPTY WAL. This is
+        the loss the cluster drill's checker must catch."""
+        io = self._io(tmp_path, {"seed": 0, "fsync_lies": True})
+        n = _node(tmp_path, io=io)
+        app = _one_frame(P.encode_peer_append(
+            0, term=1, prev_idx=0, prev_term=0, commit=0, round_no=1,
+            entries=[(1, _rec(b"a", b"1")), (1, _rec(b"b", b"2"))]))
+        (rep,) = n.on_peer_frame(*app)     # acked as if durable
+        assert P.decode_peer_append_reply(_one_frame(rep)[1])[2] is True
+        assert n._wal_hi == 2
+        assert os.path.getsize(n._wal_path) == 0    # the lie, on disk
+
+        r = _node(tmp_path)                # restart on the real bytes
+        assert r.last_idx == 0             # the acked log is GONE
+
+
+class TestManifestRecovery:
+    def _sealed_store(self, tmp_path):
+        ps = blobs(64, seed=21)
+        s = TieredStore(ENTRY, root=str(tmp_path), hot_entries=16,
+                        segment_entries=8)
+        for i, b in enumerate(ps, 1):
+            s.put(i, b, 1)
+        assert s.stats["segments_sealed"] >= 2
+        return s, ps
+
+    def test_torn_manifest_falls_back_to_prev_generation(self, tmp_path):
+        """manifest.json caught half-written (the non-atomic-writer
+        state): adoption must fall back to manifest.json.prev — one
+        seal older, still a consistent sealed set — and never reseal
+        the segments it lists."""
+        from raft_tpu.cluster.storage import torn_truncate
+
+        s1, ps = self._sealed_store(tmp_path)
+        assert torn_truncate(os.path.join(str(tmp_path),
+                                          "manifest.json"))
+        s2 = TieredStore(ENTRY, root=str(tmp_path), hot_entries=16,
+                         segment_entries=8, adopt=True)
+        assert s2.stats["manifest_fallbacks"] == 1
+        assert s2.stats["segments_adopted"] >= 1
+        assert s2.stats["segments_resealed"] == 0
+        lo, hi = s2._sealed[0]
+        assert s2.get(lo) == (ps[lo - 1], 1)        # reads through
+
+    def test_missing_manifest_double_crash_rides_prev(self, tmp_path):
+        """The double-crash window: died after unlinking/replacing
+        manifest.json but .prev survived — same fallback, no loss of
+        the adopted set."""
+        self._sealed_store(tmp_path)
+        os.unlink(os.path.join(str(tmp_path), "manifest.json"))
+        s2 = TieredStore(ENTRY, root=str(tmp_path), hot_entries=16,
+                         segment_entries=8, adopt=True)
+        assert s2.stats["manifest_fallbacks"] == 1
+        assert s2.stats["segments_adopted"] >= 1
+
+    def test_both_manifests_corrupt_is_a_fresh_start(self, tmp_path):
+        """Both generations rotten: adopt must degrade to an empty
+        store (the snapshot stream re-backfills), never crash or
+        half-adopt garbage."""
+        self._sealed_store(tmp_path)
+        for name in ("manifest.json", "manifest.json.prev"):
+            p = os.path.join(str(tmp_path), name)
+            if os.path.exists(p):
+                with open(p, "w") as f:
+                    f.write("{ rotten")
+        s2 = TieredStore(ENTRY, root=str(tmp_path), hot_entries=16,
+                         segment_entries=8, adopt=True)
+        assert s2.stats["segments_adopted"] == 0
+        assert s2._sealed == []
+
+    def test_every_crash_state_has_a_loadable_manifest(self, tmp_path):
+        """The .prev chain invariant: after any number of seals, BOTH
+        manifest.json and manifest.json.prev parse (each written
+        atomically) — there is no crash point where a reader finds
+        zero loadable generations."""
+        import json as _json
+
+        self._sealed_store(tmp_path)
+        for name in ("manifest.json", "manifest.json.prev"):
+            with open(os.path.join(str(tmp_path), name)) as f:
+                doc = _json.load(f)
+            assert doc["sealed"]
+
+
+class TestClusterTLS:
+    def test_peer_wire_round_trip_over_tls(self, tmp_path):
+        """TLS end to end, once: self-signed cert through
+        cluster/auth.py on every child — leader election and the
+        first committed noop require REAL peer-frame round trips over
+        the encrypted transport, mutual-auth both ways."""
+        import shutil as _shutil
+        import subprocess
+        import time as _time
+
+        from raft_tpu.cluster import ClusterBroken, ClusterSupervisor
+
+        if _shutil.which("openssl") is None:
+            pytest.skip("openssl not available for self-signed certs")
+        cert = str(tmp_path / "cert.pem")
+        key = str(tmp_path / "key.pem")
+        gen = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+             "-subj", "/CN=raft-tpu-test",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            capture_output=True, text=True, timeout=60,
+        )
+        if gen.returncode != 0:
+            pytest.skip(f"openssl cannot mint a cert: {gen.stderr}")
+
+        base = str(tmp_path / "cluster")
+        sup = ClusterSupervisor(
+            3, base, heartbeat_s=0.05, election_timeout_s=0.4,
+            segment_entries=16, hot_entries=32,
+            tls_cert=cert, tls_key=key, tls_ca=cert,
+        )
+        try:
+            try:
+                sup.start_all()
+            except ClusterBroken as ex:
+                pytest.skip(f"multi-process clusters cannot run: {ex}")
+            deadline = _time.monotonic() + 15.0
+            lead = None
+            while _time.monotonic() < deadline:
+                lead = sup.leader()
+                if lead is not None and (sup.status(lead) or {}).get(
+                        "commit", 0) >= 1:
+                    break
+                _time.sleep(0.1)
+            st = sup.status(lead) if lead is not None else None
+            assert st is not None and st["commit"] >= 1, (
+                "no leader committed over TLS; child log:\n"
+                + sup.child_log_tail(0))
+        finally:
+            sup.stop_all()
+
+
+# ------------------------------------------ cluster storage drill
+@pytest.fixture(scope="class")
+def storage_drill():
+    """One seed-5 run of the storage-fault nemesis (~25 s: lying disk
+    under 3 real processes, composed with partition / kill -9 /
+    restart-adopt / mid-run EIO fail-stop)."""
+    from raft_tpu.chaos.runner import cluster_storage_run
+    from raft_tpu.cluster import ClusterBroken
+
+    try:
+        rep = cluster_storage_run(5)
+    except ClusterBroken as ex:
+        pytest.skip(f"multi-process clusters cannot run here: {ex}")
+    yield rep
+    shutil.rmtree(rep.base_dir, ignore_errors=True)
+
+
+class TestClusterStorageDrill:
+    def test_seed5_linearizable_under_the_lying_disk(self, storage_drill):
+        rep = storage_drill
+        assert rep.verdict == LINEARIZABLE
+        for cls, res in rep.per_class.items():
+            assert res.verdict == LINEARIZABLE, (cls, res)
+        assert rep.kills >= 1 and rep.partitions >= 1
+        assert rep.restarts >= 2                 # torn victim + EIO node
+        assert rep.digest_ok, rep.digest_detail
+
+    def test_recovery_receipts_all_present(self, storage_drill):
+        """Every hardened path actually fired: WAL truncated at the
+        first bad CRC, manifest rode .prev, the flipped shard was
+        reconstructed, the full window shed typed, stalls absorbed —
+        and the handoff contract still held on the rotten dirs."""
+        rep = storage_drill
+        assert rep.storage_ok, rep.summary()
+        assert rep.wal_truncated >= 1
+        assert rep.manifest_fallbacks >= 1
+        assert rep.segment_reconstructs >= 1
+        assert rep.disk_full_sheds >= 1
+        assert rep.stalls >= 1
+        assert rep.handoff_ok
+        assert rep.segments_resealed == 0        # even off .prev
+
+    def test_eio_fail_stop_publishes_the_certificate(self, storage_drill):
+        """The fsyncgate contract, end to end: exit 97, death.json
+        from the node's own hand, and ZERO post-EIO fsync calls."""
+        rep = storage_drill
+        assert rep.fail_stop_ok
+        assert rep.eio_exit == 97
+        assert rep.eio_cert and rep.eio_cert["errno"] == 5
+        assert rep.fsync_after_eio == 0
+
+
+class TestClusterStorageBrokenVariants:
+    def test_fsync_lies_is_caught_by_the_checker(self):
+        """A disk whose fsync returns before durability: after a
+        cluster-wide kill -9 the acked writes are gone, and the
+        per-class checker must flag the loss — a passing run here
+        would mean the harness lost its teeth."""
+        from raft_tpu.chaos.runner import cluster_storage_run
+        from raft_tpu.cluster import ClusterBroken
+
+        try:
+            rep = cluster_storage_run(5, broken="fsync_lies")
+        except ClusterBroken as ex:
+            pytest.skip(f"multi-process clusters cannot run here: {ex}")
+        try:
+            assert rep.caught is True
+            assert rep.caught_by == "checker"
+            assert rep.verdict == "VIOLATION"
+        finally:
+            shutil.rmtree(rep.base_dir, ignore_errors=True)
+
+    def test_wal_skip_corrupt_is_caught_by_the_digest_plane(self):
+        """Replay that SKIPS a corrupt WAL record: every later index
+        shifts, Raft's (index, term) checks all pass, the client
+        history stays clean — only the cross-node commit digest can
+        see the divergence, and it must."""
+        from raft_tpu.chaos.runner import cluster_storage_run
+        from raft_tpu.cluster import ClusterBroken
+
+        try:
+            rep = cluster_storage_run(5, broken="wal_skip_corrupt")
+        except ClusterBroken as ex:
+            pytest.skip(f"multi-process clusters cannot run here: {ex}")
+        try:
+            assert rep.caught is True
+            assert rep.caught_by == "digest"
+            assert not rep.digest_ok
+            assert "DIVERGED" in rep.digest_detail
+        finally:
+            shutil.rmtree(rep.base_dir, ignore_errors=True)

@@ -43,3 +43,78 @@ def test_ruff_check_clean():
         "ruff check found issues (rule set pinned in pyproject.toml):\n"
         + proc.stdout + proc.stderr
     )
+
+
+# ------------------------------------------- fsync-discipline gate
+# The storage-fault nemesis (docs/CLUSTER.md storage-fault model) only
+# has teeth while EVERY durable write in the consensus path rides the
+# VFS seam (raft_tpu/cluster/storage.py) — one direct open()/os.replace
+# in node.py or tiered.py and the lying disk silently stops covering
+# that write. This AST gate pins the discipline: in the files below, no
+# write-mode open(), no os.fsync, no os.replace, no tempfile use. Read-
+# mode open() is fine (reads can't corrupt), and storage.py itself is
+# the one place the real syscalls are allowed to live.
+
+_SEAM_FILES = (
+    "raft_tpu/cluster/node.py",
+    "raft_tpu/ckpt/tiered.py",
+)
+
+
+def _dotted(node):
+    """'os.replace'-style name for a call target, best effort."""
+    import ast
+
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _write_mode_open(call):
+    """True when this is open(...) with a write/append/create mode."""
+    import ast
+
+    if _dotted(call.func) != "open":
+        return False
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False                     # bare open(path) reads
+    if not isinstance(mode, ast.Constant) or not isinstance(
+            mode.value, str):
+        return True                      # dynamic mode: suspicious
+    return any(ch in mode.value for ch in "wax+")
+
+
+def test_durable_writes_ride_the_vfs_seam():
+    import ast
+
+    offenders = []
+    for rel in _SEAM_FILES:
+        tree = ast.parse((REPO / rel).read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if (name in ("os.fsync", "os.replace")
+                        or name.startswith("tempfile.")
+                        or _write_mode_open(node)):
+                    offenders.append(f"{rel}:{node.lineno}: {name}")
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names]
+                src = getattr(node, "module", None) or ""
+                if "tempfile" in mods or src == "tempfile":
+                    offenders.append(f"{rel}:{node.lineno}: "
+                                     "import tempfile")
+    assert not offenders, (
+        "durable writes must go through raft_tpu/cluster/storage.py "
+        "(the FaultyIO seam cannot cover direct syscalls):\n"
+        + "\n".join(offenders)
+    )

@@ -127,6 +127,11 @@ GATED_METRICS: Dict[str, str] = {
     # the kill row rides the existing macro gate.
     "cluster_goodput_eps": "up",
     "handoff_ratio": "down",
+    # storage round (round 18): WAL group commit — cluster-wide
+    # replicated entries per shared fsync on the goodput row. Gates UP
+    # so the one-fsync-per-ingest-sweep coalescing can never quietly
+    # fall back to fsync-per-append (1.0 is the degenerate floor).
+    "wal_fsync_batched": "up",
 }
 
 
