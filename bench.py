@@ -1977,7 +1977,7 @@ def bench_txn() -> dict:
 # ------------------------------------------------- multi-process cluster
 def bench_cluster() -> dict:
     """The serving tier measured AS DEPLOYED (docs/CLUSTER.md): real OS
-    processes, one replica each, peer frames over loopback TCP. Three
+    processes, one replica each, peer frames over loopback TCP. Four
     rows, emitted incrementally:
 
     - ``cluster_goodput`` — N unbatched single-op writes over CONNS
@@ -1988,6 +1988,16 @@ def bench_cluster() -> dict:
       gates UP in tools/bench_diff.py; the ratio is REPORTED UNGATED —
       it prices real peer replication across process boundaries, a
       deployment property, not a regression axis.
+    - ``cluster_latency`` — the same closed-loop shape with a 5ms±2ms
+      per-hop delay injected on every PEER link (the netfault seam,
+      docs/CLUSTER.md network-fault model) next to clean loopback:
+      goodput and e2e p50/p99 under real peer RTT, and
+      ``wal_fsync_batched`` re-measured — a slower quorum round means
+      MORE acks share each fsync, so group commit should amortize
+      better, not worse. ``cluster_rtt_goodput_eps`` gates UP and the
+      faulted ``e2e_p99_ms`` / ``wal_fsync_batched`` ride the existing
+      gates; old artifacts without the row stay comparable (bench_diff
+      gates on the key intersection only).
     - ``cluster_kill9`` — open-loop arrivals paced at 2x the measured
       cluster capacity with the LEADER killed -9 mid-window: e2e p99
       through failover (``e2e_p99_ms`` gates DOWN), plus the
@@ -2070,6 +2080,12 @@ def bench_cluster() -> dict:
         NODES, base, heartbeat_s=0.05, election_timeout_s=0.4,
         snap_threshold=24, segment_entries=16, hot_entries=32,
     )
+    # arm the netfault plan plumbing at boot (an empty plan injects
+    # nothing) so the latency row can merge a live peer-RTT fault in
+    # mid-run — the children only poll net.json if it existed at start
+    from raft_tpu.cluster.netfault import write_net_plan
+    for i in range(NODES):
+        write_net_plan(sup.node_dir(i), {"seed": 23})
     try:
         try:
             sup.start_all()
@@ -2151,7 +2167,65 @@ def bench_cluster() -> dict:
                                     asyncio.run(goodput_row()))
         eps = max(rows["goodput"]["cluster_goodput_eps"], 1.0)
 
-        # ---- row 2: kill -9 at 2x ------------------------------------
+        # ---- row 2: injected peer RTT --------------------------------
+        N_LAT = 240
+
+        async def latency_probe() -> dict:
+            cs = [await connect(i % NODES) for i in range(CONNS)]
+            lats: list = []
+            fsyncs0 = total_wal_fsyncs()
+            t0 = time.perf_counter()
+
+            async def w(ci, c, n):
+                for j in range(n):
+                    b0 = time.perf_counter()
+                    try:
+                        await c.submit(keys[j % len(keys)],
+                                       b"L%d-%d" % (ci, j))
+                    except _errs:
+                        continue
+                    lats.append((time.perf_counter() - b0) * 1e3)
+
+            await asyncio.gather(
+                *[w(ci, c, N_LAT // CONNS) for ci, c in enumerate(cs)]
+            )
+            wall = time.perf_counter() - t0
+            for c in cs:
+                await c.close()
+            await asyncio.sleep(0.7)    # one status-publish period
+            dsync = max(total_wal_fsyncs() - fsyncs0, 1)
+            p50, p99 = _percentiles(lats)
+            return {
+                "acked": len(lats),
+                "eps": len(lats) / max(wall, 1e-9),
+                "p50_ms": p50, "p99_ms": p99,
+                "fsync_batched": NODES * len(lats) / dsync,
+            }
+
+        clean = asyncio.run(latency_probe())
+        # 5ms +/- 2ms per peer hop, peer links only (client conns stay
+        # clean — the row prices quorum RTT, not client RTT)
+        sup.net_fault({"delay_ms": 5, "jitter_ms": 2})
+        time.sleep(0.3)                 # children poll the plan ~50ms
+        rtt = asyncio.run(latency_probe())
+        sup.net_fault({"delay_ms": None, "jitter_ms": None})
+        time.sleep(0.3)
+        rows["latency"] = _emit_leg("cluster_latency", {
+            "injected_peer_delay_ms": 5,
+            "injected_peer_jitter_ms": 2,
+            "clean_goodput_eps": round(clean["eps"], 1),
+            "cluster_rtt_goodput_eps": round(rtt["eps"], 1),
+            "rtt_vs_clean": round(
+                rtt["eps"] / max(clean["eps"], 1e-9), 3),
+            "clean_e2e_p50_ms": round(clean["p50_ms"], 2),
+            "clean_e2e_p99_ms": round(clean["p99_ms"], 2),
+            "e2e_p50_ms": round(rtt["p50_ms"], 2),
+            "e2e_p99_ms": round(rtt["p99_ms"], 2),
+            "wal_fsync_batched_clean": round(clean["fsync_batched"], 2),
+            "wal_fsync_batched": round(rtt["fsync_batched"], 2),
+        })
+
+        # ---- row 3: kill -9 at 2x ------------------------------------
         rate = 2.0 * eps
         OPS_KILL = max((int(rate * 3.0) // CONNS) * CONNS, 300)
         #   ~3 s of arrivals at exactly 2x measured capacity: the window
@@ -2212,7 +2286,7 @@ def bench_cluster() -> dict:
         rows["kill9"] = _emit_leg("cluster_kill9",
                                   asyncio.run(kill_row()))
 
-        # ---- row 3: restart handoff vs re-seal -----------------------
+        # ---- row 4: restart handoff vs re-seal -----------------------
         def survivors_commit() -> int:
             return max(
                 (commit_of(i) for i in range(NODES)

@@ -13,6 +13,7 @@ import json
 import sys
 
 from raft_tpu.chaos.runner import (
+    cluster_net_run,
     cluster_run,
     cluster_storage_run,
     migration_run,
@@ -139,6 +140,27 @@ def main(argv=None) -> int:
                          "checkpoints); with --broken fsync_lies or "
                          "wal_skip_corrupt, succeeds only if the lie "
                          "was CAUGHT")
+    ap.add_argument("--cluster-net", action="store_true",
+                    help="run the network-fault nemesis over the "
+                         "multi-process cluster (docs/CLUSTER.md "
+                         "network-fault model): every peer byte rides "
+                         "the netfault seam, and latency + jitter, a "
+                         "bandwidth trickle, torn frames, duplicate / "
+                         "reordered / cross-redial-replayed delivery, "
+                         "and post-header bit corruption compose with "
+                         "an ASYMMETRIC partition of the leader and "
+                         "kill -9 / restart-with-handoff; succeeds "
+                         "only if every read class holds its contract "
+                         "AND every wire receipt is present (injected "
+                         "corruption all dropped at the CRC check "
+                         "with commit digests agreeing, dup/reordered "
+                         "replies credited as zero lease evidence, "
+                         "CheckQuorum demotion then re-election "
+                         "within the liveness window, torn "
+                         "connections redialed, the killed ex-leader "
+                         "rejoined); with --broken peer_no_crc or "
+                         "lease_stale_round, succeeds only if the lie "
+                         "was CAUGHT")
     ap.add_argument("--txn", action="store_true",
                     help="run the cross-group transaction drill "
                          "(docs/TXN.md): a replicated 2PC coordinator "
@@ -184,7 +206,8 @@ def main(argv=None) -> int:
                     choices=["dirty_reads", "commit_rewind",
                              "lease_skew", "txn_partial_commit",
                              "txn_dirty_read", "fsync_lies",
-                             "wal_skip_corrupt"],
+                             "wal_skip_corrupt", "peer_no_crc",
+                             "lease_stale_round"],
                     default=None,
                     help="deliberately broken variant; the run SUCCEEDS "
                          "(exit 0) only if the harness catches it — "
@@ -209,7 +232,15 @@ def main(argv=None) -> int:
                          "kill -9, and wal_skip_corrupt (a WAL replay "
                          "that SKIPS a corrupt record instead of "
                          "truncating; needs --cluster-storage) must "
-                         "trip the cross-node commit-digest plane. "
+                         "trip the cross-node commit-digest plane, "
+                         "peer_no_crc (frame-CRC negotiation disabled; "
+                         "needs --cluster-net) must let injected wire "
+                         "corruption into the log where the digest "
+                         "plane catches it, and lease_stale_round (a "
+                         "lease clock that credits append replies at "
+                         "arrival time regardless of round; needs "
+                         "--cluster-net) must serve a stale lease "
+                         "read the per-class checker flags. "
                          "A passing broken run means the harness "
                          "lost its teeth")
     ap.add_argument("--audit", action="store_true",
@@ -312,7 +343,7 @@ def main(argv=None) -> int:
                          or args.reconfig or args.migration
                          or args.segments or args.membership
                          or args.reads or args.wire or args.txn
-                         or args.cluster_storage
+                         or args.cluster_storage or args.cluster_net
                          or args.overload_recovery is not None):
         ap.error("--cluster is a standalone multi-process drill (its "
                  "kill -9 / partition / pause / overload / restart "
@@ -324,15 +355,91 @@ def main(argv=None) -> int:
     if args.cluster_storage and (
             args.multi or args.overload or args.reconfig
             or args.migration or args.segments or args.membership
-            or args.reads or args.wire or args.txn
+            or args.reads or args.wire or args.txn or args.cluster_net
             or args.broken not in (None, "fsync_lies",
                                    "wal_skip_corrupt")
             or args.overload_recovery is not None):
         ap.error("--cluster-storage is a standalone multi-process "
                  "drill (--broken fsync_lies / wal_skip_corrupt are "
                  "its only compositions)")
+    if (args.broken in ("peer_no_crc", "lease_stale_round")
+            and not args.cluster_net):
+        ap.error("--broken %s applies to the --cluster-net drill"
+                 % args.broken)
+    if args.cluster_net and (
+            args.multi or args.overload or args.reconfig
+            or args.migration or args.segments or args.membership
+            or args.reads or args.wire or args.txn
+            or args.broken not in (None, "peer_no_crc",
+                                   "lease_stale_round")
+            or args.overload_recovery is not None):
+        ap.error("--cluster-net is a standalone multi-process drill "
+                 "(--broken peer_no_crc / lease_stale_round are its "
+                 "only compositions)")
 
     ok = True
+    if args.cluster_net:
+        from raft_tpu.cluster import ClusterBroken
+
+        for seed in range(args.seed, args.seed + args.sweep):
+            try:
+                rep = cluster_net_run(
+                    seed, nodes=args.cluster_nodes,
+                    clients=args.clients, keys=args.keys,
+                    step_budget=args.step_budget,
+                    blackbox_dir=args.blackbox_dir,
+                    broken=args.broken,
+                )
+            except ClusterBroken as ex:
+                print(json.dumps({
+                    "seed": seed, "verdict": "BROKEN_ENV",
+                    "error": str(ex).splitlines()[0],
+                }), flush=True)
+                return 1
+            print(rep.summary())
+            print(json.dumps({
+                "seed": seed,
+                "verdict": rep.verdict,
+                "per_class": {c: r.verdict
+                              for c, r in rep.per_class.items()},
+                "ops": rep.ops,
+                "op_counts": rep.op_counts,
+                "kills": rep.kills,
+                "restarts": rep.restarts,
+                "partitions": rep.partitions,
+                "frames_delayed": rep.frames_delayed,
+                "frames_dup": rep.frames_dup,
+                "frames_reordered": rep.frames_reordered,
+                "frames_replayed": rep.frames_replayed,
+                "conns_torn": rep.conns_torn,
+                "corrupt_injected": rep.corrupt_injected,
+                "corrupt_dropped": rep.corrupt_dropped,
+                "stale_round_ignored": rep.stale_round_ignored,
+                "demotions": rep.demotions,
+                "reelected": rep.reelected,
+                "reelect_s": rep.reelect_s,
+                "dialer_drops": rep.dialer_drops,
+                "redials": rep.redials,
+                "generation": rep.generation,
+                "segments_adopted": rep.segments_adopted,
+                "rejoined": rep.rejoined,
+                "digest_ok": rep.digest_ok,
+                "digest_detail": rep.digest_detail,
+                "broken": rep.broken,
+                "caught": rep.caught,
+                "caught_by": rep.caught_by,
+                "base_dir": rep.base_dir,
+            }), flush=True)
+            if args.broken:
+                # the flag's contract: a CAUGHT lie IS success
+                ok = ok and bool(rep.caught)
+            else:
+                ok = ok and (
+                    rep.verdict == "LINEARIZABLE"
+                    and rep.handoff_ok
+                    and rep.net_ok
+                )
+        return 0 if ok else 1
     if args.cluster_storage:
         from raft_tpu.cluster import ClusterBroken
 

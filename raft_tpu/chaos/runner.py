@@ -4487,3 +4487,584 @@ def cluster_storage_run(
         repro=(f"python -m raft_tpu.chaos --cluster-storage "
                f"--seed {seed}{flag.get(broken, '')}"),
     )
+
+
+# ---------------------------------------- the cluster network drill
+@dataclasses.dataclass
+class ClusterNetReport:
+    """Result of :func:`cluster_net_run` — the lying-NETWORK nemesis
+    over the multi-process cluster tier (docs/CLUSTER.md network-fault
+    model): every peer byte rides the ``cluster/netfault.py`` seam,
+    and the drill composes seed-driven latency + jitter, a bandwidth
+    trickle, mid-frame connection tears, duplicate + reordered +
+    cross-redial-replayed delivery, and post-header bit corruption
+    with an ASYMMETRIC partition of the leader (its sends deliver, its
+    replies vanish — the send-only-leader wedge) and the process
+    faults the cluster tier already owns (``kill -9``,
+    restart-with-handoff).
+
+    The healthy run must come back LINEARIZABLE per read class WITH
+    the wire receipts: corruption was INJECTED and every corrupted
+    frame was DROPPED at the CRC check (never decoded into the log —
+    commit digests still agree), connections were torn and redialed,
+    duplicated/reordered replies were counted as zero lease evidence
+    (``stale_round_ignored``), and the asymmetrically-partitioned
+    leader DEMOTED itself (CheckQuorum) so a new leader rose within
+    the liveness window.
+
+    The broken variants are the teeth check: ``peer_no_crc`` (CRC
+    negotiation disabled — injected corruption is accepted and the
+    commit-digest plane must diverge) and ``lease_stale_round``
+    (append replies credit lease evidence at ARRIVAL time regardless
+    of round — delayed in-flight replies stretch a deposed leader's
+    lease past the next election and the per-class checker must flag
+    the stale read). A broken run SUCCEEDS only when ``caught``."""
+
+    seed: int
+    broken: Optional[str]
+    per_class: Dict[str, "CheckResult"]
+    ops: int
+    op_counts: Dict[str, int]
+    nodes: int
+    kills: int
+    restarts: int
+    partitions: int
+    frames_delayed: int       # releases scheduled late (latency/bw)
+    frames_dup: int
+    frames_reordered: int
+    frames_replayed: int      # cross-redial-incarnation duplicates
+    conns_torn: int           # mid-frame cut + FIN
+    corrupt_injected: int     # bit flips the nemesis put on the wire
+    corrupt_dropped: int      # frames the CRC check refused to decode
+    stale_round_ignored: int  # dup/reordered replies credited ZERO
+    demotions: int            # CheckQuorum step-downs (asym leader)
+    reelected: bool
+    reelect_s: float          # asym partition -> new leader wall time
+    dialer_drops: int         # bounded-buffer frame drops
+    redials: int
+    generation: int           # kill -9 victim's post-restart generation
+    segments_adopted: int
+    rejoined: bool
+    digest_ok: bool
+    digest_detail: str
+    caught: Optional[bool]    # broken runs: the harness saw the lie
+    caught_by: str
+    statuses: Dict[int, Optional[dict]]
+    base_dir: str
+    repro: str
+
+    @property
+    def verdict(self) -> str:
+        verdicts = [c.verdict for c in self.per_class.values()]
+        if VIOLATION in verdicts:
+            return VIOLATION
+        if any(v != LINEARIZABLE for v in verdicts):
+            return "UNDETERMINED"
+        return LINEARIZABLE
+
+    @property
+    def handoff_ok(self) -> bool:
+        return (self.generation >= 2 and self.segments_adopted >= 1
+                and self.rejoined)
+
+    @property
+    def net_ok(self) -> bool:
+        """Every wire receipt the healthy run must produce."""
+        return (self.frames_delayed >= 1 and self.frames_dup >= 1
+                and self.conns_torn >= 1 and self.redials >= 1
+                and self.corrupt_injected >= 1
+                and self.corrupt_dropped >= 1
+                and self.stale_round_ignored >= 1
+                and self.demotions >= 1 and self.reelected
+                and self.digest_ok)
+
+    def summary(self) -> str:
+        cls = {c: r.verdict for c, r in self.per_class.items()}
+        core = (
+            f"seed={self.seed} classes={cls} ops={self.ops} "
+            f"delayed={self.frames_delayed} dup={self.frames_dup} "
+            f"reordered={self.frames_reordered} "
+            f"replayed={self.frames_replayed} torn={self.conns_torn} "
+            f"corrupt={self.corrupt_injected}/{self.corrupt_dropped} "
+            f"stale_ignored={self.stale_round_ignored} "
+            f"demotions={self.demotions} reelected={self.reelected} "
+            f"reelect_s={self.reelect_s:.2f} redials={self.redials} "
+            f"drops={self.dialer_drops} gen={self.generation} "
+            f"rejoined={self.rejoined} digest_ok={self.digest_ok}"
+        )
+        if self.broken:
+            return (f"{core} broken={self.broken} caught={self.caught} "
+                    f"by={self.caught_by}")
+        return core
+
+
+def cluster_net_run(
+    seed: int,
+    nodes: int = 3,
+    clients: int = 3,
+    keys: int = 4,
+    ops_per_phase: int = 12,
+    preload: int = 96,
+    step_budget: int = 500_000,
+    base_dir: Optional[str] = None,
+    blackbox_dir: Optional[str] = None,
+    broken: Optional[str] = None,
+) -> ClusterNetReport:
+    """The network-fault nemesis drill (``--cluster-net``): the
+    multi-process cluster under a lying network. Healthy composition:
+
+    1. PRELOAD on a clean wire — the ``net.json`` seam is armed benign
+       on every node from first boot, and the per-peer ``CAP_CRC``
+       latches establish while frames are intact;
+    2. arm the full wire chaos on every node: latency + jitter,
+       bandwidth trickle, torn frames (mid-frame cut + FIN), duplicate
+       and reordered delivery, cross-redial replay, and post-header
+       bit corruption — every corrupted frame must be DROPPED at the
+       CRC check (counted, never decoded), every tear redialed;
+    3. ASYMMETRIC partition of the leader: its appends deliver (so
+       vote stickiness suppresses elections — the wedge) but every
+       reply to it vanishes; CheckQuorum must demote it within an
+       election timeout and a new leader must rise — the liveness
+       gate;
+    4. ``kill -9`` the ex-leader under live wire faults, write through
+       the survivors, restart it — the catch-up stream resumes across
+       torn connections from the last acked cursor;
+    5. final traffic, lift the faults, quiesce; per-class check +
+       cross-node commit-digest comparison + the wire receipts.
+
+    ``broken="peer_no_crc"`` / ``broken="lease_stale_round"`` run the
+    deliberately broken planes instead; see the report class. Raises
+    :class:`raft_tpu.cluster.ClusterBroken` when the environment
+    cannot spawn children at all."""
+    import asyncio
+    import time as _time
+
+    from raft_tpu.cluster import ClusterBroken, ClusterSupervisor
+    from raft_tpu.cluster.netfault import (
+        merge_net_plan, read_net_stats, write_net_plan,
+    )
+    from raft_tpu.net import WireClient, WireDisconnected, WireRefused
+    from raft_tpu.net.client import WireError
+
+    assert broken in (None, "peer_no_crc", "lease_stale_round"), broken
+    base = base_dir or tempfile.mkdtemp(
+        prefix=f"cluster-net-seed{seed}-")
+    bdir = blackbox_dir or os.path.join(base, "blackbox")
+    env = {"RAFT_TPU_BLACKBOX_DIR": bdir}
+    if broken == "peer_no_crc":
+        env["RAFT_TPU_PEER_NO_CRC"] = "1"
+    elif broken == "lease_stale_round":
+        env["RAFT_TPU_LEASE_STALE_ROUND"] = "1"
+    sup = ClusterSupervisor(
+        nodes, base,
+        heartbeat_s=0.05,
+        # the stale-round variant ramps a reply delay under the sound
+        # CheckQuorum threshold (= the election timeout); the wider
+        # timeout gives the ramp honest headroom without changing what
+        # is on trial (the lease clock, not the election)
+        election_timeout_s=(0.6 if broken == "lease_stale_round"
+                            else 0.4),
+        snap_threshold=24, segment_entries=16, hot_entries=32,
+        fast_fail=6,
+        env=env,
+    )
+    for i in range(nodes):
+        # the seam must exist from first boot (the child arms NetFaults
+        # only when net.json is present); benign until a phase merges
+        # fault keys in
+        write_net_plan(sup.node_dir(i), {"seed": seed})
+
+    history = History()
+    key_pool = [f"nk{i}".encode() for i in range(keys)]
+    now = _time.monotonic
+    counters = [0] * (clients + 3)
+    kills = restarts = partitions = 0
+    evidence: Dict[int, Optional[dict]] = {}
+    wire_totals: Dict[str, int] = {}
+    corrupt_dropped_dead = 0     # killed incarnations' counted drops
+    stale_ignored_dead = 0
+    dialer_dead = {"drops": 0, "redials": 0}
+    rejoined = False
+    reelected = False
+    reelect_s = -1.0
+    demotions = 0
+    victim = -1
+    caught: Optional[bool] = None
+    caught_by = ""
+    digest_ok, digest_detail = True, ""
+
+    #: the full healthy-run wire chaos (frame units are per-node
+    #: GLOBAL every-N clocks, so cadence survives redials)
+    chaos = {
+        "delay_ms": 2, "jitter_ms": 3, "bw_bytes_s": 262144,
+        "dup_every": 5, "reorder_every": 9, "reorder_hold_ms": 30,
+        "corrupt_every": 4, "torn_every": 45, "replay_redial": True,
+    }
+
+    _WRITE_AMBIGUOUS = (WireDisconnected, WireError, ConnectionError,
+                        OSError)
+    _READ_DEAD = (WireRefused, WireError, WireDisconnected,
+                  ConnectionError, OSError)
+
+    def _harvest(i: int) -> None:
+        """Fold one node's published wire counters into the totals —
+        called before a kill (the next incarnation restarts at zero)
+        and once per node at the end."""
+        for k, v in read_net_stats(sup.node_dir(i)).items():
+            wire_totals[k] = wire_totals.get(k, 0) + int(v)
+
+    async def write_one(wc, cid: int, key: bytes, value: bytes) -> None:
+        rec = history.invoke(cid, WRITE, key, value, now())
+        try:
+            await wc.submit(key, value)
+        except WireRefused:
+            rec.fail(history.stamp(now()))   # typed: provably no effect
+        except _WRITE_AMBIGUOUS:
+            rec.info()                        # outcome unknown
+        else:
+            rec.ok(history.stamp(now()))
+
+    async def client_ops(wc, cid: int, n: int, crng) -> None:
+        for _ in range(n):
+            key = key_pool[crng.randrange(len(key_pool))]
+            p = crng.random()
+            if p < 0.55:
+                counters[cid] += 1
+                await write_one(wc, cid, key,
+                                f"c{cid}v{counters[cid]}".encode())
+            else:
+                cls = "session" if p > 0.85 else "linearizable"
+                rec = history.invoke(cid, READ, key, None, now())
+                if cls == "session":
+                    rec.ryw_floor = wc.session.floor.get(0, 0)
+                try:
+                    out = await wc.read(key, cls=cls)
+                except _READ_DEAD:
+                    rec.fail(history.stamp(now()))
+                else:
+                    rec.read_class = out.cls
+                    rec.serve_index = out.index
+                    rec.ok(history.stamp(now()), out.value)
+
+    async def preload_writes(wc, cid: int, n: int) -> None:
+        for _ in range(n):
+            counters[cid] += 1
+            i = counters[cid]
+            await write_one(wc, cid, key_pool[i % len(key_pool)],
+                            f"c{cid}v{i}".encode())
+
+    async def read_round(wc, cid: int) -> None:
+        for key in key_pool:
+            rec = history.invoke(cid, READ, key, None, now())
+            try:
+                out = await wc.read(key, cls="linearizable")
+            except _READ_DEAD:
+                rec.fail(history.stamp(now()))
+            else:
+                rec.read_class = out.cls
+                rec.serve_index = out.index
+                rec.ok(history.stamp(now()), out.value)
+
+    def _commit_of(i: int) -> int:
+        st = sup.status(i)
+        return int(st["commit"]) if st else 0
+
+    async def _connect(cid: int, pin: Optional[int] = None,
+                       retries: int = 40):
+        at = pin if pin is not None else (cid - 1) % nodes
+        host, _, port = sup.addr(at).rpartition(":")
+        return await WireClient(
+            host or "127.0.0.1", int(port), pool=1, retries=retries,
+            max_backoff_s=0.25,
+            rng=random.Random(f"cluster-net:{seed}:conn{cid}"),
+            addr_map=sup.addr_map() if pin is None else None,
+        ).connect()
+
+    async def main_healthy() -> None:
+        nonlocal kills, restarts, partitions, evidence, rejoined
+        nonlocal victim, demotions, reelected, reelect_s
+        nonlocal corrupt_dropped_dead, stale_ignored_dead
+        wcs = [await _connect(cid) for cid in range(1, clients + 1)]
+        rngs = [random.Random(f"cluster-net:{seed}:{cid}")
+                for cid in range(1, clients + 1)]
+
+        # ---- phase 1: preload on a clean wire (CRC latches set) -----
+        per = max(1, preload // clients)
+        blackbox.mark("net_preload", writes=per * clients)
+        await asyncio.gather(*[
+            preload_writes(wc, cid + 1, per)
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- phase 2: full wire chaos on every node -----------------
+        sup.net_fault(dict(chaos, seed=seed))
+        blackbox.mark("net_arm_chaos", plan=chaos)
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        # ---- phase 3: asymmetric partition of the leader ------------
+        lead = sup.leader()
+        lead = lead if lead is not None else 0
+        sup.partition_asym(lead)
+        partitions += 1
+        t0 = now()
+        deadline = t0 + 12.0
+        while now() < deadline:
+            st = sup.status(lead)
+            if st and int(st.get("leader_demotions", 0)) >= 1:
+                demotions = int(st["leader_demotions"])
+                break
+            await asyncio.sleep(0.05)
+        while now() < deadline:
+            for j in range(nodes):
+                st = sup.status(j)
+                if (j != lead and st and st.get("role") == "leader"
+                        and sup.alive(j)):
+                    reelected = True
+                    reelect_s = now() - t0
+                    break
+            if reelected:
+                break
+            await asyncio.sleep(0.05)
+        blackbox.mark("net_asym_verdict", lead=lead,
+                      demotions=demotions, reelected=reelected,
+                      reelect_s=round(max(reelect_s, 0.0), 3))
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        sup.heal()
+        # ---- phase 4: kill -9 the ex-leader under live faults -------
+        victim = lead
+        st = sup.status(victim) or {}
+        corrupt_dropped_dead += int(st.get("peer_frames_corrupt", 0))
+        stale_ignored_dead += int(st.get("stale_round_ignored", 0))
+        for k in ("drops", "redials"):
+            dialer_dead[k] += int((st.get("dialer") or {}).get(k, 0))
+        _harvest(victim)
+        sup.kill9(victim)
+        kills += 1
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        target = max(_commit_of(i) for i in range(nodes) if i != victim)
+        sup.restart(victim)
+        restarts += 1
+        deadline = now() + 20.0
+        while now() < deadline:
+            st = sup.status(victim)
+            if (st and st.get("generation", 1) >= 2
+                    and int(st.get("commit", 0)) >= target):
+                rejoined = True
+                break
+            await asyncio.sleep(0.1)
+        blackbox.mark("net_rejoin", node=victim, rejoined=rejoined,
+                      target=target)
+        # ---- phase 5: final traffic, lift faults, quiesce -----------
+        await asyncio.gather(*[
+            client_ops(wc, cid + 1, ops_per_phase // 2, rngs[cid])
+            for cid, wc in enumerate(wcs)
+        ])
+        sup.net_fault({k: None for k in chaos})
+        await read_round(wcs[0], 1)
+        for wc in wcs:
+            await wc.close()
+        await asyncio.sleep(0.7)   # one status-publish period
+        evidence = {i: sup.status(i) for i in range(nodes)}
+        for i in range(nodes):
+            _harvest(i)
+
+    async def main_peer_no_crc() -> None:
+        """CRC negotiation disabled on every node: injected corruption
+        decodes as a legal frame, the follower applies the flipped
+        record, and the commit-digest plane must diverge."""
+        nonlocal evidence, digest_ok, digest_detail, caught, caught_by
+        wcs = [await _connect(cid) for cid in range(1, clients + 1)]
+        await asyncio.gather(*[
+            preload_writes(wc, cid + 1, max(1, 40 // clients))
+            for cid, wc in enumerate(wcs)
+        ])
+        sup.net_fault({"seed": seed, "corrupt_every": 3})
+        blackbox.mark("net_arm_corrupt", crc=False)
+        deadline = now() + 25.0
+        while now() < deadline:
+            await asyncio.gather(*[
+                client_ops(wc, cid + 1, 4,
+                           random.Random(f"cluster-net:{seed}:{cid}"))
+                for cid, wc in enumerate(wcs)
+            ])
+            evidence = {i: sup.status(i) for i in range(nodes)}
+            digest_ok, digest_detail = _digest_agreement(evidence)
+            if not digest_ok:
+                break
+            await asyncio.sleep(0.2)
+        for wc in wcs:
+            await wc.close()
+        await asyncio.sleep(0.7)
+        evidence = {i: sup.status(i) for i in range(nodes)}
+        ok2, det2 = _digest_agreement(evidence)
+        if not ok2:
+            digest_ok, digest_detail = ok2, det2
+        for i in range(nodes):
+            _harvest(i)
+        injected = int(wire_totals.get("frames_corrupt_injected", 0))
+        caught = (not digest_ok) and injected >= 1
+        caught_by = "digest" if caught else ""
+        blackbox.mark("net_no_crc_verdict", caught=caught,
+                      injected=injected, detail=digest_detail)
+
+    async def main_lease_stale_round() -> None:
+        """Append replies credit lease evidence at ARRIVAL time (env-
+        armed). A reply-delay ramp on the followers fills the wire
+        with in-flight acks, then a ONE-SIDED partition (the followers
+        stop talking to — and hearing — the old leader, but its own
+        side stays open): the delayed acks keep arriving and keep
+        refreshing the broken lease while the majority elects a new
+        leader and commits a fresh write. The old leader serves the
+        overwritten value as a lease read — the per-class checker must
+        flag it."""
+        nonlocal evidence, caught, caught_by, partitions
+        wcs = [await _connect(cid) for cid in range(1, clients + 1)]
+        await asyncio.gather(*[
+            preload_writes(wc, cid + 1, max(1, 24 // clients))
+            for cid, wc in enumerate(wcs)
+        ])
+        lead = sup.leader()
+        lead = lead if lead is not None else 0
+        followers = [i for i in range(nodes) if i != lead]
+        # the ramp: each step widens the reply delay by LESS than the
+        # CheckQuorum threshold, so the arrival gap at each step never
+        # demotes the leader (the broken clock keeps ack ages near
+        # zero in steady state — masking CheckQuorum is the bug's own
+        # signature); scoped per-peer so follower<->follower traffic
+        # (the coming election) stays fast
+        for d in (450, 900, 1350, 1800, 2250):
+            for j in followers:
+                merge_net_plan(sup.node_dir(j), {
+                    "seed": seed,
+                    "to": {str(lead): {"delay_ms": d}},
+                })
+            await asyncio.sleep(0.55)
+        blackbox.mark("net_stale_ramp_done", lead=lead)
+        # one-sided partition: ONLY the followers deny (both their
+        # sends and their receives); the old leader's side stays open
+        # so the in-flight delayed acks land on it
+        for j in followers:
+            merge_net_plan(sup.node_dir(j), {"deny": [lead]})
+        partitions += 1
+        blackbox.mark("net_partition_one_sided", lead=lead)
+        new_lead = None
+        deadline = now() + 8.0
+        while now() < deadline and new_lead is None:
+            for j in followers:
+                st = sup.status(j)
+                if st and st.get("role") == "leader":
+                    new_lead = j
+                    break
+            await asyncio.sleep(0.05)
+        wk = key_pool[0]
+        if new_lead is not None:
+            wc2 = await _connect(clients + 1, pin=new_lead)
+            await write_one(wc2, clients + 1, wk,
+                            b"fresh-after-partition")
+            await wc2.close()
+        blackbox.mark("net_fresh_write", new_lead=new_lead)
+        # hammer reads at the OLD leader while stale in-flight acks
+        # keep its broken lease alive
+        wc3 = await _connect(clients + 2, pin=lead, retries=2)
+        t_end = now() + 2.2
+        while now() < t_end:
+            rec = history.invoke(clients + 2, READ, wk, None, now())
+            try:
+                out = await wc3.read(wk, cls="linearizable")
+            except _READ_DEAD:
+                rec.fail(history.stamp(now()))
+            else:
+                rec.read_class = out.cls
+                rec.serve_index = out.index
+                rec.ok(history.stamp(now()), out.value)
+            await asyncio.sleep(0.05)
+        await wc3.close()
+        for wc in wcs:
+            await wc.close()
+        await asyncio.sleep(0.7)
+        evidence = {i: sup.status(i) for i in range(nodes)}
+        for i in range(nodes):
+            _harvest(i)
+
+    mains = {None: main_healthy, "peer_no_crc": main_peer_no_crc,
+             "lease_stale_round": main_lease_stale_round}
+    with blackbox.journal_for(f"cluster_net_seed{seed}", bdir):
+        blackbox.mark("cluster_net_run", seed=seed, nodes=nodes,
+                      broken=broken)
+        try:
+            sup.start_all()
+            asyncio.run(mains[broken]())
+        finally:
+            sup.stop_all()
+        history.close()
+        blackbox.mark("check_history", ops=len(history))
+        per_class = check_read_classes(history, step_budget=step_budget)
+        blackbox.mark("check_done", verdicts={
+            c: r.verdict for c, r in per_class.items()
+        })
+
+    if broken is None:
+        digest_ok, digest_detail = _digest_agreement(evidence)
+    elif broken == "lease_stale_round":
+        verdicts = [c.verdict for c in per_class.values()]
+        caught = VIOLATION in verdicts
+        caught_by = "checker" if caught else ""
+        digest_detail = "n/a (lease_stale_round)"
+
+    def _sum_stat(key: str) -> int:
+        return sum(int((st or {}).get(key, 0))
+                   for st in evidence.values())
+
+    def _sum_dialer(key: str) -> int:
+        return sum(int(((st or {}).get("dialer") or {}).get(key, 0))
+                   for st in evidence.values())
+
+    vstat = evidence.get(victim) or {}
+    tier = vstat.get("tier", {})
+    flag = {"peer_no_crc": " --broken peer_no_crc",
+            "lease_stale_round": " --broken lease_stale_round"}
+    return ClusterNetReport(
+        seed=seed,
+        broken=broken,
+        per_class=per_class,
+        ops=len(history),
+        op_counts=history.counts(),
+        nodes=nodes,
+        kills=kills,
+        restarts=restarts,
+        partitions=partitions,
+        frames_delayed=int(wire_totals.get("frames_delayed", 0)),
+        frames_dup=int(wire_totals.get("frames_dup", 0)),
+        frames_reordered=int(wire_totals.get("frames_reordered", 0)),
+        frames_replayed=int(wire_totals.get("frames_replayed", 0)),
+        conns_torn=int(wire_totals.get("conns_torn", 0)),
+        corrupt_injected=int(
+            wire_totals.get("frames_corrupt_injected", 0)),
+        corrupt_dropped=(_sum_stat("peer_frames_corrupt")
+                         + corrupt_dropped_dead),
+        stale_round_ignored=(_sum_stat("stale_round_ignored")
+                             + stale_ignored_dead),
+        demotions=demotions,
+        reelected=reelected,
+        reelect_s=reelect_s,
+        dialer_drops=_sum_dialer("drops") + dialer_dead["drops"],
+        redials=_sum_dialer("redials") + dialer_dead["redials"],
+        generation=int(vstat.get("generation", 0)),
+        segments_adopted=int(tier.get("segments_adopted", 0)),
+        rejoined=rejoined,
+        digest_ok=digest_ok,
+        digest_detail=digest_detail,
+        caught=caught,
+        caught_by=caught_by,
+        statuses=evidence,
+        base_dir=base,
+        repro=(f"python -m raft_tpu.chaos --cluster-net "
+               f"--seed {seed}{flag.get(broken, '')}"),
+    )

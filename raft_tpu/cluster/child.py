@@ -26,6 +26,7 @@ import sys
 
 from raft_tpu.cluster.auth import ClusterAuth
 from raft_tpu.cluster.dialer import PeerDialer
+from raft_tpu.cluster.netfault import NetFaults
 from raft_tpu.cluster.node import RaftNode
 from raft_tpu.cluster.storage import DiskFailStop, FaultyIO
 from raft_tpu.net.server import IngestServer, PeerBackend
@@ -45,6 +46,15 @@ async def serve(spec: dict, node_id: int) -> None:
           else None)
     if io is not None:
         blackbox.mark("faulty_io_armed", node=node_id, plan=io.plan)
+    # the network-nemesis hook, same contract one layer out: a fault
+    # plan at <data_dir>/net.json puts the lying network under every
+    # socket this process opens (peer dials AND accepted conns) —
+    # absent the file at boot, the seam is the raw asyncio transport
+    nf = (NetFaults(data_dir)
+          if os.path.exists(os.path.join(data_dir, "net.json"))
+          else None)
+    if nf is not None:
+        blackbox.mark("net_faults_armed", node=node_id)
     node = RaftNode(
         node_id, peers, data_dir,
         heartbeat_s=spec.get("heartbeat_s", 0.05),
@@ -64,12 +74,13 @@ async def serve(spec: dict, node_id: int) -> None:
         certfile=spec.get("tls_cert"), keyfile=spec.get("tls_key"),
         cafile=spec.get("tls_ca"),
     )
-    dialer = PeerDialer(node, auth)
+    dialer = PeerDialer(node, auth, netfaults=nf)
     host, _, port = peers[node_id].rpartition(":")
     server = IngestServer(
         node, host=host or "127.0.0.1", port=int(port),
         peer=PeerBackend(node, auth),
         ssl=auth.server_ssl(),     # None when no certs configured
+        netfaults=nf,
     )
     blackbox.mark("child_bind", node=node_id, port=int(port))
     await server.start()
@@ -116,8 +127,14 @@ async def serve(spec: dict, node_id: int) -> None:
             ticks += 1
             if ticks % status_every == 0:
                 try:
+                    st = node.status()
+                    # wire-health diagnostics ride the same snapshot:
+                    # buffered-frame drops and redial counts are the
+                    # first thing to look at under a trickle fault
+                    st["dialer"] = dict(dialer.stats)
+                    st["net_faults"] = dict(nf.stats) if nf else {}
                     with open(status_tmp, "w") as f:
-                        json.dump(node.status(), f)
+                        json.dump(st, f)
                     os.replace(status_tmp, status_path)
                 except OSError:
                     pass

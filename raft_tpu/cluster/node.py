@@ -207,6 +207,14 @@ class RaftNode:
         # nemesis drill can arm it)
         self._wal_skip_corrupt = bool(
             os.environ.get("RAFT_TPU_WAL_SKIP_CORRUPT"))
+        # the lease_stale_round broken variant: append replies credit
+        # lease evidence at ARRIVAL time regardless of which round they
+        # echo — the bug the round-stamped lease clock exists to
+        # prevent (a delayed or replayed reply stretches the lease past
+        # a rival's election). Env-gated so only the network-nemesis
+        # drill can arm it.
+        self._lease_stale_round = bool(
+            os.environ.get("RAFT_TPU_LEASE_STALE_ROUND"))
         # commit-digest audit plane: a rolling crc32 over every applied
         # (idx, term, record), checkpointed at fixed indices — replicas
         # applying the same prefix MUST agree byte-for-byte, so any
@@ -222,6 +230,8 @@ class RaftNode:
             "reads_lease": 0, "reads_read_index": 0, "denied_frames": 0,
             "wal_fsyncs": 0, "wal_truncated_records": 0,
             "wal_skipped_corrupt": 0, "disk_full_shed": 0,
+            "peer_frames_corrupt": 0, "leader_demotions": 0,
+            "stale_round_ignored": 0,
         }
         self._replay_adopted()
 
@@ -229,10 +239,17 @@ class RaftNode:
         self.last_heard = now
         self.timeout = self._new_timeout()
         self.outbox: List[Tuple[int, bytes]] = []    # (peer id, frame)
+        # the partition plan (net.json deny keys + the legacy
+        # ctrl-<id>.json alias): `deny` blocks both directions,
+        # `deny_to` only our sends, `deny_from` only our receives —
+        # the asymmetric halves a real one-directional blackhole needs
         self.deny: set = set()
-        self._ctrl_mtime = 0.0
+        self.deny_to: set = set()
+        self.deny_from: set = set()
+        self._plan_mtimes: Tuple = (None, None)
 
         # leader bookkeeping (reset on every election win)
+        self._lead_since = now   # CheckQuorum grace floor (see tick)
         self.next_idx: Dict[int, int] = {}
         self.match_idx: Dict[int, int] = {}
         self.hb_round = 0
@@ -507,28 +524,80 @@ class RaftNode:
                 self._broadcast_appends(now, heartbeat=True)
                 self._dirty = False
             self._advance_commit(now)
+            self._check_quorum(now)
         elif now - self.last_heard >= self.timeout:
             self._start_election(now)
 
+    def _check_quorum(self, now: float) -> None:
+        """CheckQuorum: a leader whose REPLY quorum — the majority-th
+        freshest successful append ack, the exact evidence the lease
+        counts — has been stale for a full election timeout steps
+        down. Under an asymmetric partition (our appends deliver, the
+        replies blackhole) the followers still hear a live leader, so
+        vote stickiness keeps suppressing elections and a send-only
+        leader would wedge the cluster forever: it can neither commit
+        (no acks) nor be replaced (no timeouts). Demoting on stale
+        acks breaks the wedge — the ex-leader goes silent, follower
+        timers expire, a connected majority elects. ``_lead_since``
+        floors every peer's ack age so a fresh leader gets one full
+        timeout of grace before its first demotion check; the lease
+        itself still runs on raw ``ack_at`` (never seeded — a floor
+        there would fabricate lease evidence)."""
+        if self.majority < 2:
+            return
+        ages = sorted(
+            now - max(self.ack_at.get(p, -1e9), self._lead_since)
+            for p in self.others)
+        if ages[self.majority - 2] <= self.timeout_base:
+            return
+        self.stats["leader_demotions"] += 1
+        blackbox.mark("leader_demote", node=self.node_id,
+                      term=self.term,
+                      stale_s=round(ages[self.majority - 2], 3))
+        self._step_down(self.term, now)
+        # drop the self-belief too: stickiness must not make this node
+        # refuse the very election its demotion exists to allow
+        self.leader_id = None
+
     def _poll_ctrl(self) -> None:
-        path = os.path.join(self.data_dir, f"ctrl-{self.node_id}.json")
-        try:
-            mtime = os.stat(path).st_mtime
-        except OSError:
-            if self.deny:
-                self.deny = set()
-                blackbox.mark("ctrl_heal", node=self.node_id)
+        """Poll the partition plan: ``net.json`` (the merged network
+        fault plan — deny keys are its symmetric-deny special case)
+        plus the legacy ``ctrl-<id>.json`` alias, union'd so existing
+        drills run unchanged."""
+        paths = (os.path.join(self.data_dir, "net.json"),
+                 os.path.join(self.data_dir,
+                              f"ctrl-{self.node_id}.json"))
+        mtimes = []
+        for path in paths:
+            try:
+                mtimes.append(os.stat(path).st_mtime)
+            except OSError:
+                mtimes.append(None)
+        if tuple(mtimes) == self._plan_mtimes:
             return
-        if mtime == self._ctrl_mtime:
+        self._plan_mtimes = tuple(mtimes)
+        deny: set = set()
+        deny_to: set = set()
+        deny_from: set = set()
+        for path in paths:
+            try:
+                with open(path) as f:
+                    plan = json.load(f)
+            except (OSError, ValueError):
+                continue
+            deny |= set(plan.get("deny", []))
+            deny_to |= set(plan.get("deny_to", []))
+            deny_from |= set(plan.get("deny_from", []))
+        if (deny, deny_to, deny_from) == (
+                self.deny, self.deny_to, self.deny_from):
             return
-        self._ctrl_mtime = mtime
-        try:
-            with open(path) as f:
-                self.deny = set(json.load(f).get("deny", []))
+        self.deny, self.deny_to, self.deny_from = deny, deny_to, deny_from
+        if deny or deny_to or deny_from:
             blackbox.mark("ctrl_deny", node=self.node_id,
-                          deny=sorted(self.deny))
-        except (OSError, ValueError):
-            pass
+                          deny=sorted(deny), deny_to=sorted(deny_to),
+                          deny_from=sorted(deny_from))
+        else:
+            blackbox.mark("ctrl_heal", node=self.node_id)
 
     # ---------------------------------------------------------- elections
     def _start_election(self, now: float) -> None:
@@ -552,6 +621,7 @@ class RaftNode:
     def _become_leader(self, now: float) -> None:
         self.role = LEADER
         self.leader_id = self.node_id
+        self._lead_since = now
         self.stats["terms_won"] += 1
         self.next_idx = {p: self.last_idx + 1 for p in self.others}
         self.match_idx = {p: 0 for p in self.others}
@@ -709,7 +779,7 @@ class RaftNode:
         if self.failed:
             return []      # fail-stopped: the ticker is about to exit
         sender = struct.unpack_from("!I", payload)[0]
-        if sender in self.deny:
+        if sender in self.deny or sender in self.deny_from:
             self.stats["denied_frames"] += 1
             return []
         if kind == P.PEER_VOTE:
@@ -856,9 +926,25 @@ class RaftNode:
             # never stretch the window past a partitioned peer's
             # earliest legal election
             sent = self._round_sent.get(round_no)
-            if sent is not None and sent > self.ack_at.get(
-                    follower, -1e9):
+            if self._lease_stale_round:
+                # BROKEN (chaos drill): clock leadership evidence off
+                # REPLY ARRIVAL, any round. A reply delayed in flight —
+                # or replayed by the network across a redial — from a
+                # long-superseded round now refreshes the lease as if
+                # the follower acked just now, so a deposed leader can
+                # keep serving "lease" reads the new leader has already
+                # overwritten. The per-class checker catches the stale
+                # read.
+                self.ack_at[follower] = now
+            elif sent is None:
+                # round too old to have a send stamp (pruned) or never
+                # sent by THIS leadership: a duplicated/reordered reply
+                # proves nothing about recency — count and ignore
+                self.stats["stale_round_ignored"] += 1
+            elif sent > self.ack_at.get(follower, -1e9):
                 self.ack_at[follower] = sent
+            elif round_no <= self.peer_round.get(follower, 0):
+                self.stats["stale_round_ignored"] += 1
             if round_no > self.peer_round.get(follower, 0):
                 self.peer_round[follower] = round_no
             if match_idx > self.match_idx.get(follower, 0):
@@ -956,7 +1042,7 @@ class RaftNode:
         return []
 
     def _to(self, peer: int, frame: bytes) -> None:
-        if peer in self.deny:
+        if peer in self.deny or peer in self.deny_to:
             self.stats["denied_frames"] += 1
             return
         self.outbox.append((peer, frame))

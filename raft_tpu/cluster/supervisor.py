@@ -14,8 +14,15 @@ process-level fault surface the chaos nemeses compose:
 - :meth:`restart` — respawn on the SAME dirs and port: the child
   adopts its previous generation's sealed segments by manifest and
   rejoins via the resumable catch-up stream.
-- :meth:`partition` / :meth:`heal` — write the per-node
-  ``ctrl-<id>.json`` deny-lists the nodes poll each tick.
+- :meth:`partition` / :meth:`heal` — fold deny-lists into each node's
+  polled ``net.json`` fault plan (symmetric deny is just the degenerate
+  network fault); the legacy ``ctrl-<id>.json`` alias is still written
+  so pre-existing drills and tooling see the same files.
+- :meth:`partition_asym` — the one-directional blackhole: the target's
+  sends deliver but its receives vanish, the exact shape that wedges a
+  send-only leader unless CheckQuorum demotes it.
+- :meth:`net_fault` — merge wire-fault keys (latency, trickle, torn,
+  dup, corrupt...) into chosen nodes' ``net.json`` mid-run.
 
 **Crash-loop fast-fail** (the test_multiprocess pattern): if
 ``fast_fail`` consecutive spawns die or fail to report ready within
@@ -40,6 +47,7 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from raft_tpu.cluster.netfault import merge_net_plan
 from raft_tpu.obs import blackbox
 
 
@@ -339,17 +347,33 @@ class ClusterSupervisor:
 
     def partition(self, groups: List[List[int]]) -> None:
         """Deny-list every pair that crosses a group boundary (the
-        userspace partition: no root, heals by file removal)."""
+        userspace partition: no root, heals by file removal). The deny
+        set rides each node's ``net.json`` fault plan — a symmetric
+        partition is just the degenerate network fault — with the
+        legacy ``ctrl-<id>.json`` still written as an alias."""
         side = {i: gi for gi, grp in enumerate(groups) for i in grp}
         for i in range(self.n):
             deny = [j for j in range(self.n)
                     if j != i and side.get(j) != side.get(i)]
+            os.makedirs(self.node_dir(i), exist_ok=True)
+            merge_net_plan(self.node_dir(i), {"deny": deny})
             path = os.path.join(self.node_dir(i),
                                 f"ctrl-{i}.json")
-            os.makedirs(self.node_dir(i), exist_ok=True)
             with open(path, "w") as f:
                 json.dump({"deny": deny}, f)
         blackbox.mark("cluster_partition", groups=groups)
+
+    def partition_asym(self, target: int) -> None:
+        """One-directional blackhole around ``target``: everything it
+        SENDS still delivers, everything sent TO it vanishes. Followers
+        keep hearing a live leader (so vote stickiness suppresses
+        elections) while the leader hears nothing back — the exact
+        asymmetry only CheckQuorum demotion can un-wedge."""
+        others = [j for j in range(self.n) if j != target]
+        merge_net_plan(self.node_dir(target), {"deny_from": others})
+        for j in others:
+            merge_net_plan(self.node_dir(j), {"deny_to": [target]})
+        blackbox.mark("cluster_partition_asym", target=target)
 
     def heal(self) -> None:
         for i in range(self.n):
@@ -358,7 +382,28 @@ class ClusterSupervisor:
                                        f"ctrl-{i}.json"))
             except OSError:
                 pass
+            # clear the deny keys but PRESERVE wire-fault keys: healing
+            # a partition must not silently lift a latency/corruption
+            # nemesis that is part of the same drill
+            if os.path.exists(os.path.join(self.node_dir(i),
+                                           "net.json")):
+                merge_net_plan(self.node_dir(i), {
+                    "deny": None, "deny_to": None, "deny_from": None})
         blackbox.mark("cluster_heal")
+
+    def net_fault(self, patch: dict, nodes: Optional[List[int]] = None
+                  ) -> None:
+        """Merge wire-fault keys into the ``net.json`` plan of the
+        given nodes (all by default). ``None`` values delete keys. The
+        children poll the plan at ~50 ms, so faults land mid-run
+        without restarts — but the seam itself only exists in children
+        whose plan file was present at BOOT (write an empty plan before
+        :meth:`start_all` to arm it)."""
+        for i in (range(self.n) if nodes is None else nodes):
+            os.makedirs(self.node_dir(i), exist_ok=True)
+            merge_net_plan(self.node_dir(i), patch)
+        blackbox.mark("cluster_net_fault", patch=patch,
+                      nodes=list(nodes) if nodes is not None else "all")
 
     # ------------------------------------------------------------ teardown
     def stop_all(self) -> None:

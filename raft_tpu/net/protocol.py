@@ -43,6 +43,7 @@ tests/test_net_protocol.py::TestCapabilityCompat).
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 MAGIC = 0x5254          # "RT"
@@ -113,6 +114,50 @@ CAP_TXN = 0x02
 #: without a peer backend never advertises the bit, and every PEER
 #: frame it receives falls to the unknown-kind close.
 CAP_PEER = 0x04
+#: peer frames carry a CRC32 trailer (``crc_seal``/``crc_open``,
+#: flagged by ``CRC_FLAG`` on the kind byte). Advertised by a dialing
+#: peer in a capability byte appended to PEER_HELLO; the server seals
+#: its replies on that connection, and the dialer starts sealing once
+#: the first flagged frame comes back. Same additive contract again: a
+#: pre-CRC peer never advertises, never gets a flagged frame, and the
+#: whole exchange stays byte-identical (pinned by
+#: tests/test_cluster.py::TestPeerCrc in BOTH mixed pairings).
+CAP_CRC = 0x08
+
+#: second-highest bit on the kind byte: the frame ends with a CRC32
+#: trailer over (kind byte + payload). Negotiated via ``CAP_CRC`` —
+#: never sent to a peer that did not prove it speaks flagged frames,
+#: because a pre-CRC decoder sees an unknown kind and closes.
+CRC_FLAG = 0x40
+
+
+def crc_seal(frame: bytes) -> bytes:
+    """Append a CRC32 trailer to one complete encoded frame and set
+    ``CRC_FLAG``: header length grows by 4, the trailer covers the
+    flagged kind byte + the payload. Idempotent-unsafe by design —
+    callers seal exactly once, at the send boundary."""
+    magic, version, kind, length = _HEADER.unpack_from(frame)
+    kind |= CRC_FLAG
+    payload = frame[_HEADER.size:]
+    crc = zlib.crc32(bytes((kind,)) + payload)
+    return (_HEADER.pack(magic, version, kind, length + 4)
+            + payload + struct.pack("!I", crc))
+
+
+def crc_open(kind: int, payload: bytes) -> Tuple[int, bytes, bool]:
+    """Verify + strip a frame's CRC trailer: returns ``(base_kind,
+    payload, ok)``. Unflagged frames pass through ``ok=True`` (the
+    pre-CRC peer — additive compat). A failed CRC returns ``ok=False``
+    and the caller MUST drop the frame unparsed (count it, never
+    decode garbage into the log) — Raft's retransmit replaces it."""
+    if not kind & CRC_FLAG:
+        return kind, payload, True
+    if len(payload) < 4:
+        return kind & ~CRC_FLAG, b"", False
+    body = payload[:-4]
+    (want,) = struct.unpack_from("!I", payload, len(payload) - 4)
+    ok = zlib.crc32(bytes((kind,)) + body) == want
+    return kind & ~CRC_FLAG, body, ok
 
 _TRACE_CTX = struct.Struct("!QQB")
 TRACE_CTX_BYTES = _TRACE_CTX.size        # 17
@@ -649,18 +694,22 @@ def is_peer_kind(kind: int) -> bool:
 
 
 def encode_peer_hello(node_id: int, token: bytes = b"",
-                      last_idx: int = 0, **kw) -> bytes:
+                      last_idx: int = 0, caps: int = 0, **kw) -> bytes:
     """Peer identification + auth: ``token`` is verified by the
     receiving server's auth hook (cluster.auth) before any other PEER
     frame is honored on the connection; a mismatch answers ERROR and
     closes. ``last_idx`` is the sender's durable log floor — the
     resumable-handoff hint a restarted process opens with, so the
     leader resumes the catch-up stream past the adopted segments
-    instead of replaying history the disk already holds."""
-    return encode_frame(
-        PEER_HELLO,
-        struct.pack("!IQ", node_id, last_idx) + _pb16(token), **kw
-    )
+    instead of replaying history the disk already holds. ``caps`` is
+    the dialer's capability byte (``CAP_CRC``), appended only when
+    nonzero — the additive contract: a caps-less hello is
+    byte-identical to the pre-capability encoding, and the old decoder
+    ignores the trailing byte."""
+    body = struct.pack("!IQ", node_id, last_idx) + _pb16(token)
+    if caps:
+        body += struct.pack("!B", caps)
+    return encode_frame(PEER_HELLO, body, **kw)
 
 
 def decode_peer_hello(payload: bytes) -> Tuple[int, int, bytes]:
@@ -668,6 +717,16 @@ def decode_peer_hello(payload: bytes) -> Tuple[int, int, bytes]:
     node_id, last_idx = struct.unpack_from("!IQ", payload)
     token, _ = _ub16(payload, 12)
     return node_id, last_idx, token
+
+
+def decode_peer_hello_caps(payload: bytes) -> Tuple[int, int, bytes, int]:
+    """``decode_peer_hello`` plus the trailing capability byte (0 when
+    absent — a pre-CRC dialer)."""
+    _need(payload, 0, 12)
+    node_id, last_idx = struct.unpack_from("!IQ", payload)
+    token, off = _ub16(payload, 12)
+    caps = payload[off] if len(payload) > off else 0
+    return node_id, last_idx, token, caps
 
 
 def encode_peer_vote(node_id: int, term: int, last_idx: int,

@@ -70,6 +70,7 @@ zero extra device syncs attached or detached (the PR-6 contract).
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -77,6 +78,21 @@ from raft_tpu.admission.gate import Overloaded
 from raft_tpu.multi.engine import NotLeader, ReadLagging
 from raft_tpu.net import protocol as P
 from raft_tpu.raft.engine import LinearizableReadRefused
+
+_NF = None
+
+
+def _netfault_mod():
+    """The wire seam module (cluster/netfault.py), resolved lazily:
+    net/ cannot import the cluster package at module load because
+    cluster/node.py imports THIS module — the classic cycle. By the
+    time a connection is accepted, the import graph is settled."""
+    global _NF
+    if _NF is None:
+        from raft_tpu.cluster import netfault
+
+        _NF = netfault
+    return _NF
 
 
 class _Done:
@@ -317,13 +333,26 @@ class PeerBackend:
         self.auth = auth
         self._peer_conns: Dict[int, object] = {}   # peer id -> last conn
         self._flush_scheduled = False
+        self._no_crc = bool(os.environ.get("RAFT_TPU_PEER_NO_CRC"))
 
     def on_frame(self, conn, kind: int, payload: bytes):
         if kind == P.PEER_HELLO:
-            peer_id, last_idx, token = P.decode_peer_hello(payload)
+            peer_id, last_idx, token, caps = \
+                P.decode_peer_hello_caps(payload)
             if self.auth is not None:
                 self.auth.verify(token)       # raises PeerAuthError
             conn.peer_id = peer_id
+            if caps & P.CAP_CRC and not self._no_crc:
+                # the dialer advertised CRC: seal every reply on this
+                # connection — our first flagged frame is what latches
+                # the dialer's own sealing (protocol.py CAP_CRC)
+                conn.crc_tx = True
+            wire = getattr(conn, "wire", None)
+            if wire is not None:
+                # re-scope the seam conn: peer traffic, not client —
+                # the fault plan distinguishes the two
+                wire.peer = peer_id
+                wire.client = False
             self._peer_conns[peer_id] = conn
             return self.node.on_peer_hello(peer_id, last_idx)
         if getattr(conn, "peer_id", None) is None and self.auth is not None:
@@ -381,13 +410,20 @@ class _Conn:
 
     _next_cid = 0
 
-    def __init__(self, reader, writer, max_frame_bytes: int):
+    def __init__(self, reader, writer, max_frame_bytes: int,
+                 wire=None):
         self.reader = reader
         self.writer = writer
+        # every byte this connection moves rides the netfault seam —
+        # RealConn in production, FaultyConn under the nemesis (the
+        # lint gate bans direct transport calls in this file)
+        self.wire = (wire if wire is not None
+                     else _netfault_mod().RealConn(reader, writer))
         self.decoder = P.FrameDecoder(max_frame_bytes)
         self.session: Dict[int, int] = {}
         self.caps = 0            # negotiated capability intersection
         self.peer_id = None      # set by an authenticated PEER_HELLO
+        self.crc_tx = False      # seal outbound frames (CAP_CRC peer)
         self.bytes_in = 0
         self.bytes_out = 0
         self.open = True
@@ -405,7 +441,9 @@ class _Conn:
         if not self.open:
             return 0
         try:
-            self.writer.write(frame)
+            if self.crc_tx:
+                frame = P.crc_seal(frame)
+            self.wire.write(frame)
             self.bytes_out += len(frame)
             return len(frame)
         except (ConnectionError, RuntimeError):
@@ -471,6 +509,7 @@ class IngestServer:
         txn=None,
         peer=None,
         ssl=None,
+        netfaults=None,
     ) -> None:
         self.backend = backend
         self.host = host
@@ -514,6 +553,11 @@ class IngestServer:
         self.ssl = ssl
         #   ssl.SSLContext (cluster/auth.py server_ssl) — every byte of
         #   this port, client and peer alike, rides TLS when set
+        self.netfaults = netfaults
+        #   cluster.netfault.NetFaults — when set, every accepted
+        #   connection is wrapped in a FaultyConn and the node's
+        #   net.json plan injects wire faults under this server's
+        #   writes (None = RealConn passthrough, zero overhead)
 
         self._server: Optional[asyncio.base_events.Server] = None
         self._pump_task: Optional[asyncio.Task] = None
@@ -590,11 +634,13 @@ class IngestServer:
 
     # ----------------------------------------------------- reader tasks
     async def _handle_conn(self, reader, writer) -> None:
-        conn = _Conn(reader, writer, self.max_frame_bytes)
+        wire = (self.netfaults.wrap(reader, writer, client=True)
+                if self.netfaults is not None else None)
+        conn = _Conn(reader, writer, self.max_frame_bytes, wire=wire)
         self._conns.append(conn)
         try:
             while self._running:
-                data = await reader.read(1 << 16)
+                data = await conn.wire.read(1 << 16)
                 if not data:
                     break
                 conn.bytes_in += len(data)
@@ -620,7 +666,7 @@ class IngestServer:
                     # a frame handler declared the stream unrecoverable
                     # (protocol violation): flush the ERROR and close
                     try:
-                        await conn.writer.drain()
+                        await conn.wire.drain()
                     except (ConnectionError, RuntimeError):
                         pass
                     break
@@ -640,6 +686,21 @@ class IngestServer:
 
     def _on_frame(self, conn: _Conn, kind: int, payload: bytes) -> None:
         try:
+            if kind & P.CRC_FLAG and P.is_peer_kind(kind & ~P.CRC_FLAG):
+                # only peer-plane frames are ever sealed inbound (the
+                # dialer is the sole CRC sender toward this server);
+                # any other kind with the bit set is an unknown kind
+                # and falls through to the protocol-ERROR path below
+                kind, payload, crc_ok = P.crc_open(kind, payload)
+                if not crc_ok:
+                    # frame-integrity failure (CAP_CRC trailer
+                    # mismatch): drop UNPARSED and count — garbage must
+                    # never decode into the log; Raft's retransmit
+                    # re-sends what mattered on the next heartbeat
+                    self._refusal("peer_frame_corrupt")
+                    if self.peer is not None:
+                        self.peer.node.stats["peer_frames_corrupt"] += 1
+                    return
             kind, trace, payload = P.split_trace(kind, payload)
             if kind == P.HELLO:
                 # reconnect-and-resume: adopt the client's session
@@ -1178,7 +1239,7 @@ class IngestServer:
             if not conn.open:
                 continue
             try:
-                await conn.writer.drain()
+                await conn.wire.drain()
             except (ConnectionError, RuntimeError):
                 conn.open = False
 

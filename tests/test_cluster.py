@@ -1002,3 +1002,328 @@ class TestClusterStorageBrokenVariants:
             assert "DIVERGED" in rep.digest_detail
         finally:
             shutil.rmtree(rep.base_dir, ignore_errors=True)
+
+
+# --------------------------------------------- peer frame integrity
+class TestPeerFrameCrc:
+    def test_cap_crc_negotiation_is_additive(self):
+        """Both mixed pairings of the compat contract: an old peer
+        decodes a CRC-advertising hello unchanged (trailing caps byte
+        ignored), and a capability-less hello is byte-identical to the
+        pre-CRC encoding (an old sender is indistinguishable)."""
+        _, h = _one_frame(P.encode_peer_hello(
+            2, token=b"cluster-secret", last_idx=97, caps=P.CAP_CRC))
+        assert P.decode_peer_hello(h) == (2, 97, b"cluster-secret")
+        assert P.decode_peer_hello_caps(h) == \
+            (2, 97, b"cluster-secret", P.CAP_CRC)
+        assert P.encode_peer_hello(2, token=b"t", last_idx=5) == \
+            P.encode_peer_hello(2, token=b"t", last_idx=5, caps=0)
+        assert P.decode_peer_hello_caps(
+            _one_frame(P.encode_peer_hello(2, token=b"t", last_idx=5))[1]
+        ) == (2, 5, b"t", 0)
+
+    def test_crc_seal_roundtrips_and_flags_the_kind(self):
+        frame = P.encode_peer_append(
+            0, term=4, prev_idx=10, prev_term=3, commit=9, round_no=12,
+            entries=[(3, b"a" * ENTRY)])
+        kind, payload = _one_frame(P.crc_seal(frame))
+        assert kind & P.CRC_FLAG
+        base, body, ok = P.crc_open(kind, payload)
+        assert ok is True and base == P.PEER_APPEND
+        assert P.decode_peer_append(body) == \
+            (0, 4, 10, 3, 9, 12, [(3, b"a" * ENTRY)])
+
+    def test_crc_open_rejects_a_single_flipped_bit(self):
+        sealed = bytearray(P.crc_seal(P.encode_peer_append(
+            0, term=4, prev_idx=10, prev_term=3, commit=9, round_no=12,
+            entries=[(3, b"a" * ENTRY)])))
+        sealed[-1] ^= 0x01                       # inside the payload+crc
+        kind, payload = _one_frame(bytes(sealed))
+        _, _, ok = P.crc_open(kind, payload)
+        assert ok is False
+
+    def test_unflagged_frames_pass_through_untouched(self):
+        """An old peer's frames carry no flag: crc_open is the
+        identity — never a false integrity failure on legacy bytes."""
+        frame = P.encode_peer_vote(1, term=7, last_idx=41, last_term=6)
+        kind, payload = _one_frame(frame)
+        base, body, ok = P.crc_open(kind, payload)
+        assert (base, body, ok) == (kind, payload, True)
+
+
+# ------------------------------------------------------- check quorum
+class TestCheckQuorum:
+    def test_stale_ack_quorum_demotes_the_leader(self, tmp_path):
+        """A send-only leader (appends deliver, replies blackhole)
+        must step down once its freshest ack is a full election
+        timeout stale — otherwise vote stickiness wedges the cluster:
+        followers hear a live leader, so no one times out, and the
+        leader commits nothing forever."""
+        from raft_tpu.cluster.node import FOLLOWER, LEADER
+
+        n = _node(tmp_path)
+        n.role, n.term, n.leader_id = LEADER, 3, 1
+        now = n.now()
+        n._lead_since = now - 10.0               # grace long expired
+        n.ack_at = {0: now - 10.0, 2: now - 10.0}
+        n.tick(now)
+        assert n.role == FOLLOWER
+        assert n.leader_id is None               # stickiness released
+        assert n.stats["leader_demotions"] == 1
+
+    def test_fresh_leader_gets_a_full_timeout_of_grace(self, tmp_path):
+        """A just-elected leader has no acks yet by construction:
+        ``_lead_since`` floors the ages so the demotion check cannot
+        fire before one full timeout of real silence."""
+        from raft_tpu.cluster.node import LEADER
+
+        n = _node(tmp_path)
+        n.role, n.term = LEADER, 3
+        now = n.now()
+        n._lead_since = now                      # election just won
+        n.tick(now)
+        assert n.role == LEADER
+        assert n.stats["leader_demotions"] == 0
+
+    def test_one_live_follower_sustains_the_quorum(self, tmp_path):
+        """majority=2 of 3: the leader plus ONE acking follower is a
+        quorum — a single dead peer must never demote."""
+        from raft_tpu.cluster.node import LEADER
+
+        n = _node(tmp_path)
+        n.role, n.term = LEADER, 3
+        now = n.now()
+        n._lead_since = now - 10.0
+        n.ack_at = {0: now}                      # peer 2 long silent
+        n.tick(now)
+        assert n.role == LEADER
+        assert n.stats["leader_demotions"] == 0
+
+
+# ------------------------------------------- stale-round discipline
+class TestStaleRoundDiscipline:
+    def _leader(self, tmp_path, node_id=1):
+        from raft_tpu.cluster.node import LEADER
+
+        n = _node(tmp_path, node_id=node_id)
+        n.log = [(1, _rec(b"a", b"1"))] * 3
+        n.role, n.term = LEADER, 1
+        n._wal_hi = 3
+        return n
+
+    def test_duplicated_reply_is_counted_and_credits_nothing(self, tmp_path):
+        """The network nemesis duplicates frames: the second copy of
+        an already-credited round is zero evidence — the lease clock
+        must not move, and the duplicate is a first-class counter."""
+        n = self._leader(tmp_path)
+        n._round_sent = {7: 100.0}
+        rep = _one_frame(P.encode_peer_append_reply(
+            0, term=1, success=True, match_idx=3, round_no=7))
+        n.on_peer_frame(*rep)
+        assert n.ack_at[0] == 100.0
+        n.on_peer_frame(*rep)                    # the wire's duplicate
+        assert n.ack_at[0] == 100.0
+        assert n.stats["stale_round_ignored"] == 1
+
+    def test_pruned_round_replay_is_counted(self, tmp_path):
+        """A reply replayed across a redial can echo a round whose
+        send stamp was pruned (or another leadership's): no stamp, no
+        evidence — counted, ignored."""
+        n = self._leader(tmp_path)
+        rep = _one_frame(P.encode_peer_append_reply(
+            0, term=1, success=True, match_idx=3, round_no=99))
+        n.on_peer_frame(*rep)
+        assert n.ack_at == {}
+        assert n.stats["stale_round_ignored"] == 1
+
+    def test_broken_env_clocks_arrival_not_send(self, tmp_path,
+                                                monkeypatch):
+        """The lease_stale_round broken variant (env-gated for the
+        nemesis drill): ANY successful reply — unknown round included
+        — refreshes the lease at arrival time. This is the bug the
+        round-stamped clock prevents; the drill proves the checker
+        catches its stale reads."""
+        import time as _t
+
+        monkeypatch.setenv("RAFT_TPU_LEASE_STALE_ROUND", "1")
+        n = self._leader(tmp_path)
+        rep = _one_frame(P.encode_peer_append_reply(
+            0, term=1, success=True, match_idx=3, round_no=99))
+        n.on_peer_frame(*rep)
+        assert 0 in n.ack_at                     # credited at ARRIVAL
+        assert _t.monotonic() - n.ack_at[0] < 1.0
+        assert n.stats["stale_round_ignored"] == 0
+
+
+# --------------------------------------------- snap stream cursor
+class TestSnapStreamCursor:
+    def _streaming_leader(self, tmp_path):
+        from raft_tpu.cluster.node import LEADER
+
+        n = _node(tmp_path, snap_chunk=4, snap_threshold=4)
+        n.log = [(2, _rec(b"k%d" % i, b"v%d" % i)) for i in range(1, 13)]
+        n.role, n.term = LEADER, 2
+        n.commit = n._wal_hi = 12
+        return n
+
+    def _chunk_base(self, frame):
+        return P.decode_peer_snap_chunk(_one_frame(frame)[1])[2]
+
+    def test_duplicated_ack_leaves_the_cursor_exact(self, tmp_path):
+        """Snap acks carry the follower's literal last_idx: a
+        duplicate (the wire's, or a retransmit's) re-bases the next
+        chunk at EXACTLY the same cursor — never skips ahead, never
+        double-advances."""
+        n = self._streaming_leader(tmp_path)
+        n._start_snap(0)
+        ((_, first),) = n.outbox
+        assert self._chunk_base(first) == 1
+        n.outbox.clear()
+        ack = _one_frame(P.encode_peer_snap_ack(0, term=2, match_idx=4))
+        n.on_peer_frame(*ack)
+        ((_, nxt),) = n.outbox
+        assert self._chunk_base(nxt) == 5        # past the acked cursor
+        n.outbox.clear()
+        n.on_peer_frame(*ack)                    # the wire's duplicate
+        ((_, dup),) = n.outbox
+        assert self._chunk_base(dup) == 5        # cursor unmoved
+        assert n.match_idx[0] == 4
+
+    def test_torn_stream_resumes_from_last_acked_cursor(self, tmp_path):
+        """A connection torn mid-chunk (then redialed) loses the
+        in-flight chunk AND its ack. After a few silent heartbeats the
+        leader re-sends from the recorded match — resumable-by-
+        match-index, not restart-at-one."""
+        n = self._streaming_leader(tmp_path)
+        n._start_snap(0)
+        n.outbox.clear()
+        ack = _one_frame(P.encode_peer_snap_ack(0, term=2, match_idx=4))
+        n.on_peer_frame(*ack)                    # chunk 1-4 landed
+        n.outbox.clear()
+        # chunk 5-8 dies with the torn conn; its ack never comes
+        now = n.now()
+        n._snap_sent[0] = now - 1.0              # > 4 heartbeats silent
+        n._broadcast_appends(now, heartbeat=True)
+        chunks = [f for p, f in n.outbox
+                  if p == 0 and _one_frame(f)[0] == P.PEER_SNAP_CHUNK]
+        assert len(chunks) == 1
+        assert self._chunk_base(chunks[0]) == 5  # resumed, not restarted
+
+    def test_final_ack_closes_the_stream(self, tmp_path):
+        n = self._streaming_leader(tmp_path)
+        n._start_snap(0)
+        n.on_peer_frame(*_one_frame(
+            P.encode_peer_snap_ack(0, term=2, match_idx=12)))
+        assert 0 not in n.snap_mode
+        assert n.next_idx[0] == 13
+
+
+# ---------------------------------------------- cluster net drill
+@pytest.fixture(scope="class")
+def net_drill():
+    """One seed-7 run of the network-fault nemesis (~60 s: the lying
+    network under 3 real processes — latency+jitter, trickle,
+    mid-frame torn conns, duplicates, reorder, cross-redial replay,
+    bit corruption, an asymmetric partition — composed with kill -9
+    and restart-adopt)."""
+    from raft_tpu.chaos.runner import cluster_net_run
+    from raft_tpu.cluster import ClusterBroken
+
+    try:
+        rep = cluster_net_run(7)
+    except ClusterBroken as ex:
+        pytest.skip(f"multi-process clusters cannot run here: {ex}")
+    yield rep
+    shutil.rmtree(rep.base_dir, ignore_errors=True)
+
+
+class TestClusterNetDrill:
+    def test_seed7_linearizable_under_the_lying_network(self, net_drill):
+        rep = net_drill
+        assert rep.verdict == LINEARIZABLE
+        for cls, res in rep.per_class.items():
+            assert res.verdict == LINEARIZABLE, (cls, res)
+        assert rep.digest_ok, rep.digest_detail
+        assert rep.kills >= 1 and rep.partitions >= 1
+
+    def test_wire_fault_receipts_all_present(self, net_drill):
+        """Every armed fault actually fired AND every hardened path
+        answered: frames delayed / duplicated / reordered / replayed,
+        conns torn and redialed, corruption injected AND dropped by
+        the CRC gate, stale rounds refused by the lease clock, the
+        send-only leader demoted by CheckQuorum, a successor elected."""
+        rep = net_drill
+        assert rep.net_ok, rep.summary()
+        assert rep.frames_delayed >= 1
+        assert rep.frames_dup >= 1
+        assert rep.conns_torn >= 1
+        assert rep.redials >= 1
+        assert rep.corrupt_injected >= 1
+        assert rep.corrupt_dropped >= 1
+        assert rep.stale_round_ignored >= 1
+        assert rep.demotions >= 1
+        assert rep.reelected and rep.reelect_s is not None
+
+    def test_restart_rides_the_durable_handoff(self, net_drill):
+        rep = net_drill
+        assert rep.handoff_ok, rep.summary()
+        assert rep.generation >= 2
+        assert rep.segments_adopted >= 1
+        assert rep.rejoined
+
+    def test_dialer_diagnostics_surface_in_status_and_explain(
+            self, net_drill):
+        """Under wire faults the dialer's redials (and drops, when
+        they happen) are the first diagnostic anyone needs: they ride
+        every node's status snapshot and the merged --explain
+        timeline as first-class marks."""
+        from raft_tpu.obs.__main__ import _explain_any
+
+        rep = net_drill
+        assert any("dialer" in st for st in rep.statuses.values() if st)
+        assert sum(int(st.get("dialer", {}).get("dials", 0))
+                   for st in rep.statuses.values() if st) >= 1
+        text = _explain_any(os.path.join(rep.base_dir, "blackbox"))
+        assert "net_faults_armed" in text
+        assert "peer_redial" in text
+
+
+class TestClusterNetBrokenVariants:
+    def test_peer_no_crc_is_caught_by_the_digest_plane(self):
+        """CRC negotiation disabled cluster-wide: a flipped bit in an
+        append's record payload decodes cleanly, the follower applies
+        garbage, Raft's (index, term) checks all pass — only the
+        commit-digest plane can see it, and it must."""
+        from raft_tpu.chaos.runner import cluster_net_run
+        from raft_tpu.cluster import ClusterBroken
+
+        try:
+            rep = cluster_net_run(7, broken="peer_no_crc")
+        except ClusterBroken as ex:
+            pytest.skip(f"multi-process clusters cannot run here: {ex}")
+        try:
+            assert rep.caught is True
+            assert rep.caught_by == "digest"
+            assert not rep.digest_ok
+            assert "DIVERGED" in rep.digest_detail
+        finally:
+            shutil.rmtree(rep.base_dir, ignore_errors=True)
+
+    def test_lease_stale_round_is_caught_by_the_checker(self):
+        """Arrival-clocked lease evidence + delayed in-flight acks +
+        a one-sided partition: the deposed leader keeps serving
+        'lease' reads the successor already overwrote — the per-class
+        checker must flag the stale read as a VIOLATION."""
+        from raft_tpu.chaos.runner import cluster_net_run
+        from raft_tpu.cluster import ClusterBroken
+
+        try:
+            rep = cluster_net_run(7, broken="lease_stale_round")
+        except ClusterBroken as ex:
+            pytest.skip(f"multi-process clusters cannot run here: {ex}")
+        try:
+            assert rep.caught is True
+            assert rep.caught_by == "checker"
+            assert rep.verdict == "VIOLATION"
+        finally:
+            shutil.rmtree(rep.base_dir, ignore_errors=True)

@@ -118,3 +118,55 @@ def test_durable_writes_ride_the_vfs_seam():
         "(the FaultyIO seam cannot cover direct syscalls):\n"
         + "\n".join(offenders)
     )
+
+
+# ------------------------------------------- socket-discipline gate
+# The network-fault nemesis (docs/CLUSTER.md network-fault model) only
+# has teeth while EVERY peer/client byte rides the netfault seam
+# (raft_tpu/cluster/netfault.py) — one raw asyncio.open_connection or
+# direct StreamWriter.write in the dialer or server and the lying
+# network silently stops covering that path. This gate pins the
+# discipline: in the files below, no open_connection, no raw socket
+# construction, and no read/write/drain on a bare reader/writer
+# (``.close()`` is fine — tearing a transport down needs no seam;
+# ``asyncio.start_server`` is fine — accepting is not moving bytes,
+# and every ACCEPTED stream is wrapped before its first read).
+# netfault.py itself is the one place the real transport calls live.
+
+_WIRE_SEAM_FILES = (
+    "raft_tpu/cluster/dialer.py",
+    "raft_tpu/net/server.py",
+)
+
+_RAW_STREAM_METHODS = ("read", "readexactly", "readuntil", "readline",
+                       "write", "writelines", "drain")
+
+
+def test_peer_bytes_ride_the_netfault_seam():
+    import ast
+
+    offenders = []
+    for rel in _WIRE_SEAM_FILES:
+        tree = ast.parse((REPO / rel).read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if (name == "asyncio.open_connection"
+                    or name == "socket.socket"
+                    or name.endswith(".create_connection")):
+                offenders.append(f"{rel}:{node.lineno}: {name}")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RAW_STREAM_METHODS):
+                recv = _dotted(node.func.value)
+                tail = recv.rsplit(".", 1)[-1]
+                if tail in ("reader", "writer") or tail.endswith(
+                        ("_reader", "_writer")):
+                    offenders.append(
+                        f"{rel}:{node.lineno}: "
+                        f"{recv}.{node.func.attr}")
+    assert not offenders, (
+        "peer/client bytes must go through raft_tpu/cluster/netfault.py "
+        "(the FaultyConn seam cannot cover raw transport calls):\n"
+        + "\n".join(offenders)
+    )

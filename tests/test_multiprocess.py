@@ -19,6 +19,16 @@ import sys
 
 import pytest
 
+#: this jaxlib's CPU backend cannot run cross-process collectives
+#: ("Multiprocess computations aren't implemented on the CPU backend"),
+#: so every test in this file fails deterministically in the tier-1
+#: container while burning ~47 s of its 870 s wall budget. That headroom
+#: now funds the cluster network-fault drill (README wall-budget rule:
+#: new tier-1 cost must displace old cost in the same PR) — the file
+#: rides the `slow` lane until a gloo-stable jaxlib lands (ROADMAP
+#: item 5), where a real multi-process backend can make these pass.
+pytestmark = pytest.mark.slow
+
 CHILD = r'''
 import os, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=3"

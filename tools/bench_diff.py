@@ -132,6 +132,15 @@ GATED_METRICS: Dict[str, str] = {
     # so the one-fsync-per-ingest-sweep coalescing can never quietly
     # fall back to fsync-per-append (1.0 is the degenerate floor).
     "wal_fsync_batched": "up",
+    # network round (round 19): the cluster_latency row — goodput with
+    # 5ms±2ms injected on every peer link gates UP (a pipelining
+    # regression shows up here first, where a quorum round actually
+    # costs something); its faulted e2e_p99_ms and wal_fsync_batched
+    # ride the existing gates. Old artifacts without the row compare
+    # clean: legs and metric keys gate on the INTERSECTION only, so a
+    # new row reports as ``added`` and never fails a diff against a
+    # pre-round-19 baseline.
+    "cluster_rtt_goodput_eps": "up",
 }
 
 
